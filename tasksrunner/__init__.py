@@ -1,0 +1,32 @@
+"""tasksrunner — a Python-native distributed-application-runtime framework.
+
+A ground-up rebuild of the capability set of the reference workshop
+(chsakell/aca-dotnet-workshop, mounted at /root/reference): Dapr-style
+"building blocks" — service invocation with app-id discovery, pluggable
+state stores with key-prefixing and filter queries, CloudEvents pub/sub
+with declarative subscriptions, input/output/cron bindings, secret
+stores, YAML component configuration with scoping, sidecar-style process
+decoupling, structured observability, KEDA-style backlog autoscaling, a
+local multi-app orchestrator, and a declarative deploy/plan layer.
+
+The reference defines WHAT (capability matrix, component names, API
+shapes — see SURVEY.md §2); this package defines HOW, idiomatically in
+async Python. Nothing is translated line-by-line from the reference's
+C#.
+"""
+
+__version__ = "0.1.0"
+
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.component.loader import load_components, load_component_file
+from tasksrunner.component.registry import ComponentRegistry, driver
+from tasksrunner.secrets import drivers as _secret_drivers  # noqa: F401  (registers drivers)
+
+__all__ = [
+    "ComponentSpec",
+    "load_components",
+    "load_component_file",
+    "ComponentRegistry",
+    "driver",
+    "__version__",
+]

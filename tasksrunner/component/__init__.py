@@ -1,0 +1,12 @@
+from tasksrunner.component.spec import ComponentSpec, SecretRef
+from tasksrunner.component.loader import load_components, load_component_file
+from tasksrunner.component.registry import ComponentRegistry, driver
+
+__all__ = [
+    "ComponentSpec",
+    "SecretRef",
+    "load_components",
+    "load_component_file",
+    "ComponentRegistry",
+    "driver",
+]

@@ -1,0 +1,97 @@
+"""Load component specs from a resources directory.
+
+Equivalent surface: `dapr run --resources-path ./components` loading
+every YAML in the folder (reference: snippets/dapr-run-backend-api.md),
+and `az containerapp env dapr-component set --yaml` loading a single
+cloud-dialect file whose component name comes from the CLI
+(docs/aca/04-aca-dapr-stateapi/index.md).
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Iterable
+
+import yaml
+
+from tasksrunner.component.spec import ComponentSpec, parse_component
+from tasksrunner.errors import ComponentError
+
+_YAML_SUFFIXES = {".yaml", ".yml"}
+
+
+def load_component_file(path: str | pathlib.Path, *, name: str | None = None) -> list[ComponentSpec]:
+    """Parse one YAML file (may hold multiple ``---`` documents)."""
+    path = pathlib.Path(path)
+    try:
+        text = path.read_text()
+    except OSError as exc:
+        raise ComponentError(f"cannot read component file {path}: {exc}") from exc
+
+    specs: list[ComponentSpec] = []
+    for doc in yaml.safe_load_all(text):
+        if doc is None:
+            continue
+        specs.append(parse_component(doc, default_name=name or path.stem, source=str(path)))
+    return specs
+
+
+def load_components(
+    resources_path: str | pathlib.Path,
+    *,
+    app_id: str | None = None,
+) -> list[ComponentSpec]:
+    """Load every component YAML under ``resources_path``.
+
+    ``app_id`` filters by scope the way the sidecar only loads
+    components visible to its app. Duplicate names are an error — the
+    name is the app-facing identity and must be unambiguous.
+    """
+    root = pathlib.Path(resources_path)
+    if not root.is_dir():
+        raise ComponentError(f"resources path {root} is not a directory")
+
+    specs: list[ComponentSpec] = []
+    for path in sorted(root.iterdir()):
+        if path.suffix.lower() not in _YAML_SUFFIXES or not path.is_file():
+            continue
+        specs.extend(load_component_file(path))
+
+    seen: dict[str, ComponentSpec] = {}
+    for spec in specs:
+        if spec.name in seen:
+            raise ComponentError(
+                f"duplicate component name {spec.name!r} "
+                f"({seen[spec.name].source} and {spec.source})"
+            )
+        seen[spec.name] = spec
+
+    if app_id is not None:
+        specs = [s for s in specs if s.in_scope(app_id)]
+    return specs
+
+
+def dump_components(specs: Iterable[ComponentSpec]) -> str:
+    """Render specs back to local-dialect YAML (diagnostics / what-if)."""
+    docs = []
+    for s in specs:
+        meta_items = []
+        for key, value in s.metadata.items():
+            if isinstance(value, str):
+                meta_items.append({"name": key, "value": value})
+            else:
+                meta_items.append(
+                    {"name": key, "secretKeyRef": {"name": value.key, "key": value.key}}
+                )
+        doc: dict = {
+            "apiVersion": "tasksrunner/v1",
+            "kind": "Component",
+            "metadata": {"name": s.name},
+            "spec": {"type": s.type, "version": s.version, "metadata": meta_items},
+        }
+        if s.scopes:
+            doc["scopes"] = list(s.scopes)
+        if s.secret_store:
+            doc["auth"] = {"secretStore": s.secret_store}
+        docs.append(doc)
+    return yaml.safe_dump_all(docs, sort_keys=False)

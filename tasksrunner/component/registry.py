@@ -1,0 +1,168 @@
+"""Driver registry + per-app component registry.
+
+``driver`` registers a factory for a component ``type`` string
+(``state.sqlite``, ``pubsub.memory``, ``bindings.cron``...). A
+``ComponentRegistry`` holds the specs visible to one app-id and
+instantiates them lazily with secrets resolved.
+
+Type aliasing lets the reference's cloud-typed component files
+(``state.azure.cosmosdb``, ``pubsub.azure.servicebus``,
+``bindings.azure.storagequeues``...) run unchanged against local-parity
+drivers — the framework analog of the reference's "swap Redis in for
+Cosmos locally" move (docs/aca/04-aca-dapr-stateapi/index.md:29-33),
+inverted: we keep the cloud file and swap the engine underneath.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable
+
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.errors import (
+    ComponentNotFound,
+    ComponentScopeError,
+    DriverNotFound,
+)
+from tasksrunner.secrets.base import SecretStore
+from tasksrunner.secrets.resolver import SecretResolver
+
+#: factory(spec, resolved_metadata) -> component instance
+DriverFactory = Callable[[ComponentSpec, dict[str, str]], Any]
+
+_DRIVERS: dict[str, DriverFactory] = {}
+
+
+def driver(type_name: str, *aliases: str) -> Callable[[DriverFactory], DriverFactory]:
+    """Register a component driver for one or more ``type`` strings."""
+
+    def register(factory: DriverFactory) -> DriverFactory:
+        for t in (type_name, *aliases):
+            _DRIVERS[t] = factory
+        return factory
+
+    return register
+
+
+def resolve_driver(type_name: str) -> DriverFactory:
+    try:
+        return _DRIVERS[type_name]
+    except KeyError:
+        known = ", ".join(sorted(_DRIVERS))
+        raise DriverNotFound(
+            f"no driver for component type {type_name!r} (known: {known})"
+        ) from None
+
+
+def registered_types() -> list[str]:
+    return sorted(_DRIVERS)
+
+
+class ComponentRegistry:
+    """Instantiated components for one app identity.
+
+    Mirrors a sidecar's view of its resources directory: only specs in
+    scope are visible; the same YAML served to two app-ids yields two
+    scoped views (SURVEY.md §2.4 scope column).
+
+    Secret-store components are instantiated eagerly at construction and
+    wired into the resolver, because every other component's secretRef
+    resolution may depend on them (reference: ``secretStoreComponent``
+    indirection, aca-components/containerapps-bindings-in-storagequeue.yaml:3-8).
+    """
+
+    def __init__(
+        self,
+        specs: list[ComponentSpec],
+        *,
+        app_id: str | None = None,
+        secret_resolver: SecretResolver | None = None,
+    ):
+        self.app_id = app_id
+        self.resolver = secret_resolver or SecretResolver()
+        self._specs: dict[str, ComponentSpec] = {}
+        self._instances: dict[str, Any] = {}
+
+        for spec in specs:
+            if spec.in_scope(app_id):
+                self._specs[spec.name] = spec
+
+        # Pass 1: secret stores first (see docstring). Inline `secrets:`
+        # lists need no store here — parse_cloud_schema already
+        # materialised refs against them at parse time.
+        for spec in self._specs.values():
+            if spec.block == "secretstores":
+                store = self._build(spec)
+                if isinstance(store, SecretStore):
+                    self.resolver.add_store(store)
+                self._instances[spec.name] = store
+
+    # -- construction ----------------------------------------------------
+
+    def _build(self, spec: ComponentSpec) -> Any:
+        factory = resolve_driver(spec.type)
+        metadata = self.resolver.resolve_metadata(spec)
+        return factory(spec, metadata)
+
+    # -- lookup ----------------------------------------------------------
+
+    def spec(self, name: str) -> ComponentSpec:
+        try:
+            return self._specs[name]
+        except KeyError:
+            raise ComponentNotFound(
+                f"component {name!r} is not registered"
+                + (f" for app {self.app_id!r}" if self.app_id else "")
+            ) from None
+
+    def get(self, name: str, *, block: str | None = None) -> Any:
+        """Return (building lazily) the component instance ``name``.
+
+        ``block`` asserts the building-block family — asking the state
+        API for a pubsub component is a 400, as in the reference.
+        """
+        spec = self.spec(name)
+        if block is not None and spec.block != block:
+            raise ComponentNotFound(
+                f"component {name!r} is {spec.type!r}, not a {block} component"
+            )
+        if name not in self._instances:
+            self._instances[name] = self._build(spec)
+        return self._instances[name]
+
+    def names(self, block: str | None = None) -> list[str]:
+        return sorted(
+            n for n, s in self._specs.items() if block is None or s.block == block
+        )
+
+    def check_scope(self, name: str, app_id: str) -> None:
+        """Explicit scope check for multi-tenant registries."""
+        spec = self.spec(name)
+        if not spec.in_scope(app_id):
+            raise ComponentScopeError(
+                f"component {name!r} is not scoped to app {app_id!r}"
+            )
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def close(self) -> None:
+        """Close every instantiated component (sync or async close).
+
+        One failing close must not leak the rest; errors are collected
+        and re-raised together after everything has been attempted.
+        """
+        errors: list[Exception] = []
+        for name, instance in list(self._instances.items()):
+            closer = getattr(instance, "aclose", None) or getattr(instance, "close", None)
+            if closer is None:
+                continue
+            try:
+                result = closer()
+                if inspect.isawaitable(result):
+                    await result
+            except Exception as exc:
+                exc.add_note(f"while closing component {name!r}")
+                errors.append(exc)
+        self._instances.clear()
+        if errors:
+            raise ExceptionGroup("component close failures", errors)

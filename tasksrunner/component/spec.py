@@ -1,0 +1,215 @@
+"""Component specification and the two YAML schemas that produce it.
+
+The reference configures every pluggable backend through named, typed,
+scoped component files in two schema dialects:
+
+* the "local" dialect (``apiVersion``/``kind: Component``/``spec``),
+  e.g. ``/root/reference/components/dapr-statestore-cosmos.yaml:1-18``;
+* the "cloud" dialect (flattened: ``componentType``/``version``/
+  ``metadata``/``secrets``/``scopes``), e.g.
+  ``/root/reference/aca-components/containerapps-statestore-cosmos.yaml:1-11``.
+
+The core invariant (SURVEY.md §1 L1): application code refers to
+components **by name only**; swapping the file swaps the backend with
+zero code change. Both dialects parse into one ``ComponentSpec``.
+
+Secrets may appear three ways, mirroring the reference's dev→prod
+promotion path (SURVEY.md §2.4 end):
+
+* inline plaintext ``value`` (local dev);
+* ``secretKeyRef: {name, key}`` (local dialect) resolved against the
+  store named by ``auth.secretStore``;
+* ``secretRef: <name>`` (cloud dialect) resolved against the file's own
+  ``secrets:`` list first, then against ``secretStoreComponent``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+from tasksrunner.errors import ComponentError
+
+
+def scalar_str(value: Any) -> str:
+    """Render a YAML scalar as the string a component driver expects.
+
+    Component metadata is string-typed; unquoted YAML booleans must
+    come out as ``"true"``/``"false"`` (not Python's ``"True"``) or
+    drivers checking ``== "true"`` silently misread them.
+    """
+    if value is None:
+        return ""
+    if value is True:
+        return "true"
+    if value is False:
+        return "false"
+    return str(value)
+
+
+@dataclass(frozen=True)
+class SecretRef:
+    """A deferred secret lookup: resolve ``key`` in secret store ``store``.
+
+    ``store`` may be ``None``, meaning "the spec declared a ref but
+    named no secret store" — resolution then uses the runtime's default
+    secret store, or fails loudly.
+    """
+
+    key: str
+    store: str | None = None
+
+
+@dataclass
+class ComponentSpec:
+    """A parsed, schema-neutral component definition."""
+
+    name: str
+    type: str
+    version: str = "v1"
+    #: Metadata values: plain strings, or SecretRef for deferred secrets.
+    metadata: dict[str, str | SecretRef] = field(default_factory=dict)
+    #: App-ids allowed to use this component. Empty = visible to all.
+    scopes: list[str] = field(default_factory=list)
+    #: Inline secrets carried by the cloud dialect's ``secrets:`` list.
+    inline_secrets: dict[str, str] = field(default_factory=dict)
+    #: Default secret store for refs that don't name one.
+    secret_store: str | None = None
+    #: Where this spec was loaded from (diagnostics only).
+    source: str | None = None
+
+    def in_scope(self, app_id: str | None) -> bool:
+        """Whether ``app_id`` may use this component.
+
+        ``None`` (no app identity, e.g. tests driving the registry
+        directly) sees everything, like `dapr run` without app-id.
+        """
+        if not self.scopes or app_id is None:
+            return True
+        return app_id in self.scopes
+
+    @property
+    def block(self) -> str:
+        """Building-block family: the first dot-segment of ``type``.
+
+        ``state.sqlite`` → ``state``; ``bindings.cron`` → ``bindings``;
+        matches the reference's type taxonomy (state.*, pubsub.*,
+        bindings.*, secretstores.*).
+        """
+        return self.type.split(".", 1)[0]
+
+
+def _metadata_items(raw: Any, *, where: str) -> list[Mapping[str, Any]]:
+    if raw is None:
+        return []
+    if not isinstance(raw, list):
+        raise ComponentError(f"{where}: metadata must be a list of items")
+    for item in raw:
+        if not isinstance(item, Mapping) or "name" not in item:
+            raise ComponentError(f"{where}: each metadata item needs a name")
+    return raw
+
+
+def _parse_scopes(raw: Any, *, where: str) -> list[str]:
+    if raw is None:
+        return []
+    if not isinstance(raw, list) or not all(isinstance(s, str) for s in raw):
+        raise ComponentError(f"{where}: scopes must be a list of app-ids")
+    return list(raw)
+
+
+def parse_local_schema(doc: Mapping[str, Any], *, default_name: str, source: str | None = None) -> ComponentSpec:
+    """Parse the local dialect (``kind: Component`` + ``spec``)."""
+    where = source or default_name
+    meta = doc.get("metadata") or {}
+    name = meta.get("name") or default_name
+    spec = doc.get("spec")
+    if not isinstance(spec, Mapping) or "type" not in spec:
+        raise ComponentError(f"{where}: missing spec.type")
+
+    auth = doc.get("auth") or {}
+    secret_store = auth.get("secretStore")
+
+    metadata: dict[str, str | SecretRef] = {}
+    for item in _metadata_items(spec.get("metadata"), where=where):
+        key = str(item["name"])
+        if "secretKeyRef" in item:
+            ref = item["secretKeyRef"] or {}
+            metadata[key] = SecretRef(
+                key=str(ref.get("key") or ref.get("name") or key),
+                store=secret_store,
+            )
+        elif "value" in item:
+            metadata[key] = scalar_str(item["value"])
+        else:
+            raise ComponentError(f"{where}: metadata item {key!r} needs value or secretKeyRef")
+
+    return ComponentSpec(
+        name=str(name),
+        type=str(spec["type"]),
+        version=str(spec.get("version", "v1")),
+        metadata=metadata,
+        scopes=_parse_scopes(doc.get("scopes"), where=where),
+        secret_store=secret_store,
+        source=source,
+    )
+
+
+def parse_cloud_schema(doc: Mapping[str, Any], *, default_name: str, source: str | None = None) -> ComponentSpec:
+    """Parse the cloud dialect (flattened ``componentType`` schema).
+
+    The cloud dialect carries no component name in-file (the deploy
+    command names it); ``default_name`` (filename stem or manifest key)
+    supplies it.
+    """
+    where = source or default_name
+    ctype = doc.get("componentType")
+    if not ctype:
+        raise ComponentError(f"{where}: missing componentType")
+
+    secret_store = doc.get("secretStoreComponent")
+
+    inline_secrets: dict[str, str] = {}
+    for item in doc.get("secrets") or []:
+        if not isinstance(item, Mapping) or "name" not in item:
+            raise ComponentError(f"{where}: each secrets item needs a name")
+        inline_secrets[str(item["name"])] = scalar_str(item.get("value", ""))
+
+    metadata: dict[str, str | SecretRef] = {}
+    for item in _metadata_items(doc.get("metadata"), where=where):
+        key = str(item["name"])
+        if "secretRef" in item:
+            ref_name = str(item["secretRef"])
+            if ref_name in inline_secrets:
+                metadata[key] = inline_secrets[ref_name]
+            else:
+                metadata[key] = SecretRef(key=ref_name, store=secret_store)
+        elif "value" in item:
+            metadata[key] = scalar_str(item["value"])
+        else:
+            raise ComponentError(f"{where}: metadata item {key!r} needs value or secretRef")
+
+    return ComponentSpec(
+        name=str(doc.get("name") or default_name),
+        type=str(ctype),
+        version=str(doc.get("version", "v1")),
+        metadata=metadata,
+        scopes=_parse_scopes(doc.get("scopes"), where=where),
+        inline_secrets=inline_secrets,
+        secret_store=secret_store,
+        source=source,
+    )
+
+
+def parse_component(doc: Mapping[str, Any], *, default_name: str, source: str | None = None) -> ComponentSpec:
+    """Dispatch on schema dialect."""
+    if not isinstance(doc, Mapping):
+        raise ComponentError(f"{source or default_name}: component document must be a mapping")
+    if "componentType" in doc:
+        return parse_cloud_schema(doc, default_name=default_name, source=source)
+    if doc.get("kind") == "Component" or "spec" in doc:
+        return parse_local_schema(doc, default_name=default_name, source=source)
+    raise ComponentError(
+        f"{source or default_name}: unrecognised component schema "
+        "(expected kind: Component or componentType)"
+    )

@@ -1,0 +1,94 @@
+"""Framework error hierarchy.
+
+Mirrors the failure surface of the reference's runtime (Dapr sidecar
+HTTP errors: unknown component, permission/scope denial, malformed
+request) so the sidecar API layer can map exceptions to status codes
+uniformly.
+"""
+
+from __future__ import annotations
+
+
+class TasksRunnerError(Exception):
+    """Base class for all framework errors."""
+
+    #: HTTP status the sidecar API maps this error to.
+    http_status = 500
+
+
+class ComponentError(TasksRunnerError):
+    """A component file or definition is malformed."""
+
+    http_status = 400
+
+
+class ComponentNotFound(TasksRunnerError):
+    """No component with the requested name is registered / in scope.
+
+    The reference's sidecar returns 400 ERR_STATE_STORE_NOT_FOUND /
+    ERR_PUBSUB_NOT_FOUND for this case; we use 400 likewise.
+    """
+
+    http_status = 400
+
+
+class ComponentScopeError(TasksRunnerError):
+    """Component exists but is not scoped to the calling app-id."""
+
+    http_status = 403
+
+
+class DriverNotFound(ComponentError):
+    """No driver registered for a component's `type` string."""
+
+    http_status = 400
+
+
+class SecretError(TasksRunnerError):
+    """Secret resolution failed (missing key, missing store...)."""
+
+    http_status = 500
+
+
+class SecretNotFound(SecretError):
+    http_status = 404
+
+
+class StateError(TasksRunnerError):
+    http_status = 500
+
+
+class EtagMismatch(StateError):
+    """Optimistic-concurrency conflict on a state write."""
+
+    http_status = 409
+
+
+class QueryError(StateError):
+    """Malformed state query or store without query support.
+
+    The reference hits this when querying a non-query-capable store
+    (plain Redis) — docs/aca/04-aca-dapr-stateapi/index.md:166-168.
+    """
+
+    http_status = 400
+
+
+class PubSubError(TasksRunnerError):
+    http_status = 500
+
+
+class BindingError(TasksRunnerError):
+    http_status = 500
+
+
+class InvocationError(TasksRunnerError):
+    """Service invocation failed (unknown app-id, connection refused)."""
+
+    http_status = 500
+
+
+class AppNotFound(InvocationError):
+    """Name resolution failed for a target app-id."""
+
+    http_status = 404

@@ -1,0 +1,11 @@
+from tasksrunner.secrets.base import SecretStore
+from tasksrunner.secrets.local import EnvSecretStore, FileSecretStore, StaticSecretStore
+from tasksrunner.secrets.resolver import SecretResolver
+
+__all__ = [
+    "SecretStore",
+    "EnvSecretStore",
+    "FileSecretStore",
+    "StaticSecretStore",
+    "SecretResolver",
+]

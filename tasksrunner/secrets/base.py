@@ -1,0 +1,36 @@
+"""Secret-store building block interface.
+
+API surface mirrors the reference's secret API (sidecar route
+``GET /v1.0/secrets/{store}/{key}``, returning ``{key: value}``) and the
+Key Vault-backed component ``secretstoreakv``
+(aca-components/containerapps-secretstore-kv.yaml:1-7).
+"""
+
+from __future__ import annotations
+
+import abc
+
+
+class SecretStore(abc.ABC):
+    """A named source of secrets.
+
+    Implementations are synchronous: secret reads happen at component
+    init and on the (rare) secret API path, never in a hot loop.
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+
+    @abc.abstractmethod
+    def get(self, key: str) -> str:
+        """Return the secret value or raise ``SecretNotFound``."""
+
+    @abc.abstractmethod
+    def keys(self) -> list[str]:
+        """List available secret names (bulk-secret API)."""
+
+    def bulk(self) -> dict[str, str]:
+        return {k: self.get(k) for k in self.keys()}
+
+    def close(self) -> None:  # pragma: no cover - default no-op
+        pass

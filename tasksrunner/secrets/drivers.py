@@ -1,0 +1,33 @@
+"""Component drivers for the secret-store building block.
+
+Registered type names follow the reference's taxonomy
+(``secretstores.azure.keyvault`` in
+aca-components/containerapps-secretstore-kv.yaml) with local engines;
+the azure type is aliased to the env-var store so the reference's
+component file loads unchanged in local mode.
+"""
+
+from __future__ import annotations
+
+from tasksrunner.component.registry import driver
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.secrets.local import EnvSecretStore, FileSecretStore, StaticSecretStore
+
+
+@driver("secretstores.local.env", "secretstores.azure.keyvault")
+def _env_secret_store(spec: ComponentSpec, metadata: dict[str, str]) -> EnvSecretStore:
+    return EnvSecretStore(spec.name, prefix=metadata.get("prefix", ""))
+
+
+@driver("secretstores.local.file")
+def _file_secret_store(spec: ComponentSpec, metadata: dict[str, str]) -> FileSecretStore:
+    return FileSecretStore(
+        spec.name,
+        metadata["secretsFile"],
+        nested_separator=metadata.get("nestedSeparator", ":"),
+    )
+
+
+@driver("secretstores.local.static")
+def _static_secret_store(spec: ComponentSpec, metadata: dict[str, str]) -> StaticSecretStore:
+    return StaticSecretStore(spec.name, metadata)

@@ -1,0 +1,122 @@
+"""Local secret-store drivers: env-var, file-backed, and static.
+
+These are the framework's stand-ins for the reference's Azure Key Vault
+store (type ``secretstores.azure.keyvault``,
+aca-components/containerapps-secretstore-kv.yaml) — same contract, local
+backends, exactly as Redis stands in for Cosmos locally in the
+reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+
+import yaml
+
+from tasksrunner.errors import SecretError, SecretNotFound
+from tasksrunner.secrets.base import SecretStore
+
+
+class EnvSecretStore(SecretStore):
+    """Secrets from process environment variables.
+
+    ``prefix`` namespaces lookups (key ``api-key`` with prefix ``TR_``
+    reads ``TR_API_KEY``); dashes map to underscores, case-insensitive —
+    so component files can keep cloud-style kebab-case secret names.
+    """
+
+    def __init__(self, name: str = "envsecretstore", *, prefix: str = ""):
+        super().__init__(name)
+        self.prefix = prefix
+
+    def _envname(self, key: str) -> str:
+        return (self.prefix + key).replace("-", "_").upper()
+
+    def get(self, key: str) -> str:
+        env = self._envname(key)
+        if env in os.environ:
+            return os.environ[env]
+        # Exact-name fallback only for unprefixed stores — a prefix is a
+        # namespace boundary and must not leak the whole environment.
+        if not self.prefix and key in os.environ:
+            return os.environ[key]
+        raise SecretNotFound(f"secret {key!r} not in environment (looked for {env})")
+
+    def keys(self) -> list[str]:
+        if not self.prefix:
+            return sorted(os.environ)
+        pfx = self._envname("")
+        return sorted(k[len(pfx):].lower().replace("_", "-") for k in os.environ if k.startswith(pfx))
+
+
+class FileSecretStore(SecretStore):
+    """Secrets from a JSON or YAML file of flat key→value pairs.
+
+    Nested objects are flattened with ``:`` separators the way the
+    reference's .NET config flattens (``SendGrid:ApiKey``), so one file
+    can serve both config-style and secret-style lookups.
+    """
+
+    def __init__(self, name: str, path: str | pathlib.Path, *, nested_separator: str = ":"):
+        super().__init__(name)
+        self.path = pathlib.Path(path)
+        self.nested_separator = nested_separator
+        self._data = self._load()
+
+    def _load(self) -> dict[str, str]:
+        try:
+            text = self.path.read_text()
+        except OSError as exc:
+            raise SecretError(f"cannot read secret file {self.path}: {exc}") from exc
+        if self.path.suffix in (".yaml", ".yml"):
+            raw = yaml.safe_load(text) or {}
+        else:
+            raw = json.loads(text or "{}")
+        if not isinstance(raw, dict):
+            raise SecretError(f"secret file {self.path} must hold a mapping")
+        flat: dict[str, str] = {}
+
+        def walk(prefix: str, node) -> None:
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    walk(f"{prefix}{self.nested_separator}{k}" if prefix else str(k), v)
+            else:
+                flat[prefix] = "" if node is None else str(node)
+
+        walk("", raw)
+        return flat
+
+    def reload(self) -> None:
+        self._data = self._load()
+
+    def get(self, key: str) -> str:
+        try:
+            return self._data[key]
+        except KeyError:
+            raise SecretNotFound(f"secret {key!r} not in {self.path}") from None
+
+    def keys(self) -> list[str]:
+        return sorted(self._data)
+
+
+class StaticSecretStore(SecretStore):
+    """In-memory secrets — the test double, and the backing store for
+    inline ``secrets:`` lists in cloud-dialect component files."""
+
+    def __init__(self, name: str, data: dict[str, str] | None = None):
+        super().__init__(name)
+        self._data = dict(data or {})
+
+    def set(self, key: str, value: str) -> None:
+        self._data[key] = value
+
+    def get(self, key: str) -> str:
+        try:
+            return self._data[key]
+        except KeyError:
+            raise SecretNotFound(f"secret {key!r} not in store {self.name!r}") from None
+
+    def keys(self) -> list[str]:
+        return sorted(self._data)

@@ -1,0 +1,51 @@
+"""Secret resolution chain for component metadata.
+
+Implements the reference's dev→prod promotion path (SURVEY.md §2.4,
+§5.6): inline plaintext values work as-is; ``secretKeyRef``/``secretRef``
+entries resolve against a named secret-store component; refs without a
+named store fall back to the runtime's default store (env vars), so the
+same component file works locally with exported variables.
+"""
+
+from __future__ import annotations
+
+from tasksrunner.component.spec import ComponentSpec, SecretRef
+from tasksrunner.errors import SecretError
+from tasksrunner.secrets.base import SecretStore
+from tasksrunner.secrets.local import EnvSecretStore
+
+
+class SecretResolver:
+    """Maps store names → ``SecretStore`` instances and resolves specs."""
+
+    def __init__(self, *, default_store: SecretStore | None = None):
+        self._stores: dict[str, SecretStore] = {}
+        self.default_store = default_store or EnvSecretStore()
+
+    def add_store(self, store: SecretStore) -> None:
+        self._stores[store.name] = store
+
+    def store(self, name: str | None) -> SecretStore:
+        if name is None:
+            return self.default_store
+        try:
+            return self._stores[name]
+        except KeyError:
+            raise SecretError(f"secret store {name!r} is not registered") from None
+
+    def resolve_value(self, value: str | SecretRef) -> str:
+        if isinstance(value, str):
+            return value
+        return self.store(value.store).get(value.key)
+
+    def resolve_metadata(self, spec: ComponentSpec) -> dict[str, str]:
+        """Return the spec's metadata with every SecretRef materialised."""
+        out: dict[str, str] = {}
+        for key, value in spec.metadata.items():
+            try:
+                out[key] = self.resolve_value(value)
+            except SecretError as exc:
+                raise SecretError(
+                    f"component {spec.name!r}: cannot resolve metadata {key!r}: {exc}"
+                ) from exc
+        return out

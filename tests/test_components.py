@@ -1,0 +1,227 @@
+"""Component model tests: both YAML dialects, scoping, secrets chain.
+
+Contract source: SURVEY.md §2.4 (component table, dev→prod secret
+promotion) and the reference files components/*.yaml vs
+aca-components/*.yaml.
+"""
+
+import textwrap
+
+import pytest
+
+from tasksrunner import ComponentRegistry, load_component_file, load_components
+from tasksrunner.component.spec import SecretRef, parse_component
+from tasksrunner.component.registry import driver, registered_types
+from tasksrunner.errors import (
+    ComponentError,
+    ComponentNotFound,
+    ComponentScopeError,
+    SecretError,
+)
+from tasksrunner.secrets import SecretResolver, StaticSecretStore
+
+LOCAL_YAML = textwrap.dedent(
+    """
+    apiVersion: dapr.io/v1alpha1
+    kind: Component
+    metadata:
+      name: statestore
+    spec:
+      type: state.memory
+      version: v1
+      metadata:
+      - name: url
+        value: "http://localhost"
+      - name: masterKey
+        secretKeyRef:
+          name: cosmos-key
+          key: cosmos-key
+    auth:
+      secretStore: teststore
+    scopes:
+    - tasksmanager-backend-api
+    """
+)
+
+CLOUD_YAML = textwrap.dedent(
+    """
+    componentType: state.memory
+    version: v1
+    metadata:
+    - name: accountKey
+      secretRef: storage-key
+    secrets:
+    - name: storage-key
+      value: inline-secret-value
+    scopes:
+    - tasksmanager-backend-processor
+    """
+)
+
+
+@driver("state.memory")
+class _MemoryComponent:
+    """Minimal driver used by these tests (real one comes with the
+    state building block)."""
+
+    def __init__(self, spec, metadata):
+        self.spec = spec
+        self.metadata = metadata
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+
+def test_parse_local_dialect(tmp_path):
+    p = tmp_path / "statestore.yaml"
+    p.write_text(LOCAL_YAML)
+    (spec,) = load_component_file(p)
+    assert spec.name == "statestore"
+    assert spec.type == "state.memory"
+    assert spec.block == "state"
+    assert spec.metadata["url"] == "http://localhost"
+    assert spec.metadata["masterKey"] == SecretRef(key="cosmos-key", store="teststore")
+    assert spec.scopes == ["tasksmanager-backend-api"]
+
+
+def test_parse_cloud_dialect_name_from_filename(tmp_path):
+    p = tmp_path / "containerapps-statestore.yaml"
+    p.write_text(CLOUD_YAML)
+    (spec,) = load_component_file(p, name="statestore")
+    assert spec.name == "statestore"
+    # inline secrets: resolved immediately from the file's secrets list
+    assert spec.metadata["accountKey"] == "inline-secret-value"
+
+
+def test_cloud_dialect_external_secret_ref():
+    doc = {
+        "componentType": "state.memory",
+        "metadata": [{"name": "key", "secretRef": "external-key"}],
+        "secretStoreComponent": "kvstore",
+    }
+    spec = parse_component(doc, default_name="s")
+    assert spec.metadata["key"] == SecretRef(key="external-key", store="kvstore")
+
+
+def test_unknown_schema_rejected():
+    with pytest.raises(ComponentError):
+        parse_component({"foo": 1}, default_name="x")
+
+
+def test_load_directory_scope_filter_and_duplicates(tmp_path):
+    (tmp_path / "a.yaml").write_text(LOCAL_YAML)
+    (tmp_path / "b.yaml").write_text(CLOUD_YAML)
+    all_specs = load_components(tmp_path)
+    assert {s.name for s in all_specs} == {"statestore", "b"}
+
+    api_view = load_components(tmp_path, app_id="tasksmanager-backend-api")
+    assert [s.name for s in api_view] == ["statestore"]
+
+    (tmp_path / "dup.yaml").write_text(LOCAL_YAML)
+    with pytest.raises(ComponentError, match="duplicate"):
+        load_components(tmp_path)
+
+
+def test_registry_resolves_secrets_and_scopes(tmp_path):
+    (tmp_path / "a.yaml").write_text(LOCAL_YAML)
+    resolver = SecretResolver()
+    resolver.add_store(StaticSecretStore("teststore", {"cosmos-key": "s3cr3t"}))
+
+    reg = ComponentRegistry(
+        load_components(tmp_path),
+        app_id="tasksmanager-backend-api",
+        secret_resolver=resolver,
+    )
+    comp = reg.get("statestore", block="state")
+    assert comp.metadata == {"url": "http://localhost", "masterKey": "s3cr3t"}
+
+    # wrong building block
+    with pytest.raises(ComponentNotFound):
+        reg.get("statestore", block="pubsub")
+
+    # out-of-scope app sees nothing
+    other = ComponentRegistry(load_components(tmp_path), app_id="frontend")
+    with pytest.raises(ComponentNotFound):
+        other.get("statestore")
+
+
+def test_registry_missing_secret_fails_loudly(tmp_path):
+    (tmp_path / "a.yaml").write_text(LOCAL_YAML)
+    reg = ComponentRegistry(
+        load_components(tmp_path), app_id="tasksmanager-backend-api"
+    )
+    with pytest.raises(SecretError, match="masterKey"):
+        reg.get("statestore")
+
+
+def test_registry_inline_secrets_register_store(tmp_path):
+    (tmp_path / "b.yaml").write_text(CLOUD_YAML)
+    reg = ComponentRegistry(load_components(tmp_path))
+    comp = reg.get("b")
+    assert comp.metadata["accountKey"] == "inline-secret-value"
+
+
+def test_check_scope():
+    spec = parse_component(
+        {"componentType": "state.memory", "scopes": ["appA"]}, default_name="c"
+    )
+    reg = ComponentRegistry([spec])
+    reg.check_scope("c", "appA")
+    with pytest.raises(ComponentScopeError):
+        reg.check_scope("c", "appB")
+
+
+@pytest.mark.asyncio
+async def test_registry_close_calls_component_close(tmp_path):
+    (tmp_path / "b.yaml").write_text(CLOUD_YAML)
+    reg = ComponentRegistry(load_components(tmp_path))
+    comp = reg.get("b")
+    await reg.close()
+    assert comp.closed
+
+
+def test_secretstore_component_types_registered():
+    types = registered_types()
+    assert "secretstores.local.env" in types
+    assert "secretstores.azure.keyvault" in types  # reference file loads unchanged
+
+
+def test_env_secret_store_kebab_case(monkeypatch):
+    from tasksrunner.secrets import EnvSecretStore
+
+    monkeypatch.setenv("SENDGRID_API_KEY", "k")
+    store = EnvSecretStore()
+    assert store.get("sendgrid-api-key") == "k"
+
+
+def test_yaml_bool_scalars_render_lowercase():
+    spec = parse_component(
+        {
+            "componentType": "state.memory",
+            "metadata": [{"name": "decodeBase64", "value": True}],
+        },
+        default_name="c",
+    )
+    assert spec.metadata["decodeBase64"] == "true"
+
+
+def test_env_store_prefix_does_not_leak_environment(monkeypatch):
+    from tasksrunner.secrets import EnvSecretStore
+    from tasksrunner.errors import SecretNotFound
+
+    monkeypatch.setenv("HOME_SWEET", "leak")
+    store = EnvSecretStore("s", prefix="TR_")
+    with pytest.raises(SecretNotFound):
+        store.get("HOME_SWEET")
+
+
+def test_file_secret_store_nested(tmp_path):
+    from tasksrunner.secrets import FileSecretStore
+
+    f = tmp_path / "secrets.json"
+    f.write_text('{"SendGrid": {"ApiKey": "abc"}, "flat": "v"}')
+    store = FileSecretStore("files", f)
+    assert store.get("SendGrid:ApiKey") == "abc"
+    assert store.get("flat") == "v"
+    assert store.keys() == ["SendGrid:ApiKey", "flat"]
