@@ -21,6 +21,7 @@ from tasksrunner.component.spec import ComponentSpec
 from tasksrunner.component.loader import load_components, load_component_file
 from tasksrunner.component.registry import ComponentRegistry, driver
 from tasksrunner.secrets import drivers as _secret_drivers  # noqa: F401  (registers drivers)
+from tasksrunner import state as _state  # noqa: F401  (registers state drivers)
 
 __all__ = [
     "ComponentSpec",

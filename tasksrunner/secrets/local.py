@@ -70,10 +70,13 @@ class FileSecretStore(SecretStore):
             text = self.path.read_text()
         except OSError as exc:
             raise SecretError(f"cannot read secret file {self.path}: {exc}") from exc
-        if self.path.suffix in (".yaml", ".yml"):
-            raw = yaml.safe_load(text) or {}
-        else:
-            raw = json.loads(text or "{}")
+        try:
+            if self.path.suffix in (".yaml", ".yml"):
+                raw = yaml.safe_load(text) or {}
+            else:
+                raw = json.loads(text or "{}")
+        except (yaml.YAMLError, json.JSONDecodeError) as exc:
+            raise SecretError(f"cannot parse secret file {self.path}: {exc}") from exc
         if not isinstance(raw, dict):
             raise SecretError(f"secret file {self.path} must hold a mapping")
         flat: dict[str, str] = {}

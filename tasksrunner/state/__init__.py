@@ -1,0 +1,13 @@
+from tasksrunner.state.base import StateItem, StateStore, TransactionOp
+from tasksrunner.state.keyprefix import KeyPrefixer
+from tasksrunner.state.memory import InMemoryStateStore
+from tasksrunner.state.sqlite import SqliteStateStore
+
+__all__ = [
+    "StateItem",
+    "StateStore",
+    "TransactionOp",
+    "KeyPrefixer",
+    "InMemoryStateStore",
+    "SqliteStateStore",
+]

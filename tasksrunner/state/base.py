@@ -1,0 +1,99 @@
+"""State-store building block interface.
+
+API shape mirrors the client surface the reference's services program
+against (DaprClient in TasksTracker.TasksManager.Backend.Api/Services/
+TasksStoreManager.cs: SaveStateAsync :35, GetStateAsync :73,
+DeleteStateAsync :49, QueryStateAsync :56-61) and the sidecar routes
+``POST/GET/DELETE /v1.0/state/{store}`` plus
+``POST /v1.0/state/{store}/query``.
+
+Values are JSON documents (anything ``json.dumps`` accepts). Every
+write produces a fresh opaque etag; writes may assert an expected etag
+for optimistic concurrency (first-write-wins) — the reference's
+read-modify-write race noted in SURVEY.md §5.2 is thereby fixable in
+this framework, while plain last-write-wins stays the default.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Literal
+
+from tasksrunner.errors import EtagMismatch  # noqa: F401  (re-export for drivers)
+
+
+@dataclass
+class StateItem:
+    """One key's stored document + concurrency token."""
+
+    key: str
+    value: Any
+    etag: str
+
+
+@dataclass
+class TransactionOp:
+    """One operation inside a state transaction (upsert or delete)."""
+
+    operation: Literal["upsert", "delete"]
+    key: str
+    value: Any = None
+    etag: str | None = None
+
+
+@dataclass
+class QueryResponse:
+    items: list[StateItem] = field(default_factory=list)
+    #: Continuation token (index-based) when paging; None = exhausted.
+    token: str | None = None
+
+
+class StateStore(abc.ABC):
+    """Pluggable state backend. All methods are coroutine functions so
+    network-backed drivers can await; local drivers just return."""
+
+    #: Whether the backend supports the filter-query dialect. Plain
+    #: key-value backends (reference: Redis without RediSearch,
+    #: docs/aca/04-aca-dapr-stateapi/index.md:166-168) set this False
+    #: and `query` raises QueryError.
+    supports_query = True
+
+    def __init__(self, name: str):
+        self.name = name
+
+    @abc.abstractmethod
+    async def get(self, key: str) -> StateItem | None: ...
+
+    @abc.abstractmethod
+    async def set(self, key: str, value: Any, *, etag: str | None = None) -> str:
+        """Upsert; returns the new etag. Raises EtagMismatch if ``etag``
+        is given and doesn't match the stored one."""
+
+    @abc.abstractmethod
+    async def delete(self, key: str, *, etag: str | None = None) -> bool:
+        """Delete; returns False if the key didn't exist."""
+
+    @abc.abstractmethod
+    async def query(self, query: dict, *, key_prefix: str = "") -> QueryResponse:
+        """Evaluate the filter-query dialect (see state/query.py) over
+        keys starting with ``key_prefix``."""
+
+    async def bulk_get(self, keys: list[str]) -> list[StateItem | None]:
+        return [await self.get(k) for k in keys]
+
+    async def transact(self, ops: list[TransactionOp]) -> None:
+        """Apply ops atomically (best-effort for drivers without real
+        transactions; sqlite driver overrides with a DB transaction)."""
+        for op in ops:
+            if op.operation == "upsert":
+                await self.set(op.key, op.value, etag=op.etag)
+            else:
+                await self.delete(op.key, etag=op.etag)
+
+    async def keys(self, *, prefix: str = "") -> list[str]:
+        """List keys (diagnostics; not part of the reference surface)."""
+        raise NotImplementedError
+
+    def close(self) -> None:  # pragma: no cover - default no-op
+        pass

@@ -1,0 +1,55 @@
+"""Key-prefix strategies for state isolation between apps.
+
+The reference stores task state under ``"{app-id}||{taskId}"`` and
+teaches the prefix strategies ``appid`` (default), ``name``, a constant
+namespace, and ``none`` (docs/aca/04-aca-dapr-stateapi/index.md, "Key
+Prefix Strategies"; SURVEY.md §5.4). The prefix is applied at the
+sidecar layer — stores only ever see final keys — and is configured per
+component via ``keyPrefix`` metadata.
+"""
+
+from __future__ import annotations
+
+from tasksrunner.errors import ComponentError
+
+SEPARATOR = "||"
+
+
+class KeyPrefixer:
+    """Computes the storage key for (app_id, user_key)."""
+
+    def __init__(self, strategy: str = "appid", *, app_id: str | None = None,
+                 component_name: str | None = None):
+        self.strategy = strategy
+        if strategy == "appid":
+            self._prefix = f"{app_id}{SEPARATOR}" if app_id else ""
+        elif strategy == "name":
+            if not component_name:
+                raise ComponentError("keyPrefix=name requires a component name")
+            self._prefix = f"{component_name}{SEPARATOR}"
+        elif strategy == "none":
+            self._prefix = ""
+        else:
+            # any other literal acts as a constant namespace
+            self._prefix = f"{strategy}{SEPARATOR}"
+
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    def apply(self, key: str) -> str:
+        return self._prefix + key
+
+    def strip(self, storage_key: str) -> str:
+        if self._prefix and storage_key.startswith(self._prefix):
+            return storage_key[len(self._prefix):]
+        return storage_key
+
+
+def prefixer_for(metadata: dict[str, str], *, app_id: str | None,
+                 component_name: str) -> KeyPrefixer:
+    return KeyPrefixer(
+        metadata.get("keyPrefix", "appid"),
+        app_id=app_id,
+        component_name=component_name,
+    )

@@ -1,0 +1,92 @@
+"""In-memory state store — the framework's first-class test double.
+
+Plays the role FakeTasksManager's List<TaskModel> plays in the
+reference (Services/FakeTasksManager.cs:5-113): full contract, zero
+dependencies — but lives at the building-block layer so *every* app
+gets it by swapping one component file, and it is lock-guarded (the
+reference's fake is unsynchronized, SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import itertools
+from typing import Any
+
+from tasksrunner.component.registry import driver
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.errors import EtagMismatch
+from tasksrunner.state.base import QueryResponse, StateItem, StateStore, TransactionOp
+from tasksrunner.state.query import run_query
+
+
+class InMemoryStateStore(StateStore):
+    def __init__(self, name: str = "memory"):
+        super().__init__(name)
+        self._data: dict[str, StateItem] = {}
+        self._etag_counter = itertools.count(1)
+        self._lock = asyncio.Lock()
+
+    def _next_etag(self) -> str:
+        return str(next(self._etag_counter))
+
+    async def get(self, key: str) -> StateItem | None:
+        item = self._data.get(key)
+        if item is None:
+            return None
+        return StateItem(key=item.key, value=copy.deepcopy(item.value), etag=item.etag)
+
+    async def set(self, key: str, value: Any, *, etag: str | None = None) -> str:
+        async with self._lock:
+            return self._set_locked(key, value, etag)
+
+    def _set_locked(self, key: str, value: Any, etag: str | None) -> str:
+        current = self._data.get(key)
+        if etag is not None and (current is None or current.etag != etag):
+            raise EtagMismatch(f"etag mismatch for key {key!r}")
+        new_etag = self._next_etag()
+        self._data[key] = StateItem(key=key, value=copy.deepcopy(value), etag=new_etag)
+        return new_etag
+
+    async def delete(self, key: str, *, etag: str | None = None) -> bool:
+        async with self._lock:
+            current = self._data.get(key)
+            if current is None:
+                if etag is not None:
+                    raise EtagMismatch(f"etag mismatch for key {key!r}")
+                return False
+            if etag is not None and current.etag != etag:
+                raise EtagMismatch(f"etag mismatch for key {key!r}")
+            del self._data[key]
+            return True
+
+    async def transact(self, ops: list[TransactionOp]) -> None:
+        """Atomic: validate all etags under the lock, then apply."""
+        async with self._lock:
+            for op in ops:
+                current = self._data.get(op.key)
+                if op.etag is not None and (current is None or current.etag != op.etag):
+                    raise EtagMismatch(f"etag mismatch for key {op.key!r}")
+            for op in ops:
+                if op.operation == "upsert":
+                    self._set_locked(op.key, op.value, None)
+                else:
+                    self._data.pop(op.key, None)
+
+    async def query(self, query: dict, *, key_prefix: str = "") -> QueryResponse:
+        candidates = [
+            StateItem(key=it.key, value=copy.deepcopy(it.value), etag=it.etag)
+            for key, it in sorted(self._data.items())
+            if key.startswith(key_prefix)
+        ]
+        items, token = run_query(candidates, query)
+        return QueryResponse(items=items, token=token)
+
+    async def keys(self, *, prefix: str = "") -> list[str]:
+        return sorted(k for k in self._data if k.startswith(prefix))
+
+
+@driver("state.in-memory", "state.memory")
+def _memory_state(spec: ComponentSpec, metadata: dict[str, str]) -> InMemoryStateStore:
+    return InMemoryStateStore(spec.name)

@@ -1,0 +1,178 @@
+"""State query dialect: filter (EQ/NEQ/IN/AND/OR), sort, page.
+
+This is the JSON dialect the reference's API service sends through
+``DaprClient.QueryStateAsync`` (TasksStoreManager.cs:56-61 builds
+``{"filter": {"EQ": {"taskCreatedBy": "<email>"}}}``; the overdue scan
+does an EQ on a serialized datetime :125-130). Shape:
+
+    {
+      "filter": {"EQ": {"<json-path>": <value>}}
+              | {"NEQ": {...}} | {"IN": {"<path>": [v, ...]}}
+              | {"AND": [<filter>, ...]} | {"OR": [<filter>, ...]}
+              | {},
+      "sort":  [{"key": "<json-path>", "order": "ASC"|"DESC"}, ...],
+      "page":  {"limit": N, "token": "<opaque>"}
+    }
+
+Paths address into the stored JSON document with dots
+(``"taskCreatedBy"``, ``"address.city"``). Matching is on JSON values:
+strings compare as strings — which preserves the reference's
+datetime-serialization trap (Utilities/DateTimeConverter.cs: the query
+only matches if the app serializes dates with the same format it
+queries with). The framework keeps that contract visible rather than
+papering over it.
+
+Used directly by the in-memory store; the sqlite store compiles the
+same dialect to SQL (state/sqlite.py) and must stay semantically
+identical — tests/test_state.py runs the contract suite against both.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+from typing import Any
+
+from tasksrunner.errors import QueryError
+
+_FILTER_OPS = ("EQ", "NEQ", "IN", "AND", "OR")
+
+
+def get_path(doc: Any, path: str) -> Any:
+    """Extract ``a.b.c`` from a JSON document; None if absent."""
+    node = doc
+    for part in path.split("."):
+        if isinstance(node, dict) and part in node:
+            node = node[part]
+        else:
+            return None
+    return node
+
+
+def _single_entry(mapping: dict, op: str) -> tuple[str, Any]:
+    if not isinstance(mapping, dict) or len(mapping) != 1:
+        raise QueryError(f"{op} filter must hold exactly one path entry")
+    return next(iter(mapping.items()))
+
+
+def validate_filter(filt: Any) -> None:
+    """Raise QueryError on malformed filters (shared by both engines)."""
+    if filt in (None, {}):
+        return
+    if not isinstance(filt, dict) or len(filt) != 1:
+        raise QueryError("filter must hold exactly one operator")
+    op, operand = next(iter(filt.items()))
+    if op not in _FILTER_OPS:
+        raise QueryError(f"unknown filter operator {op!r} (expected one of {_FILTER_OPS})")
+    if op in ("AND", "OR"):
+        if not isinstance(operand, list) or not operand:
+            raise QueryError(f"{op} expects a non-empty list of sub-filters")
+        for sub in operand:
+            validate_filter(sub)
+    elif op == "IN":
+        path, values = _single_entry(operand, op)
+        if not isinstance(values, list):
+            raise QueryError("IN expects a list of candidate values")
+    else:
+        _single_entry(operand, op)
+
+
+def matches(doc: Any, filt: Any) -> bool:
+    """Pure-Python filter evaluation."""
+    if filt in (None, {}):
+        return True
+    op, operand = next(iter(filt.items()))
+    if op == "AND":
+        return all(matches(doc, sub) for sub in operand)
+    if op == "OR":
+        return any(matches(doc, sub) for sub in operand)
+    path, expected = _single_entry(operand, op)
+    actual = get_path(doc, path)
+    if op == "EQ":
+        return actual == expected
+    if op == "NEQ":
+        return actual != expected
+    if op == "IN":
+        return actual in expected
+    raise QueryError(f"unknown filter operator {op!r}")
+
+
+def _sort_cmp(a: Any, b: Any) -> int:
+    """Total order over heterogeneous JSON values: None first, then by
+    type name, then by value — mirrors document-store sort stability."""
+    if a == b:
+        return 0
+    if a is None:
+        return -1
+    if b is None:
+        return 1
+    ta, tb = type(a).__name__, type(b).__name__
+    # bool is an int subtype; sort numerics together
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return -1 if a < b else 1
+    if ta != tb:
+        return -1 if ta < tb else 1
+    if isinstance(a, (dict, list)):
+        # containers have no natural order; canonical JSON text gives a
+        # stable one instead of a TypeError mid-query
+        a, b = json.dumps(a, sort_keys=True), json.dumps(b, sort_keys=True)
+        if a == b:
+            return 0
+    return -1 if a < b else 1
+
+
+def sort_items(items: list, sort_spec: list[dict] | None, *, doc=lambda it: it.value) -> list:
+    if not sort_spec:
+        return items
+    for clause in sort_spec:
+        if not isinstance(clause, dict) or "key" not in clause:
+            raise QueryError("each sort clause needs a key")
+        order = str(clause.get("order", "ASC")).upper()
+        if order not in ("ASC", "DESC"):
+            raise QueryError(f"sort order must be ASC or DESC, not {clause.get('order')!r}")
+    out = list(items)
+    # apply clauses right-to-left so the leftmost is the primary key
+    for clause in reversed(sort_spec):
+        path = clause["key"]
+        reverse = str(clause.get("order", "ASC")).upper() == "DESC"
+        out.sort(
+            key=functools.cmp_to_key(
+                lambda x, y, p=path: _sort_cmp(get_path(doc(x), p), get_path(doc(y), p))
+            ),
+            reverse=reverse,
+        )
+    return out
+
+
+def paginate(items: list, page: dict | None) -> tuple[list, str | None]:
+    """Index-token paging: token is the stringified next offset."""
+    if not page:
+        return items, None
+    limit = page.get("limit")
+    token = page.get("token")
+    start = 0
+    if token is not None:
+        try:
+            start = int(token)
+        except (TypeError, ValueError):
+            raise QueryError(f"bad page token {token!r}") from None
+        if start < 0:
+            raise QueryError(f"bad page token {token!r}")
+    if limit is None:
+        return items[start:], None
+    if not isinstance(limit, int) or limit <= 0:
+        raise QueryError("page.limit must be a positive integer")
+    chunk = items[start : start + limit]
+    next_token = str(start + limit) if start + limit < len(items) else None
+    return chunk, next_token
+
+
+def run_query(items: list, query: dict, *, doc=lambda it: it.value):
+    """Full pipeline over materialised items (memory-store path)."""
+    if not isinstance(query, dict):
+        raise QueryError("query must be a JSON object")
+    filt = query.get("filter")
+    validate_filter(filt)
+    hits = [it for it in items if matches(doc(it), filt)]
+    hits = sort_items(hits, query.get("sort"), doc=doc)
+    return paginate(hits, query.get("page"))

@@ -1,0 +1,241 @@
+"""SQLite-backed state store — the durable local engine.
+
+Fills the slot Cosmos DB fills in the reference (component
+``state.azure.cosmosdb``, components/dapr-statestore-cosmos.yaml):
+durable, queryable document state. The type alias means the reference's
+cloud component file runs unchanged against this engine locally.
+
+The filter/sort dialect (state/query.py) is compiled to SQL over
+``json_extract`` so filtering happens in the engine, not in Python —
+the framework-level analog of Cosmos executing the JSON query
+server-side rather than the sidecar scanning keys.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sqlite3
+from typing import Any
+
+from tasksrunner.component.registry import driver
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.errors import EtagMismatch, QueryError
+from tasksrunner.state.base import QueryResponse, StateItem, StateStore, TransactionOp
+from tasksrunner.state.query import paginate, validate_filter
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS state (
+    key   TEXT PRIMARY KEY,
+    value TEXT NOT NULL,
+    etag  TEXT NOT NULL
+) WITHOUT ROWID;
+CREATE TABLE IF NOT EXISTS etag_seq (
+    id  INTEGER PRIMARY KEY CHECK (id = 1),
+    n   INTEGER NOT NULL
+);
+INSERT OR IGNORE INTO etag_seq(id, n) VALUES (1, 0);
+"""
+
+
+def _like_escape(prefix: str) -> str:
+    return prefix.replace("\\", "\\\\").replace("%", "\\%").replace("_", "\\_")
+
+
+def _param(value: Any) -> Any:
+    """Bind a JSON scalar the way json_extract represents it."""
+    if isinstance(value, bool):
+        return int(value)
+    return value
+
+
+def compile_filter(filt: Any) -> tuple[str, list[Any]]:
+    """Compile a validated filter to a WHERE fragment + params.
+
+    Semantics must match state.query.matches exactly; the contract
+    suite in tests/test_state.py runs both engines on the same cases.
+    """
+    if filt in (None, {}):
+        return "1", []
+    op, operand = next(iter(filt.items()))
+    if op in ("AND", "OR"):
+        parts, params = [], []
+        for sub in operand:
+            frag, p = compile_filter(sub)
+            parts.append(f"({frag})")
+            params.extend(p)
+        return f" {op} ".join(parts), params
+    path, expected = next(iter(operand.items()))
+    col = "json_extract(value, ?)"
+    jpath = "$." + path
+    if op == "EQ":
+        return f"{col} IS ?", [jpath, _param(expected)]
+    if op == "NEQ":
+        return f"{col} IS NOT ?", [jpath, _param(expected)]
+    if op == "IN":
+        if not expected:
+            return "0", []
+        placeholders = ", ".join("?" for _ in expected)
+        frag = f"{col} IN ({placeholders})"
+        params: list[Any] = [jpath, *(_param(v) for v in expected)]
+        if any(v is None for v in expected):
+            frag = f"({frag} OR json_extract(value, ?) IS NULL)"
+            params.append(jpath)
+        return frag, params
+    raise QueryError(f"unknown filter operator {op!r}")
+
+
+def compile_sort(sort_spec: list[dict] | None) -> tuple[str, list[Any]]:
+    if not sort_spec:
+        return "ORDER BY key", []
+    clauses, params = [], []
+    for clause in sort_spec:
+        if not isinstance(clause, dict) or "key" not in clause:
+            raise QueryError("each sort clause needs a key")
+        order = str(clause.get("order", "ASC")).upper()
+        if order not in ("ASC", "DESC"):
+            raise QueryError(f"sort order must be ASC or DESC, not {clause.get('order')!r}")
+        clauses.append(f"json_extract(value, ?) {order}")
+        params.append("$." + clause["key"])
+    return "ORDER BY " + ", ".join(clauses), params
+
+
+class SqliteStateStore(StateStore):
+    def __init__(self, name: str, path: str | pathlib.Path = ":memory:"):
+        super().__init__(name)
+        self.path = str(path)
+        if self.path != ":memory:":
+            pathlib.Path(self.path).parent.mkdir(parents=True, exist_ok=True)
+        self._conn = sqlite3.connect(self.path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.executescript(_SCHEMA)
+        self._conn.commit()
+
+    # -- core ops --------------------------------------------------------
+
+    async def get(self, key: str) -> StateItem | None:
+        row = self._conn.execute(
+            "SELECT value, etag FROM state WHERE key = ?", (key,)
+        ).fetchone()
+        if row is None:
+            return None
+        return StateItem(key=key, value=json.loads(row[0]), etag=row[1])
+
+    def _next_etag(self, cur: sqlite3.Cursor) -> str:
+        # Store-global monotonic sequence: a deleted-and-recreated key
+        # never reuses an old etag, so stale tokens from a previous
+        # incarnation of the key can't validate.
+        cur.execute("UPDATE etag_seq SET n = n + 1 WHERE id = 1")
+        (n,) = cur.execute("SELECT n FROM etag_seq WHERE id = 1").fetchone()
+        return str(n)
+
+    def _set_tx(self, cur: sqlite3.Cursor, key: str, value: Any, etag: str | None) -> str:
+        row = cur.execute("SELECT etag FROM state WHERE key = ?", (key,)).fetchone()
+        if etag is not None and (row is None or row[0] != etag):
+            raise EtagMismatch(f"etag mismatch for key {key!r}")
+        new_etag = self._next_etag(cur)
+        cur.execute(
+            "INSERT INTO state(key, value, etag) VALUES(?, ?, ?) "
+            "ON CONFLICT(key) DO UPDATE SET value=excluded.value, etag=excluded.etag",
+            (key, json.dumps(value, separators=(",", ":")), new_etag),
+        )
+        return new_etag
+
+    async def set(self, key: str, value: Any, *, etag: str | None = None) -> str:
+        cur = self._conn.cursor()
+        try:
+            cur.execute("BEGIN IMMEDIATE")
+            new_etag = self._set_tx(cur, key, value, etag)
+            self._conn.commit()
+            return new_etag
+        except BaseException:
+            self._conn.rollback()
+            raise
+
+    async def delete(self, key: str, *, etag: str | None = None) -> bool:
+        cur = self._conn.cursor()
+        try:
+            cur.execute("BEGIN IMMEDIATE")
+            row = cur.execute("SELECT etag FROM state WHERE key = ?", (key,)).fetchone()
+            if row is None:
+                if etag is not None:
+                    raise EtagMismatch(f"etag mismatch for key {key!r}")
+                self._conn.commit()
+                return False
+            if etag is not None and row[0] != etag:
+                raise EtagMismatch(f"etag mismatch for key {key!r}")
+            cur.execute("DELETE FROM state WHERE key = ?", (key,))
+            self._conn.commit()
+            return True
+        except BaseException:
+            self._conn.rollback()
+            raise
+
+    async def transact(self, ops: list[TransactionOp]) -> None:
+        """Contract (matches the memory engine): all etags validate
+        against the *pre-transaction* state, then ops apply in order."""
+        cur = self._conn.cursor()
+        try:
+            cur.execute("BEGIN IMMEDIATE")
+            for op in ops:
+                if op.etag is None:
+                    continue
+                row = cur.execute(
+                    "SELECT etag FROM state WHERE key = ?", (op.key,)
+                ).fetchone()
+                if row is None or row[0] != op.etag:
+                    raise EtagMismatch(f"etag mismatch for key {op.key!r}")
+            for op in ops:
+                if op.operation == "upsert":
+                    self._set_tx(cur, op.key, op.value, None)
+                else:
+                    cur.execute("DELETE FROM state WHERE key = ?", (op.key,))
+            self._conn.commit()
+        except BaseException:
+            self._conn.rollback()
+            raise
+
+    # -- query -----------------------------------------------------------
+
+    async def query(self, query: dict, *, key_prefix: str = "") -> QueryResponse:
+        if not isinstance(query, dict):
+            raise QueryError("query must be a JSON object")
+        filt = query.get("filter")
+        validate_filter(filt)
+        where, params = compile_filter(filt)
+        order, order_params = compile_sort(query.get("sort"))
+        sql = f"SELECT key, value, etag FROM state WHERE ({where})"
+        all_params = [*params]
+        if key_prefix:
+            sql += r" AND key LIKE ? ESCAPE '\'"
+            all_params.append(_like_escape(key_prefix) + "%")
+        sql += f" {order}"
+        all_params.extend(order_params)
+        try:
+            rows = self._conn.execute(sql, all_params).fetchall()
+        except sqlite3.Error as exc:
+            raise QueryError(f"query failed: {exc}") from exc
+        items = [StateItem(key=k, value=json.loads(v), etag=e) for k, v, e in rows]
+        items, token = paginate(items, query.get("page"))
+        return QueryResponse(items=items, token=token)
+
+    async def keys(self, *, prefix: str = "") -> list[str]:
+        if prefix:
+            rows = self._conn.execute(
+                r"SELECT key FROM state WHERE key LIKE ? ESCAPE '\' ORDER BY key",
+                (_like_escape(prefix) + "%",),
+            ).fetchall()
+        else:
+            rows = self._conn.execute("SELECT key FROM state ORDER BY key").fetchall()
+        return [r[0] for r in rows]
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+@driver("state.sqlite", "state.azure.cosmosdb", "state.postgresql")
+def _sqlite_state(spec: ComponentSpec, metadata: dict[str, str]) -> SqliteStateStore:
+    """Durable local engine; `databasePath` metadata picks the file
+    (defaults to in-memory). Cloud-typed component files (cosmos/postgres)
+    map here so they run unchanged in local mode."""
+    return SqliteStateStore(spec.name, metadata.get("databasePath", ":memory:"))

@@ -27,7 +27,7 @@ LOCAL_YAML = textwrap.dedent(
     metadata:
       name: statestore
     spec:
-      type: state.memory
+      type: test.fake
       version: v1
       metadata:
       - name: url
@@ -45,7 +45,7 @@ LOCAL_YAML = textwrap.dedent(
 
 CLOUD_YAML = textwrap.dedent(
     """
-    componentType: state.memory
+    componentType: test.fake
     version: v1
     metadata:
     - name: accountKey
@@ -59,7 +59,7 @@ CLOUD_YAML = textwrap.dedent(
 )
 
 
-@driver("state.memory")
+@driver("test.fake")
 class _MemoryComponent:
     """Minimal driver used by these tests (real one comes with the
     state building block)."""
@@ -78,8 +78,8 @@ def test_parse_local_dialect(tmp_path):
     p.write_text(LOCAL_YAML)
     (spec,) = load_component_file(p)
     assert spec.name == "statestore"
-    assert spec.type == "state.memory"
-    assert spec.block == "state"
+    assert spec.type == "test.fake"
+    assert spec.block == "test"
     assert spec.metadata["url"] == "http://localhost"
     assert spec.metadata["masterKey"] == SecretRef(key="cosmos-key", store="teststore")
     assert spec.scopes == ["tasksmanager-backend-api"]
@@ -96,7 +96,7 @@ def test_parse_cloud_dialect_name_from_filename(tmp_path):
 
 def test_cloud_dialect_external_secret_ref():
     doc = {
-        "componentType": "state.memory",
+        "componentType": "test.fake",
         "metadata": [{"name": "key", "secretRef": "external-key"}],
         "secretStoreComponent": "kvstore",
     }
@@ -133,7 +133,7 @@ def test_registry_resolves_secrets_and_scopes(tmp_path):
         app_id="tasksmanager-backend-api",
         secret_resolver=resolver,
     )
-    comp = reg.get("statestore", block="state")
+    comp = reg.get("statestore", block="test")
     assert comp.metadata == {"url": "http://localhost", "masterKey": "s3cr3t"}
 
     # wrong building block
@@ -164,7 +164,7 @@ def test_registry_inline_secrets_register_store(tmp_path):
 
 def test_check_scope():
     spec = parse_component(
-        {"componentType": "state.memory", "scopes": ["appA"]}, default_name="c"
+        {"componentType": "test.fake", "scopes": ["appA"]}, default_name="c"
     )
     reg = ComponentRegistry([spec])
     reg.check_scope("c", "appA")
@@ -198,7 +198,7 @@ def test_env_secret_store_kebab_case(monkeypatch):
 def test_yaml_bool_scalars_render_lowercase():
     spec = parse_component(
         {
-            "componentType": "state.memory",
+            "componentType": "test.fake",
             "metadata": [{"name": "decodeBase64", "value": True}],
         },
         default_name="c",

@@ -1,0 +1,317 @@
+"""State building-block contract suite, run against every engine.
+
+Mirrors the reference's state usage: save/get/delete by key
+(TasksStoreManager.cs:35,49,73), EQ filter query on a document field
+:56-61, EQ on a serialized datetime :125-130, and the {app-id}||{key}
+prefixing scheme (SURVEY.md §5.4). Both engines must behave
+identically — the sqlite engine compiles the dialect to SQL and the
+memory engine interprets it, so divergence here is a real bug.
+"""
+
+import pytest
+
+from tasksrunner.errors import EtagMismatch, QueryError
+from tasksrunner.state import (
+    InMemoryStateStore,
+    KeyPrefixer,
+    SqliteStateStore,
+    TransactionOp,
+)
+
+ENGINES = {
+    "memory": lambda tmp_path: InMemoryStateStore("s"),
+    "sqlite-mem": lambda tmp_path: SqliteStateStore("s"),
+    "sqlite-file": lambda tmp_path: SqliteStateStore("s", tmp_path / "state.db"),
+}
+
+
+@pytest.fixture(params=sorted(ENGINES))
+def store(request, tmp_path):
+    s = ENGINES[request.param](tmp_path)
+    yield s
+    s.close()
+
+
+TASKS = [
+    {"taskId": "t1", "taskName": "alpha", "taskCreatedBy": "a@x.com",
+     "taskDueDate": "2026-07-28T00:00:00", "isCompleted": False, "priority": 3},
+    {"taskId": "t2", "taskName": "beta", "taskCreatedBy": "b@x.com",
+     "taskDueDate": "2026-07-29T00:00:00", "isCompleted": True, "priority": 1},
+    {"taskId": "t3", "taskName": "gamma", "taskCreatedBy": "a@x.com",
+     "taskDueDate": "2026-07-28T00:00:00", "isCompleted": False, "priority": 2},
+]
+
+
+async def seed(store, prefix=""):
+    for t in TASKS:
+        await store.set(prefix + t["taskId"], t)
+
+
+@pytest.mark.asyncio
+async def test_crud_roundtrip(store):
+    etag = await store.set("k", {"a": 1})
+    item = await store.get("k")
+    assert item.value == {"a": 1} and item.etag == etag
+    etag2 = await store.set("k", {"a": 2})
+    assert etag2 != etag
+    assert (await store.get("k")).value == {"a": 2}
+    assert await store.delete("k") is True
+    assert await store.get("k") is None
+    assert await store.delete("k") is False
+
+
+@pytest.mark.asyncio
+async def test_etag_optimistic_concurrency(store):
+    etag = await store.set("k", 1)
+    with pytest.raises(EtagMismatch):
+        await store.set("k", 2, etag="bogus")
+    await store.set("k", 2, etag=etag)
+    with pytest.raises(EtagMismatch):
+        await store.delete("k", etag=etag)  # stale now
+    with pytest.raises(EtagMismatch):
+        await store.set("new-key", 1, etag="1")  # etag on missing key
+
+
+@pytest.mark.asyncio
+async def test_value_isolation(store):
+    doc = {"nested": {"n": 1}}
+    await store.set("k", doc)
+    item = await store.get("k")
+    item.value["nested"]["n"] = 99
+    assert (await store.get("k")).value["nested"]["n"] == 1
+
+
+@pytest.mark.asyncio
+async def test_transact_atomic(store):
+    await store.set("a", 1)
+    with pytest.raises(EtagMismatch):
+        await store.transact([
+            TransactionOp("upsert", "b", 2),
+            TransactionOp("delete", "a", etag="bogus"),
+        ])
+    # nothing from the failed transaction may be visible
+    assert await store.get("b") is None
+    await store.transact([
+        TransactionOp("upsert", "b", 2),
+        TransactionOp("delete", "a"),
+    ])
+    assert (await store.get("b")).value == 2
+    assert await store.get("a") is None
+
+
+@pytest.mark.asyncio
+async def test_query_eq_by_creator(store):
+    await seed(store)
+    resp = await store.query({"filter": {"EQ": {"taskCreatedBy": "a@x.com"}}})
+    assert {i.value["taskId"] for i in resp.items} == {"t1", "t3"}
+
+
+@pytest.mark.asyncio
+async def test_query_eq_serialized_datetime(store):
+    """The DateTimeConverter trap: query matches the exact serialized
+    string or nothing (reference TasksStoreManager.cs:104-130)."""
+    await seed(store)
+    hit = await store.query({"filter": {"EQ": {"taskDueDate": "2026-07-28T00:00:00"}}})
+    assert len(hit.items) == 2
+    miss = await store.query({"filter": {"EQ": {"taskDueDate": "07/28/2026 00:00:00"}}})
+    assert miss.items == []
+
+
+@pytest.mark.asyncio
+async def test_query_eq_bool_and_missing_field(store):
+    await seed(store)
+    resp = await store.query({"filter": {"EQ": {"isCompleted": True}}})
+    assert [i.value["taskId"] for i in resp.items] == ["t2"]
+    resp = await store.query({"filter": {"EQ": {"noSuchField": None}}})
+    assert len(resp.items) == 3  # missing field compares equal to null
+
+
+@pytest.mark.asyncio
+async def test_query_neq_in_and_or(store):
+    await seed(store)
+    resp = await store.query({"filter": {"NEQ": {"taskCreatedBy": "a@x.com"}}})
+    assert [i.value["taskId"] for i in resp.items] == ["t2"]
+    resp = await store.query({"filter": {"IN": {"taskName": ["alpha", "gamma"]}}})
+    assert {i.value["taskId"] for i in resp.items} == {"t1", "t3"}
+    resp = await store.query({"filter": {"AND": [
+        {"EQ": {"taskCreatedBy": "a@x.com"}},
+        {"EQ": {"isCompleted": False}},
+        {"NEQ": {"taskName": "gamma"}},
+    ]}})
+    assert [i.value["taskId"] for i in resp.items] == ["t1"]
+    resp = await store.query({"filter": {"OR": [
+        {"EQ": {"taskName": "beta"}},
+        {"EQ": {"taskName": "gamma"}},
+    ]}})
+    assert {i.value["taskId"] for i in resp.items} == {"t2", "t3"}
+
+
+@pytest.mark.asyncio
+async def test_query_in_with_null_candidate(store):
+    await seed(store)
+    resp = await store.query({"filter": {"IN": {"noField": [None]}}})
+    assert len(resp.items) == 3
+    resp = await store.query({"filter": {"IN": {"taskName": []}}})
+    assert resp.items == []
+
+
+@pytest.mark.asyncio
+async def test_query_sort_and_page(store):
+    await seed(store)
+    resp = await store.query({"sort": [{"key": "priority", "order": "DESC"}]})
+    assert [i.value["priority"] for i in resp.items] == [3, 2, 1]
+    resp = await store.query({
+        "sort": [{"key": "taskCreatedBy"}, {"key": "priority", "order": "DESC"}],
+    })
+    assert [i.value["taskId"] for i in resp.items] == ["t1", "t3", "t2"]
+    # paging walks the full result set via tokens
+    seen, token = [], None
+    while True:
+        page = {"limit": 2, **({"token": token} if token else {})}
+        resp = await store.query({"sort": [{"key": "taskId"}], "page": page})
+        seen += [i.value["taskId"] for i in resp.items]
+        token = resp.token
+        if token is None:
+            break
+    assert seen == ["t1", "t2", "t3"]
+
+
+@pytest.mark.asyncio
+async def test_query_key_prefix_isolation(store):
+    await seed(store, prefix="appA||")
+    await store.set("appB||t9", {"taskCreatedBy": "a@x.com"})
+    resp = await store.query(
+        {"filter": {"EQ": {"taskCreatedBy": "a@x.com"}}}, key_prefix="appA||"
+    )
+    assert {i.key for i in resp.items} == {"appA||t1", "appA||t3"}
+
+
+@pytest.mark.asyncio
+async def test_query_prefix_with_like_metacharacters(store):
+    await store.set("app%_x||k", {"v": 1})
+    await store.set("appZZxQQk", {"v": 2})
+    resp = await store.query({}, key_prefix="app%_x||")
+    assert [i.key for i in resp.items] == ["app%_x||k"]
+
+
+@pytest.mark.asyncio
+async def test_query_malformed_rejected(store):
+    await seed(store)
+    for bad in [
+        {"filter": {"BOGUS": {"a": 1}}},
+        {"filter": {"EQ": {"a": 1, "b": 2}}},
+        {"filter": {"AND": []}},
+        {"filter": {"IN": {"a": "not-a-list"}}},
+        {"sort": [{"order": "ASC"}]},
+        {"sort": [{"key": "a", "order": "SIDEWAYS"}]},
+        {"page": {"limit": -1}},
+        {"page": {"limit": 2, "token": "xyz"}},
+    ]:
+        with pytest.raises(QueryError):
+            await store.query(bad)
+
+
+@pytest.mark.asyncio
+async def test_nested_path_query(store):
+    await store.set("n1", {"address": {"city": "Athens"}})
+    await store.set("n2", {"address": {"city": "Berlin"}})
+    resp = await store.query({"filter": {"EQ": {"address.city": "Athens"}}})
+    assert [i.key for i in resp.items] == ["n1"]
+
+
+@pytest.mark.asyncio
+async def test_bulk_get(store):
+    await seed(store)
+    items = await store.bulk_get(["t1", "missing", "t3"])
+    assert items[0].value["taskId"] == "t1"
+    assert items[1] is None
+    assert items[2].value["taskId"] == "t3"
+
+
+@pytest.mark.asyncio
+async def test_sqlite_file_durability(tmp_path):
+    path = tmp_path / "durable.db"
+    s1 = SqliteStateStore("s", path)
+    await s1.set("k", {"v": 42})
+    s1.close()
+    s2 = SqliteStateStore("s", path)
+    assert (await s2.get("k")).value == {"v": 42}
+    s2.close()
+
+
+@pytest.mark.asyncio
+async def test_etag_not_reused_after_delete(store):
+    """A stale etag from a previous incarnation of a key must never
+    validate against the recreated key (code-review finding)."""
+    old_etag = await store.set("k", {"v": 1})
+    await store.delete("k")
+    await store.set("k", {"v": 2})
+    with pytest.raises(EtagMismatch):
+        await store.set("k", {"stale": True}, etag=old_etag)
+    assert (await store.get("k")).value == {"v": 2}
+
+
+@pytest.mark.asyncio
+async def test_transact_etags_validate_against_pre_state(store):
+    """Both engines: etags check pre-transaction state, then ops apply
+    in order — multi-op-per-key transactions agree across engines."""
+    etag = await store.set("a", 1)
+    await store.transact([
+        TransactionOp("upsert", "a", 2),
+        TransactionOp("delete", "a", etag=etag),
+    ])
+    assert await store.get("a") is None
+
+
+@pytest.mark.asyncio
+async def test_sort_on_container_values_does_not_crash(store):
+    await store.set("c1", {"address": {"city": "Athens"}})
+    await store.set("c2", {"address": {"city": "Berlin"}})
+    resp = await store.query({"sort": [{"key": "address"}]})
+    assert len(resp.items) == 2
+
+
+@pytest.mark.asyncio
+async def test_negative_page_token_rejected(store):
+    await seed(store)
+    with pytest.raises(QueryError):
+        await store.query({"page": {"limit": 2, "token": "-1"}})
+
+
+def test_state_drivers_registered_by_plain_import():
+    import subprocess, sys
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import tasksrunner; from tasksrunner.component.registry import registered_types; "
+         "print('state.sqlite' in registered_types())"],
+        capture_output=True, text=True, check=True,
+    )
+    assert out.stdout.strip() == "True"
+
+
+def test_file_secret_store_malformed_content(tmp_path):
+    from tasksrunner.secrets import FileSecretStore
+    from tasksrunner.errors import SecretError
+
+    f = tmp_path / "bad.json"
+    f.write_text("{truncated")
+    with pytest.raises(SecretError, match="cannot parse"):
+        FileSecretStore("s", f)
+
+
+def test_key_prefixer_strategies():
+    assert KeyPrefixer("appid", app_id="api").apply("t1") == "api||t1"
+    assert KeyPrefixer("appid", app_id=None).apply("t1") == "t1"
+    assert KeyPrefixer("name", component_name="statestore").apply("t1") == "statestore||t1"
+    assert KeyPrefixer("none", app_id="api").apply("t1") == "t1"
+    assert KeyPrefixer("shared-ns", app_id="api").apply("t1") == "shared-ns||t1"
+    p = KeyPrefixer("appid", app_id="api")
+    assert p.strip("api||t1") == "t1"
+
+
+def test_state_drivers_registered():
+    from tasksrunner.component.registry import registered_types
+    types = registered_types()
+    assert "state.sqlite" in types
+    assert "state.azure.cosmosdb" in types  # reference file loads unchanged
+    assert "state.in-memory" in types
