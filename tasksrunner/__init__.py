@@ -22,6 +22,15 @@ from tasksrunner.component.loader import load_components, load_component_file
 from tasksrunner.component.registry import ComponentRegistry, driver
 from tasksrunner.secrets import drivers as _secret_drivers  # noqa: F401  (registers drivers)
 from tasksrunner import state as _state  # noqa: F401  (registers state drivers)
+from tasksrunner import pubsub as _pubsub  # noqa: F401  (registers pubsub drivers)
+from tasksrunner import bindings as _bindings  # noqa: F401  (registers binding drivers)
+
+from tasksrunner.app import App, Request, Response
+from tasksrunner.client import AppClient, InvocationResponse
+from tasksrunner.runtime import Runtime, InProcAppChannel, HTTPAppChannel
+from tasksrunner.sidecar import Sidecar
+from tasksrunner.hosting import AppHost, InProcCluster
+from tasksrunner.invoke.resolver import AppAddress, NameResolver
 
 __all__ = [
     "ComponentSpec",
@@ -29,5 +38,18 @@ __all__ = [
     "load_component_file",
     "ComponentRegistry",
     "driver",
+    "App",
+    "Request",
+    "Response",
+    "AppClient",
+    "InvocationResponse",
+    "Runtime",
+    "InProcAppChannel",
+    "HTTPAppChannel",
+    "Sidecar",
+    "AppHost",
+    "InProcCluster",
+    "AppAddress",
+    "NameResolver",
     "__version__",
 ]

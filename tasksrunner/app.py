@@ -1,0 +1,268 @@
+"""Application framework: routing, subscriptions, binding handlers.
+
+The layer the three sample services are written against — the analog of the
+reference's ASP.NET controller layer, reduced to the surface the
+workshop actually uses:
+
+* HTTP routes with path params (``TasksController`` routes
+  ``api/tasks``, ``api/tasks/{id}`` — Controllers/TasksController.cs:7-76);
+* declarative topic subscriptions (``[Topic("dapr-pubsub-servicebus",
+  "tasksavedtopic")]`` — Controllers/TasksNotifierController.cs:23-25)
+  discovered by the sidecar through a ``/tasksrunner/subscribe``
+  handshake (≙ MapSubscribeHandler's ``/dapr/subscribe``,
+  Processor Program.cs:33);
+* input-binding handlers dispatched by route (cron: route = component
+  name; queue: route from component metadata — SURVEY.md §3.3-3.4);
+* CloudEvents unwrap on delivery (≙ UseCloudEvents, Program.cs:29).
+
+Handlers are ``async def handler(request) -> Response | dict | list |
+str | bytes | int | None | (status, body)``.
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable
+from urllib.parse import parse_qsl
+
+from tasksrunner import cloudevents
+from tasksrunner.errors import TasksRunnerError
+from tasksrunner.observability.tracing import (
+    TRACEPARENT_HEADER,
+    ensure_trace,
+    trace_scope,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: dict[str, str] = field(default_factory=dict)
+    headers: dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+    path_params: dict[str, str] = field(default_factory=dict)
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        return json.loads(self.body)
+
+    @property
+    def data(self) -> Any:
+        """Body with any CloudEvents envelope removed (≙ UseCloudEvents)."""
+        if not self.body:
+            return None
+        return cloudevents.unwrap(self.body, self.headers.get("content-type"))
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: Any = None
+    headers: dict[str, str] = field(default_factory=dict)
+
+    def encode(self) -> tuple[int, dict[str, str], bytes]:
+        headers = dict(self.headers)
+        if self.body is None:
+            return self.status, headers, b""
+        if isinstance(self.body, (bytes, bytearray)):
+            headers.setdefault("content-type", "application/octet-stream")
+            return self.status, headers, bytes(self.body)
+        if isinstance(self.body, str):
+            headers.setdefault("content-type", "text/plain; charset=utf-8")
+            return self.status, headers, self.body.encode()
+        headers.setdefault("content-type", "application/json")
+        return self.status, headers, json.dumps(self.body).encode()
+
+
+def _normalize(result: Any) -> Response:
+    if isinstance(result, Response):
+        return result
+    if result is None:
+        return Response(status=204)
+    if isinstance(result, int):
+        return Response(status=result)
+    if isinstance(result, tuple) and len(result) == 2 and isinstance(result[0], int):
+        return Response(status=result[0], body=result[1])
+    return Response(status=200, body=result)
+
+
+Handler = Callable[..., Awaitable[Any]]
+
+
+@dataclass
+class _Route:
+    method: str
+    segments: list[str]  # literal (lowercased) or "{param}"
+    handler: Handler
+    kind: str = "http"  # http | subscription | binding
+
+    def match(self, method: str, path: str) -> dict[str, str] | None:
+        if self.method != "*" and method.upper() != self.method:
+            return None
+        parts = [p for p in path.split("/") if p != ""]
+        if len(parts) != len(self.segments):
+            return None
+        params: dict[str, str] = {}
+        for seg, part in zip(self.segments, parts):
+            if seg.startswith("{") and seg.endswith("}"):
+                params[seg[1:-1]] = part
+            elif seg != part.lower():
+                return None
+        return params
+
+
+@dataclass
+class SubscriptionEntry:
+    pubsub_name: str
+    topic: str
+    route: str
+
+
+@dataclass
+class BindingEntry:
+    name: str
+    route: str
+
+
+class App:
+    """One service: an app-id plus its routes and declarative hooks."""
+
+    def __init__(self, app_id: str):
+        self.app_id = app_id
+        self._routes: list[_Route] = []
+        self.subscriptions: list[SubscriptionEntry] = []
+        self.binding_routes: list[BindingEntry] = []
+        self._startup_hooks: list[Callable[[], Awaitable[None]]] = []
+        self._shutdown_hooks: list[Callable[[], Awaitable[None]]] = []
+        #: set by the serving harness; the app's handle to its sidecar
+        #: (≙ the injected DaprClient)
+        self.client: Any = None
+        #: free-form per-app state (≙ DI singletons)
+        self.state: dict[str, Any] = {}
+
+    # -- registration ----------------------------------------------------
+
+    def route(self, path: str, *, methods: list[str] | str = "GET",
+              kind: str = "http") -> Callable[[Handler], Handler]:
+        if isinstance(methods, str):
+            methods = [methods]
+
+        def register(handler: Handler) -> Handler:
+            for method in methods:
+                segments = [
+                    s if s.startswith("{") else s.lower()
+                    for s in path.split("/") if s != ""
+                ]
+                self._routes.append(
+                    _Route(method=method.upper(), segments=segments,
+                           handler=handler, kind=kind)
+                )
+            return handler
+
+        return register
+
+    def get(self, path: str):
+        return self.route(path, methods="GET")
+
+    def post(self, path: str):
+        return self.route(path, methods="POST")
+
+    def put(self, path: str):
+        return self.route(path, methods="PUT")
+
+    def delete(self, path: str):
+        return self.route(path, methods="DELETE")
+
+    def subscribe(self, pubsub: str, topic: str, route: str | None = None):
+        """≙ [Topic(pubsub, topic)] on an action method."""
+        route = route or f"/events/{pubsub}/{topic}"
+
+        def register(handler: Handler) -> Handler:
+            self.subscriptions.append(
+                SubscriptionEntry(pubsub_name=pubsub, topic=topic, route=route)
+            )
+            return self.route(route, methods="POST", kind="subscription")(handler)
+
+        return register
+
+    def binding(self, name: str, route: str | None = None):
+        """Handler for an input binding; route defaults to /<name>
+        (the cron convention — SURVEY.md §3.3)."""
+        route = route or f"/{name}"
+
+        def register(handler: Handler) -> Handler:
+            self.binding_routes.append(BindingEntry(name=name, route=route))
+            return self.route(route, methods="POST", kind="binding")(handler)
+
+        return register
+
+    def on_startup(self, fn: Callable[[], Awaitable[None]]):
+        self._startup_hooks.append(fn)
+        return fn
+
+    def on_shutdown(self, fn: Callable[[], Awaitable[None]]):
+        self._shutdown_hooks.append(fn)
+        return fn
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def startup(self) -> None:
+        for hook in self._startup_hooks:
+            await hook()
+
+    async def shutdown(self) -> None:
+        for hook in self._shutdown_hooks:
+            await hook()
+
+    # -- dispatch --------------------------------------------------------
+
+    def subscription_doc(self) -> list[dict]:
+        """The /tasksrunner/subscribe handshake document."""
+        return [
+            {"pubsubname": s.pubsub_name, "topic": s.topic, "route": s.route}
+            for s in self.subscriptions
+        ]
+
+    async def handle(self, method: str, path: str, *, query: str = "",
+                     headers: dict[str, str] | None = None,
+                     body: bytes = b"") -> Response:
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        clean_path = path.split("?", 1)[0]
+
+        if method.upper() == "GET" and clean_path in ("/tasksrunner/subscribe", "/dapr/subscribe"):
+            return Response(body=self.subscription_doc())
+        if clean_path == "/healthz":
+            return Response(status=204)
+
+        for route in self._routes:
+            params = route.match(method, clean_path)
+            if params is None:
+                continue
+            request = Request(
+                method=method.upper(), path=clean_path,
+                query=dict(parse_qsl(query)), headers=headers,
+                body=body, path_params=params,
+            )
+            # Adopt the caller's trace context (same move the HTTP app
+            # server makes at ingress — in-proc and sidecar modes must
+            # trace identically).
+            ctx = ensure_trace(headers.get(TRACEPARENT_HEADER))
+            with trace_scope(ctx):
+                try:
+                    result = route.handler(request)
+                    if inspect.isawaitable(result):
+                        result = await result
+                    return _normalize(result)
+                except TasksRunnerError as exc:
+                    return Response(status=exc.http_status, body={"error": str(exc)})
+                except Exception:
+                    logger.exception("unhandled error in %s %s", method, clean_path)
+                    return Response(status=500, body={"error": "internal error"})
+        return Response(status=404, body={"error": f"no route for {method} {clean_path}"})

@@ -1,0 +1,17 @@
+from tasksrunner.bindings.base import InputBinding, OutputBinding, BindingResponse
+from tasksrunner.bindings.cron import CronBinding, CronSchedule
+from tasksrunner.bindings.localqueue import LocalQueueBinding, SqliteQueue
+from tasksrunner.bindings.blobstore import LocalBlobStoreBinding
+from tasksrunner.bindings.email import EmailOutboxBinding
+
+__all__ = [
+    "InputBinding",
+    "OutputBinding",
+    "BindingResponse",
+    "CronBinding",
+    "CronSchedule",
+    "LocalQueueBinding",
+    "SqliteQueue",
+    "LocalBlobStoreBinding",
+    "EmailOutboxBinding",
+]

@@ -1,0 +1,76 @@
+"""Bindings building-block interfaces.
+
+The reference's binding taxonomy (SURVEY.md §2.4, §3.3-3.4):
+
+* **input bindings** push external events *into* the app: the sidecar
+  polls/schedules and POSTs to an app route — storage-queue messages
+  route to ``/externaltasksprocessor/process``
+  (components/dapr-bindings-in-storagequeue.yaml:17-18), cron fires
+  POST ``/<component-name>``
+  (components/dapr-scheduled-cron.yaml, ScheduledTasksManagerController.cs:20).
+  Ack contract: 2xx from the handler consumes the event; non-2xx →
+  redelivery (docs/aca/06-aca-dapr-bindingsapi/index.md:55-56).
+* **output bindings** push app data *out*: ``invoke_binding(name,
+  operation, data, metadata)`` — blob ``create``
+  (ExternalTasksProcessorController.cs:38-43), sendgrid ``create``
+  (docs module 6 TasksNotifierController.cs:56).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable
+
+
+@dataclass
+class BindingEvent:
+    """What an input binding delivers to the app."""
+
+    binding: str
+    data: Any
+    metadata: dict[str, str] = field(default_factory=dict)
+
+
+#: App-side sink: returns True to ack (consume), False to nack (redeliver
+#: where the source supports it).
+EventSink = Callable[[BindingEvent], Awaitable[bool]]
+
+
+@dataclass
+class BindingResponse:
+    """Result of an output-binding operation."""
+
+    data: Any = None
+    metadata: dict[str, str] = field(default_factory=dict)
+
+
+class InputBinding(abc.ABC):
+    #: The app route events are delivered to. Defaults to the component
+    #: name (cron convention); queue-style bindings set it from their
+    #: ``route`` metadata.
+    route: str
+
+    def __init__(self, name: str):
+        self.name = name
+        self.route = "/" + name
+
+    @abc.abstractmethod
+    async def start(self, sink: EventSink) -> None:
+        """Begin delivering events to ``sink`` until ``stop``."""
+
+    @abc.abstractmethod
+    async def stop(self) -> None: ...
+
+
+class OutputBinding(abc.ABC):
+    def __init__(self, name: str):
+        self.name = name
+
+    @property
+    def operations(self) -> list[str]:
+        return ["create"]
+
+    @abc.abstractmethod
+    async def invoke(self, operation: str, data: Any,
+                     metadata: dict[str, str] | None = None) -> BindingResponse: ...

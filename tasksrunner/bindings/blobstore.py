@@ -1,0 +1,86 @@
+"""Filesystem blob-store output binding.
+
+Local stand-in for ``bindings.azure.blobstorage``
+(components/dapr-bindings-out-blobstorage.yaml): the processor archives
+each external task as ``{taskId}.json``
+(ExternalTasksProcessorController.cs:38-43, metadata ``blobName``).
+Operations: create, get, delete, list — the same set Dapr's blob
+binding exposes.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Any
+
+from tasksrunner.bindings.base import BindingResponse, OutputBinding
+from tasksrunner.component.registry import driver
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.errors import BindingError
+
+
+class LocalBlobStoreBinding(OutputBinding):
+    def __init__(self, name: str, root: str | pathlib.Path, *, container: str = "blobs"):
+        super().__init__(name)
+        self.root = pathlib.Path(root) / container
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    @property
+    def operations(self) -> list[str]:
+        return ["create", "get", "delete", "list"]
+
+    def _path(self, blob_name: str) -> pathlib.Path:
+        p = (self.root / blob_name).resolve()
+        if not p.is_relative_to(self.root.resolve()):
+            raise BindingError(f"blob name {blob_name!r} escapes the container")
+        return p
+
+    async def invoke(self, operation: str, data: Any,
+                     metadata: dict[str, str] | None = None) -> BindingResponse:
+        metadata = metadata or {}
+        if operation == "list":
+            names = sorted(
+                str(p.relative_to(self.root))
+                for p in self.root.rglob("*") if p.is_file()
+            )
+            return BindingResponse(data=names)
+
+        blob_name = metadata.get("blobName")
+        if not blob_name:
+            if operation == "create":
+                import uuid
+                blob_name = str(uuid.uuid4())
+            else:
+                raise BindingError(f"{operation} requires blobName metadata")
+        path = self._path(blob_name)
+
+        if operation == "create":
+            path.parent.mkdir(parents=True, exist_ok=True)
+            if isinstance(data, (bytes, bytearray)):
+                path.write_bytes(data)
+            elif isinstance(data, str):
+                path.write_text(data)
+            else:
+                path.write_text(json.dumps(data, indent=2))
+            return BindingResponse(metadata={"blobName": blob_name})
+        if operation == "get":
+            if not path.is_file():
+                raise BindingError(f"blob {blob_name!r} does not exist")
+            return BindingResponse(data=path.read_bytes(),
+                                   metadata={"blobName": blob_name})
+        if operation == "delete":
+            existed = path.is_file()
+            if existed:
+                path.unlink()
+            return BindingResponse(metadata={"deleted": "true" if existed else "false"})
+        raise BindingError(f"blob binding has no operation {operation!r}")
+
+
+@driver("bindings.localblob", "bindings.azure.blobstorage")
+def _blob_binding(spec: ComponentSpec, metadata: dict[str, str]) -> LocalBlobStoreBinding:
+    return LocalBlobStoreBinding(
+        spec.name,
+        metadata.get("blobPath", ".tasksrunner/blobs"),
+        container=metadata.get("container", "blobs"),
+    )

@@ -1,0 +1,189 @@
+"""Cron input binding with a from-scratch 5-field schedule engine.
+
+Replicates the reference's ``bindings.cron`` component
+(components/dapr-scheduled-cron.yaml, schedule ``5 0 * * *``): on each
+fire the sidecar POSTs an empty event to the app route named after the
+component (ScheduledTasksManagerController route ``/ScheduledTasksManager``).
+
+Field order: minute hour day-of-month month day-of-week. Supports
+``*``, lists, ranges, steps (``*/15``, ``1-30/5``), month/day names,
+and the standard dom/dow OR rule (if both are restricted, either match
+fires). ``@every 5s``-style shorthand is also accepted for fast local
+testing (Dapr's cron binding supports @every too).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import datetime as dt
+import logging
+
+from tasksrunner.bindings.base import BindingEvent, EventSink, InputBinding
+from tasksrunner.component.registry import driver
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.errors import BindingError
+
+logger = logging.getLogger(__name__)
+
+_MONTHS = {m: i + 1 for i, m in enumerate(
+    "jan feb mar apr may jun jul aug sep oct nov dec".split())}
+_DOWS = {d: i for i, d in enumerate("sun mon tue wed thu fri sat".split())}
+
+_BOUNDS = {  # field -> (min, max)
+    "minute": (0, 59),
+    "hour": (0, 23),
+    "dom": (1, 31),
+    "month": (1, 12),
+    "dow": (0, 6),
+}
+
+
+def _parse_field(expr: str, field: str) -> tuple[set[int], bool]:
+    """Return (allowed values, was_wildcard)."""
+    lo, hi = _BOUNDS[field]
+    names = _MONTHS if field == "month" else _DOWS if field == "dow" else {}
+
+    def atom(tok: str) -> int:
+        tok = tok.strip().lower()
+        if tok in names:
+            return names[tok]
+        try:
+            v = int(tok)
+        except ValueError:
+            raise BindingError(f"bad cron {field} value {tok!r}") from None
+        if field == "dow" and v == 7:  # both 0 and 7 mean Sunday
+            v = 0
+        if not (lo <= v <= hi):
+            raise BindingError(f"cron {field} value {v} out of range {lo}-{hi}")
+        return v
+
+    allowed: set[int] = set()
+    wildcard = expr.strip() == "*"
+    for part in expr.split(","):
+        part = part.strip()
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            try:
+                step = int(step_s)
+            except ValueError:
+                raise BindingError(f"bad cron step {step_s!r}") from None
+            if step <= 0:
+                raise BindingError(f"cron step must be positive, got {step}")
+        if part in ("*", ""):
+            start, end = lo, hi
+        elif "-" in part and not part.lstrip("-").isdigit():
+            a, b = part.split("-", 1)
+            start, end = atom(a), atom(b)
+            if end < start:
+                raise BindingError(f"inverted cron range {part!r} in {field}")
+        else:
+            start = end = atom(part)
+            if "/" in expr and step > 1 and part != "*":
+                end = hi  # "N/step" means start at N
+        allowed.update(range(start, end + 1, step))
+    return allowed, wildcard
+
+
+class CronSchedule:
+    """A parsed cron expression that can compute the next fire time."""
+
+    def __init__(self, expr: str):
+        self.expr = expr.strip()
+        self.interval: float | None = None
+        if self.expr.startswith("@every"):
+            _, _, spec = self.expr.partition(" ")
+            self.interval = _parse_duration(spec.strip())
+            return
+        fields = self.expr.split()
+        if len(fields) == 6:
+            # Dapr's cron binding accepts 6-field (with seconds); we
+            # accept and ignore a leading seconds field of "0".
+            fields = fields[1:]
+        if len(fields) != 5:
+            raise BindingError(
+                f"cron expression needs 5 fields (minute hour dom month dow), got {self.expr!r}"
+            )
+        (self.minutes, _), (self.hours, _) = (
+            _parse_field(fields[0], "minute"), _parse_field(fields[1], "hour"))
+        self.doms, self.dom_wild = _parse_field(fields[2], "dom")
+        self.months, _ = _parse_field(fields[3], "month")
+        self.dows, self.dow_wild = _parse_field(fields[4], "dow")
+
+    def matches(self, t: dt.datetime) -> bool:
+        if self.interval is not None:
+            raise BindingError("@every schedules have no calendar match")
+        if t.minute not in self.minutes or t.hour not in self.hours:
+            return False
+        if t.month not in self.months:
+            return False
+        dom_ok = t.day in self.doms
+        dow_ok = ((t.weekday() + 1) % 7) in self.dows  # python Mon=0 → cron Sun=0
+        if not self.dom_wild and not self.dow_wild:
+            return dom_ok or dow_ok  # standard cron OR rule
+        return dom_ok and dow_ok
+
+    def next_after(self, t: dt.datetime) -> dt.datetime:
+        if self.interval is not None:
+            return t + dt.timedelta(seconds=self.interval)
+        candidate = t.replace(second=0, microsecond=0) + dt.timedelta(minutes=1)
+        # bounded scan: four years covers any satisfiable 5-field expr
+        limit = candidate + dt.timedelta(days=1462)
+        while candidate <= limit:
+            if self.matches(candidate):
+                return candidate
+            candidate += dt.timedelta(minutes=1)
+        raise BindingError(f"cron expression {self.expr!r} never fires")
+
+
+def _parse_duration(spec: str) -> float:
+    units = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}
+    for suffix in ("ms", "s", "m", "h"):
+        if spec.endswith(suffix):
+            try:
+                return float(spec[: -len(suffix)]) * units[suffix]
+            except ValueError:
+                break
+    raise BindingError(f"bad @every duration {spec!r} (want e.g. 500ms, 5s, 2m, 1h)")
+
+
+class CronBinding(InputBinding):
+    def __init__(self, name: str, schedule: str):
+        super().__init__(name)
+        self.schedule = CronSchedule(schedule)
+        self._task: asyncio.Task | None = None
+
+    async def start(self, sink: EventSink) -> None:
+        async def loop() -> None:
+            while True:
+                now = dt.datetime.now()
+                if self.schedule.interval is not None:
+                    delay = self.schedule.interval
+                else:
+                    delay = (self.schedule.next_after(now) - now).total_seconds()
+                await asyncio.sleep(max(delay, 0.0))
+                try:
+                    await sink(BindingEvent(binding=self.name, data=None,
+                                            metadata={"schedule": self.schedule.expr}))
+                except Exception:
+                    logger.exception("cron %s delivery failed", self.name)
+
+        self._task = asyncio.create_task(loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+
+@driver("bindings.cron")
+def _cron_binding(spec: ComponentSpec, metadata: dict[str, str]) -> CronBinding:
+    try:
+        schedule = metadata["schedule"]
+    except KeyError:
+        raise BindingError(f"cron component {spec.name!r} needs schedule metadata") from None
+    return CronBinding(spec.name, schedule)

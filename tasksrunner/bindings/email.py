@@ -1,0 +1,70 @@
+"""Email output binding — file-outbox engine (SendGrid stand-in).
+
+Local stand-in for ``bindings.twilio.sendgrid``
+(components/dapr-bindings-out-sendgrid.yaml): the processor sends task
+notifications via ``invoke_binding("sendgrid", "create", body,
+{emailTo, emailToName, subject})``
+(docs/aca/06-aca-dapr-bindingsapi/TasksNotifierController.cs:38-57).
+Here each send is appended as a JSON document to an outbox directory so
+tests and humans can assert on "sent" mail — the same observability the
+workshop gets from the SendGrid dashboard.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import time
+import uuid
+from typing import Any
+
+from tasksrunner.bindings.base import BindingResponse, OutputBinding
+from tasksrunner.component.registry import driver
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.errors import BindingError
+
+
+class EmailOutboxBinding(OutputBinding):
+    def __init__(self, name: str, outbox: str | pathlib.Path, *,
+                 default_from: str = "", api_key: str = ""):
+        super().__init__(name)
+        self.outbox = pathlib.Path(outbox)
+        self.outbox.mkdir(parents=True, exist_ok=True)
+        self.default_from = default_from
+        self.api_key = api_key  # kept to exercise the secretRef path
+
+    async def invoke(self, operation: str, data: Any,
+                     metadata: dict[str, str] | None = None) -> BindingResponse:
+        if operation != "create":
+            raise BindingError(f"email binding supports only create, not {operation!r}")
+        metadata = metadata or {}
+        to = metadata.get("emailTo")
+        if not to:
+            raise BindingError("email create requires emailTo metadata")
+        mail_id = str(uuid.uuid4())
+        doc = {
+            "id": mail_id,
+            "from": metadata.get("emailFrom", self.default_from),
+            "to": to,
+            "toName": metadata.get("emailToName", ""),
+            "subject": metadata.get("subject", ""),
+            "body": data if isinstance(data, str) else json.dumps(data),
+            "sentAt": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        (self.outbox / f"{mail_id}.json").write_text(json.dumps(doc, indent=2))
+        return BindingResponse(metadata={"mailId": mail_id})
+
+    def sent(self) -> list[dict]:
+        """All mail in the outbox, oldest first (test/diagnostic API)."""
+        docs = [json.loads(p.read_text()) for p in self.outbox.glob("*.json")]
+        return sorted(docs, key=lambda d: d["sentAt"])
+
+
+@driver("bindings.smtp", "bindings.twilio.sendgrid")
+def _email_binding(spec: ComponentSpec, metadata: dict[str, str]) -> EmailOutboxBinding:
+    return EmailOutboxBinding(
+        spec.name,
+        metadata.get("outboxPath", ".tasksrunner/outbox"),
+        default_from=metadata.get("emailFrom", ""),
+        api_key=metadata.get("apiKey", ""),
+    )

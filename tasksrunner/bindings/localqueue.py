@@ -1,0 +1,197 @@
+"""SQLite-backed message queue: input binding + output binding + raw queue.
+
+The local stand-in for the reference's Azure Storage Queue pair
+(components/dapr-bindings-in-storagequeue.yaml: the sidecar polls
+``external-tasks-queue`` and POSTs each message to the app route from
+the component's ``route`` metadata; 2xx acks/deletes, non-2xx →
+redelivery — docs/aca/06-aca-dapr-bindingsapi/index.md:47-60). External
+producers drop messages in via the ``SqliteQueue`` API, an output
+binding, or any sqlite client — the moral equivalent of the workshop's
+"send a message with Azure Storage Explorer" step.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import pathlib
+import sqlite3
+import time
+import uuid
+from typing import Any
+
+from tasksrunner.bindings.base import (
+    BindingEvent,
+    BindingResponse,
+    EventSink,
+    InputBinding,
+    OutputBinding,
+)
+from tasksrunner.component.registry import driver
+from tasksrunner.component.spec import ComponentSpec
+
+logger = logging.getLogger(__name__)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS queue (
+    id            TEXT PRIMARY KEY,
+    data          TEXT NOT NULL,
+    enqueued      REAL NOT NULL,
+    visible_at    REAL NOT NULL,
+    attempts      INTEGER NOT NULL DEFAULT 0,
+    done          INTEGER NOT NULL DEFAULT 0
+);
+CREATE INDEX IF NOT EXISTS idx_queue_visible ON queue (done, visible_at);
+"""
+
+
+class SqliteQueue:
+    """The queue itself — shared across processes via the db file."""
+
+    def __init__(self, path: str | pathlib.Path, *, claim_lease: float = 30.0):
+        self.path = str(path)
+        if self.path != ":memory:":
+            pathlib.Path(self.path).parent.mkdir(parents=True, exist_ok=True)
+        self.claim_lease = claim_lease
+        self._conn = sqlite3.connect(self.path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA busy_timeout=5000")
+        self._conn.executescript(_SCHEMA)
+        self._conn.commit()
+
+    def send(self, data: Any) -> str:
+        msg_id = str(uuid.uuid4())
+        now = time.time()
+        self._conn.execute(
+            "INSERT INTO queue(id, data, enqueued, visible_at) VALUES (?,?,?,?)",
+            (msg_id, json.dumps(data), now, now),
+        )
+        self._conn.commit()
+        return msg_id
+
+    def claim(self) -> tuple[str, Any, int] | None:
+        """Claim the next visible message: (id, data, attempt#)."""
+        now = time.time()
+        cur = self._conn.cursor()
+        try:
+            cur.execute("BEGIN IMMEDIATE")
+            row = cur.execute(
+                "SELECT id, data, attempts FROM queue "
+                "WHERE done = 0 AND visible_at <= ? ORDER BY enqueued LIMIT 1",
+                (now,),
+            ).fetchone()
+            if row is None:
+                self._conn.commit()
+                return None
+            msg_id, data, attempts = row
+            cur.execute(
+                "UPDATE queue SET visible_at = ?, attempts = attempts + 1 WHERE id = ?",
+                (now + self.claim_lease, msg_id),
+            )
+            self._conn.commit()
+        except BaseException:
+            self._conn.rollback()
+            raise
+        return msg_id, json.loads(data), attempts + 1
+
+    def ack(self, msg_id: str) -> None:
+        self._conn.execute("UPDATE queue SET done = 1 WHERE id = ?", (msg_id,))
+        self._conn.commit()
+
+    def nack(self, msg_id: str, *, delay: float = 0.2) -> None:
+        self._conn.execute(
+            "UPDATE queue SET visible_at = ? WHERE id = ?",
+            (time.time() + delay, msg_id),
+        )
+        self._conn.commit()
+
+    def dead_letter(self, msg_id: str) -> None:
+        self._conn.execute("UPDATE queue SET done = 2 WHERE id = ?", (msg_id,))
+        self._conn.commit()
+
+    def backlog(self) -> int:
+        (n,) = self._conn.execute(
+            "SELECT COUNT(*) FROM queue WHERE done = 0"
+        ).fetchone()
+        return n
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+class LocalQueueBinding(InputBinding, OutputBinding):
+    """Input side polls and delivers; output side `create` enqueues."""
+
+    def __init__(self, name: str, path: str, *, route: str | None = None,
+                 poll_interval: float = 0.05, max_attempts: int = 3,
+                 retry_delay: float = 0.2):
+        InputBinding.__init__(self, name)
+        self.queue = SqliteQueue(path)
+        if route:
+            self.route = route if route.startswith("/") else "/" + route
+        self.poll_interval = poll_interval
+        self.max_attempts = max_attempts
+        self.retry_delay = retry_delay
+        self._task: asyncio.Task | None = None
+
+    async def start(self, sink: EventSink) -> None:
+        async def loop() -> None:
+            while True:
+                claimed = self.queue.claim()
+                if claimed is None:
+                    await asyncio.sleep(self.poll_interval)
+                    continue
+                msg_id, data, attempt = claimed
+                try:
+                    ok = await sink(BindingEvent(
+                        binding=self.name, data=data,
+                        metadata={"messageId": msg_id, "attempt": str(attempt)},
+                    ))
+                except Exception:
+                    logger.exception("queue %s delivery failed", self.name)
+                    ok = False
+                if ok:
+                    self.queue.ack(msg_id)
+                elif attempt >= self.max_attempts:
+                    logger.warning("dead-lettering queue message %s after %d attempts",
+                                   msg_id, attempt)
+                    self.queue.dead_letter(msg_id)
+                else:
+                    self.queue.nack(msg_id, delay=self.retry_delay)
+
+        self._task = asyncio.create_task(loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        self.queue.close()
+
+    async def invoke(self, operation: str, data: Any,
+                     metadata: dict[str, str] | None = None) -> BindingResponse:
+        if operation != "create":
+            from tasksrunner.errors import BindingError
+            raise BindingError(f"queue binding supports only create, not {operation!r}")
+        msg_id = self.queue.send(data)
+        return BindingResponse(metadata={"messageId": msg_id})
+
+
+@driver("bindings.localqueue", "bindings.azure.storagequeues")
+def _localqueue_binding(spec: ComponentSpec, metadata: dict[str, str]) -> LocalQueueBinding:
+    # `queueName` (reference metadata) maps to a db file under queuePath's
+    # directory so the azure-typed component file works unchanged.
+    root = metadata.get("queuePath", ".tasksrunner/queues")
+    qname = metadata.get("queueName", spec.name)
+    return LocalQueueBinding(
+        spec.name,
+        str(pathlib.Path(root) / f"{qname}.db"),
+        route=metadata.get("route"),
+        poll_interval=float(metadata.get("pollIntervalSeconds", 0.05)),
+        max_attempts=int(metadata.get("maxRetries", 3)),
+        retry_delay=float(metadata.get("retryDelaySeconds", 0.2)),
+    )

@@ -1,0 +1,337 @@
+"""AppClient — the SDK services program against (≙ DaprClient).
+
+Method-for-method parity with the reference's client usage:
+
+* ``invoke_method`` — Pages/Tasks/Index.cshtml.cs:48, Create :46, Edit :38/:66;
+* ``save_state`` / ``get_state`` / ``delete_state`` — TasksStoreManager.cs:35/:73/:49;
+* ``query_state`` — TasksStoreManager.cs:56-61, :125-130;
+* ``publish_event`` — TasksStoreManager.cs:151-156;
+* ``invoke_binding`` — ExternalTasksProcessorController.cs:38-43,
+  docs module 6 TasksNotifierController.cs:56;
+* ``get_secret`` — Dapr secret API (SURVEY.md §5.6).
+
+Two transports behind one surface: ``AppClient.direct(runtime)`` binds
+straight to an in-process Runtime (tests, single-process mode);
+``AppClient.http(port)`` talks to a sidecar over localhost HTTP, which
+is how real services run. Both must behave identically — the
+integration suite runs the same scenarios through each.
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import os
+from typing import Any
+
+from tasksrunner.bindings.base import BindingResponse
+from tasksrunner.errors import (
+    EtagMismatch,
+    InvocationError,
+    QueryError,
+    SecretNotFound,
+    TasksRunnerError,
+)
+from tasksrunner.runtime import Runtime
+from tasksrunner.state.base import StateItem
+
+DEFAULT_SIDECAR_PORT = 3500
+PORT_ENV = "TASKSRUNNER_HTTP_PORT"
+
+
+class InvocationResponse:
+    def __init__(self, status: int, headers: dict[str, str], body: bytes):
+        self.status = status
+        self.headers = headers
+        self.body = body
+
+    @property
+    def ok(self) -> bool:
+        return 200 <= self.status < 300
+
+    def json(self) -> Any:
+        if not self.body:
+            return None
+        return json.loads(self.body)
+
+    def raise_for_status(self) -> "InvocationResponse":
+        if not self.ok:
+            detail = self.body[:300].decode("utf-8", "replace")
+            raise InvocationError(f"invocation returned {self.status}: {detail}")
+        return self
+
+
+class _Transport(abc.ABC):
+    @abc.abstractmethod
+    async def save_state(self, store, items): ...
+    @abc.abstractmethod
+    async def get_state(self, store, key) -> StateItem | None: ...
+    @abc.abstractmethod
+    async def delete_state(self, store, key, etag): ...
+    @abc.abstractmethod
+    async def query_state(self, store, query) -> dict: ...
+    @abc.abstractmethod
+    async def transact_state(self, store, operations): ...
+    @abc.abstractmethod
+    async def publish(self, pubsub, topic, data, raw): ...
+    @abc.abstractmethod
+    async def invoke_binding(self, name, operation, data, metadata) -> BindingResponse: ...
+    @abc.abstractmethod
+    async def invoke(self, app_id, method_path, http_method, query, headers, body): ...
+    @abc.abstractmethod
+    async def get_secret(self, store, key) -> dict[str, str]: ...
+    @abc.abstractmethod
+    async def bulk_secrets(self, store) -> dict[str, str]: ...
+    async def close(self): ...
+
+
+class _DirectTransport(_Transport):
+    def __init__(self, runtime: Runtime):
+        self.runtime = runtime
+
+    async def save_state(self, store, items):
+        await self.runtime.save_state(store, items)
+
+    async def get_state(self, store, key):
+        return await self.runtime.get_state(store, key)
+
+    async def delete_state(self, store, key, etag):
+        await self.runtime.delete_state(store, key, etag=etag)
+
+    async def query_state(self, store, query):
+        return await self.runtime.query_state(store, query)
+
+    async def transact_state(self, store, operations):
+        await self.runtime.transact_state(store, operations)
+
+    async def publish(self, pubsub, topic, data, raw):
+        await self.runtime.publish(pubsub, topic, data, raw=raw)
+
+    async def invoke_binding(self, name, operation, data, metadata):
+        return await self.runtime.invoke_output_binding(name, operation, data, metadata)
+
+    async def invoke(self, app_id, method_path, http_method, query, headers, body):
+        return await self.runtime.invoke(
+            app_id, method_path, http_method=http_method, query=query,
+            headers=headers, body=body)
+
+    async def get_secret(self, store, key):
+        return self.runtime.get_secret(store, key)
+
+    async def bulk_secrets(self, store):
+        return self.runtime.bulk_secrets(store)
+
+
+class _HTTPTransport(_Transport):
+    """Talks to the local sidecar's /v1.0 API, mapping HTTP errors back
+    to the same exception types the direct transport raises."""
+
+    def __init__(self, base_url: str):
+        self.base = base_url.rstrip("/")
+        self._session = None
+
+    async def _request(self, method: str, path: str, *, json_body=None,
+                       headers=None, data=None, params=None):
+        if self._session is None:
+            import aiohttp
+            self._session = aiohttp.ClientSession()
+        url = self.base + path
+        try:
+            async with self._session.request(
+                method, url, json=json_body, data=data,
+                headers=headers or {}, params=params) as resp:
+                return resp.status, dict(resp.headers), await resp.read()
+        except OSError as exc:
+            raise InvocationError(f"sidecar unreachable at {url}: {exc}") from exc
+
+    @staticmethod
+    def _raise(status: int, body: bytes, *, context: str) -> None:
+        try:
+            message = json.loads(body).get("error", "")
+        except (ValueError, AttributeError):
+            message = body[:200].decode("utf-8", "replace")
+        exc_type: type[TasksRunnerError]
+        if status == 409:
+            exc_type = EtagMismatch
+        elif status == 404 and "secret" in context:
+            exc_type = SecretNotFound
+        elif status == 400 and "query" in context:
+            exc_type = QueryError
+        else:
+            exc_type = TasksRunnerError
+        exc = exc_type(f"{context}: {message or status}")
+        exc.http_status = status
+        raise exc
+
+    async def save_state(self, store, items):
+        status, _, body = await self._request(
+            "POST", f"/v1.0/state/{store}", json_body=items)
+        if status >= 300:
+            self._raise(status, body, context=f"save state {store}")
+
+    async def get_state(self, store, key):
+        status, headers, body = await self._request("GET", f"/v1.0/state/{store}/{key}")
+        if status == 204 or (status == 200 and not body):
+            return None
+        if status >= 300:
+            self._raise(status, body, context=f"get state {store}")
+        return StateItem(key=key, value=json.loads(body),
+                         etag=headers.get("etag", ""))
+
+    async def delete_state(self, store, key, etag):
+        headers = {"if-match": etag} if etag else {}
+        status, _, body = await self._request(
+            "DELETE", f"/v1.0/state/{store}/{key}", headers=headers)
+        if status >= 300:
+            self._raise(status, body, context=f"delete state {store}")
+
+    async def query_state(self, store, query):
+        status, _, body = await self._request(
+            "POST", f"/v1.0/state/{store}/query", json_body=query)
+        if status >= 300:
+            self._raise(status, body, context=f"query state {store}")
+        return json.loads(body)
+
+    async def transact_state(self, store, operations):
+        status, _, body = await self._request(
+            "POST", f"/v1.0/state/{store}/transaction",
+            json_body={"operations": operations})
+        if status >= 300:
+            self._raise(status, body, context=f"state transaction {store}")
+
+    async def publish(self, pubsub, topic, data, raw):
+        params = {"metadata.rawPayload": "true"} if raw else None
+        status, _, body = await self._request(
+            "POST", f"/v1.0/publish/{pubsub}/{topic}", json_body=data,
+            params=params)
+        if status >= 300:
+            self._raise(status, body, context=f"publish {pubsub}/{topic}")
+
+    async def invoke_binding(self, name, operation, data, metadata):
+        status, _, body = await self._request(
+            "POST", f"/v1.0/bindings/{name}",
+            json_body={"operation": operation, "data": data,
+                       "metadata": metadata or {}})
+        if status >= 300:
+            self._raise(status, body, context=f"binding {name}")
+        doc = json.loads(body)
+        return BindingResponse(data=doc.get("data"),
+                               metadata=doc.get("metadata") or {})
+
+    async def invoke(self, app_id, method_path, http_method, query, headers, body):
+        path = f"/v1.0/invoke/{app_id}/method/" + method_path.lstrip("/")
+        if query:
+            path += f"?{query}"
+        return await self._request(http_method, path, headers=headers, data=body)
+
+    async def get_secret(self, store, key):
+        status, _, body = await self._request("GET", f"/v1.0/secrets/{store}/{key}")
+        if status >= 300:
+            self._raise(status, body, context=f"secret {store}")
+        return json.loads(body)
+
+    async def bulk_secrets(self, store):
+        status, _, body = await self._request("GET", f"/v1.0/secrets/{store}/bulk")
+        if status >= 300:
+            self._raise(status, body, context=f"secret {store}")
+        return json.loads(body)
+
+    async def close(self):
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class AppClient:
+    """The app-facing SDK. Create with ``AppClient.http()`` beside a
+    sidecar, or ``AppClient.direct(runtime)`` in-process."""
+
+    def __init__(self, transport: _Transport):
+        self._t = transport
+
+    @classmethod
+    def http(cls, port: int | None = None, host: str = "127.0.0.1") -> "AppClient":
+        if port is None:
+            port = int(os.environ.get(PORT_ENV, DEFAULT_SIDECAR_PORT))
+        return cls(_HTTPTransport(f"http://{host}:{port}"))
+
+    @classmethod
+    def direct(cls, runtime: Runtime) -> "AppClient":
+        return cls(_DirectTransport(runtime))
+
+    # -- state -----------------------------------------------------------
+
+    async def save_state(self, store: str, key: str, value: Any, *,
+                         etag: str | None = None) -> None:
+        item: dict[str, Any] = {"key": key, "value": value}
+        if etag is not None:
+            item["etag"] = etag
+        await self._t.save_state(store, [item])
+
+    async def save_state_bulk(self, store: str, items: list[dict]) -> None:
+        await self._t.save_state(store, items)
+
+    async def get_state(self, store: str, key: str) -> Any:
+        item = await self._t.get_state(store, key)
+        return None if item is None else item.value
+
+    async def get_state_item(self, store: str, key: str) -> StateItem | None:
+        return await self._t.get_state(store, key)
+
+    async def delete_state(self, store: str, key: str, *,
+                           etag: str | None = None) -> None:
+        await self._t.delete_state(store, key, etag)
+
+    async def query_state(self, store: str, query: dict) -> dict:
+        return await self._t.query_state(store, query)
+
+    async def query_state_values(self, store: str, query: dict) -> list[Any]:
+        return [r["data"] for r in (await self._t.query_state(store, query))["results"]]
+
+    async def transact_state(self, store: str, operations: list[dict]) -> None:
+        await self._t.transact_state(store, operations)
+
+    # -- pub/sub ---------------------------------------------------------
+
+    async def publish_event(self, pubsub: str, topic: str, data: Any, *,
+                            raw: bool = False) -> None:
+        await self._t.publish(pubsub, topic, data, raw)
+
+    # -- bindings --------------------------------------------------------
+
+    async def invoke_binding(self, name: str, operation: str, data: Any = None,
+                             metadata: dict[str, str] | None = None) -> BindingResponse:
+        return await self._t.invoke_binding(name, operation, data, metadata)
+
+    # -- invocation ------------------------------------------------------
+
+    async def invoke_method(self, app_id: str, method_path: str, *,
+                            http_method: str = "POST", data: Any = None,
+                            query: str = "",
+                            headers: dict[str, str] | None = None) -> InvocationResponse:
+        headers = dict(headers or {})
+        body = b""
+        if data is not None:
+            body = json.dumps(data).encode()
+            headers.setdefault("content-type", "application/json")
+        status, resp_headers, resp_body = await self._t.invoke(
+            app_id, method_path, http_method, query, headers, body)
+        return InvocationResponse(status, resp_headers, resp_body)
+
+    async def invoke_json(self, app_id: str, method_path: str, *,
+                          http_method: str = "GET", data: Any = None,
+                          query: str = "") -> Any:
+        resp = await self.invoke_method(
+            app_id, method_path, http_method=http_method, data=data, query=query)
+        return resp.raise_for_status().json()
+
+    # -- secrets ---------------------------------------------------------
+
+    async def get_secret(self, store: str, key: str) -> str:
+        return (await self._t.get_secret(store, key))[key]
+
+    async def bulk_secrets(self, store: str) -> dict[str, str]:
+        return await self._t.bulk_secrets(store)
+
+    async def close(self) -> None:
+        await self._t.close()

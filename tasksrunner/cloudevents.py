@@ -1,0 +1,55 @@
+"""CloudEvents 1.0 envelope helpers.
+
+The reference publishes through the sidecar which wraps payloads in
+CloudEvents, and the processor unwraps them with ``UseCloudEvents()``
+(TasksTracker.Processor.Backend.Svc/Program.cs:29). Same contract here:
+publish wraps, subscriber-side middleware unwraps, and raw payloads
+pass through untouched when the content-type isn't cloudevents+json.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any
+
+CONTENT_TYPE = "application/cloudevents+json"
+
+
+def wrap(
+    data: Any,
+    *,
+    source: str,
+    topic: str,
+    pubsub_name: str,
+    event_id: str | None = None,
+    data_content_type: str = "application/json",
+) -> dict:
+    return {
+        "specversion": "1.0",
+        "id": event_id or str(uuid.uuid4()),
+        "source": source,
+        "type": "com.tasksrunner.event.sent",
+        "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "datacontenttype": data_content_type,
+        "topic": topic,
+        "pubsubname": pubsub_name,
+        "data": data,
+    }
+
+
+def is_cloudevent(doc: Any) -> bool:
+    return isinstance(doc, dict) and "specversion" in doc and "data" in doc
+
+
+def unwrap(body: bytes, content_type: str | None) -> Any:
+    """Return the inner data if ``body`` is a CloudEvent, else the
+    JSON-decoded body (or raw bytes if not JSON)."""
+    try:
+        doc = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        return body
+    if (content_type or "").startswith(CONTENT_TYPE) or is_cloudevent(doc):
+        return doc.get("data")
+    return doc

@@ -1,0 +1,195 @@
+"""Hosting: run an App with its sidecar, in-process or over HTTP.
+
+Two shapes, behaviorally identical (SURVEY.md §7.4 hard part #1):
+
+* ``AppHost`` — the real thing: the app served on its app-port, a
+  sidecar process-mate on its sidecar-port, registration in the shared
+  name-resolver file. One AppHost per service process is what the
+  orchestrator launches — the analog of one ``dapr run --app-id X
+  --app-port P --dapr-http-port D`` terminal
+  (snippets/dapr-run-backend-api.md:4-16).
+* ``InProcCluster`` — every app + runtime in one event loop with
+  direct channels; the integration-test harness (the analog of the
+  VS Code compound launcher, .vscode/tasks.json) and the engine for
+  fast local dev.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+from tasksrunner.app import App
+from tasksrunner.client import AppClient
+from tasksrunner.component.loader import load_components
+from tasksrunner.component.registry import ComponentRegistry
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.invoke.resolver import AppAddress, NameResolver
+from tasksrunner.observability.tracing import (
+    TRACEPARENT_HEADER,
+    ensure_trace,
+    trace_scope,
+)
+from tasksrunner.runtime import HTTPAppChannel, InProcAppChannel, Runtime
+from tasksrunner.sidecar import Sidecar
+
+logger = logging.getLogger(__name__)
+
+
+def build_app_server(app: App) -> web.Application:
+    """aiohttp adapter serving an App over HTTP (the app's own port)."""
+
+    async def dispatch(request: web.Request) -> web.Response:
+        ctx = ensure_trace(request.headers.get(TRACEPARENT_HEADER))
+        with trace_scope(ctx):
+            body = await request.read()
+            resp = await app.handle(
+                request.method, request.path, query=request.query_string,
+                headers=dict(request.headers), body=body)
+            status, headers, payload = resp.encode()
+            return web.Response(status=status, body=payload, headers=headers)
+
+    server = web.Application(client_max_size=16 * 1024 * 1024)
+    server.router.add_route("*", "/{path:.*}", dispatch)
+    return server
+
+
+class AppHost:
+    """App server + sidecar for one service, in one process."""
+
+    def __init__(
+        self,
+        app: App,
+        *,
+        components_path: str | None = None,
+        specs: list[ComponentSpec] | None = None,
+        app_port: int = 0,
+        sidecar_port: int = 0,
+        host: str = "127.0.0.1",
+        registry_file: str | None = None,
+        resolver: NameResolver | None = None,
+    ):
+        self.app = app
+        self.host = host
+        self.app_port = app_port
+        self.sidecar_port = sidecar_port
+        if specs is None:
+            specs = load_components(components_path) if components_path else []
+        self.specs = specs
+        self.resolver = resolver or NameResolver(registry_file=registry_file)
+        self._app_runner: web.AppRunner | None = None
+        self.sidecar: Sidecar | None = None
+        self.client: AppClient | None = None
+
+    async def start(self) -> None:
+        # 1. the app's own HTTP server
+        self._app_runner = web.AppRunner(build_app_server(self.app))
+        await self._app_runner.setup()
+        site = web.TCPSite(self._app_runner, self.host, self.app_port)
+        await site.start()
+        if self.app_port == 0:
+            self.app_port = self._app_runner.addresses[0][1]
+
+        # 2. the sidecar beside it
+        registry = ComponentRegistry(self.specs, app_id=self.app.app_id)
+        runtime = Runtime(
+            self.app.app_id, registry, resolver=self.resolver,
+            app_channel=HTTPAppChannel(self.host, self.app_port),
+        )
+        self.sidecar = Sidecar(runtime, host=self.host, port=self.sidecar_port)
+        await self.sidecar.start()
+        self.sidecar_port = self.sidecar.port
+
+        # 3. register for peer discovery, hand the app its client
+        self.resolver.register(AppAddress(
+            app_id=self.app.app_id, host=self.host,
+            sidecar_port=self.sidecar_port, app_port=self.app_port,
+        ))
+        self.client = AppClient.http(self.sidecar_port, self.host)
+        self.app.client = self.client
+        await self.app.startup()
+        logger.info("app %s on :%d, sidecar on :%d",
+                    self.app.app_id, self.app_port, self.sidecar_port)
+
+    async def stop(self) -> None:
+        await self.app.shutdown()
+        self.resolver.unregister(self.app.app_id)
+        if self.client is not None:
+            await self.client.close()
+        if self.sidecar is not None:
+            await self.sidecar.stop()
+        if self._app_runner is not None:
+            await self._app_runner.cleanup()
+            self._app_runner = None
+
+
+class InProcCluster:
+    """N apps + N runtimes in one event loop, no sockets.
+
+    Each app still gets its *own* scoped component registry and its own
+    runtime — only the transport differs from production.
+    """
+
+    def __init__(self, specs: list[ComponentSpec] | None = None):
+        self.specs = specs or []
+        self.apps: dict[str, App] = {}
+        self.runtimes: dict[str, Runtime] = {}
+        self._channels: dict[str, InProcAppChannel] = {}
+        #: component instances shared across apps by name (a broker
+        #: must be one object for publisher and subscriber in-proc)
+        self._shared_instances: dict[str, object] = {}
+
+    def add_app(self, app: App) -> None:
+        self.apps[app.app_id] = app
+
+    def _make_registry(self, app_id: str) -> ComponentRegistry:
+        reg = ComponentRegistry(self.specs, app_id=app_id)
+        # share instances across apps: first builder wins, others reuse
+        original_get = reg.get
+
+        def sharing_get(name: str, *, block: str | None = None):
+            if name in self._shared_instances:
+                spec = reg.spec(name)  # scope + block checks still apply
+                if block is not None and spec.block != block:
+                    original_get(name, block=block)  # raises consistently
+                reg._instances[name] = self._shared_instances[name]
+                return self._shared_instances[name]
+            instance = original_get(name, block=block)
+            self._shared_instances[name] = instance
+            return instance
+
+        reg.get = sharing_get  # type: ignore[method-assign]
+        return reg
+
+    async def start(self) -> None:
+        for app_id, app in self.apps.items():
+            channel = InProcAppChannel(app)
+            self._channels[app_id] = channel
+            runtime = Runtime(app_id, self._make_registry(app_id),
+                              app_channel=channel)
+            self.runtimes[app_id] = runtime
+            app.client = AppClient.direct(runtime)
+        # wire peers after all channels exist
+        for app_id, runtime in self.runtimes.items():
+            runtime.peers = {
+                other: ch for other, ch in self._channels.items() if other != app_id
+            }
+        for app_id, app in self.apps.items():
+            await app.startup()
+            await self.runtimes[app_id].start()
+
+    async def stop(self) -> None:
+        for app_id, app in self.apps.items():
+            await app.shutdown()
+        seen: set[int] = set()
+        for runtime in self.runtimes.values():
+            # shared instances: make sure each closes exactly once
+            for name, inst in list(runtime.registry._instances.items()):
+                if id(inst) in seen:
+                    runtime.registry._instances.pop(name)
+                seen.add(id(inst))
+            await runtime.stop()
+
+    def client(self, app_id: str) -> AppClient:
+        return self.apps[app_id].client

@@ -1,0 +1,3 @@
+from tasksrunner.invoke.resolver import AppAddress, NameResolver
+
+__all__ = ["AppAddress", "NameResolver"]

@@ -1,0 +1,140 @@
+"""App-id name resolution for service invocation.
+
+The reference's sidecar resolves ``InvokeMethodAsync(..., "tasksmanager-
+backend-api", ...)`` to a peer sidecar by app-id (mDNS locally, the ACA
+control plane in the cloud — docs/aca/03-aca-dapr-integration/index.md:
+107-127). Here the registry is a JSON file shared by all local
+sidecars: each sidecar registers itself on startup, peers re-read on
+miss or mtime change. A static in-memory mode serves tests and
+single-process setups.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import tempfile
+import time
+from dataclasses import asdict, dataclass
+
+from tasksrunner.errors import AppNotFound
+
+
+@dataclass
+class AppAddress:
+    app_id: str
+    host: str
+    sidecar_port: int
+    app_port: int | None = None
+    pid: int | None = None
+    registered_at: float = 0.0
+
+    @property
+    def base_url(self) -> str:
+        return f"http://{self.host}:{self.sidecar_port}"
+
+
+class NameResolver:
+    """app-id → AppAddress, backed by a static table and/or a registry file."""
+
+    def __init__(self, *, registry_file: str | pathlib.Path | None = None,
+                 static: dict[str, AppAddress] | None = None):
+        self.registry_file = pathlib.Path(registry_file) if registry_file else None
+        self._static = dict(static or {})
+        self._cache: dict[str, AppAddress] = {}
+        self._mtime = 0.0
+
+    # -- registration ----------------------------------------------------
+
+    def register(self, addr: AppAddress) -> None:
+        addr.registered_at = time.time()
+        if addr.pid is None:
+            addr.pid = os.getpid()
+        if self.registry_file is None:
+            self._static[addr.app_id] = addr
+            return
+        self._mutate(lambda entries: entries.__setitem__(addr.app_id, asdict(addr)))
+
+    def unregister(self, app_id: str) -> None:
+        if self.registry_file is None:
+            self._static.pop(app_id, None)
+            return
+        self._mutate(lambda entries: entries.pop(app_id, None))
+
+    def _mutate(self, fn) -> None:
+        """Atomic read-modify-write with a lock file (cross-process)."""
+        assert self.registry_file is not None
+        self.registry_file.parent.mkdir(parents=True, exist_ok=True)
+        lock = self.registry_file.with_suffix(".lock")
+        deadline = time.time() + 5.0
+        while True:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                break
+            except FileExistsError:
+                if time.time() > deadline:
+                    # stale lock (holder crashed): steal it
+                    try:
+                        lock.unlink()
+                    except FileNotFoundError:
+                        pass
+                time.sleep(0.01)
+        try:
+            entries = self._read_file()
+            fn(entries)
+            tmp_fd, tmp_path = tempfile.mkstemp(dir=self.registry_file.parent)
+            with os.fdopen(tmp_fd, "w") as f:
+                json.dump(entries, f, indent=2)
+            os.replace(tmp_path, self.registry_file)
+        finally:
+            os.close(fd)
+            try:
+                lock.unlink()
+            except FileNotFoundError:
+                pass
+
+    def _read_file(self) -> dict[str, dict]:
+        if self.registry_file is None or not self.registry_file.is_file():
+            return {}
+        try:
+            return json.loads(self.registry_file.read_text() or "{}")
+        except ValueError:
+            return {}
+
+    # -- resolution ------------------------------------------------------
+
+    def _refresh(self) -> None:
+        if self.registry_file is None:
+            return
+        try:
+            mtime = self.registry_file.stat().st_mtime
+        except OSError:
+            return
+        if mtime == self._mtime:
+            return
+        self._mtime = mtime
+        self._cache = {
+            app_id: AppAddress(**entry) for app_id, entry in self._read_file().items()
+        }
+
+    def resolve(self, app_id: str) -> AppAddress:
+        if app_id in self._static:
+            return self._static[app_id]
+        self._refresh()
+        if app_id in self._cache:
+            return self._cache[app_id]
+        # force one re-read in case the peer registered this instant
+        self._mtime = 0.0
+        self._refresh()
+        try:
+            return self._cache[app_id]
+        except KeyError:
+            known = sorted({*self._static, *self._cache})
+            raise AppNotFound(
+                f"no app registered with id {app_id!r} (known: {known})"
+            ) from None
+
+    def known_apps(self) -> list[str]:
+        self._refresh()
+        return sorted({*self._static, *self._cache})

@@ -1,0 +1,13 @@
+from tasksrunner.observability.tracing import TraceContext, current_trace, trace_scope
+from tasksrunner.observability.logging import configure_logging, service_logger
+from tasksrunner.observability.metrics import MetricsRegistry, metrics
+
+__all__ = [
+    "TraceContext",
+    "current_trace",
+    "trace_scope",
+    "configure_logging",
+    "service_logger",
+    "MetricsRegistry",
+    "metrics",
+]

@@ -1,0 +1,50 @@
+"""Structured logging with service-role tagging and trace correlation.
+
+Replicates the reference's observability posture (SURVEY.md §5.1, §5.5):
+every service logs through ``ILogger<T>`` with a Cloud.RoleName set by
+AppInsightsTelemetryInitializer.cs so the three services are
+distinguishable in one stream. Here: a logfmt-ish line format carrying
+``role=<app-id>`` and ``trace=<trace-id>`` on every record, so the
+orchestrator's multiplexed output is greppable per service and per
+transaction.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from tasksrunner.observability.tracing import current_trace
+
+
+class _RoleTraceFilter(logging.Filter):
+    def __init__(self, role: str):
+        super().__init__()
+        self.role = role
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.role = self.role
+        ctx = current_trace()
+        record.trace = ctx.trace_id[:16] if ctx else "-"
+        return True
+
+
+FORMAT = "%(asctime)s %(levelname)-7s role=%(role)s trace=%(trace)s %(name)s :: %(message)s"
+
+
+def configure_logging(role: str, *, level: int = logging.INFO,
+                      stream=None) -> logging.Logger:
+    """Configure the root logger for one service process."""
+    root = logging.getLogger()
+    root.setLevel(level)
+    for h in list(root.handlers):
+        root.removeHandler(h)
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(logging.Formatter(FORMAT))
+    handler.addFilter(_RoleTraceFilter(role))
+    root.addHandler(handler)
+    return root
+
+
+def service_logger(name: str) -> logging.Logger:
+    return logging.getLogger(name)

@@ -1,0 +1,55 @@
+"""In-process metrics counters/gauges, exported via the sidecar's
+``/v1.0/metadata`` route.
+
+The reference's metrics (CPU/memory/replica counts, request rates) come
+from the platform + App Insights (SURVEY.md §5.5); the framework-level
+equivalents here are request/publish/delivery counters every sidecar
+maintains, which the orchestrator and autoscaler read.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, float] = defaultdict(float)
+        self._gauges: dict[str, float] = {}
+        self.started_at = time.time()
+
+    def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
+        with self._lock:
+            self._counters[self._key(name, labels)] += value
+
+    def set_gauge(self, name: str, value: float, **labels: str) -> None:
+        with self._lock:
+            self._gauges[self._key(name, labels)] = value
+
+    def get(self, name: str, **labels: str) -> float:
+        key = self._key(name, labels)
+        with self._lock:
+            if key in self._gauges:
+                return self._gauges[key]
+            return self._counters.get(key, 0.0)
+
+    @staticmethod
+    def _key(name: str, labels: dict[str, str]) -> str:
+        if not labels:
+            return name
+        tag = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        return f"{name}{{{tag}}}"
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            out = dict(self._counters)
+            out.update(self._gauges)
+            out["uptime_seconds"] = time.time() - self.started_at
+            return out
+
+
+#: process-global default registry
+metrics = MetricsRegistry()

@@ -1,0 +1,83 @@
+"""W3C trace-context propagation across invoke/pubsub/binding hops.
+
+The reference gets distributed tracing from the App Insights SDK plus
+sidecar telemetry (SURVEY.md §5.1): one logical operation (create task
+→ state write → publish → processor handle) renders as a single
+transaction across three services. Here the same capability is carried
+by ``traceparent`` headers: generated at the first ingress, propagated
+through every sidecar hop and into pub/sub message metadata, and
+attached to structured logs so logs from all services correlate.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import secrets
+from contextlib import contextmanager
+from dataclasses import dataclass, field, replace
+
+TRACEPARENT_HEADER = "traceparent"
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    trace_id: str  # 32 hex chars
+    span_id: str   # 16 hex chars
+    flags: str = "01"
+    #: spans recorded locally under this trace (exported via /v1.0/metadata)
+    baggage: dict = field(default_factory=dict)
+
+    @classmethod
+    def new(cls) -> "TraceContext":
+        return cls(trace_id=secrets.token_hex(16), span_id=secrets.token_hex(8))
+
+    @classmethod
+    def parse(cls, header: str | None) -> "TraceContext | None":
+        if not header:
+            return None
+        parts = header.split("-")
+        if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+            return None
+        return cls(trace_id=parts[1], span_id=parts[2], flags=parts[3])
+
+    def child(self) -> "TraceContext":
+        return replace(self, span_id=secrets.token_hex(8))
+
+    @property
+    def header(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{self.flags}"
+
+
+_current: contextvars.ContextVar[TraceContext | None] = contextvars.ContextVar(
+    "tasksrunner_trace", default=None
+)
+
+
+def current_trace() -> TraceContext | None:
+    return _current.get()
+
+
+def ensure_trace(incoming_header: str | None = None) -> TraceContext:
+    """Adopt the incoming context (new child span) or start a new trace."""
+    ctx = TraceContext.parse(incoming_header)
+    ctx = ctx.child() if ctx else TraceContext.new()
+    _current.set(ctx)
+    return ctx
+
+
+@contextmanager
+def trace_scope(ctx: TraceContext):
+    token = _current.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _current.reset(token)
+
+
+def outgoing_headers() -> dict[str, str]:
+    """Headers to attach to an outbound hop (child span of current)."""
+    ctx = current_trace()
+    if ctx is None:
+        ctx = TraceContext.new()
+        _current.set(ctx)
+    return {TRACEPARENT_HEADER: ctx.child().header}

@@ -1,0 +1,11 @@
+from tasksrunner.pubsub.base import Message, PubSubBroker, Subscription
+from tasksrunner.pubsub.memory import InMemoryBroker
+from tasksrunner.pubsub.sqlite import SqliteBroker
+
+__all__ = [
+    "Message",
+    "PubSubBroker",
+    "Subscription",
+    "InMemoryBroker",
+    "SqliteBroker",
+]

@@ -1,0 +1,73 @@
+"""Pub/sub building-block interface.
+
+Semantics replicated from the reference (SURVEY.md §2.4, §5.8):
+
+* topic-based fan-out: every *consumer group* (≙ a Service Bus
+  subscription, named after the consuming app-id —
+  bicep/modules/service-bus.bicep:55-57) receives each message;
+* **competing consumers** within one group: replicas of the same app
+  share the group, each message goes to exactly one of them;
+* **at-least-once** delivery: a handler outcome of "nack" (non-2xx in
+  the reference, docs/aca/05-aca-dapr-pubsubapi, §3.4 ack contract)
+  makes the message visible again for redelivery;
+* durability is broker-dependent: groups outlive their subscribers, so
+  consumers need not be up when messages arrive
+  (docs/aca/05-aca-dapr-pubsubapi/index.md:27-29) — the sqlite broker
+  honors this, the in-memory broker only within its process.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable
+
+
+@dataclass
+class Message:
+    id: str
+    topic: str
+    data: Any
+    #: transport metadata (content-type etc.)
+    metadata: dict[str, str] = field(default_factory=dict)
+    #: delivery attempt counter, 1-based
+    attempt: int = 1
+
+
+#: Handler returns True to ack, False to nack (→ redelivery). A raised
+#: exception counts as nack.
+Handler = Callable[[Message], Awaitable[bool]]
+
+
+@dataclass
+class Subscription:
+    topic: str
+    group: str
+    _cancel: Callable[[], Awaitable[None]] | None = None
+
+    async def cancel(self) -> None:
+        if self._cancel is not None:
+            await self._cancel()
+            self._cancel = None
+
+
+class PubSubBroker(abc.ABC):
+    def __init__(self, name: str):
+        self.name = name
+
+    @abc.abstractmethod
+    async def publish(self, topic: str, data: Any, *, metadata: dict[str, str] | None = None) -> str:
+        """Publish; returns the message id."""
+
+    @abc.abstractmethod
+    async def subscribe(self, topic: str, group: str, handler: Handler) -> Subscription:
+        """Register ``handler`` as one competing consumer in ``group``."""
+
+    @abc.abstractmethod
+    async def ensure_group(self, topic: str, group: str) -> None:
+        """Create the durable group without attaching a consumer (≙ the
+        Bicep-provisioned Service Bus subscription existing before the
+        app ever runs)."""
+
+    async def aclose(self) -> None:  # pragma: no cover - default no-op
+        pass

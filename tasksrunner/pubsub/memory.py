@@ -1,0 +1,117 @@
+"""In-process pub/sub broker.
+
+The zero-dependency test double for the broker slot (reference local
+slot: Redis via components/dapr-pubsub-redis.yaml). Honors the full
+delivery contract — per-group fan-out, round-robin competing consumers,
+nack → redelivery with bounded retries — but only within one process
+and without durability across restarts (use SqliteBroker for that).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import uuid
+from collections import defaultdict
+from typing import Any
+
+from tasksrunner.component.registry import driver
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.pubsub.base import Handler, Message, PubSubBroker, Subscription
+
+logger = logging.getLogger(__name__)
+
+
+class _Group:
+    """One consumer group on one topic: a queue + competing consumers."""
+
+    def __init__(self) -> None:
+        self.queue: asyncio.Queue[Message] = asyncio.Queue()
+        self.consumers: list[Handler] = []
+        self.rr = itertools.count()
+        self.pump: asyncio.Task | None = None
+
+
+class InMemoryBroker(PubSubBroker):
+    def __init__(self, name: str = "memory", *, max_attempts: int = 3,
+                 retry_delay: float = 0.05):
+        super().__init__(name)
+        self.max_attempts = max_attempts
+        self.retry_delay = retry_delay
+        self._groups: dict[str, dict[str, _Group]] = defaultdict(dict)
+        #: messages that exhausted retries (inspectable dead-letter list)
+        self.dead_letters: list[Message] = []
+        self._closed = False
+
+    async def publish(self, topic: str, data: Any, *, metadata=None) -> str:
+        msg_id = str(uuid.uuid4())
+        for group in self._groups.get(topic, {}).values():
+            group.queue.put_nowait(
+                Message(id=msg_id, topic=topic, data=data, metadata=dict(metadata or {}))
+            )
+        return msg_id
+
+    async def ensure_group(self, topic: str, group: str) -> None:
+        if group not in self._groups[topic]:
+            self._groups[topic][group] = _Group()
+
+    async def subscribe(self, topic: str, group: str, handler: Handler) -> Subscription:
+        await self.ensure_group(topic, group)
+        g = self._groups[topic][group]
+        g.consumers.append(handler)
+        if g.pump is None:
+            g.pump = asyncio.create_task(self._pump(topic, group, g))
+
+        async def cancel() -> None:
+            if handler in g.consumers:
+                g.consumers.remove(handler)
+            if not g.consumers and g.pump is not None:
+                g.pump.cancel()
+                g.pump = None
+
+        return Subscription(topic=topic, group=group, _cancel=cancel)
+
+    async def _pump(self, topic: str, group_name: str, g: _Group) -> None:
+        while not self._closed:
+            msg = await g.queue.get()
+            if not g.consumers:
+                # group exists but no live consumer: park it back and wait
+                await asyncio.sleep(self.retry_delay)
+                g.queue.put_nowait(msg)
+                continue
+            handler = g.consumers[next(g.rr) % len(g.consumers)]
+            try:
+                ok = await handler(msg)
+            except Exception:
+                logger.exception("handler error on topic %s group %s", topic, group_name)
+                ok = False
+            if not ok:
+                if msg.attempt >= self.max_attempts:
+                    logger.warning(
+                        "dead-lettering message %s on %s/%s after %d attempts",
+                        msg.id, topic, group_name, msg.attempt,
+                    )
+                    self.dead_letters.append(msg)
+                else:
+                    msg.attempt += 1
+                    asyncio.get_running_loop().call_later(
+                        self.retry_delay, g.queue.put_nowait, msg
+                    )
+
+    async def aclose(self) -> None:
+        self._closed = True
+        for groups in self._groups.values():
+            for g in groups.values():
+                if g.pump is not None:
+                    g.pump.cancel()
+                    g.pump = None
+
+
+@driver("pubsub.in-memory", "pubsub.memory")
+def _memory_pubsub(spec: ComponentSpec, metadata: dict[str, str]) -> InMemoryBroker:
+    return InMemoryBroker(
+        spec.name,
+        max_attempts=int(metadata.get("maxRetries", 3)),
+        retry_delay=float(metadata.get("retryDelaySeconds", 0.05)),
+    )

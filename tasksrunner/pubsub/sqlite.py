@@ -1,0 +1,272 @@
+"""SQLite-backed durable pub/sub broker.
+
+Fills the slot Azure Service Bus fills in the reference
+(components/dapr-pubsub-svcbus.yaml, type ``pubsub.azure.servicebus``)
+and Redis fills locally: a shared broker reachable by every app's
+sidecar process through one database file. Delivery contract
+(at-least-once, per-group fan-out, competing consumers via claim
+leases, bounded redelivery then dead-letter) matches
+tasksrunner/pubsub/base.py.
+
+The visible backlog per group (`backlog()`) is the scale signal the
+KEDA-style autoscaler watches — the analog of the `azure-servicebus`
+scaler's messageCount on a topic subscription
+(bicep/modules/container-apps/processor-backend-service.bicep:158-180).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import pathlib
+import sqlite3
+import time
+import uuid
+from typing import Any
+
+from tasksrunner.component.registry import driver
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.pubsub.base import Handler, Message, PubSubBroker, Subscription
+
+logger = logging.getLogger(__name__)
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS groups (
+    topic TEXT NOT NULL,
+    grp   TEXT NOT NULL,
+    PRIMARY KEY (topic, grp)
+);
+CREATE TABLE IF NOT EXISTS messages (
+    id       TEXT PRIMARY KEY,
+    topic    TEXT NOT NULL,
+    data     TEXT NOT NULL,
+    metadata TEXT NOT NULL,
+    created  REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS deliveries (
+    msg_id        TEXT NOT NULL,
+    topic         TEXT NOT NULL,
+    grp           TEXT NOT NULL,
+    attempts      INTEGER NOT NULL DEFAULT 0,
+    visible_at    REAL NOT NULL,
+    claimed_until REAL NOT NULL DEFAULT 0,
+    done          INTEGER NOT NULL DEFAULT 0,  -- 0 pending, 1 acked, 2 dead
+    PRIMARY KEY (msg_id, grp)
+);
+CREATE INDEX IF NOT EXISTS idx_deliveries_pending
+    ON deliveries (topic, grp, done, visible_at);
+"""
+
+
+class SqliteBroker(PubSubBroker):
+    def __init__(
+        self,
+        name: str,
+        path: str | pathlib.Path,
+        *,
+        max_attempts: int = 3,
+        retry_delay: float = 0.2,
+        claim_lease: float = 30.0,
+        poll_interval: float = 0.05,
+    ):
+        super().__init__(name)
+        self.path = str(path)
+        if self.path != ":memory:":
+            pathlib.Path(self.path).parent.mkdir(parents=True, exist_ok=True)
+        self.max_attempts = max_attempts
+        self.retry_delay = retry_delay
+        self.claim_lease = claim_lease
+        self.poll_interval = poll_interval
+        self._conn = sqlite3.connect(self.path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA busy_timeout=5000")
+        self._conn.executescript(_SCHEMA)
+        self._conn.commit()
+        self._tasks: list[asyncio.Task] = []
+        self._closed = False
+
+    # -- publish ---------------------------------------------------------
+
+    async def publish(self, topic: str, data: Any, *, metadata=None) -> str:
+        msg_id = str(uuid.uuid4())
+        now = time.time()
+        cur = self._conn.cursor()
+        try:
+            cur.execute("BEGIN IMMEDIATE")
+            cur.execute(
+                "INSERT INTO messages(id, topic, data, metadata, created) VALUES (?,?,?,?,?)",
+                (msg_id, topic, json.dumps(data), json.dumps(dict(metadata or {})), now),
+            )
+            groups = [r[0] for r in cur.execute(
+                "SELECT grp FROM groups WHERE topic = ?", (topic,)
+            ).fetchall()]
+            for grp in groups:
+                cur.execute(
+                    "INSERT INTO deliveries(msg_id, topic, grp, visible_at) VALUES (?,?,?,?)",
+                    (msg_id, topic, grp, now),
+                )
+            self._conn.commit()
+        except BaseException:
+            self._conn.rollback()
+            raise
+        return msg_id
+
+    async def ensure_group(self, topic: str, group: str) -> None:
+        self._conn.execute(
+            "INSERT OR IGNORE INTO groups(topic, grp) VALUES (?, ?)", (topic, group)
+        )
+        self._conn.commit()
+
+    # -- consume ---------------------------------------------------------
+
+    def _claim_one(self, topic: str, group: str) -> Message | None:
+        now = time.time()
+        cur = self._conn.cursor()
+        try:
+            cur.execute("BEGIN IMMEDIATE")
+            row = cur.execute(
+                "SELECT d.msg_id, d.attempts, m.data, m.metadata FROM deliveries d "
+                "JOIN messages m ON m.id = d.msg_id "
+                "WHERE d.topic = ? AND d.grp = ? AND d.done = 0 "
+                "AND d.visible_at <= ? AND d.claimed_until <= ? "
+                "ORDER BY d.visible_at LIMIT 1",
+                (topic, group, now, now),
+            ).fetchone()
+            if row is None:
+                self._conn.commit()
+                return None
+            msg_id, attempts, data, metadata = row
+            cur.execute(
+                "UPDATE deliveries SET claimed_until = ?, attempts = attempts + 1 "
+                "WHERE msg_id = ? AND grp = ?",
+                (now + self.claim_lease, msg_id, group),
+            )
+            self._conn.commit()
+        except BaseException:
+            self._conn.rollback()
+            raise
+        return Message(
+            id=msg_id, topic=topic, data=json.loads(data),
+            metadata=json.loads(metadata), attempt=attempts + 1,
+        )
+
+    def _ack(self, msg_id: str, group: str) -> None:
+        self._conn.execute(
+            "UPDATE deliveries SET done = 1 WHERE msg_id = ? AND grp = ?",
+            (msg_id, group),
+        )
+        self._conn.commit()
+
+    def _nack(self, msg: Message, group: str) -> None:
+        if msg.attempt >= self.max_attempts:
+            logger.warning(
+                "dead-lettering message %s on %s/%s after %d attempts",
+                msg.id, msg.topic, group, msg.attempt,
+            )
+            self._conn.execute(
+                "UPDATE deliveries SET done = 2 WHERE msg_id = ? AND grp = ?",
+                (msg.id, group),
+            )
+        else:
+            self._conn.execute(
+                "UPDATE deliveries SET visible_at = ?, claimed_until = 0 "
+                "WHERE msg_id = ? AND grp = ?",
+                (time.time() + self.retry_delay, msg.id, group),
+            )
+        self._conn.commit()
+
+    async def subscribe(self, topic: str, group: str, handler: Handler) -> Subscription:
+        await self.ensure_group(topic, group)
+        stop = asyncio.Event()
+
+        async def poll_loop() -> None:
+            while not stop.is_set() and not self._closed:
+                msg = self._claim_one(topic, group)
+                if msg is None:
+                    try:
+                        await asyncio.wait_for(stop.wait(), timeout=self.poll_interval)
+                    except asyncio.TimeoutError:
+                        pass
+                    continue
+                try:
+                    ok = await handler(msg)
+                except Exception:
+                    logger.exception("handler error on topic %s group %s", topic, group)
+                    ok = False
+                if ok:
+                    self._ack(msg.id, group)
+                else:
+                    self._nack(msg, group)
+
+        task = asyncio.create_task(poll_loop())
+        self._tasks.append(task)
+
+        async def cancel() -> None:
+            stop.set()
+            try:
+                await task
+            except asyncio.CancelledError:
+                # broker.aclose() may have force-cancelled the poll loop
+                # already (shared broker, multiple runtimes)
+                pass
+
+        return Subscription(topic=topic, group=group, _cancel=cancel)
+
+    # -- introspection ---------------------------------------------------
+
+    def backlog(self, topic: str, group: str) -> int:
+        """Visible, un-acked message count — the autoscale signal."""
+        (n,) = self._conn.execute(
+            "SELECT COUNT(*) FROM deliveries WHERE topic = ? AND grp = ? AND done = 0",
+            (topic, group),
+        ).fetchone()
+        return n
+
+    def dead_letters(self, topic: str, group: str) -> list[str]:
+        rows = self._conn.execute(
+            "SELECT msg_id FROM deliveries WHERE topic = ? AND grp = ? AND done = 2",
+            (topic, group),
+        ).fetchall()
+        return [r[0] for r in rows]
+
+    def gc(self, *, older_than: float = 3600.0) -> int:
+        """Drop messages fully settled in every group."""
+        cutoff = time.time() - older_than
+        cur = self._conn.execute(
+            "DELETE FROM messages WHERE created < ? AND NOT EXISTS "
+            "(SELECT 1 FROM deliveries d WHERE d.msg_id = messages.id AND d.done = 0)",
+            (cutoff,),
+        )
+        self._conn.execute(
+            "DELETE FROM deliveries WHERE done != 0 AND NOT EXISTS "
+            "(SELECT 1 FROM messages m WHERE m.id = deliveries.msg_id)"
+        )
+        self._conn.commit()
+        return cur.rowcount
+
+    async def aclose(self) -> None:
+        self._closed = True
+        for task in self._tasks:
+            task.cancel()
+        for task in self._tasks:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+        self._conn.close()
+
+
+@driver("pubsub.sqlite", "pubsub.azure.servicebus", "pubsub.redis")
+def _sqlite_pubsub(spec: ComponentSpec, metadata: dict[str, str]) -> SqliteBroker:
+    """Durable local broker; cloud/redis-typed component files (the
+    reference's dapr-pubsub-svcbus.yaml / dapr-pubsub-redis.yaml shapes)
+    run unchanged against it. `brokerPath` picks the shared db file."""
+    return SqliteBroker(
+        spec.name,
+        metadata.get("brokerPath", ".tasksrunner/pubsub-" + spec.name + ".db"),
+        max_attempts=int(metadata.get("maxRetries", 3)),
+        retry_delay=float(metadata.get("retryDelaySeconds", 0.2)),
+        poll_interval=float(metadata.get("pollIntervalSeconds", 0.05)),
+    )

@@ -1,0 +1,389 @@
+"""The runtime core — every building-block operation, transport-neutral.
+
+This is the sidecar's brain: one ``Runtime`` per app identity, holding
+that app's scoped ``ComponentRegistry`` and a channel to the app
+itself. The HTTP sidecar (tasksrunner/sidecar.py) adapts it onto
+Dapr-shaped routes; the in-process client drives it directly. Keeping
+one implementation behind both transports is what makes the two modes
+behaviorally identical (SURVEY.md §7.4 "sidecar process model").
+
+Capabilities and their reference anchors:
+
+* state CRUD/query with {app-id}||{key} prefixing —
+  Services/TasksStoreManager.cs, docs module 4;
+* pub/sub publish with CloudEvents wrap + consumer delivery with
+  at-least-once ack — docs module 5, Processor Program.cs:29-33;
+* input bindings (cron/queue) delivered to app routes, output bindings
+  invoked by name — docs modules 6-7;
+* service invocation by app-id through peer sidecars — docs module 3;
+* secret reads — docs module 9 / SURVEY.md §5.6;
+* trace propagation on every hop — SURVEY.md §5.1.
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import json
+import logging
+from typing import Any
+
+from tasksrunner import cloudevents
+from tasksrunner.app import App
+from tasksrunner.bindings.base import BindingEvent, InputBinding, OutputBinding
+from tasksrunner.component.registry import ComponentRegistry
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.errors import (
+    BindingError,
+    ComponentNotFound,
+    InvocationError,
+    StateError,
+)
+from tasksrunner.invoke.resolver import NameResolver
+from tasksrunner.observability.metrics import metrics
+from tasksrunner.observability.tracing import (
+    TRACEPARENT_HEADER,
+    ensure_trace,
+    outgoing_headers,
+    trace_scope,
+)
+from tasksrunner.pubsub.base import Message, PubSubBroker
+from tasksrunner.state.base import StateStore, TransactionOp
+from tasksrunner.state.keyprefix import KeyPrefixer
+
+logger = logging.getLogger(__name__)
+
+
+class AppChannel(abc.ABC):
+    """How the runtime reaches its application."""
+
+    @abc.abstractmethod
+    async def request(self, method: str, path: str, *, query: str = "",
+                      headers: dict[str, str] | None = None,
+                      body: bytes = b"") -> tuple[int, dict[str, str], bytes]: ...
+
+    async def close(self) -> None:  # pragma: no cover - default no-op
+        pass
+
+
+class InProcAppChannel(AppChannel):
+    """Direct dispatch into an ``App`` object (test / single-process mode)."""
+
+    def __init__(self, app: App):
+        self.app = app
+
+    async def request(self, method, path, *, query="", headers=None, body=b""):
+        resp = await self.app.handle(method, path, query=query,
+                                     headers=headers, body=body)
+        return resp.encode()
+
+
+class HTTPAppChannel(AppChannel):
+    """HTTP dispatch to the app process (sidecar mode)."""
+
+    def __init__(self, host: str, port: int):
+        self.base = f"http://{host}:{port}"
+        self._session = None
+
+    async def _ensure_session(self):
+        if self._session is None:
+            import aiohttp
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def request(self, method, path, *, query="", headers=None, body=b""):
+        session = await self._ensure_session()
+        url = self.base + path + (f"?{query}" if query else "")
+        try:
+            async with session.request(method, url, headers=headers or {},
+                                       data=body) as resp:
+                return resp.status, dict(resp.headers), await resp.read()
+        except OSError as exc:
+            raise InvocationError(f"app unreachable at {url}: {exc}") from exc
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+class Runtime:
+    def __init__(
+        self,
+        app_id: str | None,
+        registry: ComponentRegistry,
+        *,
+        resolver: NameResolver | None = None,
+        app_channel: AppChannel | None = None,
+    ):
+        self.app_id = app_id
+        self.registry = registry
+        self.resolver = resolver or NameResolver()
+        self.app_channel = app_channel
+        #: in-process peer channels (app-id → AppChannel); consulted
+        #: before name resolution so a single-process cluster can route
+        #: invokes without HTTP (must stay behaviorally identical to the
+        #: sidecar path — same headers, same status mapping)
+        self.peers: dict[str, AppChannel] = {}
+        self._subscriptions = []
+        self._input_bindings: list[InputBinding] = []
+        self._session = None  # outbound aiohttp session for peer invokes
+        self._started = False
+
+    # -- helpers ---------------------------------------------------------
+
+    def _state_store(self, name: str) -> tuple[StateStore, KeyPrefixer]:
+        store = self.registry.get(name, block="state")
+        spec: ComponentSpec = self.registry.spec(name)
+        raw = spec.metadata.get("keyPrefix")
+        strategy = raw if isinstance(raw, str) else "appid"
+        prefixer = KeyPrefixer(strategy, app_id=self.app_id, component_name=name)
+        return store, prefixer
+
+    # -- state -----------------------------------------------------------
+
+    async def save_state(self, store_name: str, items: list[dict]) -> None:
+        store, prefixer = self._state_store(store_name)
+        for item in items:
+            if "key" not in item:
+                raise StateError("each state item needs a key")
+            await store.set(prefixer.apply(str(item["key"])), item.get("value"),
+                            etag=item.get("etag"))
+        metrics.inc("state_save", len(items), store=store_name)
+
+    async def get_state(self, store_name: str, key: str):
+        store, prefixer = self._state_store(store_name)
+        metrics.inc("state_get", store=store_name)
+        return await store.get(prefixer.apply(key))
+
+    async def delete_state(self, store_name: str, key: str, *, etag=None) -> bool:
+        store, prefixer = self._state_store(store_name)
+        metrics.inc("state_delete", store=store_name)
+        return await store.delete(prefixer.apply(key), etag=etag)
+
+    async def query_state(self, store_name: str, query: dict) -> dict:
+        store, prefixer = self._state_store(store_name)
+        resp = await store.query(query, key_prefix=prefixer.prefix)
+        metrics.inc("state_query", store=store_name)
+        return {
+            "results": [
+                {"key": prefixer.strip(i.key), "data": i.value, "etag": i.etag}
+                for i in resp.items
+            ],
+            "token": resp.token,
+        }
+
+    async def transact_state(self, store_name: str, operations: list[dict]) -> None:
+        store, prefixer = self._state_store(store_name)
+        ops = []
+        for op in operations:
+            kind = op.get("operation")
+            if kind not in ("upsert", "delete"):
+                raise StateError(f"unknown transaction operation {kind!r}")
+            req = op.get("request") or {}
+            if "key" not in req:
+                raise StateError("each transaction request needs a key")
+            ops.append(TransactionOp(
+                operation=kind, key=prefixer.apply(str(req["key"])),
+                value=req.get("value"), etag=req.get("etag"),
+            ))
+        await store.transact(ops)
+        metrics.inc("state_transact", store=store_name)
+
+    # -- secrets ---------------------------------------------------------
+
+    def get_secret(self, store_name: str, key: str) -> dict[str, str]:
+        store = self.registry.get(store_name, block="secretstores")
+        return {key: store.get(key)}
+
+    def bulk_secrets(self, store_name: str) -> dict[str, str]:
+        store = self.registry.get(store_name, block="secretstores")
+        return store.bulk()
+
+    # -- pub/sub ---------------------------------------------------------
+
+    async def publish(self, pubsub_name: str, topic: str, data: Any, *,
+                      metadata: dict[str, str] | None = None,
+                      raw: bool = False) -> str:
+        broker: PubSubBroker = self.registry.get(pubsub_name, block="pubsub")
+        envelope = data if raw else cloudevents.wrap(
+            data, source=self.app_id or "tasksrunner", topic=topic,
+            pubsub_name=pubsub_name,
+        )
+        meta = dict(metadata or {})
+        meta.update(outgoing_headers())
+        msg_id = await broker.publish(topic, envelope, metadata=meta)
+        metrics.inc("publish", pubsub=pubsub_name, topic=topic)
+        return msg_id
+
+    # -- bindings --------------------------------------------------------
+
+    async def invoke_output_binding(self, name: str, operation: str, data: Any,
+                                    metadata: dict[str, str] | None = None):
+        binding = self.registry.get(name, block="bindings")
+        if not isinstance(binding, OutputBinding):
+            raise BindingError(f"component {name!r} is not an output binding")
+        metrics.inc("binding_invoke", binding=name, operation=operation)
+        return await binding.invoke(operation, data, metadata)
+
+    # -- service invocation ----------------------------------------------
+
+    async def invoke(self, target_app_id: str, method_path: str, *,
+                     http_method: str = "POST", query: str = "",
+                     headers: dict[str, str] | None = None,
+                     body: bytes = b"") -> tuple[int, dict[str, str], bytes]:
+        headers = {k.lower(): v for k, v in (headers or {}).items()}
+        incoming = headers.get(TRACEPARENT_HEADER)
+        if incoming:
+            # caller supplied an explicit trace context: continue it
+            with trace_scope(ensure_trace(incoming)):
+                headers.update(outgoing_headers())
+        else:
+            headers.update(outgoing_headers())
+        path = "/" + method_path.lstrip("/")
+        metrics.inc("invoke", target=target_app_id)
+
+        if self.app_id is not None and target_app_id == self.app_id:
+            if self.app_channel is None:
+                raise InvocationError(f"no app channel for local app {self.app_id!r}")
+            return await self.app_channel.request(
+                http_method, path, query=query, headers=headers, body=body)
+
+        if target_app_id in self.peers:
+            return await self.peers[target_app_id].request(
+                http_method, path, query=query, headers=headers, body=body)
+
+        addr = self.resolver.resolve(target_app_id)
+        if self._session is None:
+            import aiohttp
+            self._session = aiohttp.ClientSession()
+        url = f"{addr.base_url}/v1.0/invoke/{target_app_id}/method{path}"
+        if query:
+            url += f"?{query}"
+        try:
+            async with self._session.request(http_method, url, headers=headers,
+                                             data=body) as resp:
+                return resp.status, dict(resp.headers), await resp.read()
+        except OSError as exc:
+            raise InvocationError(
+                f"cannot reach sidecar of {target_app_id!r} at {addr.base_url}: {exc}"
+            ) from exc
+
+    # -- consumer-side lifecycle -----------------------------------------
+
+    async def _wait_for_app(self, timeout: float = 30.0) -> None:
+        """The subscribe-handshake ordering problem (SURVEY.md §7.4):
+        the app may not be listening yet when the sidecar starts."""
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            try:
+                status, _, _ = await self.app_channel.request("GET", "/healthz")
+                if status < 500:
+                    return
+            except Exception:
+                pass
+            if asyncio.get_running_loop().time() > deadline:
+                raise InvocationError(
+                    f"app {self.app_id!r} did not become healthy within {timeout}s")
+            await asyncio.sleep(0.1)
+
+    async def start(self) -> None:
+        """Run the subscribe handshake and start input bindings."""
+        if self._started or self.app_channel is None:
+            self._started = True
+            return
+        await self._wait_for_app()
+
+        # 1. topic subscriptions (≙ sidecar GET /dapr/subscribe)
+        status, _, body = await self.app_channel.request("GET", "/tasksrunner/subscribe")
+        subscriptions = json.loads(body) if status == 200 and body else []
+        for sub in subscriptions:
+            pubsub_name, topic, route = sub["pubsubname"], sub["topic"], sub["route"]
+            try:
+                broker = self.registry.get(pubsub_name, block="pubsub")
+            except ComponentNotFound:
+                logger.warning("app %s subscribes to unknown pubsub %r — skipped",
+                               self.app_id, pubsub_name)
+                continue
+            handler = self._make_subscription_handler(route)
+            self._subscriptions.append(
+                await broker.subscribe(topic, self.app_id or "default", handler))
+            logger.info("subscribed %s to %s/%s -> %s",
+                        self.app_id, pubsub_name, topic, route)
+
+        # 2. input bindings scoped to this app
+        for name in self.registry.names(block="bindings"):
+            instance = self.registry.get(name)
+            if isinstance(instance, InputBinding):
+                await instance.start(self._make_binding_sink(instance))
+                self._input_bindings.append(instance)
+                logger.info("input binding %s -> %s", name, instance.route)
+        self._started = True
+
+    def _make_subscription_handler(self, route: str):
+        async def deliver(msg: Message) -> bool:
+            ctx = ensure_trace(msg.metadata.get(TRACEPARENT_HEADER))
+            with trace_scope(ctx):
+                body = json.dumps(msg.data).encode()
+                headers = {
+                    "content-type": cloudevents.CONTENT_TYPE,
+                    TRACEPARENT_HEADER: ctx.header,
+                }
+                try:
+                    status, _, _ = await self.app_channel.request(
+                        "POST", route, headers=headers, body=body)
+                except Exception:
+                    logger.exception("delivery to %s failed", route)
+                    return False
+                metrics.inc("pubsub_delivery", route=route, status=str(status))
+                return 200 <= status < 300
+        return deliver
+
+    def _make_binding_sink(self, binding: InputBinding):
+        async def sink(event: BindingEvent) -> bool:
+            ctx = ensure_trace(None)
+            with trace_scope(ctx):
+                body = b"" if event.data is None else json.dumps(event.data).encode()
+                headers = {"content-type": "application/json",
+                           TRACEPARENT_HEADER: ctx.header}
+                headers.update(event.metadata)
+                try:
+                    status, _, _ = await self.app_channel.request(
+                        "POST", binding.route, headers=headers, body=body)
+                except Exception:
+                    logger.exception("binding delivery to %s failed", binding.route)
+                    return False
+                metrics.inc("binding_delivery", binding=binding.name,
+                            status=str(status))
+                return 200 <= status < 300
+        return sink
+
+    # -- metadata / teardown ---------------------------------------------
+
+    def metadata(self) -> dict:
+        return {
+            "id": self.app_id,
+            "components": [
+                {"name": n, "type": self.registry.spec(n).type}
+                for n in self.registry.names()
+            ],
+            "subscriptions": [
+                {"topic": s.topic, "group": s.group} for s in self._subscriptions
+            ],
+            "metrics": metrics.snapshot(),
+        }
+
+    async def stop(self) -> None:
+        for sub in self._subscriptions:
+            await sub.cancel()
+        self._subscriptions.clear()
+        for binding in self._input_bindings:
+            await binding.stop()
+        self._input_bindings.clear()
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+        if self.app_channel is not None:
+            await self.app_channel.close()
+        await self.registry.close()
+        self._started = False

@@ -1,0 +1,207 @@
+"""The sidecar process: Dapr-shaped HTTP API over a Runtime.
+
+Route surface replicated from the reference's sidecar usage
+(SURVEY.md §1 L2):
+
+* ``POST/GET/DELETE /v1.0/state/{store}[/{key}]``, ``/query``,
+  ``/transaction`` — docs/aca/04-aca-dapr-stateapi/index.md:41-46;
+* ``POST /v1.0/publish/{pubsub}/{topic}`` — docs module 5 :60-66;
+* ``POST /v1.0/bindings/{name}`` — docs module 6 :60-74;
+* ``ANY /v1.0/invoke/{app-id}/method/{path}`` — docs module 3 :107-127;
+* ``GET /v1.0/secrets/{store}/{key}`` (+ ``/bulk``);
+* ``GET /v1.0/healthz``, ``GET /v1.0/metadata``.
+
+Run it beside an app process (``python -m tasksrunner sidecar ...`` or
+via the orchestrator) exactly as ``dapr run`` does
+(snippets/dapr-run-backend-api.md:4-16): the app talks to
+``localhost:<sidecar-port>``, never to peers directly.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from aiohttp import web
+
+from tasksrunner.errors import TasksRunnerError
+from tasksrunner.observability.tracing import (
+    TRACEPARENT_HEADER,
+    ensure_trace,
+    trace_scope,
+)
+from tasksrunner.runtime import Runtime
+from tasksrunner.state.base import StateItem
+
+logger = logging.getLogger(__name__)
+
+
+def _json_error(exc: Exception) -> web.Response:
+    status = exc.http_status if isinstance(exc, TasksRunnerError) else 500
+    if not isinstance(exc, TasksRunnerError):
+        logger.exception("unhandled sidecar error")
+    return web.json_response({"error": str(exc) or type(exc).__name__}, status=status)
+
+
+def build_sidecar_app(runtime: Runtime) -> web.Application:
+    routes = web.RouteTableDef()
+
+    def _traced(handler):
+        async def wrapped(request: web.Request):
+            ctx = ensure_trace(request.headers.get(TRACEPARENT_HEADER))
+            with trace_scope(ctx):
+                try:
+                    return await handler(request)
+                except Exception as exc:  # noqa: BLE001 - mapped to status
+                    return _json_error(exc)
+        return wrapped
+
+    # -- state ----------------------------------------------------------
+
+    @routes.post("/v1.0/state/{store}")
+    @_traced
+    async def save_state(request: web.Request):
+        items = await request.json()
+        if not isinstance(items, list):
+            raise TasksRunnerError("state save body must be a list of {key, value}")
+        await runtime.save_state(request.match_info["store"], items)
+        return web.Response(status=204)
+
+    @routes.get("/v1.0/state/{store}/{key}")
+    @_traced
+    async def get_state(request: web.Request):
+        item: StateItem | None = await runtime.get_state(
+            request.match_info["store"], request.match_info["key"])
+        if item is None:
+            return web.Response(status=204)  # Dapr returns empty for missing keys
+        return web.json_response(item.value, headers={"etag": item.etag})
+
+    @routes.delete("/v1.0/state/{store}/{key}")
+    @_traced
+    async def delete_state(request: web.Request):
+        etag = request.headers.get("if-match")
+        await runtime.delete_state(request.match_info["store"],
+                                   request.match_info["key"], etag=etag)
+        return web.Response(status=204)
+
+    @routes.post("/v1.0/state/{store}/query")
+    @_traced
+    async def query_state(request: web.Request):
+        result = await runtime.query_state(
+            request.match_info["store"], await request.json())
+        return web.json_response(result)
+
+    @routes.post("/v1.0/state/{store}/transaction")
+    @_traced
+    async def transact_state(request: web.Request):
+        body = await request.json()
+        await runtime.transact_state(
+            request.match_info["store"], body.get("operations", []))
+        return web.Response(status=204)
+
+    # -- secrets ---------------------------------------------------------
+
+    @routes.get("/v1.0/secrets/{store}/bulk")
+    @_traced
+    async def bulk_secrets(request: web.Request):
+        return web.json_response(runtime.bulk_secrets(request.match_info["store"]))
+
+    @routes.get("/v1.0/secrets/{store}/{key}")
+    @_traced
+    async def get_secret(request: web.Request):
+        return web.json_response(
+            runtime.get_secret(request.match_info["store"],
+                               request.match_info["key"]))
+
+    # -- pub/sub ---------------------------------------------------------
+
+    @routes.post("/v1.0/publish/{pubsub}/{topic}")
+    @_traced
+    async def publish(request: web.Request):
+        body = await request.read()
+        data = json.loads(body) if body else None
+        raw = request.query.get("metadata.rawPayload") == "true"
+        msg_id = await runtime.publish(
+            request.match_info["pubsub"], request.match_info["topic"], data,
+            raw=raw)
+        return web.json_response({"messageId": msg_id})
+
+    # -- bindings --------------------------------------------------------
+
+    @routes.post("/v1.0/bindings/{name}")
+    @_traced
+    async def invoke_binding(request: web.Request):
+        body = await request.json()
+        resp = await runtime.invoke_output_binding(
+            request.match_info["name"],
+            body.get("operation", "create"),
+            body.get("data"),
+            body.get("metadata") or {},
+        )
+        payload = resp.data
+        if isinstance(payload, (bytes, bytearray)):
+            payload = payload.decode("utf-8", "replace")
+        return web.json_response({"data": payload, "metadata": resp.metadata})
+
+    # -- service invocation ----------------------------------------------
+
+    @routes.route("*", "/v1.0/invoke/{app_id}/method/{path:.*}")
+    @_traced
+    async def invoke(request: web.Request):
+        target = request.match_info["app_id"]
+        path = request.match_info["path"]
+        body = await request.read()
+        fwd_headers = {
+            k.lower(): v for k, v in request.headers.items()
+            if k.lower() in ("content-type", "accept") or k.lower().startswith("x-")
+        }
+        status, headers, resp_body = await runtime.invoke(
+            target, path, http_method=request.method,
+            query=request.query_string, headers=fwd_headers, body=body)
+        return web.Response(
+            status=status, body=resp_body,
+            content_type=(headers.get("content-type", "application/json")
+                          .split(";")[0]),
+        )
+
+    # -- meta ------------------------------------------------------------
+
+    @routes.get("/v1.0/healthz")
+    async def healthz(request: web.Request):
+        return web.Response(status=204)
+
+    @routes.get("/v1.0/metadata")
+    async def metadata(request: web.Request):
+        return web.json_response(runtime.metadata())
+
+    app = web.Application(client_max_size=16 * 1024 * 1024)
+    app.add_routes(routes)
+    return app
+
+
+class Sidecar:
+    """Runtime + HTTP server, with lifecycle management."""
+
+    def __init__(self, runtime: Runtime, *, host: str = "127.0.0.1", port: int = 3500):
+        self.runtime = runtime
+        self.host = host
+        self.port = port
+        self._http = build_sidecar_app(runtime)
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self._http)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:  # pick the real ephemeral port
+            self.port = self._runner.addresses[0][1]
+        await self.runtime.start()
+        logger.info("sidecar for %s listening on %s:%d",
+                    self.runtime.app_id, self.host, self.port)
+
+    async def stop(self) -> None:
+        await self.runtime.stop()
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

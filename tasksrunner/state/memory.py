@@ -76,11 +76,16 @@ class InMemoryStateStore(StateStore):
 
     async def query(self, query: dict, *, key_prefix: str = "") -> QueryResponse:
         candidates = [
-            StateItem(key=it.key, value=copy.deepcopy(it.value), etag=it.etag)
-            for key, it in sorted(self._data.items())
+            it for key, it in sorted(self._data.items())
             if key.startswith(key_prefix)
         ]
+        # filter/sort/page on the live items (read-only), deep-copy only
+        # the page actually returned
         items, token = run_query(candidates, query)
+        items = [
+            StateItem(key=it.key, value=copy.deepcopy(it.value), etag=it.etag)
+            for it in items
+        ]
         return QueryResponse(items=items, token=token)
 
     async def keys(self, *, prefix: str = "") -> list[str]:

@@ -73,8 +73,19 @@ def validate_filter(filt: Any) -> None:
         path, values = _single_entry(operand, op)
         if not isinstance(values, list):
             raise QueryError("IN expects a list of candidate values")
+        for v in values:
+            _require_scalar(v, op)
     else:
-        _single_entry(operand, op)
+        _, v = _single_entry(operand, op)
+        _require_scalar(v, op)
+
+
+def _require_scalar(value: Any, op: str) -> None:
+    # Containers can't bind as SQL parameters and document-store query
+    # dialects compare scalars only; rejecting here keeps both engines
+    # identical instead of one matching and one erroring.
+    if isinstance(value, (dict, list)):
+        raise QueryError(f"{op} comparison values must be scalars, not {type(value).__name__}")
 
 
 def matches(doc: Any, filt: Any) -> bool:
@@ -97,22 +108,28 @@ def matches(doc: Any, filt: Any) -> bool:
     raise QueryError(f"unknown filter operator {op!r}")
 
 
+def _sort_rank(v: Any) -> int:
+    """Type rank matching SQLite's storage-class order (NULL < numeric
+    < text < everything-else), so both query engines sort mixed-type
+    fields identically."""
+    if v is None:
+        return 0
+    if isinstance(v, (bool, int, float)):
+        return 1
+    if isinstance(v, str):
+        return 2
+    return 3  # containers sort last, as JSON text
+
+
 def _sort_cmp(a: Any, b: Any) -> int:
-    """Total order over heterogeneous JSON values: None first, then by
-    type name, then by value — mirrors document-store sort stability."""
+    """Total order over heterogeneous JSON values, aligned with the
+    sqlite engine's ORDER BY json_extract semantics."""
     if a == b:
         return 0
-    if a is None:
-        return -1
-    if b is None:
-        return 1
-    ta, tb = type(a).__name__, type(b).__name__
-    # bool is an int subtype; sort numerics together
-    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
-        return -1 if a < b else 1
-    if ta != tb:
-        return -1 if ta < tb else 1
-    if isinstance(a, (dict, list)):
+    ra, rb = _sort_rank(a), _sort_rank(b)
+    if ra != rb:
+        return -1 if ra < rb else 1
+    if ra == 3:
         # containers have no natural order; canonical JSON text gives a
         # stable one instead of a TypeError mid-query
         a, b = json.dumps(a, sort_keys=True), json.dumps(b, sort_keys=True)

@@ -20,9 +20,9 @@ from typing import Any
 
 from tasksrunner.component.registry import driver
 from tasksrunner.component.spec import ComponentSpec
-from tasksrunner.errors import EtagMismatch, QueryError
+from tasksrunner.errors import EtagMismatch, QueryError, StateError
 from tasksrunner.state.base import QueryResponse, StateItem, StateStore, TransactionOp
-from tasksrunner.state.query import paginate, validate_filter
+from tasksrunner.state.query import validate_filter
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS state (
@@ -134,10 +134,17 @@ class SqliteStateStore(StateStore):
         if etag is not None and (row is None or row[0] != etag):
             raise EtagMismatch(f"etag mismatch for key {key!r}")
         new_etag = self._next_etag(cur)
+        try:
+            # allow_nan=False: NaN/Infinity would poison json_extract for
+            # every later query on the store; reject at write time the way
+            # a real document DB does.
+            doc = json.dumps(value, separators=(",", ":"), allow_nan=False)
+        except ValueError as exc:
+            raise StateError(f"value for key {key!r} is not valid JSON: {exc}") from exc
         cur.execute(
             "INSERT INTO state(key, value, etag) VALUES(?, ?, ?) "
             "ON CONFLICT(key) DO UPDATE SET value=excluded.value, etag=excluded.etag",
-            (key, json.dumps(value, separators=(",", ":")), new_etag),
+            (key, doc, new_etag),
         )
         return new_etag
 
@@ -211,13 +218,39 @@ class SqliteStateStore(StateStore):
             all_params.append(_like_escape(key_prefix) + "%")
         sql += f" {order}"
         all_params.extend(order_params)
+
+        # Page in the engine: same offset-token format as query.paginate,
+        # but via LIMIT/OFFSET so unmatched pages never leave SQLite.
+        page = query.get("page") or {}
+        limit = page.get("limit")
+        token = page.get("token")
+        start = 0
+        if token is not None:
+            try:
+                start = int(token)
+            except (TypeError, ValueError):
+                raise QueryError(f"bad page token {token!r}") from None
+            if start < 0:
+                raise QueryError(f"bad page token {token!r}")
+        if limit is not None and (not isinstance(limit, int) or limit <= 0):
+            raise QueryError("page.limit must be a positive integer")
+        if limit is not None:
+            sql += " LIMIT ? OFFSET ?"
+            all_params.extend([limit + 1, start])  # +1 probes for a next page
+        elif start:
+            sql += " LIMIT -1 OFFSET ?"
+            all_params.append(start)
+
         try:
             rows = self._conn.execute(sql, all_params).fetchall()
         except sqlite3.Error as exc:
             raise QueryError(f"query failed: {exc}") from exc
+        next_token = None
+        if limit is not None and len(rows) > limit:
+            rows = rows[:limit]
+            next_token = str(start + limit)
         items = [StateItem(key=k, value=json.loads(v), etag=e) for k, v, e in rows]
-        items, token = paginate(items, query.get("page"))
-        return QueryResponse(items=items, token=token)
+        return QueryResponse(items=items, token=next_token)
 
     async def keys(self, *, prefix: str = "") -> list[str]:
         if prefix:

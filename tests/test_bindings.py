@@ -1,0 +1,151 @@
+"""Bindings building-block tests: queue in/out, blob, email outbox.
+
+Contract source: SURVEY.md §3.4 (input→invoke→output chain) and the
+component table §2.4.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from tasksrunner.bindings import (
+    EmailOutboxBinding,
+    LocalBlobStoreBinding,
+    LocalQueueBinding,
+    SqliteQueue,
+)
+from tasksrunner.errors import BindingError
+
+
+async def wait_until(cond, timeout=3.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError("condition not met in time")
+        await asyncio.sleep(0.01)
+
+
+@pytest.mark.asyncio
+async def test_queue_input_binding_ack_consumes(tmp_path):
+    binding = LocalQueueBinding("externaltasksmanager", str(tmp_path / "q.db"),
+                                route="/externaltasksprocessor/process",
+                                poll_interval=0.01)
+    assert binding.route == "/externaltasksprocessor/process"
+    got = []
+
+    async def sink(event):
+        got.append(event)
+        return True
+
+    await binding.start(sink)
+    binding.queue.send({"taskName": "external"})
+    await wait_until(lambda: len(got) == 1)
+    assert got[0].data == {"taskName": "external"}
+    assert binding.queue.backlog() == 0
+    await binding.stop()
+
+
+@pytest.mark.asyncio
+async def test_queue_nack_redelivers_then_dead_letters(tmp_path):
+    binding = LocalQueueBinding("q", str(tmp_path / "q.db"),
+                                poll_interval=0.01, max_attempts=2,
+                                retry_delay=0.01)
+    attempts = []
+
+    async def sink(event):
+        attempts.append(int(event.metadata["attempt"]))
+        return False
+
+    await binding.start(sink)
+    binding.queue.send({"n": 1})
+    await wait_until(lambda: len(attempts) >= 2)
+    await asyncio.sleep(0.05)
+    assert attempts == [1, 2]
+    assert binding.queue.backlog() == 0  # dead-lettered, not pending
+    await binding.stop()
+
+
+@pytest.mark.asyncio
+async def test_queue_output_binding_enqueues(tmp_path):
+    binding = LocalQueueBinding("q", str(tmp_path / "q.db"), poll_interval=0.01)
+    resp = await binding.invoke("create", {"external": True})
+    assert resp.metadata["messageId"]
+    assert binding.queue.backlog() == 1
+    with pytest.raises(BindingError):
+        await binding.invoke("get", None)
+    await binding.stop()
+
+
+@pytest.mark.asyncio
+async def test_queue_cross_process_producer(tmp_path):
+    """An external producer writes via a separate SqliteQueue handle
+    (≙ Azure Storage Explorer dropping a message in the queue)."""
+    path = tmp_path / "q.db"
+    binding = LocalQueueBinding("q", str(path), poll_interval=0.01)
+    got = []
+
+    async def sink(event):
+        got.append(event.data)
+        return True
+
+    await binding.start(sink)
+    producer = SqliteQueue(path)
+    producer.send({"from": "outside"})
+    await wait_until(lambda: got == [{"from": "outside"}])
+    producer.close()
+    await binding.stop()
+
+
+@pytest.mark.asyncio
+async def test_blob_binding_crud(tmp_path):
+    blob = LocalBlobStoreBinding("externaltasksblobstore", tmp_path)
+    task = {"taskId": "abc", "taskName": "archived"}
+    resp = await blob.invoke("create", task, {"blobName": "abc.json"})
+    assert resp.metadata["blobName"] == "abc.json"
+
+    got = await blob.invoke("get", None, {"blobName": "abc.json"})
+    assert json.loads(got.data) == task
+
+    listing = await blob.invoke("list", None)
+    assert listing.data == ["abc.json"]
+
+    await blob.invoke("delete", None, {"blobName": "abc.json"})
+    assert (await blob.invoke("list", None)).data == []
+
+    with pytest.raises(BindingError):
+        await blob.invoke("get", None, {"blobName": "abc.json"})
+
+
+@pytest.mark.asyncio
+async def test_blob_binding_rejects_escape(tmp_path):
+    blob = LocalBlobStoreBinding("b", tmp_path)
+    with pytest.raises(BindingError, match="escapes"):
+        await blob.invoke("create", "x", {"blobName": "../../etc/passwd"})
+
+
+@pytest.mark.asyncio
+async def test_email_outbox(tmp_path):
+    mail = EmailOutboxBinding("sendgrid", tmp_path / "outbox",
+                              default_from="noreply@tasksrunner.local")
+    await mail.invoke("create", "<b>task assigned</b>", {
+        "emailTo": "a@x.com", "emailToName": "A", "subject": "tasks assigned",
+    })
+    sent = mail.sent()
+    assert len(sent) == 1
+    assert sent[0]["to"] == "a@x.com"
+    assert sent[0]["from"] == "noreply@tasksrunner.local"
+    assert sent[0]["subject"] == "tasks assigned"
+
+    with pytest.raises(BindingError, match="emailTo"):
+        await mail.invoke("create", "x", {})
+
+
+def test_binding_drivers_registered():
+    from tasksrunner.component.registry import registered_types
+    types = registered_types()
+    # reference component types load unchanged
+    assert "bindings.cron" in types
+    assert "bindings.azure.storagequeues" in types
+    assert "bindings.azure.blobstorage" in types
+    assert "bindings.twilio.sendgrid" in types

@@ -1,0 +1,99 @@
+"""Cron schedule engine tests (reference schedule: `5 0 * * *`,
+components/dapr-scheduled-cron.yaml)."""
+
+import asyncio
+import datetime as dt
+
+import pytest
+
+from tasksrunner.bindings.cron import CronBinding, CronSchedule
+from tasksrunner.errors import BindingError
+
+
+def d(*args):
+    return dt.datetime(*args)
+
+
+def test_reference_schedule_daily_0005():
+    s = CronSchedule("5 0 * * *")
+    assert s.next_after(d(2026, 7, 29, 12, 0)) == d(2026, 7, 30, 0, 5)
+    assert s.next_after(d(2026, 7, 29, 0, 4)) == d(2026, 7, 29, 0, 5)
+    assert s.next_after(d(2026, 7, 29, 0, 5)) == d(2026, 7, 30, 0, 5)  # strictly after
+
+
+def test_steps_ranges_lists():
+    s = CronSchedule("*/15 * * * *")
+    assert s.next_after(d(2026, 1, 1, 10, 0)) == d(2026, 1, 1, 10, 15)
+    assert s.next_after(d(2026, 1, 1, 10, 50)) == d(2026, 1, 1, 11, 0)
+    s = CronSchedule("0 9-17 * * *")
+    assert s.next_after(d(2026, 1, 1, 18, 0)) == d(2026, 1, 2, 9, 0)
+    s = CronSchedule("0 0 1,15 * *")
+    assert s.next_after(d(2026, 1, 2, 0, 0)) == d(2026, 1, 15, 0, 0)
+
+
+def test_month_and_dow_names():
+    s = CronSchedule("0 0 * jan *")
+    assert s.next_after(d(2026, 2, 1, 0, 0)) == d(2027, 1, 1, 0, 0)
+    s = CronSchedule("30 8 * * mon")
+    nxt = s.next_after(d(2026, 7, 29, 9, 0))  # Wednesday
+    assert nxt == d(2026, 8, 3, 8, 30)  # next Monday
+    assert nxt.weekday() == 0
+
+
+def test_dow_sunday_as_0_and_7():
+    for expr in ("0 0 * * 0", "0 0 * * 7", "0 0 * * sun"):
+        nxt = CronSchedule(expr).next_after(d(2026, 7, 29, 0, 0))
+        assert nxt.weekday() == 6  # python Sunday
+
+
+def test_dom_dow_or_rule():
+    # standard cron: if both dom and dow are restricted, either matches
+    s = CronSchedule("0 0 13 * fri")
+    nxt = s.next_after(d(2026, 7, 29, 0, 0))
+    # July 31 2026 is a Friday, before Aug 13
+    assert nxt == d(2026, 7, 31, 0, 0)
+
+
+def test_six_field_form_accepted():
+    s = CronSchedule("0 5 0 * * *")
+    assert s.next_after(d(2026, 7, 29, 12, 0)) == d(2026, 7, 30, 0, 5)
+
+
+def test_every_shorthand():
+    s = CronSchedule("@every 5s")
+    assert s.interval == 5.0
+    assert CronSchedule("@every 500ms").interval == 0.5
+    assert CronSchedule("@every 2m").interval == 120.0
+
+
+@pytest.mark.parametrize("bad", [
+    "* * * *",              # 4 fields
+    "61 * * * *",           # out of range
+    "* * 0 * *",            # dom 0
+    "a b c d e",
+    "@every 5parsecs",
+    "*/0 * * * *",          # zero step
+    "0 0 30-10 * *",        # inverted range
+])
+def test_malformed_rejected(bad):
+    with pytest.raises(BindingError):
+        CronSchedule(bad)
+
+
+@pytest.mark.asyncio
+async def test_cron_binding_fires_and_stops():
+    fired = []
+    binding = CronBinding("ScheduledTasksManager", "@every 30ms")
+
+    async def sink(event):
+        fired.append(event)
+        return True
+
+    await binding.start(sink)
+    await asyncio.sleep(0.2)
+    await binding.stop()
+    count = len(fired)
+    assert count >= 3
+    assert fired[0].binding == "ScheduledTasksManager"
+    await asyncio.sleep(0.1)
+    assert len(fired) == count  # nothing after stop

@@ -1,0 +1,258 @@
+"""End-to-end integration: the SURVEY.md §7.3 minimum slice.
+
+frontend → invoke → API → state store → publish → processor handler,
+run identically through BOTH hosting modes:
+
+* InProcCluster (direct channels, no sockets)
+* AppHost pairs (real aiohttp app servers + sidecars on ephemeral
+  ports, Dapr-shaped /v1.0 HTTP between them)
+
+This mirrors the reference's end-of-module-5 local milestone: three
+`dapr run` terminals, browser CRUD, consumer logging the event
+(SURVEY.md §3.1 call stack).
+"""
+
+import asyncio
+import textwrap
+import uuid
+
+import pytest
+
+from tasksrunner import App, AppHost, InProcCluster, load_components
+from tasksrunner.errors import TasksRunnerError
+
+COMPONENTS_YAML = textwrap.dedent(
+    """
+    apiVersion: dapr.io/v1alpha1
+    kind: Component
+    metadata:
+      name: statestore
+    spec:
+      type: state.in-memory
+      version: v1
+    scopes:
+    - backend-api
+    ---
+    apiVersion: dapr.io/v1alpha1
+    kind: Component
+    metadata:
+      name: taskspubsub
+    spec:
+      type: pubsub.sqlite
+      version: v1
+      metadata:
+      - name: brokerPath
+        value: "{broker_path}"
+      - name: pollIntervalSeconds
+        value: "0.01"
+    """
+)
+
+
+def make_api_app() -> App:
+    app = App("backend-api")
+
+    @app.get("/api/tasks")
+    async def list_tasks(req):
+        created_by = req.query.get("createdBy", "")
+        result = await app.client.query_state(
+            "statestore", {"filter": {"EQ": {"taskCreatedBy": created_by}}})
+        return [r["data"] for r in result["results"]]
+
+    @app.post("/api/tasks")
+    async def create_task(req):
+        task = req.json()
+        task_id = str(uuid.uuid4())
+        task["taskId"] = task_id
+        await app.client.save_state("statestore", task_id, task)
+        await app.client.publish_event("taskspubsub", "tasksavedtopic", task)
+        return 201, {"taskId": task_id}
+
+    @app.get("/api/tasks/{task_id}")
+    async def get_task(req):
+        task = await app.client.get_state("statestore", req.path_params["task_id"])
+        if task is None:
+            return 404
+        return task
+
+    return app
+
+
+def make_frontend_app() -> App:
+    app = App("frontend")
+
+    @app.post("/tasks/create")
+    async def create(req):
+        resp = await app.client.invoke_method(
+            "backend-api", "api/tasks", http_method="POST", data=req.json())
+        resp.raise_for_status()
+        return {"taskId": resp.json()["taskId"]}
+
+    @app.get("/tasks")
+    async def list_tasks(req):
+        return await app.client.invoke_json(
+            "backend-api", "api/tasks",
+            query=f"createdBy={req.query.get('createdBy', '')}")
+
+    return app
+
+
+def make_processor_app(received: list) -> App:
+    app = App("processor")
+
+    @app.subscribe(pubsub="taskspubsub", topic="tasksavedtopic",
+                   route="/api/tasksnotifier/tasksaved")
+    async def on_task_saved(req):
+        received.append(req.data)  # CloudEvents-unwrapped payload
+        return 200
+
+    return app
+
+
+def specs_for(tmp_path):
+    text = COMPONENTS_YAML.format(broker_path=tmp_path / "broker.db")
+    f = tmp_path / "components.yaml"
+    f.write_text(text)
+    return load_components(tmp_path)
+
+
+async def wait_until(cond, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError("condition not met in time")
+        await asyncio.sleep(0.02)
+
+
+async def run_slice(frontend_client, received):
+    """The canonical write path + read path, driven from the frontend."""
+    resp = await frontend_client.invoke_method(
+        "frontend", "tasks/create", http_method="POST",
+        data={"taskName": "demo", "taskCreatedBy": "a@x.com"})
+    assert resp.ok, resp.body
+    task_id = resp.json()["taskId"]
+
+    tasks = await frontend_client.invoke_json(
+        "frontend", "tasks", query="createdBy=a@x.com")
+    assert [t["taskId"] for t in tasks] == [task_id]
+
+    await wait_until(lambda: len(received) == 1)
+    assert received[0]["taskId"] == task_id
+    assert received[0]["taskName"] == "demo"
+    return task_id
+
+
+@pytest.mark.asyncio
+async def test_end_to_end_in_proc(tmp_path):
+    received: list = []
+    cluster = InProcCluster(specs_for(tmp_path))
+    cluster.add_app(make_api_app())
+    cluster.add_app(make_frontend_app())
+    cluster.add_app(make_processor_app(received))
+    await cluster.start()
+    try:
+        await run_slice(cluster.client("frontend"), received)
+        # scoping: frontend must NOT see the API-scoped state store
+        with pytest.raises(TasksRunnerError):
+            await cluster.client("frontend").get_state("statestore", "x")
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_end_to_end_http_sidecars(tmp_path):
+    received: list = []
+    specs = specs_for(tmp_path)
+    registry_file = str(tmp_path / "apps.json")
+
+    hosts = [
+        AppHost(make_api_app(), specs=specs, registry_file=registry_file),
+        AppHost(make_frontend_app(), specs=specs, registry_file=registry_file),
+        AppHost(make_processor_app(received), specs=specs,
+                registry_file=registry_file),
+    ]
+    for h in hosts:
+        await h.start()
+    try:
+        task_id = await run_slice(hosts[1].client, received)
+
+        # drive the sidecar API raw, as the workshop's manual probes do
+        # (docs/aca/04-aca-dapr-stateapi/index.md:41-75)
+        import aiohttp
+        api_sidecar = hosts[0].sidecar_port
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                f"http://127.0.0.1:{api_sidecar}/v1.0/state/statestore/{task_id}"
+            ) as r:
+                assert r.status == 200
+                doc = await r.json()
+                assert doc["taskName"] == "demo"
+            async with s.get(
+                f"http://127.0.0.1:{api_sidecar}/v1.0/metadata"
+            ) as r:
+                meta = await r.json()
+                assert meta["id"] == "backend-api"
+                assert any(c["name"] == "statestore" for c in meta["components"])
+    finally:
+        for h in hosts:
+            await h.stop()
+
+
+@pytest.mark.asyncio
+async def test_invoke_unknown_app_id_404(tmp_path):
+    cluster = InProcCluster(specs_for(tmp_path))
+    cluster.add_app(make_frontend_app())
+    await cluster.start()
+    try:
+        resp = await cluster.client("frontend").invoke_method(
+            "nonexistent-app", "api/tasks", http_method="GET")
+    except TasksRunnerError as exc:
+        assert "no app registered" in str(exc)
+    else:
+        assert resp.status == 404
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_trace_propagates_across_invoke_and_pubsub(tmp_path):
+    """One logical operation carries one trace id across all three
+    services (SURVEY.md §5.1 App-Map capability)."""
+    seen_traces: dict[str, str] = {}
+    specs = specs_for(tmp_path)
+
+    api = App("backend-api")
+
+    @api.post("/api/tasks")
+    async def create(req):
+        seen_traces["api"] = req.headers.get("traceparent", "")
+        await api.client.publish_event("taskspubsub", "tasksavedtopic", req.json())
+        return 201, {"taskId": "t"}
+
+    processor_traces: list[str] = []
+    processor = App("processor")
+
+    @processor.subscribe(pubsub="taskspubsub", topic="tasksavedtopic",
+                         route="/on-saved")
+    async def on_saved(req):
+        processor_traces.append(req.headers.get("traceparent", ""))
+        return 200
+
+    frontend = make_frontend_app()
+
+    cluster = InProcCluster(specs)
+    for a in (api, frontend, processor):
+        cluster.add_app(a)
+    await cluster.start()
+    try:
+        root = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        resp = await cluster.client("frontend").invoke_method(
+            "frontend", "tasks/create", http_method="POST",
+            data={"taskName": "t"}, headers={"traceparent": root})
+        # frontend route handler → invoke → api handler
+        await wait_until(lambda: len(processor_traces) == 1)
+        trace_id = "ab" * 16
+        assert trace_id in seen_traces["api"]
+        assert trace_id in processor_traces[0]
+    finally:
+        await cluster.stop()

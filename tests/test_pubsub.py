@@ -1,0 +1,236 @@
+"""Pub/sub broker contract suite: fan-out per group, competing
+consumers, at-least-once redelivery, dead-letter, durable groups.
+
+Contract source: SURVEY.md §2.4/§5.8 — Service Bus topic + per-app
+subscription semantics that the reference's processor relies on
+(bicep/modules/service-bus.bicep:55-57; ack contract in docs module 5).
+"""
+
+import asyncio
+
+import pytest
+
+from tasksrunner.pubsub import InMemoryBroker, SqliteBroker
+
+
+def make_memory(tmp_path):
+    return InMemoryBroker("b", max_attempts=3, retry_delay=0.01)
+
+
+def make_sqlite(tmp_path):
+    return SqliteBroker("b", tmp_path / "broker.db", max_attempts=3,
+                        retry_delay=0.01, poll_interval=0.01)
+
+
+BROKERS = {"memory": make_memory, "sqlite": make_sqlite}
+
+
+@pytest.fixture(params=sorted(BROKERS))
+def broker_factory(request, tmp_path):
+    # tests close their brokers themselves (aclose must run on the
+    # test's own event loop, which is gone by fixture teardown)
+    return lambda: BROKERS[request.param](tmp_path)
+
+
+async def wait_until(cond, timeout=3.0, interval=0.01):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError("condition not met in time")
+        await asyncio.sleep(interval)
+
+
+@pytest.mark.asyncio
+async def test_groups_each_get_a_copy(broker_factory):
+    broker = broker_factory()
+    got_a, got_b = [], []
+
+    async def ha(msg):
+        got_a.append(msg.data)
+        return True
+
+    async def hb(msg):
+        got_b.append(msg.data)
+        return True
+
+    await broker.subscribe("tasksavedtopic", "app-a", ha)
+    await broker.subscribe("tasksavedtopic", "app-b", hb)
+    await broker.publish("tasksavedtopic", {"n": 1})
+    await broker.publish("tasksavedtopic", {"n": 2})
+    await wait_until(lambda: len(got_a) == 2 and len(got_b) == 2)
+    assert sorted(m["n"] for m in got_a) == [1, 2]
+    assert sorted(m["n"] for m in got_b) == [1, 2]
+    await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_competing_consumers_share_one_group(broker_factory):
+    broker = broker_factory()
+    got_1, got_2 = [], []
+
+    async def h1(msg):
+        got_1.append(msg.data["n"])
+        return True
+
+    async def h2(msg):
+        got_2.append(msg.data["n"])
+        return True
+
+    await broker.subscribe("t", "workers", h1)
+    await broker.subscribe("t", "workers", h2)
+    for n in range(10):
+        await broker.publish("t", {"n": n})
+    await wait_until(lambda: len(got_1) + len(got_2) == 10)
+    await asyncio.sleep(0.05)
+    assert len(got_1) + len(got_2) == 10  # exactly once per group
+    assert sorted(got_1 + got_2) == list(range(10))
+    await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_nack_redelivers_then_dead_letters(broker_factory):
+    broker = broker_factory()
+    attempts = []
+
+    async def failing(msg):
+        attempts.append(msg.attempt)
+        return False
+
+    await broker.subscribe("t", "g", failing)
+    await broker.publish("t", {"x": 1})
+    await wait_until(lambda: len(attempts) >= 3)
+    await asyncio.sleep(0.1)
+    assert len(attempts) == 3  # max_attempts then dead-letter
+    assert attempts == [1, 2, 3]
+    await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_handler_exception_counts_as_nack(broker_factory):
+    broker = broker_factory()
+    calls = []
+
+    async def exploding(msg):
+        calls.append(msg.attempt)
+        if msg.attempt < 2:
+            raise RuntimeError("boom")
+        return True
+
+    await broker.subscribe("t", "g", exploding)
+    await broker.publish("t", {"x": 1})
+    await wait_until(lambda: len(calls) == 2)
+    await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_durable_group_receives_while_consumer_down(broker_factory):
+    """Consumers need not be up when messages arrive
+    (docs/aca/05-aca-dapr-pubsubapi/index.md:27-29)."""
+    broker = broker_factory()
+    await broker.ensure_group("t", "g")  # provisioned, no consumer yet
+    await broker.publish("t", {"n": 1})
+
+    got = []
+
+    async def h(msg):
+        got.append(msg.data["n"])
+        return True
+
+    sub = await broker.subscribe("t", "g", h)
+    await wait_until(lambda: got == [1])
+    await sub.cancel()
+    await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_no_group_no_delivery(broker_factory):
+    """A message published before the group exists is not seen by a
+    group created later (Service Bus subscription semantics)."""
+    broker = broker_factory()
+    await broker.publish("t", {"n": 0})
+    got = []
+
+    async def h(msg):
+        got.append(msg.data)
+        return True
+
+    await broker.subscribe("t", "late-group", h)
+    await broker.publish("t", {"n": 1})
+    await wait_until(lambda: len(got) == 1)
+    assert got == [{"n": 1}]
+    await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_sqlite_broker_durable_across_reopen(tmp_path):
+    b1 = SqliteBroker("b", tmp_path / "broker.db", poll_interval=0.01)
+    await b1.ensure_group("t", "g")
+    await b1.publish("t", {"n": 42})
+    assert b1.backlog("t", "g") == 1
+    await b1.aclose()
+
+    b2 = SqliteBroker("b", tmp_path / "broker.db", poll_interval=0.01)
+    got = []
+
+    async def h(msg):
+        got.append(msg.data["n"])
+        return True
+
+    await b2.subscribe("t", "g", h)
+    await wait_until(lambda: got == [42])
+    assert b2.backlog("t", "g") == 0
+    await b2.aclose()
+
+
+@pytest.mark.asyncio
+async def test_sqlite_broker_cross_connection_competing(tmp_path):
+    """Two broker objects on the same file (≙ two sidecar processes)
+    compete for one group without double-delivery."""
+    path = tmp_path / "broker.db"
+    b1 = SqliteBroker("b", path, poll_interval=0.01)
+    b2 = SqliteBroker("b", path, poll_interval=0.01)
+    got_1, got_2 = [], []
+
+    async def h1(msg):
+        got_1.append(msg.data["n"])
+        return True
+
+    async def h2(msg):
+        got_2.append(msg.data["n"])
+        return True
+
+    await b1.subscribe("t", "g", h1)
+    await b2.subscribe("t", "g", h2)
+    for n in range(20):
+        await b1.publish("t", {"n": n})
+    await wait_until(lambda: len(got_1) + len(got_2) == 20)
+    await asyncio.sleep(0.1)
+    assert sorted(got_1 + got_2) == list(range(20))
+    await b1.aclose()
+    await b2.aclose()
+
+
+@pytest.mark.asyncio
+async def test_backlog_and_dead_letters_visible(tmp_path):
+    broker = SqliteBroker("b", tmp_path / "broker.db", max_attempts=1,
+                          poll_interval=0.01)
+    await broker.ensure_group("t", "g")
+    await broker.publish("t", {"n": 1})
+    assert broker.backlog("t", "g") == 1
+
+    async def failing(msg):
+        return False
+
+    sub = await broker.subscribe("t", "g", failing)
+    await wait_until(lambda: broker.dead_letters("t", "g") != [])
+    assert broker.backlog("t", "g") == 0
+    await sub.cancel()
+    await broker.aclose()
+
+
+def test_pubsub_drivers_registered():
+    from tasksrunner.component.registry import registered_types
+    types = registered_types()
+    assert "pubsub.azure.servicebus" in types  # reference file loads unchanged
+    assert "pubsub.redis" in types
+    assert "pubsub.in-memory" in types

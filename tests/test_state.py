@@ -272,6 +272,40 @@ async def test_sort_on_container_values_does_not_crash(store):
 
 
 @pytest.mark.asyncio
+async def test_nan_rejected_at_write_time(store):
+    """NaN would poison json_extract in the sqlite engine; both engines
+    must reject it at set() so queries can never break."""
+    from tasksrunner.errors import StateError
+    if isinstance(store, InMemoryStateStore):
+        pytest.skip("memory engine stores Python objects; nothing to poison")
+    with pytest.raises(StateError):
+        await store.set("k", float("nan"))
+    await seed(store)
+    resp = await store.query({"filter": {"EQ": {"taskName": "alpha"}}})
+    assert len(resp.items) == 1  # queries still work
+
+
+@pytest.mark.asyncio
+async def test_container_filter_operands_rejected(store):
+    await seed(store)
+    with pytest.raises(QueryError, match="scalar"):
+        await store.query({"filter": {"EQ": {"tags": ["urgent"]}}})
+    with pytest.raises(QueryError, match="scalar"):
+        await store.query({"filter": {"IN": {"a": [{"x": 1}]}}})
+
+
+@pytest.mark.asyncio
+async def test_mixed_type_sort_rank_matches_sqlite_order(store):
+    """NULL < numeric < text < container, both engines."""
+    await store.set("a", {"v": "zeta"})
+    await store.set("b", {"v": 5})
+    await store.set("c", {"w": 1})          # v missing -> null
+    await store.set("d", {"v": {"k": 1}})   # container
+    resp = await store.query({"sort": [{"key": "v"}]})
+    assert [i.key for i in resp.items] == ["c", "b", "a", "d"]
+
+
+@pytest.mark.asyncio
 async def test_negative_page_token_rejected(store):
     await seed(store)
     with pytest.raises(QueryError):
