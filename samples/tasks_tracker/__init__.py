@@ -1,0 +1,18 @@
+"""Tasks Tracker — the reference's 3-service sample application rebuilt
+on the tasksrunner framework.
+
+Service map (SURVEY.md §2.1-2.3):
+
+* ``backend_api``  — app-id ``tasksmanager-backend-api``: REST CRUD +
+  state + publish (≙ TasksTracker.TasksManager.Backend.Api)
+* ``frontend_ui``  — app-id ``tasksmanager-frontend-webapp``:
+  server-rendered UI calling the API only via service invocation
+  (≙ TasksTracker.WebPortal.Frontend.Ui)
+* ``processor``    — app-id ``tasksmanager-backend-processor``:
+  subscriber + cron job + external bindings
+  (≙ TasksTracker.Processor.Backend.Svc)
+
+Each service deliberately owns its own copy of the task model, matching
+the reference's microservice decoupling (SURVEY.md §2.3 "duplicate DTO
+— deliberate").
+"""

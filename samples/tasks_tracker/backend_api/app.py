@@ -1,0 +1,102 @@
+"""Backend API service — app-id ``tasksmanager-backend-api``.
+
+Route surface ≙ the reference's two controllers:
+
+* ``TasksController`` (Controllers/TasksController.cs:7-76): GET
+  ``api/tasks?createdBy=``, GET ``api/tasks/{id}``, POST ``api/tasks``,
+  PUT ``api/tasks/{id}``, PUT ``api/tasks/{id}/markcomplete``,
+  DELETE ``api/tasks/{id}``
+* ``OverdueTasksController`` (Controllers/OverdueTasksController.cs:7-33):
+  GET ``api/overduetasks``, POST ``api/overduetasks/markoverdue``
+
+Manager selection ≙ Program.cs DI (:13): ships with the fake manager,
+swapped to the store-backed one by config — here the ``TASKS_MANAGER``
+env var or ``make_app(manager=...)`` (module 4's swap,
+docs/aca/04-aca-dapr-stateapi/index.md:170-192).
+"""
+
+from __future__ import annotations
+
+import os
+
+from tasksrunner import App
+
+from samples.tasks_tracker.backend_api.managers import (
+    FakeTasksManager,
+    TasksManager,
+    TasksStoreManager,
+)
+
+APP_ID = "tasksmanager-backend-api"
+
+
+def make_app(manager: str | TasksManager | None = None) -> App:
+    app = App(APP_ID)
+
+    mode = manager if isinstance(manager, str) else None
+    if mode is None:
+        mode = os.environ.get("TASKS_MANAGER", "store")
+
+    @app.on_startup
+    async def init_manager():
+        if isinstance(manager, TasksManager):
+            app.state["tasks"] = manager
+        elif mode == "fake":
+            app.state["tasks"] = FakeTasksManager()
+        else:
+            app.state["tasks"] = TasksStoreManager(app.client)
+
+    def tasks() -> TasksManager:
+        return app.state["tasks"]
+
+    # -- TasksController -------------------------------------------------
+
+    @app.get("/api/tasks")
+    async def get_tasks(req):
+        created_by = req.query.get("createdBy", "")
+        if not created_by:
+            return 400, {"error": "createdBy query parameter is required"}
+        return [t.to_json() for t in await tasks().get_tasks_by_creator(created_by)]
+
+    @app.get("/api/tasks/{task_id}")
+    async def get_task(req):
+        task = await tasks().get_task_by_id(req.path_params["task_id"])
+        if task is None:
+            return 404
+        return task.to_json()
+
+    @app.post("/api/tasks")
+    async def create_task(req):
+        doc = req.json() or {}
+        if not doc.get("taskName") or not doc.get("taskCreatedBy"):
+            return 400, {"error": "taskName and taskCreatedBy are required"}
+        task_id = await tasks().create_new_task(doc)
+        return 201, {"taskId": task_id}
+
+    @app.put("/api/tasks/{task_id}")
+    async def update_task(req):
+        ok = await tasks().update_task(req.path_params["task_id"], req.json() or {})
+        return 200 if ok else 404
+
+    @app.put("/api/tasks/{task_id}/markcomplete")
+    async def mark_complete(req):
+        ok = await tasks().mark_task_completed(req.path_params["task_id"])
+        return 200 if ok else 404
+
+    @app.delete("/api/tasks/{task_id}")
+    async def delete_task(req):
+        ok = await tasks().delete_task(req.path_params["task_id"])
+        return 200 if ok else 404
+
+    # -- OverdueTasksController ------------------------------------------
+
+    @app.get("/api/overduetasks")
+    async def get_overdue(req):
+        return [t.to_json() for t in await tasks().get_yesterdays_due_tasks()]
+
+    @app.post("/api/overduetasks/markoverdue")
+    async def mark_overdue(req):
+        await tasks().mark_overdue_tasks(req.json() or [])
+        return 200
+
+    return app

@@ -1,0 +1,219 @@
+"""Tasks-manager service layer: interface + both implementations.
+
+≙ the reference's Services/ directory:
+
+* ``TasksManager``      — ITasksManager.cs:5-15 (8 async ops)
+* ``FakeTasksManager``  — FakeTasksManager.cs:5-113 (in-memory, seeds
+  10 random tasks at startup; module-1 mode and the test double)
+* ``TasksStoreManager`` — TasksStoreManager.cs:9-157 (state store CRUD
+  + EQ-filter queries + TaskSaved publish on create :36 and on
+  reassign :95-98)
+"""
+
+from __future__ import annotations
+
+import abc
+import asyncio
+import datetime as dt
+import logging
+import random
+
+from samples.tasks_tracker.backend_api.models import (
+    TaskModel,
+    add_model,
+    apply_update,
+    format_dt,
+)
+
+logger = logging.getLogger(__name__)
+
+STORE_NAME = "statestore"            # TasksStoreManager.cs:11
+PUBSUB_NAME = "dapr-pubsub-servicebus"  # TasksStoreManager.cs:153
+TOPIC_NAME = "tasksavedtopic"        # TasksStoreManager.cs:154
+
+
+class TasksManager(abc.ABC):
+    """≙ ITasksManager (Services/ITasksManager.cs:5-15)."""
+
+    @abc.abstractmethod
+    async def get_tasks_by_creator(self, created_by: str) -> list[TaskModel]: ...
+
+    @abc.abstractmethod
+    async def get_task_by_id(self, task_id: str) -> TaskModel | None: ...
+
+    @abc.abstractmethod
+    async def create_new_task(self, add_doc: dict) -> str: ...
+
+    @abc.abstractmethod
+    async def update_task(self, task_id: str, update_doc: dict) -> bool: ...
+
+    @abc.abstractmethod
+    async def mark_task_completed(self, task_id: str) -> bool: ...
+
+    @abc.abstractmethod
+    async def delete_task(self, task_id: str) -> bool: ...
+
+    @abc.abstractmethod
+    async def get_yesterdays_due_tasks(self) -> list[TaskModel]: ...
+
+    @abc.abstractmethod
+    async def mark_overdue_tasks(self, tasks: list[dict]) -> None: ...
+
+
+class FakeTasksManager(TasksManager):
+    """In-memory implementation seeded with 10 random tasks
+    (FakeTasksManager.GenerateRandomTasks, :10-25). Lock-guarded where
+    the reference's List<> was not (SURVEY.md §5.2)."""
+
+    def __init__(self, *, seed_count: int = 10):
+        self._tasks: dict[str, TaskModel] = {}
+        self._lock = asyncio.Lock()
+        rng = random.Random(42)
+        for i in range(seed_count):
+            t = TaskModel(
+                task_name=f"Task number: {i}",
+                task_created_by="tempuser@mail.com",
+                task_due_date=format_dt(
+                    dt.datetime.now() + dt.timedelta(days=rng.randint(-5, 5))),
+                task_assigned_to=f"assignee{rng.randint(1, 50)}@mail.com",
+            )
+            self._tasks[t.task_id] = t
+
+    async def get_tasks_by_creator(self, created_by):
+        return sorted(
+            (t for t in self._tasks.values() if t.task_created_by == created_by),
+            key=lambda t: t.task_created_on, reverse=True)
+
+    async def get_task_by_id(self, task_id):
+        return self._tasks.get(task_id)
+
+    async def create_new_task(self, add_doc):
+        task = add_model(add_doc)
+        async with self._lock:
+            self._tasks[task.task_id] = task
+        return task.task_id
+
+    async def update_task(self, task_id, update_doc):
+        async with self._lock:
+            task = self._tasks.get(task_id)
+            if task is None:
+                return False
+            apply_update(task, update_doc)
+            return True
+
+    async def mark_task_completed(self, task_id):
+        async with self._lock:
+            task = self._tasks.get(task_id)
+            if task is None:
+                return False
+            task.is_completed = True
+            return True
+
+    async def delete_task(self, task_id):
+        async with self._lock:
+            return self._tasks.pop(task_id, None) is not None
+
+    async def get_yesterdays_due_tasks(self):
+        yesterday = format_dt(
+            (dt.datetime.now() - dt.timedelta(days=1)).replace(
+                hour=0, minute=0, second=0, microsecond=0))
+        return [
+            t for t in self._tasks.values()
+            if t.task_due_date == yesterday and not t.is_completed
+        ]
+
+    async def mark_overdue_tasks(self, tasks):
+        async with self._lock:
+            for doc in tasks:
+                task = self._tasks.get(doc.get("taskId", ""))
+                if task is not None:
+                    task.is_over_due = True
+
+
+class TasksStoreManager(TasksManager):
+    """State-store-backed implementation (TasksStoreManager.cs:9-157).
+
+    ``client`` is the injected AppClient (≙ DaprClient). Publishes
+    TaskSaved on create and on reassignment, exactly where the
+    reference does (:36, :95-98).
+    """
+
+    def __init__(self, client):
+        self.client = client
+
+    async def _publish_task_saved(self, task: TaskModel) -> None:
+        # ≙ PublishTaskSavedEvent (TasksStoreManager.cs:151-156)
+        logger.info("Publishing task saved event for task %s", task.task_id)
+        await self.client.publish_event(PUBSUB_NAME, TOPIC_NAME, task.to_json())
+
+    async def get_tasks_by_creator(self, created_by):
+        # ≙ QueryStateAsync w/ EQ filter (TasksStoreManager.cs:56-61)
+        result = await self.client.query_state(
+            STORE_NAME, {"filter": {"EQ": {"taskCreatedBy": created_by}}})
+        tasks = [TaskModel.from_json(r["data"]) for r in result["results"]]
+        # ≙ the LINQ order-by-created-desc done app-side (:63-66)
+        return sorted(tasks, key=lambda t: t.task_created_on, reverse=True)
+
+    async def get_task_by_id(self, task_id):
+        doc = await self.client.get_state(STORE_NAME, task_id)
+        return None if doc is None else TaskModel.from_json(doc)
+
+    async def create_new_task(self, add_doc):
+        task = add_model(add_doc)
+        logger.info("Saving new task with id %s", task.task_id)
+        await self.client.save_state(STORE_NAME, task.task_id, task.to_json())
+        await self._publish_task_saved(task)
+        return task.task_id
+
+    async def update_task(self, task_id, update_doc):
+        task = await self.get_task_by_id(task_id)
+        if task is None:
+            return False
+        previous_assignee = task.task_assigned_to  # :92
+        apply_update(task, update_doc)
+        await self.client.save_state(STORE_NAME, task_id, task.to_json())
+        if previous_assignee != task.task_assigned_to:
+            # reassignment republishes the saved event (:95-98)
+            await self._publish_task_saved(task)
+        return True
+
+    async def mark_task_completed(self, task_id):
+        task = await self.get_task_by_id(task_id)
+        if task is None:
+            return False
+        task.is_completed = True
+        await self.client.save_state(STORE_NAME, task_id, task.to_json())
+        return True
+
+    async def delete_task(self, task_id):
+        logger.info("Deleting task with id %s", task_id)
+        if await self.get_task_by_id(task_id) is None:
+            return False
+        await self.client.delete_state(STORE_NAME, task_id)
+        return True
+
+    async def get_yesterdays_due_tasks(self):
+        # ≙ EQ on the *serialized* due date (TasksStoreManager.cs:104-130,
+        # the DateTimeConverter trap): only tasks stored with exactly
+        # yesterday-midnight due dates match.
+        yesterday = format_dt(
+            (dt.datetime.now() - dt.timedelta(days=1)).replace(
+                hour=0, minute=0, second=0, microsecond=0))
+        result = await self.client.query_state(
+            STORE_NAME, {"filter": {"EQ": {"taskDueDate": yesterday}}})
+        return [
+            t for t in (TaskModel.from_json(r["data"]) for r in result["results"])
+            if not t.is_completed
+        ]
+
+    async def mark_overdue_tasks(self, tasks):
+        # ≙ the per-task sequential SaveStateAsync loop
+        # (TasksStoreManager.cs:141-148) — the reference's only hot loop
+        for doc in tasks:
+            task_id = doc.get("taskId", "")
+            task = await self.get_task_by_id(task_id)
+            if task is None:
+                continue
+            logger.info("Marking task %s as overdue", task_id)
+            task.is_over_due = True
+            await self.client.save_state(STORE_NAME, task_id, task.to_json())

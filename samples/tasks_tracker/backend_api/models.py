@@ -1,0 +1,96 @@
+"""Task DTOs for the backend API service.
+
+Field-for-field parity with the reference's models
+(TasksTracker.TasksManager.Backend.Api/Models/TaskModel.cs:3-29):
+TaskModel (8 props), TaskAddModel (4), TaskUpdateModel (4). JSON names
+use the same camelCase the reference serializes.
+
+Datetime contract: all dates serialize with ``DATETIME_FORMAT`` — the
+role the reference's DateTimeConverter plays
+(Utilities/DateTimeConverter.cs:6-30): state queries filter on the
+*serialized* string, so writer and query must agree on one format.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import uuid
+from dataclasses import dataclass, field
+from typing import Any
+
+DATETIME_FORMAT = "%Y-%m-%dT%H:%M:%S"
+
+
+def format_dt(value: dt.datetime) -> str:
+    return value.strftime(DATETIME_FORMAT)
+
+
+def parse_dt(text: str) -> dt.datetime:
+    # accept a few common forms but always *emit* DATETIME_FORMAT
+    for fmt in (DATETIME_FORMAT, "%Y-%m-%d", "%Y-%m-%dT%H:%M:%S.%f"):
+        try:
+            return dt.datetime.strptime(text, fmt)
+        except ValueError:
+            continue
+    return dt.datetime.fromisoformat(text)
+
+
+@dataclass
+class TaskModel:
+    task_id: str = field(default_factory=lambda: str(uuid.uuid4()))
+    task_name: str = ""
+    task_created_by: str = ""
+    task_created_on: str = field(default_factory=lambda: format_dt(dt.datetime.now()))
+    task_due_date: str = ""
+    task_assigned_to: str = ""
+    is_completed: bool = False
+    is_over_due: bool = False
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "taskId": self.task_id,
+            "taskName": self.task_name,
+            "taskCreatedBy": self.task_created_by,
+            "taskCreatedOn": self.task_created_on,
+            "taskDueDate": self.task_due_date,
+            "taskAssignedTo": self.task_assigned_to,
+            "isCompleted": self.is_completed,
+            "isOverDue": self.is_over_due,
+        }
+
+    @classmethod
+    def from_json(cls, doc: dict[str, Any]) -> "TaskModel":
+        return cls(
+            task_id=doc.get("taskId") or str(uuid.uuid4()),
+            task_name=doc.get("taskName", ""),
+            task_created_by=doc.get("taskCreatedBy", ""),
+            task_created_on=doc.get("taskCreatedOn", ""),
+            task_due_date=doc.get("taskDueDate", ""),
+            task_assigned_to=doc.get("taskAssignedTo", ""),
+            is_completed=bool(doc.get("isCompleted", False)),
+            is_over_due=bool(doc.get("isOverDue", False)),
+        )
+
+
+def add_model(doc: dict[str, Any]) -> TaskModel:
+    """≙ TaskAddModel → new TaskModel (TasksStoreManager.CreateNewTask)."""
+    due = doc.get("taskDueDate", "")
+    if due:
+        due = format_dt(parse_dt(due))
+    return TaskModel(
+        task_name=doc.get("taskName", ""),
+        task_created_by=doc.get("taskCreatedBy", ""),
+        task_due_date=due,
+        task_assigned_to=doc.get("taskAssignedTo", ""),
+    )
+
+
+def apply_update(task: TaskModel, doc: dict[str, Any]) -> TaskModel:
+    """≙ TaskUpdateModel applied in UpdateTask (TasksStoreManager.cs:84-101)."""
+    if "taskName" in doc:
+        task.task_name = doc["taskName"]
+    if "taskDueDate" in doc and doc["taskDueDate"]:
+        task.task_due_date = format_dt(parse_dt(doc["taskDueDate"]))
+    if "taskAssignedTo" in doc:
+        task.task_assigned_to = doc["taskAssignedTo"]
+    return task

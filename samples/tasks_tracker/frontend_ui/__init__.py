@@ -1,0 +1,3 @@
+from samples.tasks_tracker.frontend_ui.app import make_app
+
+__all__ = ["make_app"]

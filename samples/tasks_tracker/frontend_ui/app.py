@@ -1,0 +1,210 @@
+"""Frontend web app — app-id ``tasksmanager-frontend-webapp``.
+
+Server-rendered UI ≙ the reference's Razor Pages
+(TasksTracker.WebPortal.Frontend.Ui/Pages):
+
+* ``/``                 — email form → ``TasksCreatedByCookie`` →
+  redirect to /tasks (Pages/Index.cshtml.cs:23-31)
+* ``/tasks``            — list for the cookie user via service
+  invocation only, plus complete/delete post handlers
+  (Pages/Tasks/Index.cshtml.cs:8-72; invoke at :48)
+* ``/tasks/create``     — form → POST api/tasks (Create.cshtml.cs:46)
+* ``/tasks/edit/{id}``  — GET task :38 + PUT update :66
+
+Every backend call goes through ``invoke_method`` to app-id
+``tasksmanager-backend-api`` — the frontend knows no backend URL
+(the whole point of module 3).
+"""
+
+from __future__ import annotations
+
+import html
+from http.cookies import SimpleCookie
+from urllib.parse import urlencode
+
+from tasksrunner import App, Response
+
+APP_ID = "tasksmanager-frontend-webapp"
+BACKEND_APP_ID = "tasksmanager-backend-api"
+COOKIE_NAME = "TasksCreatedByCookie"  # Pages/Index.cshtml.cs:27
+
+
+def _cookie_user(req) -> str | None:
+    jar = SimpleCookie(req.headers.get("cookie", ""))
+    morsel = jar.get(COOKIE_NAME)
+    return morsel.value if morsel else None
+
+
+def _redirect(location: str, *, set_cookie: str | None = None) -> Response:
+    headers = {"location": location}
+    if set_cookie is not None:
+        headers["set-cookie"] = f"{COOKIE_NAME}={set_cookie}; Path=/; HttpOnly"
+    return Response(status=303, headers=headers)
+
+
+def _page(title: str, body: str) -> Response:
+    doc = f"""<!doctype html>
+<html><head><meta charset="utf-8"><title>{html.escape(title)} — Tasks Tracker</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2rem auto; max-width: 56rem; }}
+ table {{ border-collapse: collapse; width: 100%; }}
+ th, td {{ border: 1px solid #ccc; padding: .4rem .6rem; text-align: left; }}
+ .overdue {{ color: #b00; font-weight: 600; }} .done {{ color: #080; }}
+ form.inline {{ display: inline; }}
+ input, button {{ padding: .3rem .5rem; margin: .15rem 0; }}
+</style></head>
+<body><h1>Tasks Tracker</h1>{body}</body></html>"""
+    return Response(status=200, body=doc,
+                    headers={"content-type": "text/html; charset=utf-8"})
+
+
+def make_app() -> App:
+    app = App(APP_ID)
+
+    # -- landing page (Pages/Index.cshtml) -------------------------------
+
+    @app.get("/")
+    async def index(req):
+        return _page("Sign in", """
+<p>Enter your email to view and manage your tasks.</p>
+<form method="post" action="/">
+  <label>Email <input type="email" name="email" required></label>
+  <button type="submit">Continue</button>
+</form>""")
+
+    @app.post("/")
+    async def index_post(req):
+        email = _form_field(req, "email")
+        if not email:
+            return _page("Sign in", "<p>Email is required.</p>")
+        return _redirect("/tasks", set_cookie=email)
+
+    # -- task list (Pages/Tasks/Index.cshtml) ----------------------------
+
+    @app.get("/tasks")
+    async def task_list(req):
+        user = _cookie_user(req)
+        if not user:
+            return _redirect("/")
+        tasks = await app.client.invoke_json(
+            BACKEND_APP_ID, "api/tasks",
+            query=urlencode({"createdBy": user}))
+        rows = "".join(_task_row(t) for t in tasks) or \
+            '<tr><td colspan="6">No tasks yet.</td></tr>'
+        return _page("Tasks", f"""
+<p>Signed in as <b>{html.escape(user)}</b> — <a href="/tasks/create">Create new task</a></p>
+<table><tr><th>Name</th><th>Due</th><th>Assigned to</th><th>Status</th>
+<th></th><th></th></tr>{rows}</table>""")
+
+    def _task_row(t: dict) -> str:
+        status = ('<span class="done">completed</span>' if t.get("isCompleted")
+                  else '<span class="overdue">overdue</span>' if t.get("isOverDue")
+                  else "open")
+        tid = html.escape(t.get("taskId", ""))
+        return f"""<tr>
+<td><a href="/tasks/edit/{tid}">{html.escape(t.get('taskName', ''))}</a></td>
+<td>{html.escape(t.get('taskDueDate', ''))}</td>
+<td>{html.escape(t.get('taskAssignedTo', ''))}</td>
+<td>{status}</td>
+<td><form class="inline" method="post" action="/tasks/complete/{tid}">
+    <button {'disabled' if t.get('isCompleted') else ''}>Complete</button></form></td>
+<td><form class="inline" method="post" action="/tasks/delete/{tid}">
+    <button>Delete</button></form></td></tr>"""
+
+    @app.post("/tasks/complete/{task_id}")
+    async def complete(req):
+        # ≙ OnPostCompleteAsync (Pages/Tasks/Index.cshtml.cs:65-71)
+        await app.client.invoke_method(
+            BACKEND_APP_ID, f"api/tasks/{req.path_params['task_id']}/markcomplete",
+            http_method="PUT")
+        return _redirect("/tasks")
+
+    @app.post("/tasks/delete/{task_id}")
+    async def delete(req):
+        # ≙ OnPostDeleteAsync (:57-63)
+        await app.client.invoke_method(
+            BACKEND_APP_ID, f"api/tasks/{req.path_params['task_id']}",
+            http_method="DELETE")
+        return _redirect("/tasks")
+
+    # -- create (Pages/Tasks/Create.cshtml) ------------------------------
+
+    @app.get("/tasks/create")
+    async def create_form(req):
+        if not _cookie_user(req):
+            return _redirect("/")
+        return _page("Create task", """
+<h2>New task</h2>
+<form method="post" action="/tasks/create">
+  <p><label>Name <input name="taskName" required></label></p>
+  <p><label>Due date <input type="date" name="taskDueDate" required></label></p>
+  <p><label>Assigned to <input type="email" name="taskAssignedTo" required></label></p>
+  <button type="submit">Create</button> <a href="/tasks">Cancel</a>
+</form>""")
+
+    @app.post("/tasks/create")
+    async def create_post(req):
+        user = _cookie_user(req)
+        if not user:
+            return _redirect("/")
+        form = _form(req)
+        resp = await app.client.invoke_method(
+            BACKEND_APP_ID, "api/tasks", http_method="POST",
+            data={
+                "taskName": form.get("taskName", ""),
+                "taskCreatedBy": user,
+                "taskDueDate": form.get("taskDueDate", ""),
+                "taskAssignedTo": form.get("taskAssignedTo", ""),
+            })
+        resp.raise_for_status()
+        return _redirect("/tasks")
+
+    # -- edit (Pages/Tasks/Edit.cshtml) ----------------------------------
+
+    @app.get("/tasks/edit/{task_id}")
+    async def edit_form(req):
+        if not _cookie_user(req):
+            return _redirect("/")
+        tid = req.path_params["task_id"]
+        resp = await app.client.invoke_method(
+            BACKEND_APP_ID, f"api/tasks/{tid}", http_method="GET")
+        if resp.status == 404:
+            return Response(status=404, body="task not found")
+        t = resp.raise_for_status().json()
+        due = html.escape((t.get("taskDueDate") or "")[:10])
+        return _page("Edit task", f"""
+<h2>Edit task</h2>
+<form method="post" action="/tasks/edit/{html.escape(tid)}">
+  <p><label>Name <input name="taskName" value="{html.escape(t.get('taskName', ''))}" required></label></p>
+  <p><label>Due date <input type="date" name="taskDueDate" value="{due}" required></label></p>
+  <p><label>Assigned to <input type="email" name="taskAssignedTo"
+       value="{html.escape(t.get('taskAssignedTo', ''))}" required></label></p>
+  <button type="submit">Save</button> <a href="/tasks">Cancel</a>
+</form>""")
+
+    @app.post("/tasks/edit/{task_id}")
+    async def edit_post(req):
+        if not _cookie_user(req):
+            return _redirect("/")
+        form = _form(req)
+        resp = await app.client.invoke_method(
+            BACKEND_APP_ID, f"api/tasks/{req.path_params['task_id']}",
+            http_method="PUT",
+            data={
+                "taskName": form.get("taskName", ""),
+                "taskDueDate": form.get("taskDueDate", ""),
+                "taskAssignedTo": form.get("taskAssignedTo", ""),
+            })
+        resp.raise_for_status()
+        return _redirect("/tasks")
+
+    return app
+
+
+def _form(req) -> dict[str, str]:
+    from urllib.parse import parse_qsl
+    return dict(parse_qsl(req.body.decode("utf-8", "replace")))
+
+
+def _form_field(req, name: str) -> str:
+    return _form(req).get(name, "").strip()

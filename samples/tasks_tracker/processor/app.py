@@ -1,0 +1,122 @@
+"""Processor service — app-id ``tasksmanager-backend-processor``.
+
+The event-driven backend ≙ TasksTracker.Processor.Backend.Svc, three
+controllers:
+
+* ``TasksNotifierController`` (Controllers/TasksNotifierController.cs:8-33):
+  subscribes to ``tasksavedtopic`` on both the cloud pubsub
+  (``dapr-pubsub-servicebus``) and the local one (``taskspubsub``),
+  route ``POST api/tasksnotifier/tasksaved``; sends the assignee an
+  email through the ``sendgrid`` output binding gated by
+  ``SendGrid:IntegrationEnabled`` config (module-6 version,
+  docs/aca/06-aca-dapr-bindingsapi/TasksNotifierController.cs:38-57)
+* ``ScheduledTasksManagerController`` (:7-47): cron component
+  ``ScheduledTasksManager`` POSTs the route named after it; fetches
+  ``api/overduetasks`` via invoke :28, filters dueDate < today :32-38,
+  posts ``markoverdue`` :44
+* ``ExternalTasksProcessorController`` (:7-54): storage-queue input
+  binding routes to ``POST /externaltasksprocessor/process``; assigns
+  id/createdOn :29-30, saves via invoke :33, archives to the
+  ``externaltasksblobstore`` output binding with blobName "{id}.json"
+  :38-43
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import logging
+import os
+
+from tasksrunner import App
+
+logger = logging.getLogger(__name__)
+
+APP_ID = "tasksmanager-backend-processor"
+BACKEND_APP_ID = "tasksmanager-backend-api"
+CLOUD_PUBSUB = "dapr-pubsub-servicebus"  # TasksNotifierController.cs:23
+LOCAL_PUBSUB = "taskspubsub"             # :25 (Redis slot locally)
+TOPIC = "tasksavedtopic"
+SENDGRID_BINDING = "sendgrid"            # docs module 6 :13
+BLOB_BINDING = "externaltasksblobstore"  # ExternalTasksProcessorController.cs:13
+DATETIME_FORMAT = "%Y-%m-%dT%H:%M:%S"
+
+
+def make_app(*, sendgrid_enabled: bool | None = None) -> App:
+    app = App(APP_ID)
+    if sendgrid_enabled is None:
+        # ≙ config SendGrid:IntegrationEnabled (processor-backend-service
+        # .bicep:148-151, env SendGrid__IntegrationEnabled)
+        sendgrid_enabled = os.environ.get(
+            "SENDGRID__INTEGRATIONENABLED", "true").lower() == "true"
+    app.state["sendgrid_enabled"] = sendgrid_enabled
+    app.state["notified"] = []  # observable record of handled events
+
+    # -- TasksNotifierController -----------------------------------------
+
+    async def _task_saved(req):
+        task = req.data or {}
+        logger.info("Started processing message with task name '%s'",
+                    task.get("taskName"))
+        app.state["notified"].append(task)
+        if app.state["sendgrid_enabled"]:
+            await app.client.invoke_binding(
+                SENDGRID_BINDING, "create",
+                f"<p>Task <b>{task.get('taskName', '')}</b> is assigned to you.</p>",
+                {
+                    "emailTo": task.get("taskAssignedTo", ""),
+                    "emailToName": task.get("taskAssignedTo", ""),
+                    "subject": "Tasks assigned to you",
+                },
+            )
+        return 200
+
+    # both [Topic] attributes stack on one action (cloud + local slots)
+    app.subscribe(CLOUD_PUBSUB, TOPIC, route="/api/tasksnotifier/tasksaved")(_task_saved)
+    app.subscribe(LOCAL_PUBSUB, TOPIC, route="/api/tasksnotifier/tasksaved")(_task_saved)
+
+    # -- ScheduledTasksManagerController ---------------------------------
+
+    @app.binding("ScheduledTasksManager")
+    async def check_overdue_tasks_job(req):
+        run_at = dt.datetime.now()
+        logger.info("ScheduledTasksManager executed at %s", run_at)
+        overdue = await app.client.invoke_json(
+            BACKEND_APP_ID, "api/overduetasks", http_method="GET")
+        # filter runAt.Date > dueDate.Date in-process (:32-38)
+        to_mark = []
+        for task in overdue:
+            try:
+                due = dt.datetime.strptime(task.get("taskDueDate", ""),
+                                           DATETIME_FORMAT)
+            except ValueError:
+                continue
+            if run_at.date() > due.date():
+                to_mark.append(task)
+        if to_mark:
+            logger.info("Marking %d tasks overdue", len(to_mark))
+            resp = await app.client.invoke_method(
+                BACKEND_APP_ID, "api/overduetasks/markoverdue",
+                http_method="POST", data=to_mark)
+            resp.raise_for_status()
+        return 200
+
+    # -- ExternalTasksProcessorController --------------------------------
+
+    @app.binding("externaltasksmanager", route="/externaltasksprocessor/process")
+    async def process_external_task(req):
+        task = req.data or {}
+        # assign server-side identity (:29-30)
+        import uuid
+        task["taskId"] = str(uuid.uuid4())
+        task["taskCreatedOn"] = dt.datetime.now().strftime(DATETIME_FORMAT)
+        resp = await app.client.invoke_method(
+            BACKEND_APP_ID, "api/tasks", http_method="POST", data=task)
+        resp.raise_for_status()
+        created = resp.json()
+        # archive the raw payload (:38-43)
+        await app.client.invoke_binding(
+            BLOB_BINDING, "create", task,
+            {"blobName": f"{created['taskId']}.json"})
+        return 200
+
+    return app

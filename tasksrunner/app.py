@@ -181,13 +181,29 @@ class App:
         return self.route(path, methods="DELETE")
 
     def subscribe(self, pubsub: str, topic: str, route: str | None = None):
-        """≙ [Topic(pubsub, topic)] on an action method."""
+        """≙ [Topic(pubsub, topic)] on an action method. Multiple
+        subscriptions may share one route (the reference stacks a cloud
+        and a local [Topic] attribute on the same action —
+        TasksNotifierController.cs:23-25)."""
         route = route or f"/events/{pubsub}/{topic}"
 
         def register(handler: Handler) -> Handler:
             self.subscriptions.append(
                 SubscriptionEntry(pubsub_name=pubsub, topic=topic, route=route)
             )
+            existing = next(
+                (r for r in self._routes
+                 if r.kind == "subscription" and r.match("POST", route) is not None),
+                None,
+            )
+            if existing is not None:
+                if existing.handler is not handler:
+                    raise ValueError(
+                        f"route {route!r} is already bound to a different "
+                        "subscription handler; stacking topics on one route "
+                        "requires the same handler"
+                    )
+                return handler
             return self.route(route, methods="POST", kind="subscription")(handler)
 
         return register

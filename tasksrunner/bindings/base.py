@@ -54,6 +54,10 @@ class InputBinding(abc.ABC):
     def __init__(self, name: str):
         self.name = name
         self.route = "/" + name
+        #: set by the runtime that starts this binding; guards a shared
+        #: instance against being started twice (which would orphan the
+        #: first poll task)
+        self.running = False
 
     @abc.abstractmethod
     async def start(self, sink: EventSink) -> None:

@@ -97,8 +97,16 @@ class CronSchedule:
             return
         fields = self.expr.split()
         if len(fields) == 6:
-            # Dapr's cron binding accepts 6-field (with seconds); we
-            # accept and ignore a leading seconds field of "0".
+            # Dapr's cron binding accepts 6-field (with seconds). We
+            # support minute granularity: a seconds field of 0/* is
+            # accepted and dropped; anything else would silently change
+            # the schedule, so reject it (use "@every Ns" instead).
+            if fields[0] not in ("0", "*"):
+                raise BindingError(
+                    f"sub-minute cron schedules are not supported "
+                    f"(seconds field {fields[0]!r} in {self.expr!r}); "
+                    "use '@every Ns' for sub-minute cadence"
+                )
             fields = fields[1:]
         if len(fields) != 5:
             raise BindingError(

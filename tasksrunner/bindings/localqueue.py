@@ -13,6 +13,7 @@ binding, or any sqlite client — the moral equivalent of the workshop's
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import json
 import logging
 import pathlib
@@ -134,11 +135,20 @@ class LocalQueueBinding(InputBinding, OutputBinding):
         self.max_attempts = max_attempts
         self.retry_delay = retry_delay
         self._task: asyncio.Task | None = None
+        # one dedicated thread: cross-process sqlite lock waits must not
+        # stall the event loop, and it serialises connection use between
+        # the poll loop and output-side sends
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"queue-{name}")
+
+    async def _run(self, fn, *args, **kwargs):
+        return await asyncio.get_running_loop().run_in_executor(
+            self._executor, lambda: fn(*args, **kwargs))
 
     async def start(self, sink: EventSink) -> None:
         async def loop() -> None:
             while True:
-                claimed = self.queue.claim()
+                claimed = await self._run(self.queue.claim)
                 if claimed is None:
                     await asyncio.sleep(self.poll_interval)
                     continue
@@ -152,13 +162,13 @@ class LocalQueueBinding(InputBinding, OutputBinding):
                     logger.exception("queue %s delivery failed", self.name)
                     ok = False
                 if ok:
-                    self.queue.ack(msg_id)
+                    await self._run(self.queue.ack, msg_id)
                 elif attempt >= self.max_attempts:
                     logger.warning("dead-lettering queue message %s after %d attempts",
                                    msg_id, attempt)
-                    self.queue.dead_letter(msg_id)
+                    await self._run(self.queue.dead_letter, msg_id)
                 else:
-                    self.queue.nack(msg_id, delay=self.retry_delay)
+                    await self._run(self.queue.nack, msg_id, delay=self.retry_delay)
 
         self._task = asyncio.create_task(loop())
 
@@ -170,6 +180,7 @@ class LocalQueueBinding(InputBinding, OutputBinding):
             except asyncio.CancelledError:
                 pass
             self._task = None
+        self._executor.shutdown(wait=True)
         self.queue.close()
 
     async def invoke(self, operation: str, data: Any,
@@ -177,7 +188,7 @@ class LocalQueueBinding(InputBinding, OutputBinding):
         if operation != "create":
             from tasksrunner.errors import BindingError
             raise BindingError(f"queue binding supports only create, not {operation!r}")
-        msg_id = self.queue.send(data)
+        msg_id = await self._run(self.queue.send, data)
         return BindingResponse(metadata={"messageId": msg_id})
 
 

@@ -74,11 +74,14 @@ class NameResolver:
                 break
             except FileExistsError:
                 if time.time() > deadline:
-                    # stale lock (holder crashed): steal it
+                    # stale lock (holder crashed): steal it once, then
+                    # give the normal acquisition window again so we
+                    # don't unlink locks live processes just created
                     try:
                         lock.unlink()
                     except FileNotFoundError:
                         pass
+                    deadline = time.time() + 5.0
                 time.sleep(0.01)
         try:
             entries = self._read_file()

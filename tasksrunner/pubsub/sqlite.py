@@ -17,6 +17,7 @@ scaler's messageCount on a topic subscription
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import json
 import logging
 import pathlib
@@ -85,11 +86,24 @@ class SqliteBroker(PubSubBroker):
         self._conn.commit()
         self._tasks: list[asyncio.Task] = []
         self._closed = False
+        # All db work runs on one dedicated thread: cross-process lock
+        # waits (busy_timeout) must never stall the event loop, and one
+        # thread serialises use of the shared connection.
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"broker-{name}")
+
+    async def _run(self, fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(
+            self._executor, fn, *args)
 
     # -- publish ---------------------------------------------------------
 
     async def publish(self, topic: str, data: Any, *, metadata=None) -> str:
         msg_id = str(uuid.uuid4())
+        await self._run(self._publish_sync, topic, data, metadata, msg_id)
+        return msg_id
+
+    def _publish_sync(self, topic: str, data: Any, metadata, msg_id: str) -> None:
         now = time.time()
         cur = self._conn.cursor()
         try:
@@ -110,9 +124,11 @@ class SqliteBroker(PubSubBroker):
         except BaseException:
             self._conn.rollback()
             raise
-        return msg_id
 
     async def ensure_group(self, topic: str, group: str) -> None:
+        await self._run(self._ensure_group_sync, topic, group)
+
+    def _ensure_group_sync(self, topic: str, group: str) -> None:
         self._conn.execute(
             "INSERT OR IGNORE INTO groups(topic, grp) VALUES (?, ?)", (topic, group)
         )
@@ -182,7 +198,7 @@ class SqliteBroker(PubSubBroker):
 
         async def poll_loop() -> None:
             while not stop.is_set() and not self._closed:
-                msg = self._claim_one(topic, group)
+                msg = await self._run(self._claim_one, topic, group)
                 if msg is None:
                     try:
                         await asyncio.wait_for(stop.wait(), timeout=self.poll_interval)
@@ -195,9 +211,9 @@ class SqliteBroker(PubSubBroker):
                     logger.exception("handler error on topic %s group %s", topic, group)
                     ok = False
                 if ok:
-                    self._ack(msg.id, group)
+                    await self._run(self._ack, msg.id, group)
                 else:
-                    self._nack(msg, group)
+                    await self._run(self._nack, msg, group)
 
         task = asyncio.create_task(poll_loop())
         self._tasks.append(task)
@@ -255,6 +271,7 @@ class SqliteBroker(PubSubBroker):
             except (asyncio.CancelledError, Exception):
                 pass
         self._tasks.clear()
+        self._executor.shutdown(wait=True)
         self._conn.close()
 
 

@@ -211,6 +211,10 @@ class Runtime:
             pubsub_name=pubsub_name,
         )
         meta = dict(metadata or {})
+        # record the envelope type so delivery presents the right
+        # content-type (raw payloads must NOT be unwrapped downstream)
+        meta["content-type"] = (
+            "application/json" if raw else cloudevents.CONTENT_TYPE)
         meta.update(outgoing_headers())
         msg_id = await broker.publish(topic, envelope, metadata=meta)
         metrics.inc("publish", pubsub=pubsub_name, topic=topic)
@@ -315,7 +319,13 @@ class Runtime:
         for name in self.registry.names(block="bindings"):
             instance = self.registry.get(name)
             if isinstance(instance, InputBinding):
+                if instance.running:
+                    # shared instance already started by another runtime
+                    # (InProcCluster); a second start would orphan the
+                    # first poll task
+                    continue
                 await instance.start(self._make_binding_sink(instance))
+                instance.running = True
                 self._input_bindings.append(instance)
                 logger.info("input binding %s -> %s", name, instance.route)
         self._started = True
@@ -326,7 +336,8 @@ class Runtime:
             with trace_scope(ctx):
                 body = json.dumps(msg.data).encode()
                 headers = {
-                    "content-type": cloudevents.CONTENT_TYPE,
+                    "content-type": msg.metadata.get(
+                        "content-type", cloudevents.CONTENT_TYPE),
                     TRACEPARENT_HEADER: ctx.header,
                 }
                 try:
@@ -379,6 +390,7 @@ class Runtime:
         self._subscriptions.clear()
         for binding in self._input_bindings:
             await binding.stop()
+            binding.running = False
         self._input_bindings.clear()
         if self._session is not None:
             await self._session.close()

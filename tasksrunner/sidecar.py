@@ -158,11 +158,15 @@ def build_sidecar_app(runtime: Runtime) -> web.Application:
         status, headers, resp_body = await runtime.invoke(
             target, path, http_method=request.method,
             query=request.query_string, headers=fwd_headers, body=body)
-        return web.Response(
-            status=status, body=resp_body,
-            content_type=(headers.get("content-type", "application/json")
-                          .split(";")[0]),
-        )
+        # forward the app's response headers (redirect locations,
+        # cookies, etags...) — HTTP mode must not lose what the direct
+        # transport delivers; only hop-by-hop headers are dropped
+        hop_by_hop = {"content-length", "transfer-encoding", "connection",
+                      "keep-alive", "server", "date"}
+        out_headers = {
+            k: v for k, v in headers.items() if k.lower() not in hop_by_hop
+        }
+        return web.Response(status=status, body=resp_body, headers=out_headers)
 
     # -- meta ------------------------------------------------------------
 

@@ -1,0 +1,277 @@
+"""Tasks Tracker sample-app integration tests.
+
+Automates the reference's manual verification checkpoints (SURVEY.md
+§4): browser CRUD walkthrough, pub/sub consumer logs, cron overdue
+job, external-queue ingest with blob archive — against the real
+services on the real component files
+(samples/tasks_tracker/components/).
+"""
+
+import asyncio
+import datetime as dt
+import json
+import pathlib
+import re
+
+import pytest
+
+from tasksrunner import AppHost, InProcCluster, load_components
+from tasksrunner.bindings.localqueue import SqliteQueue
+
+from samples.tasks_tracker.backend_api import make_app as make_api
+from samples.tasks_tracker.backend_api.models import format_dt
+from samples.tasks_tracker.frontend_ui import make_app as make_frontend
+from samples.tasks_tracker.processor import make_app as make_processor
+
+COMPONENTS_DIR = (
+    pathlib.Path(__file__).resolve().parent.parent
+    / "samples" / "tasks_tracker" / "components"
+)
+
+API = "tasksmanager-backend-api"
+FRONTEND = "tasksmanager-frontend-webapp"
+PROCESSOR = "tasksmanager-backend-processor"
+
+
+async def wait_until(cond, timeout=5.0):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not cond():
+        if asyncio.get_running_loop().time() > deadline:
+            raise AssertionError("condition not met in time")
+        await asyncio.sleep(0.02)
+
+
+@pytest.fixture
+def isolated_cwd(tmp_path, monkeypatch):
+    """Component files use relative .tasksrunner/ paths; isolate them."""
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def build_cluster():
+    specs = load_components(COMPONENTS_DIR)
+    cluster = InProcCluster(specs)
+    api = make_api("store")
+    frontend = make_frontend()
+    processor = make_processor()
+    for a in (api, frontend, processor):
+        cluster.add_app(a)
+    return cluster, api, frontend, processor
+
+
+def cookie_from(resp) -> str:
+    m = re.match(r"([^;]+)", resp.headers.get("set-cookie", ""))
+    assert m, "no cookie set"
+    return m.group(1)
+
+
+@pytest.mark.asyncio
+async def test_frontend_crud_walkthrough(isolated_cwd):
+    """≙ the workshop's browser loop: sign in → create → list →
+    reassign → complete → delete, with the processor notified."""
+    cluster, api, frontend, processor = build_cluster()
+    await cluster.start()
+    try:
+        # sign in: email → cookie → redirect
+        resp = await frontend.handle("POST", "/", body=b"email=a%40x.com")
+        assert resp.status == 303 and resp.headers["location"] == "/tasks"
+        cookie = cookie_from(resp)
+        assert cookie == "TasksCreatedByCookie=a@x.com"
+
+        # empty list
+        resp = await frontend.handle("GET", "/tasks", headers={"cookie": cookie})
+        assert resp.status == 200 and "No tasks yet" in resp.body
+
+        # create
+        resp = await frontend.handle(
+            "POST", "/tasks/create", headers={"cookie": cookie},
+            body=b"taskName=Write+docs&taskDueDate=2026-08-01&taskAssignedTo=b%40x.com")
+        assert resp.status == 303
+
+        resp = await frontend.handle("GET", "/tasks", headers={"cookie": cookie})
+        assert "Write docs" in resp.body and "b@x.com" in resp.body
+        task_id = re.search(r"/tasks/edit/([0-9a-f-]{36})", resp.body).group(1)
+
+        # processor got the TaskSaved event and "sent" the email
+        await wait_until(lambda: len(processor.state["notified"]) == 1)
+        assert processor.state["notified"][0]["taskName"] == "Write docs"
+        outbox = list(pathlib.Path(".tasksrunner/outbox").glob("*.json"))
+        assert len(outbox) == 1
+        mail = json.loads(outbox[0].read_text())
+        assert mail["to"] == "b@x.com"
+        assert mail["subject"] == "Tasks assigned to you"
+
+        # edit page prefilled
+        resp = await frontend.handle("GET", f"/tasks/edit/{task_id}",
+                                     headers={"cookie": cookie})
+        assert 'value="Write docs"' in resp.body
+
+        # reassign → second TaskSaved publish (TasksStoreManager.cs:95-98)
+        resp = await frontend.handle(
+            "POST", f"/tasks/edit/{task_id}", headers={"cookie": cookie},
+            body=b"taskName=Write+docs&taskDueDate=2026-08-01&taskAssignedTo=c%40x.com")
+        assert resp.status == 303
+        await wait_until(lambda: len(processor.state["notified"]) == 2)
+
+        # edit without reassignment → no extra publish
+        await frontend.handle(
+            "POST", f"/tasks/edit/{task_id}", headers={"cookie": cookie},
+            body=b"taskName=Write+better+docs&taskDueDate=2026-08-01&taskAssignedTo=c%40x.com")
+        await asyncio.sleep(0.2)
+        assert len(processor.state["notified"]) == 2
+
+        # complete
+        await frontend.handle("POST", f"/tasks/complete/{task_id}",
+                              headers={"cookie": cookie})
+        resp = await frontend.handle("GET", "/tasks", headers={"cookie": cookie})
+        assert "completed" in resp.body
+
+        # delete
+        await frontend.handle("POST", f"/tasks/delete/{task_id}",
+                              headers={"cookie": cookie})
+        resp = await frontend.handle("GET", "/tasks", headers={"cookie": cookie})
+        assert "No tasks yet" in resp.body
+
+        # no-cookie access redirects to sign-in
+        resp = await frontend.handle("GET", "/tasks")
+        assert resp.status == 303 and resp.headers["location"] == "/"
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_overdue_cron_job(isolated_cwd):
+    """≙ SURVEY.md §3.3: cron fires → fetch overdue → mark overdue."""
+    cluster, api, frontend, processor = build_cluster()
+    await cluster.start()
+    try:
+        api_client = cluster.client(API)
+        yesterday = format_dt((dt.datetime.now() - dt.timedelta(days=1)).replace(
+            hour=0, minute=0, second=0, microsecond=0))
+        # store a task due yesterday directly through the API surface
+        resp = await api_client.invoke_method(
+            API, "api/tasks", http_method="POST",
+            data={"taskName": "stale", "taskCreatedBy": "a@x.com",
+                  "taskDueDate": yesterday})
+        task_id = resp.raise_for_status().json()["taskId"]
+        # and one due tomorrow (must stay untouched)
+        resp = await api_client.invoke_method(
+            API, "api/tasks", http_method="POST",
+            data={"taskName": "fresh", "taskCreatedBy": "a@x.com",
+                  "taskDueDate": format_dt(dt.datetime.now() + dt.timedelta(days=1))})
+        fresh_id = resp.raise_for_status().json()["taskId"]
+
+        # fire the cron route exactly as the sidecar would
+        resp = await cluster.client(PROCESSOR).invoke_method(
+            PROCESSOR, "ScheduledTasksManager", http_method="POST")
+        assert resp.ok
+
+        stale = await api_client.invoke_json(API, f"api/tasks/{task_id}")
+        fresh = await api_client.invoke_json(API, f"api/tasks/{fresh_id}")
+        assert stale["isOverDue"] is True
+        assert fresh["isOverDue"] is False
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_external_queue_ingest(isolated_cwd):
+    """≙ SURVEY.md §3.4: queue message → input binding → invoke API →
+    task stored → payload archived to blob store."""
+    cluster, api, frontend, processor = build_cluster()
+    await cluster.start()
+    try:
+        producer = SqliteQueue(
+            pathlib.Path(".tasksrunner/queues/external-tasks-queue.db"))
+        producer.send({"taskName": "external task",
+                       "taskCreatedBy": "external@x.com",
+                       "taskAssignedTo": "ops@x.com"})
+
+        api_client = cluster.client(API)
+
+        async def stored():
+            tasks = await api_client.invoke_json(
+                API, "api/tasks", query="createdBy=external@x.com")
+            return tasks
+
+        deadline = asyncio.get_running_loop().time() + 5
+        tasks = []
+        while not tasks:
+            tasks = await stored()
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        assert tasks[0]["taskName"] == "external task"
+
+        blob_dir = pathlib.Path(".tasksrunner/blobs/externaltaskscontainer")
+        await wait_until(lambda: list(blob_dir.glob("*.json")))
+        archived = json.loads(next(blob_dir.glob("*.json")).read_text())
+        assert archived["taskName"] == "external task"
+        producer.close()
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_fake_manager_mode_needs_no_components(isolated_cwd):
+    """≙ module 1: FakeTasksManager ships first, no state store exists
+    yet (Program.cs:13)."""
+    cluster = InProcCluster([])  # zero components on purpose
+    api = make_api("fake")
+    cluster.add_app(api)
+    await cluster.start()
+    try:
+        client = cluster.client(API)
+        seeded = await client.invoke_json(
+            API, "api/tasks", query="createdBy=tempuser@mail.com")
+        assert len(seeded) == 10  # FakeTasksManager.GenerateRandomTasks
+
+        resp = await client.invoke_method(
+            API, "api/tasks", http_method="POST",
+            data={"taskName": "t", "taskCreatedBy": "u@x.com"})
+        task_id = resp.raise_for_status().json()["taskId"]
+        assert (await client.invoke_json(API, f"api/tasks/{task_id}"))["taskName"] == "t"
+        resp = await client.invoke_method(
+            API, f"api/tasks/{task_id}/markcomplete", http_method="PUT")
+        assert resp.ok
+        resp = await client.invoke_method(
+            API, f"api/tasks/{task_id}", http_method="DELETE")
+        assert resp.ok
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_full_http_stack_with_real_browser_flow(isolated_cwd):
+    """The same walkthrough over real sockets: app servers + sidecars
+    + cookie-carrying HTTP client (≙ three `dapr run` terminals +
+    a browser, SURVEY.md §4.3)."""
+    import aiohttp
+
+    specs = load_components(COMPONENTS_DIR)
+    registry_file = str(isolated_cwd / "apps.json")
+    hosts = [
+        AppHost(make_api("store"), specs=specs, registry_file=registry_file),
+        AppHost(make_frontend(), specs=specs, registry_file=registry_file),
+        AppHost(make_processor(), specs=specs, registry_file=registry_file),
+    ]
+    for h in hosts:
+        await h.start()
+    try:
+        base = f"http://127.0.0.1:{hosts[1].app_port}"
+        jar = aiohttp.CookieJar(unsafe=True)
+        async with aiohttp.ClientSession(cookie_jar=jar) as browser:
+            async with browser.post(f"{base}/", data={"email": "web@x.com"}) as r:
+                assert r.status == 200  # after redirect
+                assert "No tasks yet" in await r.text()
+            async with browser.post(f"{base}/tasks/create", data={
+                "taskName": "via browser", "taskDueDate": "2026-08-02",
+                "taskAssignedTo": "dev@x.com",
+            }) as r:
+                page = await r.text()
+                assert "via browser" in page
+        proc_app = hosts[2].app
+        await wait_until(lambda: len(proc_app.state["notified"]) == 1)
+        assert proc_app.state["notified"][0]["taskAssignedTo"] == "dev@x.com"
+    finally:
+        for h in hosts:
+            await h.stop()
