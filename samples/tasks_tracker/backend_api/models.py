@@ -32,7 +32,11 @@ def parse_dt(text: str) -> dt.datetime:
             return dt.datetime.strptime(text, fmt)
         except ValueError:
             continue
-    return dt.datetime.fromisoformat(text)
+    try:
+        return dt.datetime.fromisoformat(text)
+    except ValueError:
+        from tasksrunner.errors import ValidationError
+        raise ValidationError(f"unparseable date {text!r}") from None
 
 
 @dataclass

@@ -113,7 +113,9 @@ def make_app(*, sendgrid_enabled: bool | None = None) -> App:
             BACKEND_APP_ID, "api/tasks", http_method="POST", data=task)
         resp.raise_for_status()
         created = resp.json()
-        # archive the raw payload (:38-43)
+        # archive under the *stored* id so the blob correlates with the
+        # state store (the API, like the reference's, assigns its own id)
+        task["taskId"] = created["taskId"]
         await app.client.invoke_binding(
             BLOB_BINDING, "create", task,
             {"blobName": f"{created['taskId']}.json"})

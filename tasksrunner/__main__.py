@@ -1,0 +1,3 @@
+from tasksrunner.cli import main
+
+main()

@@ -98,10 +98,11 @@ class CronSchedule:
         fields = self.expr.split()
         if len(fields) == 6:
             # Dapr's cron binding accepts 6-field (with seconds). We
-            # support minute granularity: a seconds field of 0/* is
-            # accepted and dropped; anything else would silently change
-            # the schedule, so reject it (use "@every Ns" instead).
-            if fields[0] not in ("0", "*"):
+            # support minute granularity: only a seconds field of
+            # exactly "0" is accepted and dropped; anything else
+            # (including "*" = every second) would silently change the
+            # schedule, so reject it (use "@every Ns" instead).
+            if fields[0] != "0":
                 raise BindingError(
                     f"sub-minute cron schedules are not supported "
                     f"(seconds field {fields[0]!r} in {self.expr!r}); "

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import threading
 import json
 import logging
 import pathlib
@@ -47,6 +48,15 @@ CREATE INDEX IF NOT EXISTS idx_queue_visible ON queue (done, visible_at);
 """
 
 
+
+def _locked(fn):
+    """Serialise a db-touching method on the instance's _db_lock."""
+    def wrapper(self, *args, **kwargs):
+        with self._db_lock:
+            return fn(self, *args, **kwargs)
+    return wrapper
+
+
 class SqliteQueue:
     """The queue itself — shared across processes via the db file."""
 
@@ -60,7 +70,11 @@ class SqliteQueue:
         self._conn.execute("PRAGMA busy_timeout=5000")
         self._conn.executescript(_SCHEMA)
         self._conn.commit()
+        # serialises cross-thread use of the shared connection (binding
+        # executor thread vs. sync producers on other threads)
+        self._db_lock = threading.Lock()
 
+    @_locked
     def send(self, data: Any) -> str:
         msg_id = str(uuid.uuid4())
         now = time.time()
@@ -71,6 +85,7 @@ class SqliteQueue:
         self._conn.commit()
         return msg_id
 
+    @_locked
     def claim(self) -> tuple[str, Any, int] | None:
         """Claim the next visible message: (id, data, attempt#)."""
         now = time.time()
@@ -96,10 +111,12 @@ class SqliteQueue:
             raise
         return msg_id, json.loads(data), attempts + 1
 
+    @_locked
     def ack(self, msg_id: str) -> None:
         self._conn.execute("UPDATE queue SET done = 1 WHERE id = ?", (msg_id,))
         self._conn.commit()
 
+    @_locked
     def nack(self, msg_id: str, *, delay: float = 0.2) -> None:
         self._conn.execute(
             "UPDATE queue SET visible_at = ? WHERE id = ?",
@@ -107,10 +124,12 @@ class SqliteQueue:
         )
         self._conn.commit()
 
+    @_locked
     def dead_letter(self, msg_id: str) -> None:
         self._conn.execute("UPDATE queue SET done = 2 WHERE id = ?", (msg_id,))
         self._conn.commit()
 
+    @_locked
     def backlog(self) -> int:
         (n,) = self._conn.execute(
             "SELECT COUNT(*) FROM queue WHERE done = 0"
@@ -180,7 +199,9 @@ class LocalQueueBinding(InputBinding, OutputBinding):
             except asyncio.CancelledError:
                 pass
             self._task = None
-        self._executor.shutdown(wait=True)
+        # don't block the loop on a possibly busy-waiting db thread
+        await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self._executor.shutdown(wait=True))
         self.queue.close()
 
     async def invoke(self, operation: str, data: Any,

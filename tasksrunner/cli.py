@@ -1,0 +1,227 @@
+"""Command-line interface.
+
+Commands mirror the reference's local workflow surface:
+
+* ``tasksrunner host``    — one service: app server + sidecar in one
+  process (what the orchestrator spawns per replica)
+* ``tasksrunner serve``   — app server only (pair with ``sidecar`` for
+  the fully decoupled two-process layout ``dapr run`` uses)
+* ``tasksrunner sidecar`` — sidecar only, attaching to a running app
+  (≙ ``dapr run --app-id X --app-port P --dapr-http-port D``,
+  snippets/dapr-run-backend-api.md:4-16)
+* ``tasksrunner run``     — multi-app orchestrator from a run config
+  (≙ the VS Code compound launcher), with KEDA-style autoscaling
+* ``tasksrunner components`` — validate/list a resources directory
+  (≙ the sidecar's component loading report)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import importlib
+import logging
+import sys
+
+from tasksrunner.app import App
+
+
+def _load_factory(spec: str):
+    """Import "pkg.module:factory" and return the factory/App."""
+    module_name, _, attr = spec.partition(":")
+    module = importlib.import_module(module_name)
+    target = getattr(module, attr or "make_app")
+    return target
+
+
+def _make_app(spec: str) -> App:
+    target = _load_factory(spec)
+    app = target() if callable(target) and not isinstance(target, App) else target
+    if not isinstance(app, App):
+        raise SystemExit(f"{spec} did not produce a tasksrunner.App")
+    return app
+
+
+def _cmd_host(args) -> None:
+    from tasksrunner.hosting import AppHost
+    from tasksrunner.observability.logging import configure_logging
+
+    app = _make_app(args.module)
+    if args.app_id:
+        app.app_id = args.app_id
+    configure_logging(app.app_id, level=getattr(logging, args.log_level.upper()))
+    host = AppHost(
+        app,
+        components_path=args.components,
+        app_port=args.app_port,
+        sidecar_port=args.sidecar_port,
+        registry_file=args.registry_file,
+        register=not args.no_register,
+    )
+
+    async def main():
+        await host.start()
+        print(f"ready app={app.app_id} app_port={host.app_port} "
+              f"sidecar_port={host.sidecar_port}", flush=True)
+        try:
+            await asyncio.Event().wait()
+        except asyncio.CancelledError:
+            pass
+        finally:
+            await host.stop()
+
+    _run_until_interrupt(main())
+
+
+def _cmd_serve(args) -> None:
+    from aiohttp import web
+    from tasksrunner.client import AppClient
+    from tasksrunner.hosting import build_app_server
+    from tasksrunner.observability.logging import configure_logging
+
+    app = _make_app(args.module)
+    configure_logging(app.app_id, level=getattr(logging, args.log_level.upper()))
+    app.client = AppClient.http(args.sidecar_port)
+
+    async def main():
+        runner = web.AppRunner(build_app_server(app))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", args.port)
+        await site.start()
+        port = runner.addresses[0][1]
+        await app.startup()
+        print(f"ready app={app.app_id} app_port={port}", flush=True)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await app.shutdown()
+            await runner.cleanup()
+
+    _run_until_interrupt(main())
+
+
+def _cmd_sidecar(args) -> None:
+    from tasksrunner.component.loader import load_components
+    from tasksrunner.component.registry import ComponentRegistry
+    from tasksrunner.invoke.resolver import AppAddress, NameResolver
+    from tasksrunner.observability.logging import configure_logging
+    from tasksrunner.runtime import HTTPAppChannel, Runtime
+    from tasksrunner.sidecar import Sidecar
+
+    configure_logging(f"{args.app_id}-sidecar",
+                      level=getattr(logging, args.log_level.upper()))
+    specs = load_components(args.components) if args.components else []
+    resolver = NameResolver(registry_file=args.registry_file)
+
+    async def main():
+        registry = ComponentRegistry(specs, app_id=args.app_id)
+        runtime = Runtime(args.app_id, registry, resolver=resolver,
+                          app_channel=HTTPAppChannel("127.0.0.1", args.app_port))
+        sidecar = Sidecar(runtime, port=args.port)
+        await sidecar.start()
+        resolver.register(AppAddress(app_id=args.app_id, host="127.0.0.1",
+                                     sidecar_port=sidecar.port,
+                                     app_port=args.app_port))
+        print(f"ready app={args.app_id} sidecar_port={sidecar.port}", flush=True)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            resolver.unregister(args.app_id)
+            await sidecar.stop()
+
+    _run_until_interrupt(main())
+
+
+def _cmd_run(args) -> None:
+    from tasksrunner.observability.logging import configure_logging
+    from tasksrunner.orchestrator.config import load_run_config
+    from tasksrunner.orchestrator.run import run_from_config
+
+    configure_logging("orchestrator",
+                      level=getattr(logging, args.log_level.upper()))
+    config = load_run_config(args.config)
+    _run_until_interrupt(run_from_config(config))
+
+
+def _cmd_components(args) -> None:
+    from tasksrunner.component.loader import load_components
+    from tasksrunner.component.registry import registered_types
+
+    specs = load_components(args.path, app_id=args.app_id)
+    known = set(registered_types())
+    status_width = max((len(s.name) for s in specs), default=4)
+    problems = 0
+    for spec in specs:
+        ok = spec.type in known
+        if not ok:
+            problems += 1
+        scope = ",".join(spec.scopes) if spec.scopes else "(all apps)"
+        print(f"{spec.name:<{status_width}}  {spec.type:<32} "
+              f"{'ok' if ok else 'NO DRIVER':<10} scopes={scope}")
+    if problems:
+        raise SystemExit(f"{problems} component(s) have no registered driver")
+
+
+def _run_until_interrupt(coro) -> None:
+    try:
+        asyncio.run(coro)
+    except KeyboardInterrupt:
+        pass
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tasksrunner",
+        description="Distributed-application runtime: building blocks, "
+                    "sidecars, and a local multi-app orchestrator.",
+    )
+    parser.add_argument("--log-level", default="info",
+                        choices=["debug", "info", "warning", "error"])
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("host", help="run one app + its sidecar in one process")
+    p.add_argument("module", help="pkg.module:factory producing a tasksrunner.App")
+    p.add_argument("--app-id", default=None,
+                   help="override the App's app-id (rarely needed)")
+    p.add_argument("--app-port", type=int, default=0)
+    p.add_argument("--sidecar-port", type=int, default=0)
+    p.add_argument("--components", default=None)
+    p.add_argument("--registry-file", default=".tasksrunner/apps.json")
+    p.add_argument("--no-register", action="store_true")
+    p.set_defaults(fn=_cmd_host)
+
+    p = sub.add_parser("serve", help="run an app server only (no sidecar)")
+    p.add_argument("module")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--sidecar-port", type=int, default=None,
+                   help="port of the sidecar this app's client talks to")
+    p.set_defaults(fn=_cmd_serve)
+
+    p = sub.add_parser("sidecar", help="run a sidecar for an app process")
+    p.add_argument("--app-id", required=True)
+    p.add_argument("--app-port", type=int, required=True)
+    p.add_argument("--port", type=int, default=3500)
+    p.add_argument("--components", default=None)
+    p.add_argument("--registry-file", default=".tasksrunner/apps.json")
+    p.set_defaults(fn=_cmd_sidecar)
+
+    p = sub.add_parser("run", help="run a multi-app config (orchestrator)")
+    p.add_argument("config")
+    p.set_defaults(fn=_cmd_run)
+
+    p = sub.add_parser("components", help="validate a components directory")
+    p.add_argument("path")
+    p.add_argument("--app-id", default=None,
+                   help="show only components in this app's scope")
+    p.set_defaults(fn=_cmd_components)
+
+    return parser
+
+
+def main(argv: list[str] | None = None) -> None:
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

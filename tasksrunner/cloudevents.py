@@ -45,11 +45,22 @@ def is_cloudevent(doc: Any) -> bool:
 
 def unwrap(body: bytes, content_type: str | None) -> Any:
     """Return the inner data if ``body`` is a CloudEvent, else the
-    JSON-decoded body (or raw bytes if not JSON)."""
+    JSON-decoded body (or raw bytes if not JSON).
+
+    When a content-type is present it is authoritative: a raw-published
+    payload delivered as ``application/json`` is never unwrapped, even
+    if it happens to look like an envelope (forwarding pre-wrapped
+    events verbatim is the main use of rawPayload). Shape-sniffing only
+    applies when no content-type was provided.
+    """
     try:
         doc = json.loads(body)
     except (ValueError, UnicodeDecodeError):
         return body
-    if (content_type or "").startswith(CONTENT_TYPE) or is_cloudevent(doc):
+    if content_type is not None:
+        if content_type.startswith(CONTENT_TYPE):
+            return doc.get("data") if isinstance(doc, dict) else doc
+        return doc
+    if is_cloudevent(doc):
         return doc.get("data")
     return doc

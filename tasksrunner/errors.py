@@ -16,6 +16,12 @@ class TasksRunnerError(Exception):
     http_status = 500
 
 
+class ValidationError(TasksRunnerError):
+    """Client-supplied input is malformed (maps to HTTP 400)."""
+
+    http_status = 400
+
+
 class ComponentError(TasksRunnerError):
     """A component file or definition is malformed."""
 

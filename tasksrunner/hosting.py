@@ -69,9 +69,11 @@ class AppHost:
         host: str = "127.0.0.1",
         registry_file: str | None = None,
         resolver: NameResolver | None = None,
+        register: bool = True,
     ):
         self.app = app
         self.host = host
+        self.register = register
         self.app_port = app_port
         self.sidecar_port = sidecar_port
         if specs is None:
@@ -101,11 +103,14 @@ class AppHost:
         await self.sidecar.start()
         self.sidecar_port = self.sidecar.port
 
-        # 3. register for peer discovery, hand the app its client
-        self.resolver.register(AppAddress(
-            app_id=self.app.app_id, host=self.host,
-            sidecar_port=self.sidecar_port, app_port=self.app_port,
-        ))
+        # 3. register for peer discovery (scale-out replicas skip this:
+        # they compete on the broker, they don't serve invokes), then
+        # hand the app its client
+        if self.register:
+            self.resolver.register(AppAddress(
+                app_id=self.app.app_id, host=self.host,
+                sidecar_port=self.sidecar_port, app_port=self.app_port,
+            ))
         self.client = AppClient.http(self.sidecar_port, self.host)
         self.app.client = self.client
         await self.app.startup()
@@ -114,7 +119,8 @@ class AppHost:
 
     async def stop(self) -> None:
         await self.app.shutdown()
-        self.resolver.unregister(self.app.app_id)
+        if self.register:
+            self.resolver.unregister(self.app.app_id)
         if self.client is not None:
             await self.client.close()
         if self.sidecar is not None:

@@ -1,0 +1,13 @@
+from tasksrunner.orchestrator.config import AppSpec, RunConfig, ScaleRule, load_run_config
+from tasksrunner.orchestrator.autoscale import AutoscaleController, read_backlog
+from tasksrunner.orchestrator.run import Orchestrator
+
+__all__ = [
+    "AppSpec",
+    "RunConfig",
+    "ScaleRule",
+    "load_run_config",
+    "AutoscaleController",
+    "read_backlog",
+    "Orchestrator",
+]

@@ -1,0 +1,159 @@
+"""KEDA-style backlog autoscaling for the local orchestrator.
+
+Replicates the reference's only parallelism mechanism (SURVEY.md §5.8):
+the processor scales 1→5 replicas, +1 per 10 messages of Service Bus
+topic-subscription backlog
+(bicep/modules/container-apps/processor-backend-service.bicep:158-181).
+Here the scaler watches the sqlite broker/queue files directly — the
+same out-of-band position KEDA occupies (it reads the broker, not the
+app) — and tells the orchestrator the desired replica count.
+
+Scale-to-zero is deliberately NOT implemented, for the reason the
+workshop rejects it: it would starve cron and input bindings
+(docs/aca/09-aca-autoscale-keda/index.md:150-160); min_replicas >= 1
+is enforced in config.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import pathlib
+import time
+from typing import Callable
+
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.errors import ComponentError
+from tasksrunner.orchestrator.config import AppSpec, ScaleRule
+from tasksrunner.pubsub.sqlite import SqliteBroker
+from tasksrunner.bindings.localqueue import SqliteQueue
+
+logger = logging.getLogger(__name__)
+
+
+def read_backlog(rule: ScaleRule, *, app_id: str,
+                 components: list[ComponentSpec],
+                 base_dir: pathlib.Path) -> int:
+    """Read the current backlog the rule watches (opens its own
+    connection to the shared file, as KEDA connects to the broker)."""
+    meta = rule.metadata
+    comp_name = meta.get("component")
+    spec = next((s for s in components if s.name == comp_name), None)
+
+    def _path(raw: str) -> pathlib.Path:
+        p = pathlib.Path(raw)
+        return p if p.is_absolute() else base_dir / p
+
+    if rule.type == "pubsub-backlog":
+        if spec is None:
+            raise ComponentError(f"scale rule references unknown component {comp_name!r}")
+        broker_path = spec.metadata.get("brokerPath")
+        if not isinstance(broker_path, str):
+            broker_path = ".tasksrunner/pubsub-" + spec.name + ".db"
+        topic = meta.get("topic", "")
+        group = meta.get("group", app_id)  # subscription named after the app
+        broker = SqliteBroker(spec.name, _path(broker_path))
+        try:
+            return broker.backlog(topic, group)
+        finally:
+            broker._conn.close()
+            broker._executor.shutdown(wait=False)
+    if rule.type == "queue-backlog":
+        if spec is None:
+            raise ComponentError(f"scale rule references unknown component {comp_name!r}")
+        root = spec.metadata.get("queuePath", ".tasksrunner/queues")
+        qname = spec.metadata.get("queueName", spec.name)
+        if not isinstance(root, str) or not isinstance(qname, str):
+            raise ComponentError(f"scale rule component {comp_name!r} has secret-typed path metadata")
+        queue = SqliteQueue(_path(root) / f"{qname}.db")
+        try:
+            return queue.backlog()
+        finally:
+            queue.close()
+    raise ComponentError(f"unknown scale rule type {rule.type!r}")
+
+
+class AutoscaleController:
+    """Computes desired replicas per app and drives a scaling callback."""
+
+    def __init__(
+        self,
+        app: AppSpec,
+        components: list[ComponentSpec],
+        set_replicas: Callable[[int], "asyncio.Future | None"],
+        *,
+        base_dir: pathlib.Path | None = None,
+        interval: float = 0.5,
+    ):
+        self.app = app
+        self.components = components
+        self.set_replicas = set_replicas
+        self.base_dir = base_dir or pathlib.Path.cwd()
+        self.interval = interval
+        self.current = app.scale.min_replicas
+        self._low_since: float | None = None
+        self._task: asyncio.Task | None = None
+
+    def desired_replicas(self) -> int:
+        """+1 replica per messageCount of backlog, clamped to bounds
+        (the KEDA azure-servicebus formula)."""
+        scale = self.app.scale
+        if not scale.rules:
+            return scale.min_replicas
+        desired = 0
+        for rule in scale.rules:
+            backlog = read_backlog(rule, app_id=self.app.app_id,
+                                   components=self.components,
+                                   base_dir=self.base_dir)
+            per = max(int(rule.metadata.get("messageCount", 10)), 1)
+            desired = max(desired, math.ceil(backlog / per))
+        return max(scale.min_replicas, min(scale.max_replicas, desired))
+
+    async def step(self) -> int:
+        desired = await asyncio.to_thread(self.desired_replicas)
+        now = time.monotonic()
+        if desired > self.current:
+            # scale out immediately (KEDA behavior)
+            self._low_since = None
+            logger.info("scaling %s out: %d -> %d replicas",
+                        self.app.app_id, self.current, desired)
+            self.current = desired
+            result = self.set_replicas(desired)
+            if asyncio.isfuture(result) or asyncio.iscoroutine(result):
+                await result
+        elif desired < self.current:
+            # scale in only after sustained low backlog (cooldown)
+            if self._low_since is None:
+                self._low_since = now
+            elif now - self._low_since >= self.app.scale.cooldown_seconds:
+                logger.info("scaling %s in: %d -> %d replicas",
+                            self.app.app_id, self.current, desired)
+                self.current = desired
+                self._low_since = None
+                result = self.set_replicas(desired)
+                if asyncio.isfuture(result) or asyncio.iscoroutine(result):
+                    await result
+        else:
+            self._low_since = None
+        return self.current
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.step()
+            except Exception:
+                logger.exception("autoscale step failed for %s", self.app.app_id)
+            await asyncio.sleep(self.interval)
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self.run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None

@@ -1,0 +1,123 @@
+"""Multi-app run configuration.
+
+The analog of the reference's local launch tooling: three ``dapr run``
+terminals (snippets/dapr-run-*.md) or the VS Code compound launcher
+(.vscode/tasks.json + launch.json:64-80), declaratively in one YAML —
+plus the KEDA-style scale block each ACA app carries in Bicep
+(processor-backend-service.bicep:158-181) brought down to local
+semantics.
+
+```yaml
+resources_path: ./components
+registry_file: .tasksrunner/apps.json
+apps:
+  - app_id: tasksmanager-backend-api
+    module: samples.tasks_tracker.backend_api:make_app
+    app_port: 5103
+    sidecar_port: 3500
+    env: { TASKS_MANAGER: store }
+  - app_id: tasksmanager-backend-processor
+    module: samples.tasks_tracker.processor:make_app
+    app_port: 5217
+    sidecar_port: 3502
+    scale:
+      min_replicas: 1
+      max_replicas: 5
+      rules:
+        - type: pubsub-backlog        # ≙ KEDA azure-servicebus scaler
+          metadata:
+            component: dapr-pubsub-servicebus
+            topic: tasksavedtopic
+            messageCount: "10"
+```
+"""
+
+from __future__ import annotations
+
+import pathlib
+from dataclasses import dataclass, field
+
+import yaml
+
+from tasksrunner.errors import ComponentError
+
+
+@dataclass
+class ScaleRule:
+    type: str  # pubsub-backlog | queue-backlog
+    metadata: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ScaleSpec:
+    min_replicas: int = 1
+    max_replicas: int = 1
+    rules: list[ScaleRule] = field(default_factory=list)
+    #: seconds of low backlog before scaling down (KEDA cooldown analog)
+    cooldown_seconds: float = 5.0
+
+
+@dataclass
+class AppSpec:
+    app_id: str
+    module: str  # "pkg.mod:factory"
+    app_port: int = 0
+    sidecar_port: int = 0
+    env: dict[str, str] = field(default_factory=dict)
+    scale: ScaleSpec = field(default_factory=ScaleSpec)
+
+
+@dataclass
+class RunConfig:
+    apps: list[AppSpec]
+    resources_path: str | None = None
+    registry_file: str = ".tasksrunner/apps.json"
+    base_dir: pathlib.Path = field(default_factory=pathlib.Path.cwd)
+
+
+def load_run_config(path: str | pathlib.Path) -> RunConfig:
+    path = pathlib.Path(path)
+    try:
+        doc = yaml.safe_load(path.read_text()) or {}
+    except OSError as exc:
+        raise ComponentError(f"cannot read run config {path}: {exc}") from exc
+    except yaml.YAMLError as exc:
+        raise ComponentError(f"cannot parse run config {path}: {exc}") from exc
+
+    apps = []
+    for raw in doc.get("apps") or []:
+        if "app_id" not in raw or "module" not in raw:
+            raise ComponentError("each app needs app_id and module")
+        scale_raw = raw.get("scale") or {}
+        rules = [
+            ScaleRule(type=r.get("type", ""), metadata={
+                str(k): str(v) for k, v in (r.get("metadata") or {}).items()
+            })
+            for r in scale_raw.get("rules") or []
+        ]
+        apps.append(AppSpec(
+            app_id=str(raw["app_id"]),
+            module=str(raw["module"]),
+            app_port=int(raw.get("app_port", 0)),
+            sidecar_port=int(raw.get("sidecar_port", 0)),
+            env={str(k): str(v) for k, v in (raw.get("env") or {}).items()},
+            scale=ScaleSpec(
+                min_replicas=int(scale_raw.get("min_replicas", 1)),
+                max_replicas=int(scale_raw.get("max_replicas", 1)),
+                rules=rules,
+                cooldown_seconds=float(scale_raw.get("cooldown_seconds", 5.0)),
+            ),
+        ))
+    if not apps:
+        raise ComponentError(f"run config {path} declares no apps")
+
+    resources = doc.get("resources_path")
+    base = path.resolve().parent
+    if resources is not None and not pathlib.Path(resources).is_absolute():
+        resources = str(base / resources)
+    return RunConfig(
+        apps=apps,
+        resources_path=resources,
+        registry_file=str(doc.get("registry_file", ".tasksrunner/apps.json")),
+        base_dir=base,
+    )

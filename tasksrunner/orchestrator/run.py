@@ -1,0 +1,197 @@
+"""The multi-app orchestrator: process supervision + log multiplexing
++ autoscaling.
+
+≙ running three ``dapr run`` terminals (snippets/dapr-run-*.md), the
+VS Code compound launcher, ACA's restart-on-crash (single-revision
+mode, SURVEY.md §5.3), and the KEDA scaler (§5.8) — in one local
+process.
+
+Each replica is a subprocess running ``python -m tasksrunner host
+<module>`` (app server + sidecar in one process, HTTP between them).
+Replica 0 owns the configured ports and the name-registry entry;
+scale-out replicas get ephemeral ports and skip registration — they
+participate through competing consumption on the shared broker, which
+is exactly how extra ACA replicas of the processor participate.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import signal
+import sys
+import time
+
+from tasksrunner.orchestrator.autoscale import AutoscaleController
+from tasksrunner.orchestrator.config import AppSpec, RunConfig
+from tasksrunner.component.loader import load_components
+
+logger = logging.getLogger(__name__)
+
+RESTART_BACKOFF = [0.2, 0.5, 1.0, 2.0, 5.0]
+
+
+class Replica:
+    def __init__(self, app: AppSpec, index: int, config: RunConfig):
+        self.app = app
+        self.index = index
+        self.config = config
+        self.proc: asyncio.subprocess.Process | None = None
+        self._pump: asyncio.Task | None = None
+        self.restarts = 0
+        self.stopping = False
+
+    @property
+    def tag(self) -> str:
+        return f"{self.app.app_id}·{self.index}"
+
+    def _command(self) -> list[str]:
+        cmd = [
+            sys.executable, "-m", "tasksrunner", "host", self.app.module,
+            "--app-id", self.app.app_id,
+            "--registry-file", self.config.registry_file,
+        ]
+        if self.config.resources_path:
+            cmd += ["--components", self.config.resources_path]
+        if self.index == 0:
+            cmd += ["--app-port", str(self.app.app_port),
+                    "--sidecar-port", str(self.app.sidecar_port)]
+        else:
+            cmd += ["--app-port", "0", "--sidecar-port", "0", "--no-register"]
+        return cmd
+
+    async def start(self) -> None:
+        env = dict(os.environ)
+        env.update(self.app.env)
+        env["TASKSRUNNER_APP_ID"] = self.app.app_id
+        env["TASKSRUNNER_REPLICA"] = str(self.index)
+        # the orchestrator's import context must reach the replicas
+        # (run configs may live outside the package root)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (os.getcwd(), env.get("PYTHONPATH")) if p)
+        self.proc = await asyncio.create_subprocess_exec(
+            *self._command(),
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+            env=env,
+            cwd=self.config.base_dir,
+        )
+        self._pump = asyncio.create_task(self._pump_logs())
+        logger.info("started replica %s (pid %d)", self.tag, self.proc.pid)
+
+    async def _pump_logs(self) -> None:
+        assert self.proc is not None and self.proc.stdout is not None
+        async for line in self.proc.stdout:
+            print(f"[{self.tag}] {line.decode('utf-8', 'replace').rstrip()}",
+                  flush=True)
+
+    async def stop(self) -> None:
+        self.stopping = True
+        if self.proc is not None and self.proc.returncode is None:
+            self.proc.terminate()
+            try:
+                await asyncio.wait_for(self.proc.wait(), timeout=5.0)
+            except asyncio.TimeoutError:
+                self.proc.kill()
+                await self.proc.wait()
+        if self._pump is not None:
+            self._pump.cancel()
+            try:
+                await self._pump
+            except asyncio.CancelledError:
+                pass
+
+    async def supervise(self) -> None:
+        """Restart on crash with bounded backoff (ACA restart analog)."""
+        while not self.stopping:
+            assert self.proc is not None
+            code = await self.proc.wait()
+            if self.stopping:
+                return
+            backoff = RESTART_BACKOFF[min(self.restarts, len(RESTART_BACKOFF) - 1)]
+            logger.warning("replica %s exited with %s; restarting in %.1fs",
+                           self.tag, code, backoff)
+            self.restarts += 1
+            await asyncio.sleep(backoff)
+            if not self.stopping:
+                await self.start()
+
+
+class Orchestrator:
+    def __init__(self, config: RunConfig):
+        self.config = config
+        self.replicas: dict[str, list[Replica]] = {}
+        self._supervisors: list[asyncio.Task] = []
+        self._scalers: list[AutoscaleController] = []
+        self._components = (
+            load_components(config.resources_path) if config.resources_path else []
+        )
+
+    async def start(self) -> None:
+        for app in self.config.apps:
+            self.replicas[app.app_id] = []
+            for i in range(app.scale.min_replicas):
+                await self._add_replica(app)
+            if app.scale.rules:
+                scaler = AutoscaleController(
+                    app, self._components,
+                    lambda n, a=app: self._set_replicas(a, n),
+                    base_dir=self.config.base_dir,
+                )
+                scaler.start()
+                self._scalers.append(scaler)
+
+    async def _add_replica(self, app: AppSpec) -> None:
+        replica = Replica(app, len(self.replicas[app.app_id]), self.config)
+        self.replicas[app.app_id].append(replica)
+        await replica.start()
+        self._supervisors.append(asyncio.create_task(replica.supervise()))
+
+    async def _set_replicas(self, app: AppSpec, desired: int) -> None:
+        current = self.replicas[app.app_id]
+        while len(current) < desired:
+            await self._add_replica(app)
+        while len(current) > desired:
+            victim = current.pop()  # never replica 0 (desired >= min >= 1)
+            await victim.stop()
+
+    def replica_count(self, app_id: str) -> int:
+        return len(self.replicas.get(app_id, []))
+
+    async def wait(self) -> None:
+        """Run until interrupted."""
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # pragma: no cover - non-unix
+                pass
+        await stop.wait()
+
+    async def stop(self) -> None:
+        for scaler in self._scalers:
+            await scaler.stop()
+        for group in self.replicas.values():
+            for replica in group:
+                await replica.stop()
+        for task in self._supervisors:
+            task.cancel()
+        for task in self._supervisors:
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        self._supervisors.clear()
+
+
+async def run_from_config(config: RunConfig) -> None:
+    orch = Orchestrator(config)
+    await orch.start()
+    apps = ", ".join(a.app_id for a in config.apps)
+    logger.info("orchestrator running apps: %s (ctrl-c to stop)", apps)
+    try:
+        await orch.wait()
+    finally:
+        await orch.stop()

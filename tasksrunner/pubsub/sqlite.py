@@ -22,6 +22,7 @@ import json
 import logging
 import pathlib
 import sqlite3
+import threading
 import time
 import uuid
 from typing import Any
@@ -60,6 +61,15 @@ CREATE INDEX IF NOT EXISTS idx_deliveries_pending
 """
 
 
+
+def _locked(fn):
+    """Serialise a db-touching method on the instance's _db_lock."""
+    def wrapper(self, *args, **kwargs):
+        with self._db_lock:
+            return fn(self, *args, **kwargs)
+    return wrapper
+
+
 class SqliteBroker(PubSubBroker):
     def __init__(
         self,
@@ -86,11 +96,14 @@ class SqliteBroker(PubSubBroker):
         self._conn.commit()
         self._tasks: list[asyncio.Task] = []
         self._closed = False
-        # All db work runs on one dedicated thread: cross-process lock
-        # waits (busy_timeout) must never stall the event loop, and one
-        # thread serialises use of the shared connection.
+        # Async paths run db work on a dedicated thread so cross-process
+        # lock waits (busy_timeout) never stall the event loop; _db_lock
+        # additionally serialises the sync introspection methods
+        # (backlog/dead_letters/gc) against it, keeping every
+        # transaction on the shared connection atomic per thread.
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"broker-{name}")
+        self._db_lock = threading.Lock()
 
     async def _run(self, fn, *args):
         return await asyncio.get_running_loop().run_in_executor(
@@ -103,6 +116,7 @@ class SqliteBroker(PubSubBroker):
         await self._run(self._publish_sync, topic, data, metadata, msg_id)
         return msg_id
 
+    @_locked
     def _publish_sync(self, topic: str, data: Any, metadata, msg_id: str) -> None:
         now = time.time()
         cur = self._conn.cursor()
@@ -128,6 +142,7 @@ class SqliteBroker(PubSubBroker):
     async def ensure_group(self, topic: str, group: str) -> None:
         await self._run(self._ensure_group_sync, topic, group)
 
+    @_locked
     def _ensure_group_sync(self, topic: str, group: str) -> None:
         self._conn.execute(
             "INSERT OR IGNORE INTO groups(topic, grp) VALUES (?, ?)", (topic, group)
@@ -136,6 +151,7 @@ class SqliteBroker(PubSubBroker):
 
     # -- consume ---------------------------------------------------------
 
+    @_locked
     def _claim_one(self, topic: str, group: str) -> Message | None:
         now = time.time()
         cur = self._conn.cursor()
@@ -167,6 +183,7 @@ class SqliteBroker(PubSubBroker):
             metadata=json.loads(metadata), attempt=attempts + 1,
         )
 
+    @_locked
     def _ack(self, msg_id: str, group: str) -> None:
         self._conn.execute(
             "UPDATE deliveries SET done = 1 WHERE msg_id = ? AND grp = ?",
@@ -174,6 +191,7 @@ class SqliteBroker(PubSubBroker):
         )
         self._conn.commit()
 
+    @_locked
     def _nack(self, msg: Message, group: str) -> None:
         if msg.attempt >= self.max_attempts:
             logger.warning(
@@ -231,6 +249,7 @@ class SqliteBroker(PubSubBroker):
 
     # -- introspection ---------------------------------------------------
 
+    @_locked
     def backlog(self, topic: str, group: str) -> int:
         """Visible, un-acked message count — the autoscale signal."""
         (n,) = self._conn.execute(
@@ -239,6 +258,7 @@ class SqliteBroker(PubSubBroker):
         ).fetchone()
         return n
 
+    @_locked
     def dead_letters(self, topic: str, group: str) -> list[str]:
         rows = self._conn.execute(
             "SELECT msg_id FROM deliveries WHERE topic = ? AND grp = ? AND done = 2",
@@ -246,6 +266,7 @@ class SqliteBroker(PubSubBroker):
         ).fetchall()
         return [r[0] for r in rows]
 
+    @_locked
     def gc(self, *, older_than: float = 3600.0) -> int:
         """Drop messages fully settled in every group."""
         cutoff = time.time() - older_than
@@ -271,7 +292,9 @@ class SqliteBroker(PubSubBroker):
             except (asyncio.CancelledError, Exception):
                 pass
         self._tasks.clear()
-        self._executor.shutdown(wait=True)
+        # don't block the loop on a possibly busy-waiting db thread
+        await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self._executor.shutdown(wait=True))
         self._conn.close()
 
 

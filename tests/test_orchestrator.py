@@ -1,0 +1,233 @@
+"""Orchestrator + autoscaler tests.
+
+Covers the KEDA-analog scaling math and cooldown (SURVEY.md §5.8), the
+run-config parser, process supervision with restart-on-crash, and a
+real multi-process launch of the Tasks Tracker config.
+"""
+
+import asyncio
+import pathlib
+import sys
+import textwrap
+
+import pytest
+
+from tasksrunner.component.spec import parse_component
+from tasksrunner.errors import ComponentError
+from tasksrunner.orchestrator import (
+    AppSpec,
+    AutoscaleController,
+    load_run_config,
+    read_backlog,
+)
+from tasksrunner.orchestrator.config import ScaleRule, ScaleSpec
+from tasksrunner.pubsub.sqlite import SqliteBroker
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_run_config_parse(tmp_path):
+    cfg = tmp_path / "run.yaml"
+    cfg.write_text(textwrap.dedent("""
+        resources_path: ./components
+        apps:
+          - app_id: api
+            module: pkg.mod:make_app
+            app_port: 5103
+            sidecar_port: 3500
+            env: { A: "1" }
+          - app_id: worker
+            module: pkg.worker:make_app
+            scale:
+              min_replicas: 2
+              max_replicas: 5
+              rules:
+                - type: pubsub-backlog
+                  metadata: { component: ps, topic: t, messageCount: 10 }
+    """))
+    config = load_run_config(cfg)
+    assert [a.app_id for a in config.apps] == ["api", "worker"]
+    assert config.apps[0].env == {"A": "1"}
+    assert config.resources_path == str(tmp_path / "components")
+    worker = config.apps[1]
+    assert worker.scale.min_replicas == 2
+    assert worker.scale.rules[0].metadata["messageCount"] == "10"
+
+    (tmp_path / "empty.yaml").write_text("apps: []")
+    with pytest.raises(ComponentError):
+        load_run_config(tmp_path / "empty.yaml")
+
+
+@pytest.mark.asyncio
+async def test_read_backlog_pubsub(tmp_path):
+    spec = parse_component({
+        "componentType": "pubsub.sqlite",
+        "metadata": [{"name": "brokerPath", "value": str(tmp_path / "b.db")}],
+    }, default_name="ps")
+    broker = SqliteBroker("ps", tmp_path / "b.db")
+    await broker.ensure_group("t", "worker")
+    for _ in range(25):
+        await broker.publish("t", {})
+    rule = ScaleRule(type="pubsub-backlog",
+                     metadata={"component": "ps", "topic": "t", "group": "worker"})
+    assert read_backlog(rule, app_id="worker", components=[spec],
+                        base_dir=tmp_path) == 25
+    await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_autoscaler_formula_and_cooldown(tmp_path):
+    """+1 replica per messageCount, clamp to [min,max]; scale-out is
+    immediate, scale-in waits for the cooldown."""
+    spec = parse_component({
+        "componentType": "pubsub.sqlite",
+        "metadata": [{"name": "brokerPath", "value": str(tmp_path / "b.db")}],
+    }, default_name="ps")
+    broker = SqliteBroker("ps", tmp_path / "b.db")
+    await broker.ensure_group("tasksavedtopic", "worker")
+
+    calls = []
+    app = AppSpec(
+        app_id="worker", module="x:y",
+        scale=ScaleSpec(min_replicas=1, max_replicas=5, cooldown_seconds=0.2,
+                        rules=[ScaleRule(type="pubsub-backlog", metadata={
+                            "component": "ps", "topic": "tasksavedtopic",
+                            "messageCount": "10"})]),
+    )
+    scaler = AutoscaleController(app, [spec], calls.append, base_dir=tmp_path)
+
+    assert await scaler.step() == 1 and calls == []
+
+    for _ in range(35):
+        await broker.publish("tasksavedtopic", {})
+    assert await scaler.step() == 4  # ceil(35/10)
+    assert calls == [4]
+
+    for _ in range(100):
+        await broker.publish("tasksavedtopic", {})
+    assert await scaler.step() == 5  # clamped at max
+    assert calls == [4, 5]
+
+    # drain the backlog; scale-in must wait for cooldown
+    broker._conn.execute("UPDATE deliveries SET done = 1")
+    broker._conn.commit()
+    assert await scaler.step() == 5  # cooldown not yet elapsed
+    await asyncio.sleep(0.25)
+    assert await scaler.step() == 1
+    assert calls == [4, 5, 1]
+    await broker.aclose()
+
+
+def test_unknown_rule_type_rejected(tmp_path):
+    with pytest.raises(ComponentError):
+        read_backlog(ScaleRule(type="cpu", metadata={}), app_id="x",
+                     components=[], base_dir=tmp_path)
+
+
+@pytest.mark.asyncio
+async def test_orchestrator_multiprocess_tasks_tracker(tmp_path):
+    """Launch the real run.yaml shape as subprocesses and drive the
+    write path across three OS processes (≙ the three-terminal local
+    milestone, SURVEY.md §7.3)."""
+    import aiohttp
+    from tasksrunner.orchestrator.config import RunConfig
+    from tasksrunner.orchestrator.run import Orchestrator
+
+    config = RunConfig(
+        apps=[
+            AppSpec(app_id="tasksmanager-backend-api",
+                    module="samples.tasks_tracker.backend_api:make_app",
+                    env={"TASKS_MANAGER": "store"}),
+            AppSpec(app_id="tasksmanager-frontend-webapp",
+                    module="samples.tasks_tracker.frontend_ui:make_app"),
+            AppSpec(app_id="tasksmanager-backend-processor",
+                    module="samples.tasks_tracker.processor:make_app"),
+        ],
+        resources_path=str(REPO / "samples" / "tasks_tracker" / "components"),
+        registry_file=str(tmp_path / "apps.json"),
+        base_dir=tmp_path,
+    )
+    orch = Orchestrator(config)
+    await orch.start()
+    try:
+        registry = tmp_path / "apps.json"
+
+        async def all_ready():
+            if not registry.is_file():
+                return False
+            import json
+            entries = json.loads(registry.read_text() or "{}")
+            return len(entries) == 3
+
+        deadline = asyncio.get_running_loop().time() + 30
+        while not await all_ready():
+            assert asyncio.get_running_loop().time() < deadline, "apps never registered"
+            await asyncio.sleep(0.2)
+
+        import json
+        entries = json.loads(registry.read_text())
+        frontend_port = entries["tasksmanager-frontend-webapp"]["app_port"]
+
+        jar = aiohttp.CookieJar(unsafe=True)
+        async with aiohttp.ClientSession(cookie_jar=jar) as browser:
+            async with browser.post(f"http://127.0.0.1:{frontend_port}/",
+                                    data={"email": "mp@x.com"}) as r:
+                assert r.status == 200
+            async with browser.post(
+                f"http://127.0.0.1:{frontend_port}/tasks/create",
+                data={"taskName": "multiproc", "taskDueDate": "2026-08-09",
+                      "taskAssignedTo": "z@x.com"}) as r:
+                assert "multiproc" in await r.text()
+
+        # the processor (third OS process) must receive the event:
+        # observable via its sendgrid outbox on disk
+        outbox = tmp_path / ".tasksrunner" / "outbox"
+        deadline = asyncio.get_running_loop().time() + 15
+        while not (outbox.is_dir() and list(outbox.glob("*.json"))):
+            assert asyncio.get_running_loop().time() < deadline, "no email archived"
+            await asyncio.sleep(0.2)
+    finally:
+        await orch.stop()
+
+
+@pytest.mark.asyncio
+async def test_replica_restart_on_crash(tmp_path):
+    """≙ ACA restart-on-crash (SURVEY.md §5.3)."""
+    from tasksrunner.orchestrator.config import RunConfig
+    from tasksrunner.orchestrator.run import Orchestrator
+
+    # an app whose process dies right after starting
+    pkg = tmp_path / "crashpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "boom.py").write_text(textwrap.dedent("""
+        import os, asyncio
+        from tasksrunner import App
+
+        def make_app():
+            app = App("crasher")
+
+            @app.on_startup
+            async def die():
+                asyncio.get_running_loop().call_later(0.3, os._exit, 17)
+
+            return app
+    """))
+    config = RunConfig(
+        apps=[AppSpec(app_id="crasher", module="crashpkg.boom:make_app")],
+        registry_file=str(tmp_path / "apps.json"),
+        base_dir=tmp_path,
+    )
+    import os
+    os.environ["PYTHONPATH"] = f"{tmp_path}{os.pathsep}{REPO}"
+    try:
+        orch = Orchestrator(config)
+        await orch.start()
+        replica = orch.replicas["crasher"][0]
+        deadline = asyncio.get_running_loop().time() + 20
+        while replica.restarts < 2:
+            assert asyncio.get_running_loop().time() < deadline, "no restarts happened"
+            await asyncio.sleep(0.1)
+    finally:
+        del os.environ["PYTHONPATH"]
+        await orch.stop()
