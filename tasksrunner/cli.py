@@ -55,6 +55,7 @@ def _cmd_host(args) -> None:
         components_path=args.components,
         app_port=args.app_port,
         sidecar_port=args.sidecar_port,
+        bind=args.host,
         registry_file=args.registry_file,
         register=not args.no_register,
     )
@@ -143,6 +144,51 @@ def _cmd_run(args) -> None:
     _run_until_interrupt(run_from_config(config))
 
 
+def _cmd_deploy(args) -> None:
+    from tasksrunner.deploy import (
+        apply_manifest,
+        load_manifest,
+        validate_manifest,
+        what_if,
+    )
+    from tasksrunner.deploy.plan import destroy
+
+    manifest = load_manifest(args.manifest)
+    if args.action == "validate":
+        problems = validate_manifest(manifest)
+        if problems:
+            for p in problems:
+                print(f"ERROR: {p}")
+            raise SystemExit(1)
+        print(f"manifest {manifest.name!r} is valid "
+              f"({len(manifest.apps)} apps, {len(manifest.components)} components)")
+    elif args.action == "what-if":
+        preview = what_if(manifest)
+        if not preview["valid"]:
+            for p in preview["problems"]:
+                print(f"ERROR: {p}")
+            raise SystemExit(1)
+        if not preview["changes"]:
+            print("no changes — recorded state matches the manifest")
+        for change in preview["changes"]:
+            if change["op"] == "modify":
+                print(f"~ {change['path']}: {change['from']!r} -> {change['to']!r}")
+            else:
+                sign = "+" if change["op"] == "create" else "-"
+                print(f"{sign} {change['path'] or manifest.name}")
+    elif args.action == "apply":
+        result = apply_manifest(manifest)
+        print(f"applied {len(result['changes'])} change(s)")
+        print(f"run config: {result['run_config']}")
+        print(f"state:      {result['state']}")
+        print(f"start with: python -m tasksrunner run {result['run_config']}")
+    elif args.action == "down":
+        if destroy(manifest):
+            print(f"environment {manifest.name!r} state removed")
+        else:
+            print(f"environment {manifest.name!r} had no recorded state")
+
+
 def _cmd_components(args) -> None:
     from tasksrunner.component.loader import load_components
     from tasksrunner.component.registry import registered_types
@@ -185,6 +231,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="override the App's app-id (rarely needed)")
     p.add_argument("--app-port", type=int, default=0)
     p.add_argument("--sidecar-port", type=int, default=0)
+    p.add_argument("--host", default="127.0.0.1",
+                   help="app server bind address (0.0.0.0 = external ingress)")
     p.add_argument("--components", default=None)
     p.add_argument("--registry-file", default=".tasksrunner/apps.json")
     p.add_argument("--no-register", action="store_true")
@@ -209,6 +257,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("config")
     p.set_defaults(fn=_cmd_run)
 
+    p = sub.add_parser(
+        "deploy",
+        help="validate / what-if / apply / down an environment manifest")
+    p.add_argument("action", choices=["validate", "what-if", "apply", "down"])
+    p.add_argument("manifest")
+    p.set_defaults(fn=_cmd_deploy)
+
     p = sub.add_parser("components", help="validate a components directory")
     p.add_argument("path")
     p.add_argument("--app-id", default=None,
@@ -220,7 +275,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> None:
     args = build_parser().parse_args(argv)
-    args.fn(args)
+    from tasksrunner.errors import TasksRunnerError
+    try:
+        args.fn(args)
+    except TasksRunnerError as exc:
+        # user-facing errors (bad manifest path, unresolved secret...)
+        # exit cleanly instead of dumping a traceback
+        raise SystemExit(f"ERROR: {exc}") from exc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,16 @@
+from tasksrunner.deploy.manifest import (
+    AppManifest,
+    EnvironmentManifest,
+    load_manifest,
+    validate_manifest,
+)
+from tasksrunner.deploy.plan import apply_manifest, what_if
+
+__all__ = [
+    "AppManifest",
+    "EnvironmentManifest",
+    "load_manifest",
+    "validate_manifest",
+    "what_if",
+    "apply_manifest",
+]

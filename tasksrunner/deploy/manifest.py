@@ -1,0 +1,238 @@
+"""Declarative environment manifest — the framework's IaC layer.
+
+Plays the role bicep/main.bicep plays in the reference: one composition
+root declaring the environment, every component (cloud-dialect files,
+named here the way ``az containerapp env dapr-component set`` names
+them), and every app with its ingress/dapr/env/secrets/scale blocks
+(bicep/modules/container-apps/webapi-backend-service.bicep:94-139,
+processor-backend-service.bicep:113-181).
+
+Shape:
+
+```yaml
+environment:
+  name: tasks-tracker-env
+  registry_file: .tasksrunner/apps.json
+components:
+  - name: statestore
+    file: aca-components/containerapps-statestore.yaml
+apps:
+  - app_id: tasksmanager-backend-api
+    module: samples.tasks_tracker.backend_api:make_app
+    app_port: 5103
+    sidecar_port: 3500
+    ingress: internal          # external | internal | none
+    env: { TASKS_MANAGER: store }
+    secrets:                   # name -> value | {env: VAR}
+      appinsights-key: { env: APPINSIGHTS_KEY }
+    scale:
+      min_replicas: 1
+      max_replicas: 5
+      rules: [ ... ]           # same schema as the run config
+```
+
+The verbs mirror the reference's CI pipeline
+(.github/workflows/infra-deploy.yml:33-160): ``validate`` ≙ bicep lint
++ ARM Validate, ``what-if`` ≙ the az what-if diff preview, ``apply`` ≙
+the deployment step.
+"""
+
+from __future__ import annotations
+
+import importlib
+import pathlib
+from dataclasses import asdict, dataclass, field
+
+import yaml
+
+from tasksrunner.component.loader import load_component_file
+from tasksrunner.component.registry import registered_types
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.errors import ComponentError
+
+INGRESS_MODES = ("external", "internal", "none")
+
+
+@dataclass
+class ComponentRef:
+    name: str
+    file: str
+
+
+@dataclass
+class AppManifest:
+    app_id: str
+    module: str
+    app_port: int = 0
+    sidecar_port: int = 0
+    ingress: str = "internal"
+    env: dict[str, str] = field(default_factory=dict)
+    #: secret name -> literal value or {"env": "VAR_NAME"} indirection
+    secrets: dict[str, object] = field(default_factory=dict)
+    min_replicas: int = 1
+    max_replicas: int = 1
+    scale_rules: list[dict] = field(default_factory=list)
+    cooldown_seconds: float = 5.0
+
+
+@dataclass
+class EnvironmentManifest:
+    name: str
+    apps: list[AppManifest]
+    components: list[ComponentRef] = field(default_factory=list)
+    registry_file: str = ".tasksrunner/apps.json"
+    source_path: pathlib.Path | None = None
+
+    @property
+    def base_dir(self) -> pathlib.Path:
+        return self.source_path.parent if self.source_path else pathlib.Path.cwd()
+
+
+def load_manifest(path: str | pathlib.Path) -> EnvironmentManifest:
+    path = pathlib.Path(path)
+    try:
+        doc = yaml.safe_load(path.read_text()) or {}
+    except OSError as exc:
+        raise ComponentError(f"cannot read manifest {path}: {exc}") from exc
+    except yaml.YAMLError as exc:
+        raise ComponentError(f"cannot parse manifest {path}: {exc}") from exc
+
+    env = doc.get("environment") or {}
+    apps = []
+    for raw in doc.get("apps") or []:
+        if "app_id" not in raw or "module" not in raw:
+            raise ComponentError(f"{path}: each app needs app_id and module")
+        scale = raw.get("scale") or {}
+        apps.append(AppManifest(
+            app_id=str(raw["app_id"]),
+            module=str(raw["module"]),
+            app_port=int(raw.get("app_port", 0)),
+            sidecar_port=int(raw.get("sidecar_port", 0)),
+            ingress=str(raw.get("ingress", "internal")),
+            env={str(k): str(v) for k, v in (raw.get("env") or {}).items()},
+            secrets=dict(raw.get("secrets") or {}),
+            min_replicas=int(scale.get("min_replicas", 1)),
+            max_replicas=int(scale.get("max_replicas", 1)),
+            scale_rules=list(scale.get("rules") or []),
+            cooldown_seconds=float(scale.get("cooldown_seconds", 5.0)),
+        ))
+
+    components = [
+        ComponentRef(name=str(c["name"]), file=str(c["file"]))
+        for c in doc.get("components") or []
+        if isinstance(c, dict) and "name" in c and "file" in c
+    ]
+
+    return EnvironmentManifest(
+        name=str(env.get("name", path.stem)),
+        apps=apps,
+        components=components,
+        registry_file=str(env.get("registry_file", ".tasksrunner/apps.json")),
+        source_path=path.resolve(),
+    )
+
+
+def resolve_components(manifest: EnvironmentManifest) -> list[ComponentSpec]:
+    """Load every referenced component file with its manifest name
+    (exactly how `az containerapp env dapr-component set --yaml` pairs
+    a name with a cloud-dialect file)."""
+    specs: list[ComponentSpec] = []
+    for ref in manifest.components:
+        file_path = pathlib.Path(ref.file)
+        if not file_path.is_absolute():
+            file_path = manifest.base_dir / file_path
+        loaded = load_component_file(file_path, name=ref.name)
+        if len(loaded) != 1:
+            raise ComponentError(
+                f"component file {file_path} must hold exactly one document")
+        specs.append(loaded[0])
+    return specs
+
+
+def validate_manifest(manifest: EnvironmentManifest, *,
+                      check_imports: bool = True) -> list[str]:
+    """≙ lint + Validate deployment mode: return a list of problems
+    (empty = valid)."""
+    problems: list[str] = []
+    if not manifest.apps:
+        problems.append("manifest declares no apps")
+
+    seen_ids: set[str] = set()
+    seen_ports: dict[int, str] = {}
+    for app in manifest.apps:
+        where = f"app {app.app_id!r}"
+        if app.app_id in seen_ids:
+            problems.append(f"duplicate app_id {app.app_id!r}")
+        seen_ids.add(app.app_id)
+        if app.ingress not in INGRESS_MODES:
+            problems.append(f"{where}: ingress must be one of {INGRESS_MODES}")
+        if app.min_replicas < 1:
+            problems.append(f"{where}: min_replicas must be >= 1 "
+                            "(scale-to-zero starves cron/input bindings)")
+        if app.max_replicas < app.min_replicas:
+            problems.append(f"{where}: max_replicas < min_replicas")
+        for port in (app.app_port, app.sidecar_port):
+            if port:
+                if port in seen_ports:
+                    problems.append(
+                        f"{where}: port {port} already used by {seen_ports[port]}")
+                seen_ports[port] = app.app_id
+        if check_imports:
+            module_name = app.module.partition(":")[0]
+            try:
+                importlib.import_module(module_name)
+            except ImportError as exc:
+                problems.append(f"{where}: module {module_name!r} not importable: {exc}")
+
+    try:
+        specs = resolve_components(manifest)
+    except ComponentError as exc:
+        problems.append(str(exc))
+        specs = []
+
+    known = set(registered_types())
+    comp_names = set()
+    for spec in specs:
+        comp_names.add(spec.name)
+        if spec.type not in known:
+            problems.append(f"component {spec.name!r}: no driver for type {spec.type!r}")
+        for scope in spec.scopes:
+            if scope not in seen_ids:
+                problems.append(
+                    f"component {spec.name!r}: scope {scope!r} matches no app")
+
+    for app in manifest.apps:
+        for rule in app.scale_rules:
+            comp = (rule.get("metadata") or {}).get("component")
+            if comp and comp not in comp_names:
+                problems.append(
+                    f"app {app.app_id!r}: scale rule references unknown "
+                    f"component {comp!r}")
+    return problems
+
+
+def desired_state(manifest: EnvironmentManifest) -> dict:
+    """Canonical JSON form of the manifest (the what-if diff input)."""
+    specs = resolve_components(manifest)
+    return {
+        "environment": manifest.name,
+        "components": {
+            s.name: {
+                "type": s.type,
+                "version": s.version,
+                "metadata": {
+                    k: (v if isinstance(v, str) else
+                        {"secretRef": v.key, "store": v.store})
+                    for k, v in s.metadata.items()
+                },
+                "scopes": sorted(s.scopes),
+            }
+            for s in specs
+        },
+        "apps": {
+            a.app_id: {
+                k: v for k, v in asdict(a).items() if k != "app_id"
+            }
+            for a in manifest.apps
+        },
+    }

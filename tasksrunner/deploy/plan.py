@@ -1,0 +1,182 @@
+"""what-if / apply: plan-diff deployment against recorded state.
+
+≙ the reference pipeline's ``az deployment group what-if`` preview and
+deploy steps (.github/workflows/infra-deploy.yml:80-160): the applied
+environment state is recorded (``.tasksrunner/deployed.json`` ≙ the
+resource group's current state), ``what_if`` diffs desired vs recorded
+without touching anything, ``apply`` records the new state and
+materialises the runnable artifacts (a run config for the orchestrator
++ provisioned resource paths + resolved app secrets).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+from typing import Any
+
+import yaml
+
+from tasksrunner.deploy.manifest import (
+    EnvironmentManifest,
+    desired_state,
+    validate_manifest,
+)
+from tasksrunner.errors import ComponentError
+
+DEPLOYED_STATE = "deployed.json"
+
+
+def _state_path(manifest: EnvironmentManifest) -> pathlib.Path:
+    return manifest.base_dir / ".tasksrunner" / DEPLOYED_STATE
+
+
+def _load_recorded(manifest: EnvironmentManifest) -> dict | None:
+    path = _state_path(manifest)
+    if not path.is_file():
+        return None
+    try:
+        return json.loads(path.read_text())
+    except ValueError:
+        return None
+
+
+def diff_states(recorded: Any, desired: Any, *, path: str = "") -> list[dict]:
+    """Structural diff: list of {op: create|delete|modify, path, ...}."""
+    changes: list[dict] = []
+    if isinstance(recorded, dict) and isinstance(desired, dict):
+        for key in sorted(set(recorded) | set(desired)):
+            sub = f"{path}.{key}" if path else str(key)
+            if key not in recorded:
+                changes.append({"op": "create", "path": sub, "value": desired[key]})
+            elif key not in desired:
+                changes.append({"op": "delete", "path": sub, "value": recorded[key]})
+            else:
+                changes.extend(diff_states(recorded[key], desired[key], path=sub))
+        return changes
+    if recorded != desired:
+        changes.append({"op": "modify", "path": path,
+                        "from": recorded, "to": desired})
+    return changes
+
+
+def what_if(manifest: EnvironmentManifest) -> dict:
+    """Preview: validate + diff desired vs recorded, touch nothing."""
+    problems = validate_manifest(manifest)
+    desired = desired_state(manifest) if not problems else {}
+    recorded = _load_recorded(manifest)
+    changes = (
+        [{"op": "create", "path": "", "value": "(entire environment)"}]
+        if recorded is None and not problems
+        else diff_states(recorded or {}, desired)
+    )
+    return {
+        "valid": not problems,
+        "problems": problems,
+        "first_deploy": recorded is None,
+        "changes": changes,
+    }
+
+
+def _resolve_secret(name: str, spec: object, *, app_id: str) -> str:
+    """Secret blocks: literal string, or {env: VAR} indirection (≙ the
+    Key Vault reference / listKeys() indirections in the Bicep app
+    modules, processor-backend-service.bicep:121-130)."""
+    if isinstance(spec, str):
+        return spec
+    if isinstance(spec, dict) and "env" in spec:
+        var = str(spec["env"])
+        if var not in os.environ:
+            raise ComponentError(
+                f"app {app_id!r}: secret {name!r} references unset env var {var!r}")
+        return os.environ[var]
+    raise ComponentError(f"app {app_id!r}: secret {name!r} must be a string or {{env: VAR}}")
+
+
+def apply_manifest(manifest: EnvironmentManifest) -> dict:
+    """Deploy: validate, record state, emit the orchestrator run config.
+
+    Returns {"run_config": path, "state": path, "changes": [...]}.
+    Secrets resolve at apply time into per-app env (the way a container
+    app's secretRef env vars materialise at deploy), so the emitted run
+    config is self-contained.
+    """
+    preview = what_if(manifest)
+    if not preview["valid"]:
+        raise ComponentError(
+            "manifest is invalid:\n  - " + "\n  - ".join(preview["problems"]))
+
+    out_dir = manifest.base_dir / ".tasksrunner"
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    # materialise the run config the orchestrator consumes
+    apps_block = []
+    for app in manifest.apps:
+        env = dict(app.env)
+        for secret_name, spec in app.secrets.items():
+            env_key = secret_name.replace("-", "_").upper()
+            env[env_key] = _resolve_secret(secret_name, spec, app_id=app.app_id)
+        entry: dict[str, Any] = {
+            "app_id": app.app_id,
+            "module": app.module,
+            "app_port": app.app_port,
+            "sidecar_port": app.sidecar_port,
+            # ingress → bind address (external = reachable off-host,
+            # ≙ the ACA external/internal ingress flag)
+            "host": "0.0.0.0" if app.ingress == "external" else "127.0.0.1",
+            "env": env,
+        }
+        if app.max_replicas > 1 or app.scale_rules:
+            entry["scale"] = {
+                "min_replicas": app.min_replicas,
+                "max_replicas": app.max_replicas,
+                "cooldown_seconds": app.cooldown_seconds,
+                "rules": app.scale_rules,
+            }
+        apps_block.append(entry)
+
+    # components land in a generated resources dir, one local-dialect
+    # file per component, names taken from the manifest
+    from tasksrunner.component.loader import dump_components
+    from tasksrunner.deploy.manifest import resolve_components
+
+    resources_dir = out_dir / f"{manifest.name}-components"
+    resources_dir.mkdir(parents=True, exist_ok=True)
+    for old in resources_dir.glob("*.yaml"):
+        old.unlink()
+    specs = resolve_components(manifest)
+    for spec in specs:
+        (resources_dir / f"{spec.name}.yaml").write_text(dump_components([spec]))
+
+    run_config = {
+        "resources_path": str(resources_dir),
+        "registry_file": manifest.registry_file,
+        "apps": apps_block,
+    }
+    run_path = out_dir / f"{manifest.name}-run.yaml"
+    run_path.write_text(yaml.safe_dump(run_config, sort_keys=False))
+
+    state_path = _state_path(manifest)
+    state_path.write_text(json.dumps(desired_state(manifest), indent=2))
+
+    return {
+        "run_config": str(run_path),
+        "state": str(state_path),
+        "changes": preview["changes"],
+        "first_deploy": preview["first_deploy"],
+    }
+
+
+def destroy(manifest: EnvironmentManifest) -> bool:
+    """Tear down the recorded environment (≙ the pipeline's manual
+    teardown input, infra-deploy.yml:10-15). Returns True if state
+    existed."""
+    state = _state_path(manifest)
+    existed = state.is_file()
+    if existed:
+        state.unlink()
+    run_path = manifest.base_dir / ".tasksrunner" / f"{manifest.name}-run.yaml"
+    if run_path.is_file():
+        run_path.unlink()
+    return existed

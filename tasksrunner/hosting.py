@@ -67,12 +67,18 @@ class AppHost:
         app_port: int = 0,
         sidecar_port: int = 0,
         host: str = "127.0.0.1",
+        bind: str | None = None,
         registry_file: str | None = None,
         resolver: NameResolver | None = None,
         register: bool = True,
     ):
         self.app = app
+        #: where the sidecar binds and where peers reach this host
         self.host = host
+        #: bind address for the app's own server only; "0.0.0.0" =
+        #: external ingress. Defaults to ``host`` — overriding it never
+        #: moves the sidecar, which stays unexposed (as in ACA).
+        self.bind = bind or host
         self.register = register
         self.app_port = app_port
         self.sidecar_port = sidecar_port
@@ -88,13 +94,16 @@ class AppHost:
         # 1. the app's own HTTP server
         self._app_runner = web.AppRunner(build_app_server(self.app))
         await self._app_runner.setup()
-        site = web.TCPSite(self._app_runner, self.host, self.app_port)
+        site = web.TCPSite(self._app_runner, self.bind, self.app_port)
         await site.start()
         if self.app_port == 0:
             self.app_port = self._app_runner.addresses[0][1]
 
         # 2. the sidecar beside it
         registry = ComponentRegistry(self.specs, app_id=self.app.app_id)
+        # the channel targets self.host: with bind=0.0.0.0 the app is
+        # reachable there too, and with a non-loopback host everything
+        # (app, sidecar, registration) consistently lives on that address
         runtime = Runtime(
             self.app.app_id, registry, resolver=self.resolver,
             app_channel=HTTPAppChannel(self.host, self.app_port),

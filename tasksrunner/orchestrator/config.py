@@ -63,6 +63,10 @@ class AppSpec:
     module: str  # "pkg.mod:factory"
     app_port: int = 0
     sidecar_port: int = 0
+    #: bind address for the app server; "0.0.0.0" = external ingress,
+    #: "127.0.0.1" = internal-only (≙ the ACA ingress block,
+    #: webapi-backend-service.bicep:94-97)
+    host: str = "127.0.0.1"
     env: dict[str, str] = field(default_factory=dict)
     scale: ScaleSpec = field(default_factory=ScaleSpec)
 
@@ -100,6 +104,7 @@ def load_run_config(path: str | pathlib.Path) -> RunConfig:
             module=str(raw["module"]),
             app_port=int(raw.get("app_port", 0)),
             sidecar_port=int(raw.get("sidecar_port", 0)),
+            host=str(raw.get("host", "127.0.0.1")),
             env={str(k): str(v) for k, v in (raw.get("env") or {}).items()},
             scale=ScaleSpec(
                 min_replicas=int(scale_raw.get("min_replicas", 1)),

@@ -54,6 +54,7 @@ class Replica:
         ]
         if self.config.resources_path:
             cmd += ["--components", self.config.resources_path]
+        cmd += ["--host", self.app.host]
         if self.index == 0:
             cmd += ["--app-port", str(self.app.app_port),
                     "--sidecar-port", str(self.app.sidecar_port)]

@@ -1,0 +1,179 @@
+"""Deploy layer tests: manifest validation, what-if diffing, apply.
+
+Contract source: the reference's IaC layer (bicep/main.bicep
+composition, app modules' ingress/scale blocks) and its CI pipeline
+verbs lint → validate → what-if → deploy
+(.github/workflows/infra-deploy.yml:33-160; SURVEY.md §2.5-2.6).
+"""
+
+import pathlib
+
+import pytest
+import yaml
+
+from tasksrunner.deploy import (
+    apply_manifest,
+    load_manifest,
+    validate_manifest,
+    what_if,
+)
+from tasksrunner.deploy.plan import destroy, diff_states
+from tasksrunner.errors import ComponentError
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SAMPLE_MANIFEST = REPO / "samples" / "tasks_tracker" / "environment.yaml"
+
+
+def test_sample_manifest_is_valid():
+    manifest = load_manifest(SAMPLE_MANIFEST)
+    assert manifest.name == "tasks-tracker-env"
+    assert [a.app_id for a in manifest.apps] == [
+        "tasksmanager-backend-api",
+        "tasksmanager-frontend-webapp",
+        "tasksmanager-backend-processor",
+    ]
+    assert validate_manifest(manifest) == []
+    processor = manifest.apps[2]
+    assert processor.max_replicas == 5
+    assert processor.scale_rules[0]["metadata"]["messageCount"] == "10"
+
+
+def _write_manifest(tmp_path, doc):
+    p = tmp_path / "env.yaml"
+    p.write_text(yaml.safe_dump(doc, sort_keys=False))
+    return p
+
+
+BASE_DOC = {
+    "environment": {"name": "test-env"},
+    "components": [],
+    "apps": [
+        {"app_id": "api", "module": "samples.tasks_tracker.backend_api:make_app",
+         "app_port": 9103, "sidecar_port": 9500, "ingress": "internal"},
+    ],
+}
+
+
+def test_validate_catches_problems(tmp_path):
+    doc = {
+        "environment": {"name": "bad"},
+        "components": [
+            {"name": "ghost", "file": "missing.yaml"},
+        ],
+        "apps": [
+            {"app_id": "a", "module": "nonexistent.module:make_app",
+             "ingress": "sideways", "app_port": 1000,
+             "scale": {"min_replicas": 0, "max_replicas": 5}},
+            {"app_id": "a", "module": "also.missing:make_app", "app_port": 1000},
+        ],
+    }
+    manifest = load_manifest(_write_manifest(tmp_path, doc))
+    problems = "\n".join(validate_manifest(manifest))
+    assert "duplicate app_id" in problems
+    assert "ingress" in problems
+    assert "min_replicas" in problems
+    assert "not importable" in problems
+    assert "port 1000" in problems
+    assert "missing.yaml" in problems
+
+
+def test_validate_scope_and_rule_refs(tmp_path):
+    comp = tmp_path / "c.yaml"
+    comp.write_text("componentType: state.sqlite\nscopes: [ghost-app]\n")
+    doc = {
+        "environment": {"name": "e"},
+        "components": [{"name": "store", "file": "c.yaml"}],
+        "apps": [
+            {"app_id": "api", "module": "samples.tasks_tracker.backend_api:make_app",
+             "scale": {"max_replicas": 3,
+                       "rules": [{"type": "pubsub-backlog",
+                                  "metadata": {"component": "nope"}}]}},
+        ],
+    }
+    problems = "\n".join(validate_manifest(load_manifest(_write_manifest(tmp_path, doc))))
+    assert "scope 'ghost-app'" in problems
+    assert "unknown component 'nope'" in problems
+
+
+def test_diff_states():
+    changes = diff_states(
+        {"apps": {"a": {"x": 1}, "b": {"y": 2}}},
+        {"apps": {"a": {"x": 9}, "c": {"z": 3}}},
+    )
+    ops = {(c["op"], c["path"]) for c in changes}
+    assert ("modify", "apps.a.x") in ops
+    assert ("delete", "apps.b") in ops
+    assert ("create", "apps.c") in ops
+
+
+def test_what_if_apply_cycle(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    manifest_path = _write_manifest(tmp_path, BASE_DOC)
+    manifest = load_manifest(manifest_path)
+
+    preview = what_if(manifest)
+    assert preview["valid"] and preview["first_deploy"]
+
+    result = apply_manifest(manifest)
+    assert result["first_deploy"]
+    run_cfg = yaml.safe_load(pathlib.Path(result["run_config"]).read_text())
+    assert run_cfg["apps"][0]["app_id"] == "api"
+    assert run_cfg["apps"][0]["host"] == "127.0.0.1"
+
+    # the emitted run config loads in the orchestrator's parser
+    from tasksrunner.orchestrator.config import load_run_config
+    parsed = load_run_config(result["run_config"])
+    assert parsed.apps[0].app_id == "api"
+
+    # idempotent: second what-if shows no changes
+    preview2 = what_if(manifest)
+    assert preview2["changes"] == [] and not preview2["first_deploy"]
+
+    # mutate: change a port → exactly one modify
+    doc2 = dict(BASE_DOC)
+    doc2["apps"] = [dict(BASE_DOC["apps"][0], app_port=9104)]
+    manifest2 = load_manifest(_write_manifest(tmp_path, doc2))
+    changes = what_if(manifest2)["changes"]
+    assert [c["op"] for c in changes] == ["modify"]
+    assert changes[0]["path"] == "apps.api.app_port"
+
+    assert destroy(manifest) is True
+    assert what_if(manifest)["first_deploy"]
+
+
+def test_apply_resolves_env_secrets(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("MY_KEY_VALUE", "s3cr3t")
+    doc = dict(BASE_DOC)
+    doc["apps"] = [dict(BASE_DOC["apps"][0],
+                        secrets={"appinsights-key": {"env": "MY_KEY_VALUE"},
+                                 "literal-key": "plain"})]
+    manifest = load_manifest(_write_manifest(tmp_path, doc))
+    result = apply_manifest(manifest)
+    run_cfg = yaml.safe_load(pathlib.Path(result["run_config"]).read_text())
+    env = run_cfg["apps"][0]["env"]
+    assert env["APPINSIGHTS_KEY"] == "s3cr3t"
+    assert env["LITERAL_KEY"] == "plain"
+
+    monkeypatch.delenv("MY_KEY_VALUE")
+    with pytest.raises(ComponentError, match="unset env var"):
+        apply_manifest(manifest)
+
+
+def test_external_ingress_binds_all_interfaces(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    doc = dict(BASE_DOC)
+    doc["apps"] = [dict(BASE_DOC["apps"][0], ingress="external")]
+    manifest = load_manifest(_write_manifest(tmp_path, doc))
+    result = apply_manifest(manifest)
+    run_cfg = yaml.safe_load(pathlib.Path(result["run_config"]).read_text())
+    assert run_cfg["apps"][0]["host"] == "0.0.0.0"
+
+
+def test_apply_rejects_invalid(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    doc = {"environment": {"name": "x"},
+           "apps": [{"app_id": "a", "module": "missing.mod:f"}]}
+    manifest = load_manifest(_write_manifest(tmp_path, doc))
+    with pytest.raises(ComponentError, match="invalid"):
+        apply_manifest(manifest)
