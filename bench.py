@@ -1,0 +1,123 @@
+"""Benchmark: end-to-end task-write throughput through the framework.
+
+The reference publishes NO performance benchmarks (BASELINE.md: no
+benchmarks directory, no throughput/latency numbers; `"published": {}`),
+so there is no reference number to beat — ``vs_baseline`` is null. The
+honest headline metric for this framework is the throughput of its
+canonical end-to-end write path (SURVEY.md §3.1):
+
+    client → service invocation → API handler → durable state write
+    (sqlite engine) → CloudEvents publish (durable sqlite broker) →
+    competing-consumer delivery to the processor handler
+
+Each unit of work therefore exercises invocation, state, pub/sub, and
+delivery — the whole runtime, not a micro-op.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import tempfile
+import time
+import pathlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+N_TASKS = 600
+WARMUP = 50
+
+
+async def bench() -> float:
+    from tasksrunner import App, InProcCluster
+    from tasksrunner.component.spec import parse_component
+
+    tmp = tempfile.mkdtemp(prefix="tasksrunner-bench-")
+    specs = [
+        parse_component({
+            "componentType": "state.sqlite",
+            "metadata": [{"name": "databasePath", "value": f"{tmp}/state.db"}],
+            "scopes": ["bench-api"],
+        }, default_name="statestore"),
+        parse_component({
+            "componentType": "pubsub.sqlite",
+            "metadata": [
+                {"name": "brokerPath", "value": f"{tmp}/broker.db"},
+                {"name": "pollIntervalSeconds", "value": "0.001"},
+            ],
+        }, default_name="pubsub"),
+    ]
+
+    api = App("bench-api")
+
+    @api.post("/api/tasks")
+    async def create(req):
+        doc = req.json()
+        await api.client.save_state("statestore", doc["taskId"], doc)
+        await api.client.publish_event("pubsub", "tasksavedtopic", doc)
+        return 201, {"taskId": doc["taskId"]}
+
+    received = 0
+    done = asyncio.Event()
+    processor = App("bench-processor")
+
+    @processor.subscribe(pubsub="pubsub", topic="tasksavedtopic", route="/on-saved")
+    async def on_saved(req):
+        nonlocal received
+        received += 1
+        if received >= N_TASKS + WARMUP:
+            done.set()
+        return 200
+
+    cluster = InProcCluster(specs)
+    cluster.add_app(api)
+    cluster.add_app(processor)
+    await cluster.start()
+    try:
+        client = cluster.client("bench-api")
+
+        async def create_task(i: int) -> None:
+            resp = await client.invoke_method(
+                "bench-api", "api/tasks", http_method="POST",
+                data={"taskId": f"t{i}", "taskName": f"task {i}",
+                      "taskCreatedBy": "bench@x.com",
+                      "taskDueDate": "2026-08-01T00:00:00"})
+            assert resp.status == 201, resp.body
+
+        for i in range(WARMUP):
+            await create_task(i)
+
+        # drive with bounded concurrency, as a load generator would
+        sem = asyncio.Semaphore(64)
+
+        async def bounded(i: int) -> None:
+            async with sem:
+                await create_task(i)
+
+        start = time.perf_counter()
+        await asyncio.gather(
+            *(bounded(i) for i in range(WARMUP, WARMUP + N_TASKS)))
+        # throughput counts full pipeline completion: all events
+        # delivered to the processor
+        await asyncio.wait_for(done.wait(), timeout=120)
+        elapsed = time.perf_counter() - start
+        return N_TASKS / elapsed
+    finally:
+        await cluster.stop()
+
+
+def main() -> None:
+    throughput = asyncio.run(bench())
+    print(json.dumps({
+        "metric": "e2e_task_write_throughput",
+        "value": round(throughput, 1),
+        "unit": "tasks/sec",
+        "vs_baseline": None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

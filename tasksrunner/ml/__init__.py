@@ -1,0 +1,36 @@
+"""OPTIONAL ML extension — NOT a ported capability.
+
+The reference (chsakell/aca-dotnet-workshop) contains no numerical or
+accelerator workload whatsoever: no tensors, kernels, training loops,
+or collectives (SURVEY.md §0, §5.7, §7.1; BASELINE.json "no CUDA, no
+NCCL, no training loop ... Target: N/A"). Everything under
+``tasksrunner.ml`` is therefore an *extension*: a demo "workload
+service" placed behind the same building-block APIs every other
+service uses, proving that compute-bearing services slot into the
+runtime like any other app.
+
+The workload is a small JAX transformer that scores task priority from
+the task's text fields, written TPU-first (bfloat16 matmuls for the
+MXU, static shapes, jit-compiled, dp×tp sharding over a
+``jax.sharding.Mesh``). It exists to exercise the framework's harness
+contract (__graft_entry__.py, bench.py) and as the pattern for users
+who want to host models on tasksrunner.
+"""
+
+from tasksrunner.ml.model import (
+    ModelConfig,
+    init_params,
+    forward,
+    loss_fn,
+    make_train_step,
+    shard_params,
+)
+
+__all__ = [
+    "ModelConfig",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "make_train_step",
+    "shard_params",
+]

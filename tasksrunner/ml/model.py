@@ -1,0 +1,193 @@
+"""Task-priority scorer: a small TPU-first transformer encoder.
+
+EXTENSION ONLY — the reference has no model to port (SURVEY.md §7.1);
+this exists to back the harness contract and demonstrate hosting
+compute services on the runtime.
+
+TPU-first design notes:
+
+* all matmuls run in bfloat16 with float32 accumulation
+  (``preferred_element_type``) so they land on the MXU at full tile
+  throughput; params are kept in float32 and cast per-step;
+* static shapes everywhere; the whole train step is one ``jax.jit``
+  region — no Python control flow inside;
+* parallelism is expressed as shardings over a 2-D
+  ``Mesh(("dp","tp"))``: batch on ``dp``, feature/head dimensions on
+  ``tp``; XLA inserts the collectives (psum for tp-reduced matmuls,
+  gradient all-reduce over dp) — nothing is hand-scheduled;
+* attention uses plain ``jnp.einsum`` so XLA can fuse QK^T → softmax
+  → V into its flash-style schedule on TPU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab: int = 8192       # hashed token ids
+    seq_len: int = 32
+    d_model: int = 128
+    n_heads: int = 4
+    d_ff: int = 512
+    n_layers: int = 2
+    n_classes: int = 5      # priority buckets
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(cfg: ModelConfig, key: jax.Array) -> dict[str, Any]:
+    keys = jax.random.split(key, 3 + cfg.n_layers)
+
+    def dense(k, shape):
+        fan_in = shape[0]
+        return (jax.random.normal(k, shape, jnp.float32)
+                / jnp.sqrt(jnp.float32(fan_in)))
+
+    layers = []
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(keys[3 + i], 6)
+        layers.append({
+            "wq": dense(lk[0], (cfg.d_model, cfg.d_model)),
+            "wk": dense(lk[1], (cfg.d_model, cfg.d_model)),
+            "wv": dense(lk[2], (cfg.d_model, cfg.d_model)),
+            "wo": dense(lk[3], (cfg.d_model, cfg.d_model)),
+            "w1": dense(lk[4], (cfg.d_model, cfg.d_ff)),
+            "w2": dense(lk[5], (cfg.d_ff, cfg.d_model)),
+            "ln1": jnp.ones((cfg.d_model,), jnp.float32),
+            "ln2": jnp.ones((cfg.d_model,), jnp.float32),
+        })
+    return {
+        "embed": 0.02 * jax.random.normal(keys[0], (cfg.vocab, cfg.d_model), jnp.float32),
+        "pos": 0.02 * jax.random.normal(keys[1], (cfg.seq_len, cfg.d_model), jnp.float32),
+        "head": dense(keys[2], (cfg.d_model, cfg.n_classes)),
+        "layers": layers,
+    }
+
+
+def _matmul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """bf16 × bf16 → f32 accumulate: the MXU-native contraction."""
+    return jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+
+
+def _layernorm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    x = x.astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * scale
+
+
+def _attention(x: jax.Array, layer: dict, cfg: ModelConfig) -> jax.Array:
+    b, s, _ = x.shape
+    h, dh = cfg.n_heads, cfg.d_head
+
+    def heads(w):
+        return _matmul(x, w).reshape(b, s, h, dh)
+
+    q, k, v = heads(layer["wq"]), heads(layer["wk"]), heads(layer["wv"])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.bfloat16),
+                        k.astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(logits / jnp.sqrt(jnp.float32(dh)), axis=-1)
+    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(jnp.bfloat16),
+                     v.astype(jnp.bfloat16),
+                     preferred_element_type=jnp.float32)
+    return _matmul(ctx.reshape(b, s, h * dh), layer["wo"])
+
+
+def forward(params: dict, tokens: jax.Array, *, cfg: ModelConfig) -> jax.Array:
+    """tokens [batch, seq] int32 → class logits [batch, n_classes]."""
+    x = params["embed"][tokens] + params["pos"][None, :, :]
+    for layer in params["layers"]:
+        x = x + _attention(_layernorm(x, layer["ln1"]), layer, cfg)
+        y = _layernorm(x, layer["ln2"])
+        y = _matmul(jax.nn.gelu(_matmul(y, layer["w1"])), layer["w2"])
+        x = x + y
+    pooled = jnp.mean(x, axis=1)
+    return _matmul(pooled, params["head"])
+
+
+def loss_fn(params: dict, tokens: jax.Array, labels: jax.Array, *,
+            cfg: ModelConfig) -> jax.Array:
+    logits = forward(params, tokens, cfg=cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+# -- sharding ------------------------------------------------------------
+
+def param_specs(cfg: ModelConfig) -> dict[str, Any]:
+    """PartitionSpecs over Mesh(("dp","tp")): feature dims on tp,
+    replicated over dp (gradients psum over dp automatically)."""
+    layer = {
+        "wq": P(None, "tp"), "wk": P(None, "tp"), "wv": P(None, "tp"),
+        "wo": P("tp", None),
+        "w1": P(None, "tp"), "w2": P("tp", None),
+        "ln1": P(None), "ln2": P(None),
+    }
+    return {
+        "embed": P(None, "tp"),
+        "pos": P(None, None),
+        "head": P(None, None),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        params, specs,
+        is_leaf=lambda x: isinstance(x, jax.Array) or not isinstance(x, (dict, list)),
+    )
+
+
+def make_train_step(cfg: ModelConfig, mesh: Mesh | None = None, *,
+                    learning_rate: float = 1e-3):
+    """One SGD step as a single jit region. With a mesh, inputs are
+    batch-sharded over dp and params tp-sharded; XLA inserts the
+    collectives."""
+
+    def step(params, tokens, labels):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, labels, cfg=cfg))(params)
+        new_params = jax.tree.map(
+            lambda p, g: (p - learning_rate * g).astype(p.dtype), params, grads)
+        return new_params, loss
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0,))
+
+    specs = param_specs(cfg)
+    param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P))
+    data_sh = NamedSharding(mesh, P("dp", None))
+    label_sh = NamedSharding(mesh, P("dp"))
+    return jax.jit(
+        step,
+        in_shardings=(param_sh, data_sh, label_sh),
+        out_shardings=(param_sh, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+
+
+def hash_tokens(texts: list[str], cfg: ModelConfig) -> jnp.ndarray:
+    """Deterministic hashed tokenizer (no external vocab): words →
+    buckets in [1, vocab); 0 is padding."""
+    import zlib
+    out = []
+    for text in texts:
+        ids = [1 + (zlib.crc32(w.lower().encode()) % (cfg.vocab - 1))
+               for w in text.split()][: cfg.seq_len]
+        ids += [0] * (cfg.seq_len - len(ids))
+        out.append(ids)
+    return jnp.asarray(out, jnp.int32)

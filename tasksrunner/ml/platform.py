@@ -1,0 +1,27 @@
+"""Platform pinning for the virtual-CPU-mesh workflows.
+
+On this machine the axon TPU plugin prepends itself to
+``jax.config.jax_platforms``, so even with ``JAX_PLATFORMS=cpu`` in the
+environment the single real chip wins. Tests and the driver's
+multichip dry-run both want the virtual N-device CPU mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=N``) instead; this
+helper pins the cpu platform, tolerating an already-initialised
+backend (in which case whatever platform won stays).
+"""
+
+from __future__ import annotations
+
+
+def pin_cpu_platform() -> bool:
+    """Best-effort pin of jax to the cpu platform. Returns True if the
+    pin was applied (or already in effect)."""
+    try:
+        import jax
+    except ImportError:  # pragma: no cover - jax is in the image
+        return False
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    except RuntimeError:
+        # backends already initialised; too late to change
+        return jax.default_backend() == "cpu"

@@ -9,8 +9,13 @@ import os
 import sys
 import pathlib
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# hard-set (not setdefault): the machine profile exports
+# JAX_PLATFORMS=axon (one real TPU chip); tests always run on the
+# virtual 8-device CPU mesh. The axon plugin also prepends itself to
+# jax.config.jax_platforms, so pin the config too, before any test
+# module can query devices.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
