@@ -1,0 +1,26 @@
+# ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
+# local dev workflow targets.
+.PHONY: test bench run validate docs-serve docs-build clean
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+run:
+	python -m tasksrunner run run.yaml
+
+validate:
+	python -m tasksrunner deploy validate samples/tasks_tracker/environment.yaml
+	python -m tasksrunner components samples/tasks_tracker/components
+
+docs-serve:
+	mkdocs serve
+
+docs-build:
+	mkdocs build
+
+clean:
+	rm -rf .tasksrunner samples/tasks_tracker/.tasksrunner
+	find . -name '__pycache__' -type d -exec rm -rf {} +

@@ -1,0 +1,35 @@
+# PowerShell twin of set_variables.sh.
+# ≙ /root/reference docs/aca/30-appendix/03-variables.md (the workshop
+# ships both a bash and a PowerShell variables workflow).
+param(
+    [ValidateSet("save", "restore", "show")]
+    [string]$Action = "restore",
+    [string]$VarsFile = ".tasksrunner/variables.env"
+)
+
+switch ($Action) {
+    "save" {
+        New-Item -ItemType Directory -Force -Path (Split-Path $VarsFile) | Out-Null
+        Get-ChildItem env: |
+            Where-Object { $_.Name -match '^(TASKSRUNNER_|TR_|TASKS_MANAGER$|SENDGRID_)' } |
+            Sort-Object Name |
+            ForEach-Object { "$($_.Name)=$($_.Value)" } |
+            Set-Content $VarsFile
+        Write-Host "saved $((Get-Content $VarsFile).Count) variable(s) to $VarsFile"
+    }
+    "restore" {
+        if (Test-Path $VarsFile) {
+            Get-Content $VarsFile | ForEach-Object {
+                $name, $value = $_ -split '=', 2
+                Set-Item -Path "env:$name" -Value $value
+            }
+            Write-Host "restored $((Get-Content $VarsFile).Count) variable(s) from $VarsFile"
+        } else {
+            Write-Host "no saved variables at $VarsFile"
+        }
+    }
+    "show" {
+        if (Test-Path $VarsFile) { Get-Content $VarsFile }
+        else { Write-Host "no saved variables at $VarsFile" }
+    }
+}

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Session variable save/restore.
+# ≙ /root/reference docs/aca/30-appendix/03-variables.md:14-40 and
+# snippets/restore-variables.md / update-variables.md: the workshop
+# persists ~30 shell variables across sessions; the framework keeps the
+# same capability for its own local workflows.
+#
+#   source scripts/set_variables.sh save    # snapshot TASKSRUNNER_*/TR_* vars
+#   source scripts/set_variables.sh restore # re-export the snapshot
+#   source scripts/set_variables.sh show    # list the snapshot
+set -u
+
+VARS_FILE="${TASKSRUNNER_VARS_FILE:-.tasksrunner/variables.env}"
+ACTION="${1:-restore}"
+
+case "$ACTION" in
+  save)
+    mkdir -p "$(dirname "$VARS_FILE")"
+    env | grep -E '^(TASKSRUNNER_|TR_|TASKS_MANAGER=|SENDGRID_)' | sort > "$VARS_FILE"
+    echo "saved $(wc -l < "$VARS_FILE") variable(s) to $VARS_FILE"
+    ;;
+  restore)
+    if [[ -f "$VARS_FILE" ]]; then
+      set -a
+      # shellcheck disable=SC1090
+      source "$VARS_FILE"
+      set +a
+      echo "restored $(wc -l < "$VARS_FILE") variable(s) from $VARS_FILE"
+    else
+      echo "no saved variables at $VARS_FILE"
+    fi
+    ;;
+  show)
+    [[ -f "$VARS_FILE" ]] && cat "$VARS_FILE" || echo "no saved variables at $VARS_FILE"
+    ;;
+  *)
+    echo "usage: source scripts/set_variables.sh [save|restore|show]" >&2
+    ;;
+esac

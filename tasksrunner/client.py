@@ -69,6 +69,8 @@ class _Transport(abc.ABC):
     @abc.abstractmethod
     async def delete_state(self, store, key, etag): ...
     @abc.abstractmethod
+    async def bulk_get_state(self, store, keys) -> list[dict]: ...
+    @abc.abstractmethod
     async def query_state(self, store, query) -> dict: ...
     @abc.abstractmethod
     async def transact_state(self, store, operations): ...
@@ -97,6 +99,9 @@ class _DirectTransport(_Transport):
 
     async def delete_state(self, store, key, etag):
         await self.runtime.delete_state(store, key, etag=etag)
+
+    async def bulk_get_state(self, store, keys):
+        return await self.runtime.bulk_get_state(store, keys)
 
     async def query_state(self, store, query):
         return await self.runtime.query_state(store, query)
@@ -184,6 +189,13 @@ class _HTTPTransport(_Transport):
             "DELETE", f"/v1.0/state/{store}/{key}", headers=headers)
         if status >= 300:
             self._raise(status, body, context=f"delete state {store}")
+
+    async def bulk_get_state(self, store, keys):
+        status, _, body = await self._request(
+            "POST", f"/v1.0/state/{store}/bulk", json_body={"keys": keys})
+        if status >= 300:
+            self._raise(status, body, context=f"bulk get state {store}")
+        return json.loads(body)
 
     async def query_state(self, store, query):
         status, _, body = await self._request(
@@ -281,6 +293,10 @@ class AppClient:
     async def delete_state(self, store: str, key: str, *,
                            etag: str | None = None) -> None:
         await self._t.delete_state(store, key, etag)
+
+    async def bulk_get_state(self, store: str, keys: list[str]) -> list[dict]:
+        """≙ DaprClient.GetBulkStateAsync: [{key, data?, etag?}]."""
+        return await self._t.bulk_get_state(store, keys)
 
     async def query_state(self, store: str, query: dict) -> dict:
         return await self._t.query_state(store, query)

@@ -34,6 +34,7 @@ from tasksrunner.bindings.base import BindingEvent, InputBinding, OutputBinding
 from tasksrunner.component.registry import ComponentRegistry
 from tasksrunner.component.spec import ComponentSpec
 from tasksrunner.errors import (
+    AppNotFound,
     BindingError,
     ComponentNotFound,
     InvocationError,
@@ -115,10 +116,19 @@ class Runtime:
         *,
         resolver: NameResolver | None = None,
         app_channel: AppChannel | None = None,
+        invoke_retries: int = 3,
+        invoke_retry_delay: float = 0.2,
     ):
         self.app_id = app_id
         self.registry = registry
         self.resolver = resolver or NameResolver()
+        #: connection-level retry policy for peer invocation (≙ the
+        #: Dapr sidecar's built-in service-invocation retries,
+        #: docs/aca/03-aca-dapr-integration/index.md:30-38). Only
+        #: transport failures retry — HTTP error statuses are returned
+        #: to the caller untouched.
+        self.invoke_retries = max(1, invoke_retries)
+        self.invoke_retry_delay = invoke_retry_delay
         self.app_channel = app_channel
         #: in-process peer channels (app-id → AppChannel); consulted
         #: before name resolution so a single-process cluster can route
@@ -160,6 +170,20 @@ class Runtime:
         store, prefixer = self._state_store(store_name)
         metrics.inc("state_delete", store=store_name)
         return await store.delete(prefixer.apply(key), etag=etag)
+
+    async def bulk_get_state(self, store_name: str, keys: list[str]) -> list[dict]:
+        """≙ Dapr's POST /v1.0/state/{store}/bulk."""
+        store, prefixer = self._state_store(store_name)
+        items = await store.bulk_get([prefixer.apply(str(k)) for k in keys])
+        metrics.inc("state_bulk_get", len(keys), store=store_name)
+        out = []
+        for key, item in zip(keys, items):
+            entry: dict = {"key": str(key)}
+            if item is not None:
+                entry["data"] = item.value
+                entry["etag"] = item.etag
+            out.append(entry)
+        return out
 
     async def query_state(self, store_name: str, query: dict) -> dict:
         store, prefixer = self._state_store(store_name)
@@ -257,21 +281,34 @@ class Runtime:
             return await self.peers[target_app_id].request(
                 http_method, path, query=query, headers=headers, body=body)
 
-        addr = self.resolver.resolve(target_app_id)
         if self._session is None:
             import aiohttp
             self._session = aiohttp.ClientSession()
-        url = f"{addr.base_url}/v1.0/invoke/{target_app_id}/method{path}"
-        if query:
-            url += f"?{query}"
-        try:
-            async with self._session.request(http_method, url, headers=headers,
-                                             data=body) as resp:
-                return resp.status, dict(resp.headers), await resp.read()
-        except OSError as exc:
-            raise InvocationError(
-                f"cannot reach sidecar of {target_app_id!r} at {addr.base_url}: {exc}"
-            ) from exc
+        last_exc: Exception | None = None
+        for attempt in range(self.invoke_retries):
+            try:
+                # re-resolve each attempt: the peer may have crashed,
+                # unregistered, and come back on a new port
+                addr = self.resolver.resolve(target_app_id)
+                url = f"{addr.base_url}/v1.0/invoke/{target_app_id}/method{path}"
+                if query:
+                    url += f"?{query}"
+                async with self._session.request(http_method, url, headers=headers,
+                                                 data=body) as resp:
+                    return resp.status, dict(resp.headers), await resp.read()
+            except (OSError, AppNotFound) as exc:
+                last_exc = exc
+                if attempt + 1 < self.invoke_retries:
+                    logger.warning(
+                        "invoke %s attempt %d/%d failed (%s); retrying",
+                        target_app_id, attempt + 1, self.invoke_retries, exc)
+                    await asyncio.sleep(self.invoke_retry_delay * (attempt + 1))
+        if isinstance(last_exc, AppNotFound):
+            raise last_exc
+        raise InvocationError(
+            f"cannot reach sidecar of {target_app_id!r} after "
+            f"{self.invoke_retries} attempts: {last_exc}"
+        ) from last_exc
 
     # -- consumer-side lifecycle -----------------------------------------
 

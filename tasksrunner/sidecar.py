@@ -24,7 +24,7 @@ import logging
 
 from aiohttp import web
 
-from tasksrunner.errors import TasksRunnerError
+from tasksrunner.errors import TasksRunnerError, ValidationError
 from tasksrunner.observability.tracing import (
     TRACEPARENT_HEADER,
     ensure_trace,
@@ -63,7 +63,7 @@ def build_sidecar_app(runtime: Runtime) -> web.Application:
     async def save_state(request: web.Request):
         items = await request.json()
         if not isinstance(items, list):
-            raise TasksRunnerError("state save body must be a list of {key, value}")
+            raise ValidationError("state save body must be a list of {key, value}")
         await runtime.save_state(request.match_info["store"], items)
         return web.Response(status=204)
 
@@ -83,6 +83,16 @@ def build_sidecar_app(runtime: Runtime) -> web.Application:
         await runtime.delete_state(request.match_info["store"],
                                    request.match_info["key"], etag=etag)
         return web.Response(status=204)
+
+    @routes.post("/v1.0/state/{store}/bulk")
+    @_traced
+    async def bulk_get_state(request: web.Request):
+        body = await request.json()
+        keys = body.get("keys") if isinstance(body, dict) else body
+        if not isinstance(keys, list):
+            raise ValidationError("bulk get body must be {\"keys\": [...]}")
+        result = await runtime.bulk_get_state(request.match_info["store"], keys)
+        return web.json_response(result)
 
     @routes.post("/v1.0/state/{store}/query")
     @_traced

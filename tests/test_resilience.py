@@ -1,0 +1,121 @@
+"""Resilience + bulk-state tests.
+
+Covers the framework analogs of SURVEY.md §5.3: sidecar invoke retries
+(Dapr's built-in service-invocation retries), crash → restart →
+re-registration recovery, and the bulk state API.
+"""
+
+import asyncio
+
+import pytest
+
+from tasksrunner import App, AppHost, InProcCluster, load_components
+from tasksrunner.component.spec import parse_component
+from tasksrunner.errors import InvocationError
+
+
+def state_spec():
+    return parse_component({"componentType": "state.in-memory"},
+                           default_name="statestore")
+
+
+@pytest.mark.asyncio
+async def test_bulk_get_state_both_transports(tmp_path):
+    api = App("api")
+
+    @api.post("/fill")
+    async def fill(req):
+        await api.client.save_state_bulk("statestore", [
+            {"key": "a", "value": 1}, {"key": "b", "value": 2},
+        ])
+        return 200
+
+    cluster = InProcCluster([state_spec()])
+    cluster.add_app(api)
+    await cluster.start()
+    try:
+        client = cluster.client("api")
+        await client.invoke_method("api", "fill", http_method="POST")
+        result = await client.bulk_get_state("statestore", ["a", "missing", "b"])
+        assert result[0] == {"key": "a", "data": 1, "etag": result[0]["etag"]}
+        assert result[1] == {"key": "missing"}
+        assert result[2]["data"] == 2
+    finally:
+        await cluster.stop()
+
+    # same through the HTTP sidecar
+    host = AppHost(api, specs=[state_spec()],
+                   registry_file=str(tmp_path / "apps.json"))
+    await host.start()
+    try:
+        await host.client.invoke_method("api", "fill", http_method="POST")
+        result = await host.client.bulk_get_state("statestore", ["a", "nope"])
+        assert result[0]["data"] == 1 and result[1] == {"key": "nope"}
+    finally:
+        await host.stop()
+
+
+@pytest.mark.asyncio
+async def test_invoke_retries_when_peer_restarts(tmp_path):
+    """A peer that crashes and re-registers on a NEW port is reached on
+    retry — the local analog of ACA restart + sidecar retries."""
+    registry_file = str(tmp_path / "apps.json")
+
+    api = App("api")
+
+    @api.get("/ping")
+    async def ping(req):
+        return {"pong": True}
+
+    caller = App("caller")
+
+    @caller.get("/call")
+    async def call(req):
+        return await caller.client.invoke_json("api", "ping")
+
+    api_host = AppHost(api, registry_file=registry_file)
+    caller_host = AppHost(caller, registry_file=registry_file)
+    await api_host.start()
+    await caller_host.start()
+    try:
+        assert (await caller_host.client.invoke_json("caller", "call"))["pong"]
+
+        # kill the api's host entirely, then bring it back on new ports
+        await api_host.stop()
+        api2 = App("api")
+
+        @api2.get("/ping")
+        async def ping2(req):
+            return {"pong": True}
+
+        api_host2 = AppHost(api2, registry_file=registry_file)
+
+        async def delayed_restart():
+            await asyncio.sleep(0.25)  # longer than the first retry delay
+            await api_host2.start()
+
+        restart = asyncio.create_task(delayed_restart())
+        # the invoke must survive the window where the peer is down
+        result = await caller_host.client.invoke_json("caller", "call")
+        assert result["pong"]
+        await restart
+        await api_host2.stop()
+    finally:
+        await caller_host.stop()
+
+
+@pytest.mark.asyncio
+async def test_invoke_fails_cleanly_after_retries_exhausted(tmp_path):
+    """Dead peer that never comes back -> InvocationError, not a hang."""
+    from tasksrunner.component.registry import ComponentRegistry
+    from tasksrunner.invoke.resolver import AppAddress, NameResolver
+    from tasksrunner.runtime import Runtime
+
+    resolver = NameResolver()
+    resolver.register(AppAddress(app_id="ghost", host="127.0.0.1",
+                                 sidecar_port=1))  # nothing listens there
+    runtime = Runtime("caller", ComponentRegistry([]), resolver=resolver,
+                      invoke_retries=2, invoke_retry_delay=0.01)
+    with pytest.raises(InvocationError, match="after 2 attempts"):
+        await runtime.invoke("ghost", "x", http_method="GET")
+    await runtime.stop()
