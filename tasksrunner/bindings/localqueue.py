@@ -67,6 +67,9 @@ class SqliteQueue:
         self.claim_lease = claim_lease
         self._conn = sqlite3.connect(self.path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
+        # WAL + NORMAL: fsync at checkpoint, not per-commit — the
+        # standard durability/throughput point for local engines
+        self._conn.execute("PRAGMA synchronous=NORMAL")
         self._conn.execute("PRAGMA busy_timeout=5000")
         self._conn.executescript(_SCHEMA)
         self._conn.commit()

@@ -108,6 +108,9 @@ class SqliteStateStore(StateStore):
             pathlib.Path(self.path).parent.mkdir(parents=True, exist_ok=True)
         self._conn = sqlite3.connect(self.path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
+        # WAL + NORMAL: fsync at checkpoint, not per-commit — the
+        # standard durability/throughput point for local engines
+        self._conn.execute("PRAGMA synchronous=NORMAL")
         self._conn.executescript(_SCHEMA)
         self._conn.commit()
 
