@@ -24,12 +24,14 @@ from __future__ import annotations
 import inspect
 import json
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Any, Awaitable, Callable
 from urllib.parse import parse_qsl
 
 from tasksrunner import cloudevents
 from tasksrunner.errors import TasksRunnerError
+from tasksrunner.observability.spans import record_span
 from tasksrunner.observability.tracing import (
     TRACEPARENT_HEADER,
     ensure_trace,
@@ -271,14 +273,22 @@ class App:
             # trace identically).
             ctx = ensure_trace(headers.get(TRACEPARENT_HEADER))
             with trace_scope(ctx):
+                started = time.time()
                 try:
                     result = route.handler(request)
                     if inspect.isawaitable(result):
                         result = await result
-                    return _normalize(result)
+                    resp = _normalize(result)
                 except TasksRunnerError as exc:
-                    return Response(status=exc.http_status, body={"error": str(exc)})
+                    resp = Response(status=exc.http_status, body={"error": str(exc)})
                 except Exception:
                     logger.exception("unhandled error in %s %s", method, clean_path)
-                    return Response(status=500, body={"error": "internal error"})
+                    resp = Response(status=500, body={"error": "internal error"})
+                record_span(
+                    kind="consumer" if route.kind in ("subscription", "binding")
+                    else "server",
+                    name=f"{method.upper()} {clean_path}", status=resp.status,
+                    start=started, duration=time.time() - started,
+                )
+                return resp
         return Response(status=404, body={"error": f"no route for {method} {clean_path}"})

@@ -46,10 +46,18 @@ def _cmd_host(args) -> None:
     from tasksrunner.hosting import AppHost
     from tasksrunner.observability.logging import configure_logging
 
+    import os
+
+    from tasksrunner.observability.spans import ENV_VAR, configure_spans
+
     app = _make_app(args.module)
     if args.app_id:
         app.app_id = args.app_id
     configure_logging(app.app_id, level=getattr(logging, args.log_level.upper()))
+    # span recording on by default for hosted services (set
+    # TASKSRUNNER_TRACE_DB= empty to disable)
+    configure_spans(app.app_id,
+                    os.environ.get(ENV_VAR, ".tasksrunner/traces.db") or None)
     host = AppHost(
         app,
         components_path=args.components,
@@ -189,6 +197,58 @@ def _cmd_deploy(args) -> None:
             print(f"environment {manifest.name!r} had no recorded state")
 
 
+def _cmd_traces(args) -> None:
+    import pathlib
+
+    from tasksrunner.observability.spans import list_traces, service_map, trace_spans
+
+    db = args.db
+    if not pathlib.Path(db).is_file():
+        raise SystemExit(f"no trace database at {db} "
+                         "(services record to .tasksrunner/traces.db by default)")
+
+    if args.action == "list":
+        rows = list_traces(db, limit=args.limit)
+        if not rows:
+            print("no traces recorded")
+            return
+        for r in rows:
+            import datetime as dt
+            ts = dt.datetime.fromtimestamp(r["started"]).strftime("%H:%M:%S")
+            print(f"{r['trace_id'][:16]}  {ts}  {r['spans']:>3} spans  "
+                  f"{(r['wall'] or 0) * 1000:7.1f} ms  {r['root']}")
+    elif args.action == "show":
+        if not args.trace_id:
+            raise SystemExit("show needs a trace id (prefix ok)")
+        spans = trace_spans(db, args.trace_id)
+        if not spans:
+            raise SystemExit(f"no spans for trace {args.trace_id!r}")
+        t0 = spans[0]["start"]
+        # real tree depth from parent ids (falls back to 0 for roots /
+        # spans whose parent wasn't recorded in this process set)
+        by_id = {s["span_id"]: s for s in spans}
+
+        def depth(s, seen=()):
+            parent = s.get("parent_id")
+            if not parent or parent not in by_id or parent in seen:
+                return 0
+            return 1 + depth(by_id[parent], (*seen, s["span_id"]))
+
+        for s in spans:
+            offset = (s["start"] - t0) * 1000
+            indent = "  " * depth(s)
+            print(f"{offset:8.1f}ms {s['duration']*1000:7.1f}ms  "
+                  f"{indent}[{s['role']}] {s['kind']:<8} {s['name']} "
+                  f"({s['status']})")
+    elif args.action == "map":
+        edges = service_map(db)
+        if not edges:
+            print("no client/producer spans recorded")
+        for e in edges:
+            print(f"{e['from']:<36} --{e['kind']}--> {e['to']:<42} "
+                  f"{e['calls']:>5} calls  avg {e['avg_ms']} ms")
+
+
 def _cmd_components(args) -> None:
     from tasksrunner.component.loader import load_components
     from tasksrunner.component.registry import registered_types
@@ -263,6 +323,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("action", choices=["validate", "what-if", "apply", "down"])
     p.add_argument("manifest")
     p.set_defaults(fn=_cmd_deploy)
+
+    p = sub.add_parser(
+        "traces",
+        help="inspect recorded traces (transaction search + service map)")
+    p.add_argument("action", choices=["list", "show", "map"])
+    p.add_argument("trace_id", nargs="?", default=None)
+    p.add_argument("--db", default=".tasksrunner/traces.db")
+    p.add_argument("--limit", type=int, default=20)
+    p.set_defaults(fn=_cmd_traces)
 
     p = sub.add_parser("components", help="validate a components directory")
     p.add_argument("path")

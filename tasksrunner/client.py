@@ -141,10 +141,21 @@ class _HTTPTransport(_Transport):
             import aiohttp
             self._session = aiohttp.ClientSession()
         url = self.base + path
+        # carry the caller's trace context over the app→sidecar hop —
+        # without this, every sidecar operation starts a fresh trace and
+        # transactions fragment (the direct transport shares the context
+        # in-process; both transports must behave identically)
+        from tasksrunner.observability.tracing import (
+            TRACEPARENT_HEADER,
+            outgoing_headers,
+        )
+        headers = dict(headers or {})
+        if TRACEPARENT_HEADER not in headers:
+            headers.update(outgoing_headers())
         try:
             async with self._session.request(
                 method, url, json=json_body, data=data,
-                headers=headers or {}, params=params) as resp:
+                headers=headers, params=params) as resp:
                 return resp.status, dict(resp.headers), await resp.read()
         except OSError as exc:
             raise InvocationError(f"sidecar unreachable at {url}: {exc}") from exc

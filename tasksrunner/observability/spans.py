@@ -1,0 +1,252 @@
+"""Span collection + query — the App Insights analog.
+
+The reference gets cross-service transaction search and the
+Application Map from App Insights (SURVEY.md §5.1,
+docs/aca/08-aca-monitoring/index.md:365-410). The framework-native
+equivalent: every process records spans (one per handled request,
+invocation, publish, delivery) into a shared sqlite file; the
+``tasksrunner traces`` CLI renders transactions and the service map.
+
+Recording is buffered and flushed off the event loop; a lost tail on
+crash is acceptable (telemetry, not state). Enabled whenever a span
+database path is configured (``TASKSRUNNER_TRACE_DB`` or AppHost
+default); disabled recording is a no-op costing one ``if``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import pathlib
+import sqlite3
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tasksrunner.observability.tracing import current_trace
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS spans (
+    trace_id TEXT NOT NULL,
+    span_id  TEXT NOT NULL,
+    parent_id TEXT,
+    role     TEXT NOT NULL,
+    kind     TEXT NOT NULL,    -- server | client | producer | consumer
+    name     TEXT NOT NULL,
+    status   INTEGER,
+    start    REAL NOT NULL,
+    duration REAL NOT NULL,
+    attrs    TEXT NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_spans_trace ON spans (trace_id, start);
+CREATE INDEX IF NOT EXISTS idx_spans_start ON spans (start);
+"""
+
+ENV_VAR = "TASKSRUNNER_TRACE_DB"
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    role: str
+    kind: str
+    name: str
+    status: int | None
+    start: float
+    duration: float
+    attrs: dict = field(default_factory=dict)
+
+
+class SpanRecorder:
+    """Buffered writer of spans into the shared trace db."""
+
+    def __init__(self, role: str, path: str | pathlib.Path, *,
+                 flush_interval: float = 0.5, max_buffer: int = 256):
+        self.role = role
+        self.path = str(path)
+        pathlib.Path(self.path).parent.mkdir(parents=True, exist_ok=True)
+        self._buffer: list[Span] = []
+        self._lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self._conn: sqlite3.Connection | None = None
+        self.flush_interval = flush_interval
+        self.max_buffer = max_buffer
+        self._timer: threading.Timer | None = None
+        atexit.register(self.flush)
+        self._schedule()
+
+    def _schedule(self) -> None:
+        self._timer = threading.Timer(self.flush_interval, self._tick)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _tick(self) -> None:
+        try:
+            self.flush()
+        finally:
+            self._schedule()
+
+    def record(self, *, kind: str, name: str, status: int | None,
+               start: float, duration: float, attrs: dict | None = None,
+               span_id: str | None = None,
+               parent_id: str | None = None) -> None:
+        """Append a span (no I/O here — the background timer flushes).
+
+        Defaults: server/consumer spans ARE the current context's span
+        (parented to the wire parent); callers recording an outbound
+        child (client/producer) pass explicit ids.
+        """
+        ctx = current_trace()
+        if ctx is None:
+            return
+        span = Span(
+            trace_id=ctx.trace_id,
+            span_id=span_id or ctx.span_id,
+            parent_id=parent_id if (parent_id or span_id) else ctx.parent_id,
+            role=self.role, kind=kind, name=name,
+            status=status, start=start, duration=duration,
+            attrs=attrs or {},
+        )
+        with self._lock:
+            self._buffer.append(span)
+            # no inline flush: record() runs on the event loop and must
+            # never pay sqlite I/O; the timer thread drains the buffer
+
+    def flush(self) -> None:
+        with self._lock:
+            batch, self._buffer = self._buffer, []
+        if not batch:
+            return
+        # I/O outside the buffer lock so record() never waits on sqlite;
+        # _io_lock serialises the writers (timer thread + close)
+        with self._io_lock:
+            if self._conn is None:
+                self._conn = sqlite3.connect(self.path, check_same_thread=False)
+                self._conn.execute("PRAGMA journal_mode=WAL")
+                self._conn.execute("PRAGMA synchronous=NORMAL")
+                self._conn.execute("PRAGMA busy_timeout=5000")
+                self._conn.executescript(_SCHEMA)
+            self._conn.executemany(
+                "INSERT INTO spans VALUES (?,?,?,?,?,?,?,?,?,?)",
+                [(s.trace_id, s.span_id, s.parent_id, s.role, s.kind, s.name,
+                  s.status, s.start, s.duration,
+                  json.dumps(s.attrs, default=str)) for s in batch],
+            )
+            self._conn.commit()
+
+    def close(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+        self.flush()
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None
+
+
+#: process-global recorder; None = tracing disabled
+_recorder: SpanRecorder | None = None
+
+
+def configure_spans(role: str, path: str | pathlib.Path | None = None) -> SpanRecorder | None:
+    """Enable span recording for this process. ``path`` falls back to
+    $TASKSRUNNER_TRACE_DB; with neither, recording stays off."""
+    global _recorder
+    path = path or os.environ.get(ENV_VAR)
+    if not path:
+        return None
+    if _recorder is not None:
+        _recorder.close()
+    _recorder = SpanRecorder(role, path)
+    return _recorder
+
+
+def recorder() -> SpanRecorder | None:
+    return _recorder
+
+
+def record_span(*, kind: str, name: str, status: int | None,
+                start: float, duration: float,
+                attrs: dict | None = None,
+                span_id: str | None = None,
+                parent_id: str | None = None) -> None:
+    if _recorder is not None:
+        _recorder.record(kind=kind, name=name, status=status, start=start,
+                         duration=duration, attrs=attrs,
+                         span_id=span_id, parent_id=parent_id)
+
+
+# -- query side ----------------------------------------------------------
+
+def _connect_ro(path: str) -> sqlite3.Connection:
+    conn = sqlite3.connect(path, check_same_thread=False)
+    conn.row_factory = sqlite3.Row
+    return conn
+
+
+def list_traces(path: str, *, limit: int = 20) -> list[dict]:
+    conn = _connect_ro(path)
+    try:
+        rows = conn.execute(
+            "SELECT trace_id, MIN(start) AS started, COUNT(*) AS spans, "
+            "SUM(duration) AS total_time, "
+            "MAX(start + duration) - MIN(start) AS wall, "
+            "GROUP_CONCAT(DISTINCT role) AS roles "
+            "FROM spans GROUP BY trace_id ORDER BY started DESC LIMIT ?",
+            (limit,),
+        ).fetchall()
+        out = []
+        for r in rows:
+            root = conn.execute(
+                "SELECT name, role, status FROM spans WHERE trace_id = ? "
+                "ORDER BY start LIMIT 1", (r["trace_id"],)).fetchone()
+            out.append({
+                "trace_id": r["trace_id"], "started": r["started"],
+                "spans": r["spans"], "wall": r["wall"],
+                "roles": sorted((r["roles"] or "").split(",")),
+                "root": f"{root['role']}: {root['name']}" if root else "?",
+                "status": root["status"] if root else None,
+            })
+        return out
+    finally:
+        conn.close()
+
+
+def trace_spans(path: str, trace_id: str) -> list[dict]:
+    conn = _connect_ro(path)
+    try:
+        rows = conn.execute(
+            "SELECT * FROM spans WHERE trace_id LIKE ? ORDER BY start",
+            (trace_id + "%",),
+        ).fetchall()
+        return [dict(r) for r in rows]
+    finally:
+        conn.close()
+
+
+def service_map(path: str) -> list[dict]:
+    """App-Map edges: caller role → target, with call counts.
+
+    Client spans carry their target in attrs; this aggregates them.
+    """
+    conn = _connect_ro(path)
+    try:
+        rows = conn.execute(
+            "SELECT role, kind, name, attrs, COUNT(*) AS n, "
+            "AVG(duration) AS avg_duration "
+            "FROM spans WHERE kind IN ('client', 'producer') "
+            "GROUP BY role, kind, name ORDER BY n DESC",
+        ).fetchall()
+        edges = []
+        for r in rows:
+            attrs = json.loads(r["attrs"]) if r["attrs"] else {}
+            target = attrs.get("target") or r["name"]
+            edges.append({
+                "from": r["role"], "to": target, "kind": r["kind"],
+                "calls": r["n"], "avg_ms": round(r["avg_duration"] * 1000, 2),
+            })
+        return edges
+    finally:
+        conn.close()

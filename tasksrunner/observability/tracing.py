@@ -24,6 +24,10 @@ class TraceContext:
     trace_id: str  # 32 hex chars
     span_id: str   # 16 hex chars
     flags: str = "01"
+    #: the span this one descends from (the wire parent after
+    #: ensure_trace, or the local parent after child()) — what lets the
+    #: span viewer reassemble the tree
+    parent_id: str | None = None
     #: spans recorded locally under this trace (exported via /v1.0/metadata)
     baggage: dict = field(default_factory=dict)
 
@@ -41,7 +45,7 @@ class TraceContext:
         return cls(trace_id=parts[1], span_id=parts[2], flags=parts[3])
 
     def child(self) -> "TraceContext":
-        return replace(self, span_id=secrets.token_hex(8))
+        return replace(self, span_id=secrets.token_hex(8), parent_id=self.span_id)
 
     @property
     def header(self) -> str:
@@ -74,10 +78,15 @@ def trace_scope(ctx: TraceContext):
         _current.reset(token)
 
 
-def outgoing_headers() -> dict[str, str]:
-    """Headers to attach to an outbound hop (child span of current)."""
+def current_or_new() -> TraceContext:
+    """The active context, creating (and installing) a root if absent."""
     ctx = current_trace()
     if ctx is None:
         ctx = TraceContext.new()
         _current.set(ctx)
-    return {TRACEPARENT_HEADER: ctx.child().header}
+    return ctx
+
+
+def outgoing_headers() -> dict[str, str]:
+    """Headers to attach to an outbound hop (child span of current)."""
+    return {TRACEPARENT_HEADER: current_or_new().child().header}

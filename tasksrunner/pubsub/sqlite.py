@@ -209,6 +209,18 @@ class SqliteBroker(PubSubBroker):
         self._conn.commit()
 
     @_locked
+    def _extend_leases(self, msg_ids: list[str], group: str) -> float:
+        """Re-lease still-unprocessed claims (slow handlers must not let
+        the batch tail expire into duplicate delivery)."""
+        until = time.time() + self.claim_lease
+        self._conn.executemany(
+            "UPDATE deliveries SET claimed_until = ? WHERE msg_id = ? AND grp = ?",
+            [(until, m, group) for m in msg_ids],
+        )
+        self._conn.commit()
+        return until
+
+    @_locked
     def _nack(self, msg: Message, group: str) -> None:
         if msg.attempt >= self.max_attempts:
             logger.warning(
@@ -241,19 +253,37 @@ class SqliteBroker(PubSubBroker):
                         pass
                     continue
                 acks: list[str] = []
-                for msg in batch:
-                    try:
-                        ok = await handler(msg)
-                    except Exception:
-                        logger.exception("handler error on topic %s group %s",
-                                         topic, group)
-                        ok = False
-                    if ok:
-                        acks.append(msg.id)
-                    else:
-                        await self._run(self._nack, msg, group)
-                if acks:
-                    await self._run(self._ack_many, acks, group)
+                lease_deadline = time.time() + self.claim_lease
+                try:
+                    for i, msg in enumerate(batch):
+                        # slow handlers: re-lease the unprocessed tail
+                        # before it expires into duplicate delivery
+                        if time.time() > lease_deadline - self.claim_lease / 2:
+                            rest = [m.id for m in batch[i:]]
+                            lease_deadline = await self._run(
+                                self._extend_leases, rest, group)
+                        try:
+                            ok = await handler(msg)
+                        except asyncio.CancelledError:
+                            raise
+                        except Exception:
+                            logger.exception("handler error on topic %s group %s",
+                                             topic, group)
+                            ok = False
+                        if ok:
+                            acks.append(msg.id)
+                        else:
+                            await self._run(self._nack, msg, group)
+                    if acks:
+                        await self._run(self._ack_many, acks, group)
+                        acks = []
+                finally:
+                    # cancelled mid-batch: ack what was already handled
+                    # (shutdown must not cause redelivery of successfully
+                    # processed messages); direct sync call — the
+                    # executor may already be rejecting work
+                    if acks:
+                        self._ack_many(acks, group)
 
         task = asyncio.create_task(poll_loop())
         self._tasks.append(task)

@@ -26,6 +26,7 @@ import abc
 import asyncio
 import json
 import logging
+import time
 from typing import Any
 
 from tasksrunner import cloudevents
@@ -42,10 +43,11 @@ from tasksrunner.errors import (
 )
 from tasksrunner.invoke.resolver import NameResolver
 from tasksrunner.observability.metrics import metrics
+from tasksrunner.observability.spans import record_span
 from tasksrunner.observability.tracing import (
     TRACEPARENT_HEADER,
+    current_or_new,
     ensure_trace,
-    outgoing_headers,
     trace_scope,
 )
 from tasksrunner.pubsub.base import Message, PubSubBroker
@@ -239,9 +241,18 @@ class Runtime:
         # content-type (raw payloads must NOT be unwrapped downstream)
         meta["content-type"] = (
             "application/json" if raw else cloudevents.CONTENT_TYPE)
-        meta.update(outgoing_headers())
+        # one child context serves as both the wire parent for consumers
+        # and the recorded producer span, so the trace tree connects
+        ctx = current_or_new()
+        child = ctx.child()
+        meta[TRACEPARENT_HEADER] = child.header
+        started = time.time()
         msg_id = await broker.publish(topic, envelope, metadata=meta)
         metrics.inc("publish", pubsub=pubsub_name, topic=topic)
+        record_span(kind="producer", name=f"publish {pubsub_name}/{topic}",
+                    status=200, start=started, duration=time.time() - started,
+                    attrs={"target": f"{pubsub_name}/{topic}"},
+                    span_id=child.span_id, parent_id=ctx.span_id)
         return msg_id
 
     # -- bindings --------------------------------------------------------
@@ -264,22 +275,34 @@ class Runtime:
         incoming = headers.get(TRACEPARENT_HEADER)
         if incoming:
             # caller supplied an explicit trace context: continue it
-            with trace_scope(ensure_trace(incoming)):
-                headers.update(outgoing_headers())
+            base_ctx = ensure_trace(incoming)
         else:
-            headers.update(outgoing_headers())
+            base_ctx = current_or_new()
+        # one child context is both the wire header and the client span
+        child = base_ctx.child()
+        headers[TRACEPARENT_HEADER] = child.header
         path = "/" + method_path.lstrip("/")
         metrics.inc("invoke", target=target_app_id)
+
+        started = time.time()
+
+        def _spanned(result: tuple[int, dict[str, str], bytes]):
+            record_span(kind="client", name=f"invoke {target_app_id}{path}",
+                        status=result[0], start=started,
+                        duration=time.time() - started,
+                        attrs={"target": target_app_id},
+                        span_id=child.span_id, parent_id=base_ctx.span_id)
+            return result
 
         if self.app_id is not None and target_app_id == self.app_id:
             if self.app_channel is None:
                 raise InvocationError(f"no app channel for local app {self.app_id!r}")
-            return await self.app_channel.request(
-                http_method, path, query=query, headers=headers, body=body)
+            return _spanned(await self.app_channel.request(
+                http_method, path, query=query, headers=headers, body=body))
 
         if target_app_id in self.peers:
-            return await self.peers[target_app_id].request(
-                http_method, path, query=query, headers=headers, body=body)
+            return _spanned(await self.peers[target_app_id].request(
+                http_method, path, query=query, headers=headers, body=body))
 
         if self._session is None:
             import aiohttp
@@ -295,7 +318,8 @@ class Runtime:
                     url += f"?{query}"
                 async with self._session.request(http_method, url, headers=headers,
                                                  data=body) as resp:
-                    return resp.status, dict(resp.headers), await resp.read()
+                    return _spanned(
+                        (resp.status, dict(resp.headers), await resp.read()))
             except (OSError, AppNotFound) as exc:
                 last_exc = exc
                 if attempt + 1 < self.invoke_retries:

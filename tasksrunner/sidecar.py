@@ -37,6 +37,10 @@ logger = logging.getLogger(__name__)
 
 
 def _json_error(exc: Exception) -> web.Response:
+    if isinstance(exc, json.JSONDecodeError):
+        # malformed request body is the caller's error, not ours
+        return web.json_response(
+            {"error": f"malformed JSON body: {exc}"}, status=400)
     status = exc.http_status if isinstance(exc, TasksRunnerError) else 500
     if not isinstance(exc, TasksRunnerError):
         logger.exception("unhandled sidecar error")

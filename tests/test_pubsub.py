@@ -228,6 +228,52 @@ async def test_backlog_and_dead_letters_visible(tmp_path):
     await broker.aclose()
 
 
+@pytest.mark.asyncio
+async def test_cancel_mid_batch_keeps_acks(tmp_path):
+    """Shutdown while a claimed batch is half-processed must not cause
+    redelivery of the messages already handled (review regression)."""
+    # short claim lease so the interrupted tail becomes visible again
+    # quickly after the restart below
+    broker = SqliteBroker("b", tmp_path / "b.db", poll_interval=0.01,
+                          claim_lease=0.3)
+    await broker.ensure_group("t", "g")
+    for n in range(6):
+        await broker.publish("t", {"n": n})
+
+    handled = []
+    block = asyncio.Event()
+
+    async def slow(msg):
+        handled.append(msg.data["n"])
+        if len(handled) == 3:
+            block.set()          # signal: cancel me now
+            await asyncio.sleep(30)
+        return True
+
+    await broker.subscribe("t", "g", slow)
+    await asyncio.wait_for(block.wait(), timeout=5)
+    # hard shutdown mid-message-3 (aclose force-cancels the poll task;
+    # sub.cancel() would drain gracefully instead)
+    await broker.aclose()
+
+    # reopen: the two fully handled messages must NOT come back;
+    # message 3 (interrupted) and the unprocessed tail must.
+    broker2 = SqliteBroker("b", tmp_path / "b.db", poll_interval=0.01)
+    redelivered = []
+
+    async def h(msg):
+        redelivered.append(msg.data["n"])
+        return True
+
+    await broker2.subscribe("t", "g", h)
+    deadline = asyncio.get_running_loop().time() + 5
+    while len(redelivered) < 4:
+        assert asyncio.get_running_loop().time() < deadline
+        await asyncio.sleep(0.02)
+    assert sorted(redelivered) == [2, 3, 4, 5]
+    await broker2.aclose()
+
+
 def test_pubsub_drivers_registered():
     from tasksrunner.component.registry import registered_types
     types = registered_types()

@@ -1,0 +1,90 @@
+"""Span collection + trace query tests (the App Insights analog,
+SURVEY.md §5.1): one user action produces one trace spanning all three
+hops, queryable by trace id, with service-map edges."""
+
+import asyncio
+
+import pytest
+
+from tasksrunner import App, InProcCluster
+from tasksrunner.component.spec import parse_component
+from tasksrunner.observability import spans as spans_mod
+
+
+@pytest.fixture
+def trace_db(tmp_path):
+    db = tmp_path / "traces.db"
+    rec = spans_mod.configure_spans("test-proc", db)
+    yield str(db)
+    rec.close()
+    spans_mod._recorder = None
+
+
+@pytest.mark.asyncio
+async def test_trace_recorded_across_hops(trace_db, tmp_path):
+    specs = [parse_component({
+        "componentType": "pubsub.sqlite",
+        "metadata": [{"name": "brokerPath", "value": str(tmp_path / "b.db")},
+                     {"name": "pollIntervalSeconds", "value": "0.01"}],
+    }, default_name="ps")]
+
+    api = App("api")
+
+    @api.post("/api/tasks")
+    async def create(req):
+        await api.client.publish_event("ps", "saved", req.json())
+        return 201, {"ok": True}
+
+    got = asyncio.Event()
+    worker = App("worker")
+
+    @worker.subscribe("ps", "saved", route="/on-saved")
+    async def on_saved(req):
+        got.set()
+        return 200
+
+    caller = App("caller")
+
+    @caller.post("/go")
+    async def go(req):
+        resp = await caller.client.invoke_method(
+            "api", "api/tasks", http_method="POST", data={"n": 1})
+        return resp.status
+
+    cluster = InProcCluster(specs)
+    for a in (api, worker, caller):
+        cluster.add_app(a)
+    await cluster.start()
+    try:
+        root = "00-" + "ef" * 16 + "-" + "12" * 8 + "-01"
+        await caller.handle("POST", "/go", headers={"traceparent": root},
+                            body=b"{}")
+        await asyncio.wait_for(got.wait(), timeout=5)
+    finally:
+        await cluster.stop()
+
+    spans_mod.recorder().flush()
+    trace_id = "ef" * 16
+    spans = spans_mod.trace_spans(trace_db, trace_id)
+    kinds = {(s["kind"], s["name"]) for s in spans}
+    assert ("server", "POST /go") in kinds
+    assert ("client", "invoke api/api/tasks") in kinds
+    assert ("server", "POST /api/tasks") in kinds
+    assert ("producer", "publish ps/saved") in kinds
+    assert ("consumer", "POST /on-saved") in kinds
+    assert all(s["trace_id"] == trace_id for s in spans)
+
+    # transaction search
+    listing = spans_mod.list_traces(trace_db)
+    assert any(t["trace_id"] == trace_id for t in listing)
+
+    # service map has the invoke and publish edges
+    edges = {(e["from"], e["to"]) for e in spans_mod.service_map(trace_db)}
+    assert ("test-proc", "api") in edges
+    assert ("test-proc", "ps/saved") in edges
+
+
+def test_recording_disabled_is_noop(tmp_path):
+    assert spans_mod.recorder() is None
+    spans_mod.record_span(kind="server", name="x", status=200,
+                          start=0.0, duration=0.1)  # must not raise
