@@ -19,6 +19,7 @@ Every backend call goes through ``invoke_method`` to app-id
 from __future__ import annotations
 
 import html
+import os
 from http.cookies import SimpleCookie
 from urllib.parse import urlencode
 
@@ -81,14 +82,32 @@ def make_app() -> App:
 
     # -- task list (Pages/Tasks/Index.cshtml) ----------------------------
 
+    async def _list_tasks(user: str) -> list[dict]:
+        """Normally via service invocation. The reference keeps a
+        pre-invocation fallback — a named HttpClient configured with
+        BackendApiConfig:BaseUrlExternalHttp (Frontend Program.cs:15-27,
+        commented alternatives in Pages/Tasks/Index.cshtml.cs:29-45);
+        same here: set BACKENDAPICONFIG__BASEURLEXTERNALHTTP to call
+        the API's HTTP endpoint directly instead."""
+        base = os.environ.get("BACKENDAPICONFIG__BASEURLEXTERNALHTTP")
+        if base:
+            import aiohttp
+            async with aiohttp.ClientSession() as session:
+                async with session.get(
+                    f"{base.rstrip('/')}/api/tasks",
+                    params={"createdBy": user}) as resp:
+                    resp.raise_for_status()
+                    return await resp.json()
+        return await app.client.invoke_json(
+            BACKEND_APP_ID, "api/tasks",
+            query=urlencode({"createdBy": user}))
+
     @app.get("/tasks")
     async def task_list(req):
         user = _cookie_user(req)
         if not user:
             return _redirect("/")
-        tasks = await app.client.invoke_json(
-            BACKEND_APP_ID, "api/tasks",
-            query=urlencode({"createdBy": user}))
+        tasks = await _list_tasks(user)
         rows = "".join(_task_row(t) for t in tasks) or \
             '<tr><td colspan="6">No tasks yet.</td></tr>'
         return _page("Tasks", f"""

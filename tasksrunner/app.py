@@ -241,6 +241,38 @@ class App:
 
     # -- dispatch --------------------------------------------------------
 
+    def openapi(self) -> dict:
+        """Minimal OpenAPI 3.1 document generated from the route table
+        (≙ the reference API's AddOpenApi/MapOpenApi, Backend.Api
+        Program.cs:16 + Microsoft.AspNetCore.OpenApi in the csproj).
+        Served at GET /openapi.json on every app."""
+        paths: dict[str, dict] = {}
+        for route in self._routes:
+            if route.kind != "http":
+                continue
+            template = "/" + "/".join(route.segments)
+            entry = paths.setdefault(template, {})
+            params = [
+                {"name": seg[1:-1], "in": "path", "required": True,
+                 "schema": {"type": "string"}}
+                for seg in route.segments
+                if seg.startswith("{") and seg.endswith("}")
+            ]
+            op: dict = {
+                "operationId": f"{route.method.lower()}_{route.handler.__name__}",
+                "responses": {"200": {"description": "success"}},
+            }
+            if route.handler.__doc__:
+                op["description"] = route.handler.__doc__.strip()
+            if params:
+                op["parameters"] = params
+            entry[route.method.lower()] = op
+        return {
+            "openapi": "3.1.0",
+            "info": {"title": self.app_id, "version": "1.0.0"},
+            "paths": dict(sorted(paths.items())),
+        }
+
     def subscription_doc(self) -> list[dict]:
         """The /tasksrunner/subscribe handshake document."""
         return [
@@ -258,6 +290,8 @@ class App:
             return Response(body=self.subscription_doc())
         if clean_path == "/healthz":
             return Response(status=204)
+        if method.upper() == "GET" and clean_path == "/openapi.json":
+            return Response(body=self.openapi())
 
         for route in self._routes:
             params = route.match(method, clean_path)

@@ -152,6 +152,9 @@ class _HTTPTransport(_Transport):
         headers = dict(headers or {})
         if TRACEPARENT_HEADER not in headers:
             headers.update(outgoing_headers())
+        token = os.environ.get("TASKSRUNNER_API_TOKEN")
+        if token:
+            headers.setdefault("tr-api-token", token)
         try:
             async with self._session.request(
                 method, url, json=json_body, data=data,

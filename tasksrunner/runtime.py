@@ -307,6 +307,11 @@ class Runtime:
         if self._session is None:
             import aiohttp
             self._session = aiohttp.ClientSession()
+        import os
+        token = os.environ.get("TASKSRUNNER_API_TOKEN")
+        if token:
+            # peer sidecars in a token-protected cluster share the token
+            headers.setdefault("tr-api-token", token)
         last_exc: Exception | None = None
         for attempt in range(self.invoke_retries):
             try:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 
 from aiohttp import web
 
@@ -47,11 +48,25 @@ def _json_error(exc: Exception) -> web.Response:
     return web.json_response({"error": str(exc) or type(exc).__name__}, status=status)
 
 
-def build_sidecar_app(runtime: Runtime) -> web.Application:
+TOKEN_ENV = "TASKSRUNNER_API_TOKEN"
+TOKEN_HEADER = "tr-api-token"
+
+
+def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None) -> web.Application:
+    if api_token is None:
+        api_token = os.environ.get(TOKEN_ENV) or None
+
     routes = web.RouteTableDef()
 
     def _traced(handler):
         async def wrapped(request: web.Request):
+            # app↔sidecar API token (≙ Dapr's dapr-api-token / the
+            # reference's identity posture, SURVEY.md §5.10): when a
+            # token is configured, every building-block call must carry
+            # it — healthz stays open for probes
+            if api_token is not None and request.headers.get(TOKEN_HEADER) != api_token:
+                return web.json_response({"error": "missing or bad api token"},
+                                         status=401)
             ctx = ensure_trace(request.headers.get(TRACEPARENT_HEADER))
             with trace_scope(ctx):
                 try:

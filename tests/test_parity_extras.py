@@ -1,0 +1,96 @@
+"""OpenAPI generation, sidecar API-token auth, frontend direct-HTTP
+fallback — the remaining SURVEY.md §2 inventory items."""
+
+import asyncio
+import os
+
+import pytest
+
+from tasksrunner import App, AppHost
+from tasksrunner.component.spec import parse_component
+
+
+@pytest.mark.asyncio
+async def test_openapi_document():
+    from samples.tasks_tracker.backend_api import make_app
+
+    app = make_app("fake")
+    resp = await app.handle("GET", "/openapi.json")
+    assert resp.status == 200
+    doc = resp.body
+    assert doc["openapi"] == "3.1.0"
+    assert doc["info"]["title"] == "tasksmanager-backend-api"
+    assert "get" in doc["paths"]["/api/tasks"]
+    assert "post" in doc["paths"]["/api/tasks"]
+    byid = doc["paths"]["/api/tasks/{task_id}"]
+    assert {"get", "put", "delete"} <= set(byid)
+    assert byid["get"]["parameters"][0]["name"] == "task_id"
+    # overdue controller surface (OverdueTasksController.cs:7-33)
+    assert "/api/overduetasks" in doc["paths"]
+    assert "/api/overduetasks/markoverdue" in doc["paths"]
+
+
+@pytest.mark.asyncio
+async def test_sidecar_api_token(tmp_path, monkeypatch):
+    import aiohttp
+
+    monkeypatch.setenv("TASKSRUNNER_API_TOKEN", "sekrit")
+    app = App("secured")
+
+    @app.get("/ping")
+    async def ping(req):
+        return {"ok": True}
+
+    host = AppHost(app, specs=[parse_component(
+        {"componentType": "state.in-memory"}, default_name="statestore")],
+        registry_file=str(tmp_path / "apps.json"))
+    await host.start()
+    try:
+        base = f"http://127.0.0.1:{host.sidecar_port}"
+        async with aiohttp.ClientSession() as s:
+            # no token -> 401
+            async with s.get(f"{base}/v1.0/state/statestore/k") as r:
+                assert r.status == 401
+            # wrong token -> 401
+            async with s.get(f"{base}/v1.0/state/statestore/k",
+                             headers={"tr-api-token": "nope"}) as r:
+                assert r.status == 401
+            # right token -> through
+            async with s.get(f"{base}/v1.0/state/statestore/k",
+                             headers={"tr-api-token": "sekrit"}) as r:
+                assert r.status == 204
+            # healthz stays open for probes
+            async with s.get(f"{base}/v1.0/healthz") as r:
+                assert r.status == 204
+        # the app's own client carries the token from env automatically
+        result = await host.client.invoke_json("secured", "ping")
+        assert result == {"ok": True}
+    finally:
+        await host.stop()
+        monkeypatch.delenv("TASKSRUNNER_API_TOKEN")
+
+
+@pytest.mark.asyncio
+async def test_frontend_direct_http_fallback(tmp_path, monkeypatch):
+    """≙ the reference frontend's BackendApiConfig:BaseUrlExternalHttp
+    named-HttpClient path (Frontend Program.cs:15-27)."""
+    from samples.tasks_tracker.backend_api import make_app as make_api
+    from samples.tasks_tracker.frontend_ui import make_app as make_frontend
+
+    registry_file = str(tmp_path / "apps.json")
+    api_host = AppHost(make_api("fake"), registry_file=registry_file)
+    frontend_host = AppHost(make_frontend(), registry_file=registry_file)
+    await api_host.start()
+    await frontend_host.start()
+    try:
+        monkeypatch.setenv("BACKENDAPICONFIG__BASEURLEXTERNALHTTP",
+                           f"http://127.0.0.1:{api_host.app_port}")
+        resp = await frontend_host.app.handle(
+            "GET", "/tasks",
+            headers={"cookie": "TasksCreatedByCookie=tempuser@mail.com"})
+        assert resp.status == 200
+        assert "Task number:" in resp.body  # seeded fake tasks rendered
+    finally:
+        monkeypatch.delenv("BACKENDAPICONFIG__BASEURLEXTERNALHTTP")
+        await frontend_host.stop()
+        await api_host.stop()
