@@ -61,6 +61,24 @@ def _page(title: str, body: str) -> Response:
 
 def make_app() -> App:
     app = App(APP_ID)
+    # one reused session for the direct-HTTP fallback path, like the
+    # reference's named HttpClient "BackEndApiExternal" (a factory-
+    # managed, reused client — Frontend Program.cs:15-27)
+    fallback_session: dict[str, object] = {}
+
+    @app.on_startup
+    async def _open_fallback_session():
+        # eager creation: a lazy check-then-create in the request path
+        # would race under concurrent first requests and leak a session
+        if os.environ.get("BACKENDAPICONFIG__BASEURLEXTERNALHTTP"):
+            import aiohttp
+            fallback_session["s"] = aiohttp.ClientSession()
+
+    @app.on_shutdown
+    async def _close_fallback_session():
+        session = fallback_session.pop("s", None)
+        if session is not None:
+            await session.close()
 
     # -- landing page (Pages/Index.cshtml) -------------------------------
 
@@ -90,14 +108,13 @@ def make_app() -> App:
         same here: set BACKENDAPICONFIG__BASEURLEXTERNALHTTP to call
         the API's HTTP endpoint directly instead."""
         base = os.environ.get("BACKENDAPICONFIG__BASEURLEXTERNALHTTP")
-        if base:
-            import aiohttp
-            async with aiohttp.ClientSession() as session:
-                async with session.get(
-                    f"{base.rstrip('/')}/api/tasks",
-                    params={"createdBy": user}) as resp:
-                    resp.raise_for_status()
-                    return await resp.json()
+        if base and "s" in fallback_session:
+            session = fallback_session["s"]
+            async with session.get(
+                f"{base.rstrip('/')}/api/tasks",
+                params={"createdBy": user}) as resp:
+                resp.raise_for_status()
+                return await resp.json()
         return await app.client.invoke_json(
             BACKEND_APP_ID, "api/tasks",
             query=urlencode({"createdBy": user}))

@@ -31,6 +31,12 @@ from tasksrunner.runtime import Runtime, InProcAppChannel, HTTPAppChannel
 from tasksrunner.sidecar import Sidecar
 from tasksrunner.hosting import AppHost, InProcCluster
 from tasksrunner.invoke.resolver import AppAddress, NameResolver
+from tasksrunner.resiliency import (
+    ResiliencyPolicies,
+    ResiliencySpec,
+    load_resiliency,
+    parse_resiliency,
+)
 
 __all__ = [
     "ComponentSpec",
@@ -51,5 +57,9 @@ __all__ = [
     "InProcCluster",
     "AppAddress",
     "NameResolver",
+    "ResiliencyPolicies",
+    "ResiliencySpec",
+    "load_resiliency",
+    "parse_resiliency",
     "__version__",
 ]

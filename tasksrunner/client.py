@@ -33,6 +33,7 @@ from tasksrunner.errors import (
     TasksRunnerError,
 )
 from tasksrunner.runtime import Runtime
+from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER
 from tasksrunner.state.base import StateItem
 
 DEFAULT_SIDECAR_PORT = 3500
@@ -152,9 +153,9 @@ class _HTTPTransport(_Transport):
         headers = dict(headers or {})
         if TRACEPARENT_HEADER not in headers:
             headers.update(outgoing_headers())
-        token = os.environ.get("TASKSRUNNER_API_TOKEN")
+        token = os.environ.get(TOKEN_ENV)
         if token:
-            headers.setdefault("tr-api-token", token)
+            headers.setdefault(TOKEN_HEADER, token)
         try:
             async with self._session.request(
                 method, url, json=json_body, data=data,

@@ -16,6 +16,7 @@ import yaml
 
 from tasksrunner.component.spec import ComponentSpec, parse_component
 from tasksrunner.errors import ComponentError
+from tasksrunner.resiliency.spec import is_resiliency_doc
 
 _YAML_SUFFIXES = {".yaml", ".yml"}
 
@@ -31,6 +32,10 @@ def load_component_file(path: str | pathlib.Path, *, name: str | None = None) ->
     specs: list[ComponentSpec] = []
     for doc in yaml.safe_load_all(text):
         if doc is None:
+            continue
+        if is_resiliency_doc(doc):
+            # Resiliency documents share the resources directory
+            # (tasksrunner/resiliency/spec.py loads them)
             continue
         specs.append(parse_component(doc, default_name=name or path.stem, source=str(path)))
     return specs

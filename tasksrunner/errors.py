@@ -94,6 +94,14 @@ class InvocationError(TasksRunnerError):
     http_status = 500
 
 
+class CircuitOpenError(TasksRunnerError):
+    """A resiliency circuit breaker is open — the call was rejected
+    without being attempted (fail-fast). Maps to 503 so callers can
+    distinguish "target is being protected" from a target-side 5xx."""
+
+    http_status = 503
+
+
 class AppNotFound(InvocationError):
     """Name resolution failed for a target app-id."""
 

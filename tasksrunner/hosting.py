@@ -31,6 +31,8 @@ from tasksrunner.observability.tracing import (
     ensure_trace,
     trace_scope,
 )
+from tasksrunner.resiliency.policy import ResiliencyPolicies
+from tasksrunner.resiliency.spec import ResiliencySpec, load_resiliency
 from tasksrunner.runtime import HTTPAppChannel, InProcAppChannel, Runtime
 from tasksrunner.sidecar import Sidecar
 
@@ -85,6 +87,10 @@ class AppHost:
         if specs is None:
             specs = load_components(components_path) if components_path else []
         self.specs = specs
+        #: Resiliency documents live beside the components (same
+        #: resources dir), exactly as Dapr loads them
+        self.resiliency_specs: list[ResiliencySpec] = (
+            load_resiliency(components_path) if components_path else [])
         self.resolver = resolver or NameResolver(registry_file=registry_file)
         self._app_runner: web.AppRunner | None = None
         self.sidecar: Sidecar | None = None
@@ -107,6 +113,9 @@ class AppHost:
         runtime = Runtime(
             self.app.app_id, registry, resolver=self.resolver,
             app_channel=HTTPAppChannel(self.host, self.app_port),
+            resiliency=ResiliencyPolicies(
+                self.resiliency_specs, app_id=self.app.app_id)
+            if self.resiliency_specs else None,
         )
         self.sidecar = Sidecar(runtime, host=self.host, port=self.sidecar_port)
         await self.sidecar.start()
@@ -146,8 +155,10 @@ class InProcCluster:
     runtime — only the transport differs from production.
     """
 
-    def __init__(self, specs: list[ComponentSpec] | None = None):
+    def __init__(self, specs: list[ComponentSpec] | None = None, *,
+                 resiliency_specs: list[ResiliencySpec] | None = None):
         self.specs = specs or []
+        self.resiliency_specs = resiliency_specs or []
         self.apps: dict[str, App] = {}
         self.runtimes: dict[str, Runtime] = {}
         self._channels: dict[str, InProcAppChannel] = {}
@@ -181,8 +192,10 @@ class InProcCluster:
         for app_id, app in self.apps.items():
             channel = InProcAppChannel(app)
             self._channels[app_id] = channel
-            runtime = Runtime(app_id, self._make_registry(app_id),
-                              app_channel=channel)
+            runtime = Runtime(
+                app_id, self._make_registry(app_id), app_channel=channel,
+                resiliency=ResiliencyPolicies(self.resiliency_specs, app_id=app_id)
+                if self.resiliency_specs else None)
             self.runtimes[app_id] = runtime
             app.client = AppClient.direct(runtime)
         # wire peers after all channels exist

@@ -1,0 +1,37 @@
+"""Declarative resiliency: timeouts, retries, circuit breakers.
+
+The reference's resilience is inherited from its platform: the Dapr
+sidecar's built-in service-invocation retries and mTLS
+(docs/aca/03-aca-dapr-integration/index.md:30-38), broker redelivery on
+non-2xx (docs/aca/06-aca-dapr-bindingsapi/index.md:55-56), and ACA
+restart/scale (SURVEY.md §5.3). Dapr — pinned at 1.14 by the reference
+(mkdocs.yml:113-114) — exposes that resilience declaratively as a
+``kind: Resiliency`` document: named policies (timeouts, retries,
+circuit breakers) bound to targets (apps, components). This package is
+the framework's native equivalent: same document shape, applied by the
+runtime to service invocation and component (outbound) operations.
+"""
+
+from tasksrunner.resiliency.policy import (
+    CircuitBreaker,
+    ResiliencyPolicies,
+    RetrySpec,
+    TargetPolicy,
+    parse_duration,
+)
+from tasksrunner.resiliency.spec import (
+    ResiliencySpec,
+    load_resiliency,
+    parse_resiliency,
+)
+
+__all__ = [
+    "CircuitBreaker",
+    "ResiliencyPolicies",
+    "ResiliencySpec",
+    "RetrySpec",
+    "TargetPolicy",
+    "load_resiliency",
+    "parse_duration",
+    "parse_resiliency",
+]

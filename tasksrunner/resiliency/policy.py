@@ -1,0 +1,295 @@
+"""Policy engine: durations, retry schedules, circuit breakers.
+
+The semantics follow the Dapr resiliency building block the reference's
+platform provides (Dapr 1.14, mkdocs.yml:113-114):
+
+* **timeouts** — per-call deadline;
+* **retries** — ``constant`` or ``exponential`` backoff, bounded by
+  ``maxRetries`` (``-1`` = unlimited) and ``maxInterval``;
+* **circuit breakers** — per-target state machine
+  (closed → open on ``consecutiveFailures >= N`` → half-open after
+  ``timeout`` → closed on probe success / open on probe failure), with
+  ``maxRequests`` concurrent probes allowed while half-open.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Iterator
+
+from tasksrunner.errors import CircuitOpenError, ComponentError
+
+logger = logging.getLogger(__name__)
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ms|s|m|h)")
+_UNIT_SECONDS = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def parse_duration(raw: str | int | float) -> float:
+    """``"500ms"``/``"5s"``/``"1m30s"``/bare seconds → float seconds."""
+    if isinstance(raw, (int, float)):
+        return float(raw)
+    text = str(raw).strip()
+    if not text:
+        raise ComponentError("empty duration")
+    matches = list(_DURATION_RE.finditer(text))
+    if matches and "".join(m.group(0) for m in matches) == text.replace(" ", ""):
+        return sum(float(m.group(1)) * _UNIT_SECONDS[m.group(2)] for m in matches)
+    try:
+        return float(text)
+    except ValueError:
+        raise ComponentError(f"cannot parse duration {raw!r}") from None
+
+
+@dataclass(frozen=True)
+class RetrySpec:
+    """A named retry policy (``spec.policies.retries.<name>``)."""
+
+    policy: str = "constant"  # or "exponential"
+    #: base delay between attempts
+    duration: float = 5.0
+    #: backoff cap for the exponential policy
+    max_interval: float = 60.0
+    #: additional attempts after the first; -1 = unlimited
+    max_retries: int = -1
+
+    def delays(self) -> Iterator[float]:
+        n = 0
+        while self.max_retries < 0 or n < self.max_retries:
+            if self.policy == "exponential":
+                yield min(self.duration * (2 ** n), self.max_interval)
+            else:
+                yield self.duration
+            n += 1
+
+
+@dataclass(frozen=True)
+class CircuitBreakerSpec:
+    """A named circuit-breaker definition (``spec.policies.circuitBreakers.<name>``)."""
+
+    name: str
+    #: consecutive failures that trip the breaker (``trip:`` expression)
+    trip_threshold: int = 5
+    #: how long the breaker stays open before allowing probes
+    timeout: float = 30.0
+    #: probes allowed while half-open
+    max_requests: int = 1
+
+
+_TRIP_RE = re.compile(r"consecutiveFailures\s*(>=|>)\s*(\d+)")
+
+
+def parse_trip(expr: str) -> int:
+    """``"consecutiveFailures >= 5"`` → 5 (the only form Dapr documents
+    for its default CB and the only one we support)."""
+    m = _TRIP_RE.fullmatch(expr.strip())
+    if not m:
+        raise ComponentError(
+            f"unsupported circuit-breaker trip expression {expr!r} "
+            "(expected 'consecutiveFailures >= N')")
+    threshold = int(m.group(2))
+    return threshold + 1 if m.group(1) == ">" else threshold
+
+
+class CircuitBreaker:
+    """Per-target breaker state machine. One instance per (policy,
+    target) pair, shared by every call to that target, so failures
+    observed by one caller protect the rest."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+    def __init__(self, spec: CircuitBreakerSpec, *, target: str = ""):
+        self.spec = spec
+        self.target = target
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self._opened_at = 0.0
+        self._half_open_inflight = 0
+
+    def before_call(self) -> None:
+        """Gate a call; raises ``CircuitOpenError`` when rejected."""
+        if self.state == self.OPEN:
+            if time.monotonic() - self._opened_at >= self.spec.timeout:
+                self.state = self.HALF_OPEN
+                self._half_open_inflight = 0
+                logger.info("circuit %s[%s] half-open (probing)",
+                            self.spec.name, self.target)
+            else:
+                raise CircuitOpenError(
+                    f"circuit {self.spec.name!r} open for target {self.target!r}")
+        if self.state == self.HALF_OPEN:
+            if self._half_open_inflight >= self.spec.max_requests:
+                raise CircuitOpenError(
+                    f"circuit {self.spec.name!r} half-open, probe limit reached "
+                    f"for target {self.target!r}")
+            self._half_open_inflight += 1
+
+    def record_success(self) -> None:
+        if self.state != self.CLOSED:
+            logger.info("circuit %s[%s] closed", self.spec.name, self.target)
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self._half_open_inflight = 0
+
+    def release_probe(self) -> None:
+        """A half-open probe ended without a verdict (e.g. the caller
+        was cancelled): free its slot so the breaker can't wedge with
+        all probes leaked."""
+        if self.state == self.HALF_OPEN and self._half_open_inflight > 0:
+            self._half_open_inflight -= 1
+
+    def record_failure(self) -> None:
+        self.consecutive_failures += 1
+        should_trip = (
+            self.state == self.HALF_OPEN
+            or self.consecutive_failures >= self.spec.trip_threshold
+        )
+        if should_trip and self.state != self.OPEN:
+            self.state = self.OPEN
+            self._opened_at = time.monotonic()
+            logger.warning("circuit %s[%s] OPEN after %d consecutive failures",
+                           self.spec.name, self.target, self.consecutive_failures)
+
+
+@dataclass
+class TargetPolicy:
+    """The resolved policy set for one target (app or component)."""
+
+    target: str
+    timeout: float | None = None
+    retry: RetrySpec | None = None
+    breaker: CircuitBreaker | None = None
+
+    async def execute(
+        self,
+        fn: Callable[[], Awaitable],
+        *,
+        retriable: tuple[type[BaseException], ...] = (OSError,),
+    ):
+        """Run ``fn`` under this policy.
+
+        ``retriable`` exceptions (plus timeouts) consume retry budget;
+        anything else propagates immediately but still counts as a
+        breaker failure. ``CircuitOpenError`` raised by the gate is
+        never retried here — fail fast is the point of the breaker.
+        """
+        delays = self.retry.delays() if self.retry else iter(())
+        while True:
+            if self.breaker is not None:
+                self.breaker.before_call()
+            try:
+                if self.timeout is not None:
+                    result = await asyncio.wait_for(fn(), self.timeout)
+                else:
+                    result = await fn()
+            except (asyncio.TimeoutError, *retriable) as exc:
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                delay = next(delays, None)
+                if delay is None:
+                    if isinstance(exc, asyncio.TimeoutError):
+                        raise TimeoutError(
+                            f"call to {self.target!r} exceeded "
+                            f"{self.timeout}s timeout") from exc
+                    raise
+                logger.warning("retrying %s in %.3fs after %r",
+                               self.target, delay, exc)
+                await asyncio.sleep(delay)
+                continue
+            except Exception:
+                if self.breaker is not None:
+                    self.breaker.record_failure()
+                raise
+            except BaseException:
+                # cancellation is not a verdict on the target's health —
+                # free the probe slot instead of leaking it (a leaked
+                # slot would pin the breaker half-open forever)
+                if self.breaker is not None:
+                    self.breaker.release_probe()
+                raise
+            if self.breaker is not None:
+                self.breaker.record_success()
+            return result
+
+
+@dataclass
+class _TargetRef:
+    timeout: str | None = None
+    retry: str | None = None
+    circuit_breaker: str | None = None
+
+
+@dataclass
+class _ParsedSpec:
+    """One parsed Resiliency document (see spec.py for the YAML side)."""
+
+    name: str
+    scopes: list[str] = field(default_factory=list)
+    timeouts: dict[str, float] = field(default_factory=dict)
+    retries: dict[str, RetrySpec] = field(default_factory=dict)
+    breakers: dict[str, CircuitBreakerSpec] = field(default_factory=dict)
+    app_targets: dict[str, _TargetRef] = field(default_factory=dict)
+    component_targets: dict[str, dict[str, _TargetRef]] = field(default_factory=dict)
+
+    def in_scope(self, app_id: str | None) -> bool:
+        if not self.scopes or app_id is None:
+            return True
+        return app_id in self.scopes
+
+
+class ResiliencyPolicies:
+    """The runtime-facing view: merged in-scope specs with per-target
+    breaker instances that persist across calls."""
+
+    def __init__(self, specs: list[_ParsedSpec], *, app_id: str | None = None):
+        self.specs = [s for s in specs if s.in_scope(app_id)]
+        self._breakers: dict[tuple[str, str], CircuitBreaker] = {}
+        self._cache: dict[tuple[str, str, str], TargetPolicy | None] = {}
+
+    def for_app(self, app_id: str) -> TargetPolicy | None:
+        """Policy applied to service invocation toward ``app_id``."""
+        return self._resolve("apps", app_id, "outbound")
+
+    def for_component(self, name: str, direction: str = "outbound") -> TargetPolicy | None:
+        """Policy applied to component operations on ``name``."""
+        return self._resolve("components", name, direction)
+
+    def _resolve(self, kind: str, name: str, direction: str) -> TargetPolicy | None:
+        cache_key = (kind, name, direction)
+        if cache_key in self._cache:
+            return self._cache[cache_key]
+        policy = None
+        for spec in self.specs:
+            if kind == "apps":
+                ref = spec.app_targets.get(name)
+            else:
+                ref = (spec.component_targets.get(name) or {}).get(direction)
+            if ref is None:
+                continue
+            timeout = spec.timeouts.get(ref.timeout) if ref.timeout else None
+            if ref.timeout and timeout is None:
+                raise ComponentError(
+                    f"resiliency {spec.name!r}: unknown timeout {ref.timeout!r}")
+            retry = spec.retries.get(ref.retry) if ref.retry else None
+            if ref.retry and retry is None:
+                raise ComponentError(
+                    f"resiliency {spec.name!r}: unknown retry {ref.retry!r}")
+            breaker = None
+            if ref.circuit_breaker:
+                cb_spec = spec.breakers.get(ref.circuit_breaker)
+                if cb_spec is None:
+                    raise ComponentError(
+                        f"resiliency {spec.name!r}: unknown circuit breaker "
+                        f"{ref.circuit_breaker!r}")
+                bk = (cb_spec.name, f"{kind}/{name}/{direction}")
+                breaker = self._breakers.setdefault(
+                    bk, CircuitBreaker(cb_spec, target=name))
+            policy = TargetPolicy(
+                target=name, timeout=timeout, retry=retry, breaker=breaker)
+            break  # first in-scope spec naming the target wins
+        self._cache[cache_key] = policy
+        return policy

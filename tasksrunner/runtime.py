@@ -26,6 +26,7 @@ import abc
 import asyncio
 import json
 import logging
+import os
 import time
 from typing import Any
 
@@ -51,6 +52,8 @@ from tasksrunner.observability.tracing import (
     trace_scope,
 )
 from tasksrunner.pubsub.base import Message, PubSubBroker
+from tasksrunner.resiliency.policy import ResiliencyPolicies
+from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER
 from tasksrunner.state.base import StateStore, TransactionOp
 from tasksrunner.state.keyprefix import KeyPrefixer
 
@@ -120,6 +123,7 @@ class Runtime:
         app_channel: AppChannel | None = None,
         invoke_retries: int = 3,
         invoke_retry_delay: float = 0.2,
+        resiliency: ResiliencyPolicies | None = None,
     ):
         self.app_id = app_id
         self.registry = registry
@@ -131,6 +135,10 @@ class Runtime:
         #: to the caller untouched.
         self.invoke_retries = max(1, invoke_retries)
         self.invoke_retry_delay = invoke_retry_delay
+        #: declarative policies (timeouts/retries/circuit breakers) —
+        #: when a target has one it replaces the builtin retry loop
+        #: (tasksrunner/resiliency, ≙ Dapr 1.14 kind: Resiliency)
+        self.resiliency = resiliency
         self.app_channel = app_channel
         #: in-process peer channels (app-id → AppChannel); consulted
         #: before name resolution so a single-process cluster can route
@@ -143,6 +151,16 @@ class Runtime:
         self._started = False
 
     # -- helpers ---------------------------------------------------------
+
+    async def _guarded(self, component_name: str, fn,
+                       retriable: tuple[type[BaseException], ...] = (OSError,)):
+        """Apply the component's outbound resiliency policy (if any)."""
+        if self.resiliency is None:
+            return await fn()
+        policy = self.resiliency.for_component(component_name)
+        if policy is None:
+            return await fn()
+        return await policy.execute(fn, retriable=retriable)
 
     def _state_store(self, name: str) -> tuple[StateStore, KeyPrefixer]:
         store = self.registry.get(name, block="state")
@@ -159,24 +177,35 @@ class Runtime:
         for item in items:
             if "key" not in item:
                 raise StateError("each state item needs a key")
-            await store.set(prefixer.apply(str(item["key"])), item.get("value"),
-                            etag=item.get("etag"))
+
+        # guard per item, not per batch: a retry must re-run only the
+        # failing write — re-running completed etag-guarded items would
+        # turn a transient blip into a spurious 409 conflict
+        for item in items:
+            key = prefixer.apply(str(item["key"]))
+            await self._guarded(
+                store_name,
+                lambda k=key, it=item: store.set(k, it.get("value"),
+                                                 etag=it.get("etag")))
         metrics.inc("state_save", len(items), store=store_name)
 
     async def get_state(self, store_name: str, key: str):
         store, prefixer = self._state_store(store_name)
         metrics.inc("state_get", store=store_name)
-        return await store.get(prefixer.apply(key))
+        return await self._guarded(store_name, lambda: store.get(prefixer.apply(key)))
 
     async def delete_state(self, store_name: str, key: str, *, etag=None) -> bool:
         store, prefixer = self._state_store(store_name)
         metrics.inc("state_delete", store=store_name)
-        return await store.delete(prefixer.apply(key), etag=etag)
+        return await self._guarded(
+            store_name, lambda: store.delete(prefixer.apply(key), etag=etag))
 
     async def bulk_get_state(self, store_name: str, keys: list[str]) -> list[dict]:
         """≙ Dapr's POST /v1.0/state/{store}/bulk."""
         store, prefixer = self._state_store(store_name)
-        items = await store.bulk_get([prefixer.apply(str(k)) for k in keys])
+        items = await self._guarded(
+            store_name,
+            lambda: store.bulk_get([prefixer.apply(str(k)) for k in keys]))
         metrics.inc("state_bulk_get", len(keys), store=store_name)
         out = []
         for key, item in zip(keys, items):
@@ -189,7 +218,8 @@ class Runtime:
 
     async def query_state(self, store_name: str, query: dict) -> dict:
         store, prefixer = self._state_store(store_name)
-        resp = await store.query(query, key_prefix=prefixer.prefix)
+        resp = await self._guarded(
+            store_name, lambda: store.query(query, key_prefix=prefixer.prefix))
         metrics.inc("state_query", store=store_name)
         return {
             "results": [
@@ -213,7 +243,9 @@ class Runtime:
                 operation=kind, key=prefixer.apply(str(req["key"])),
                 value=req.get("value"), etag=req.get("etag"),
             ))
-        await store.transact(ops)
+        # a transaction is atomic in the store, so whole-call retry is
+        # safe (unlike the per-item save loop above)
+        await self._guarded(store_name, lambda: store.transact(ops))
         metrics.inc("state_transact", store=store_name)
 
     # -- secrets ---------------------------------------------------------
@@ -247,7 +279,8 @@ class Runtime:
         child = ctx.child()
         meta[TRACEPARENT_HEADER] = child.header
         started = time.time()
-        msg_id = await broker.publish(topic, envelope, metadata=meta)
+        msg_id = await self._guarded(
+            pubsub_name, lambda: broker.publish(topic, envelope, metadata=meta))
         metrics.inc("publish", pubsub=pubsub_name, topic=topic)
         record_span(kind="producer", name=f"publish {pubsub_name}/{topic}",
                     status=200, start=started, duration=time.time() - started,
@@ -263,7 +296,8 @@ class Runtime:
         if not isinstance(binding, OutputBinding):
             raise BindingError(f"component {name!r} is not an output binding")
         metrics.inc("binding_invoke", binding=name, operation=operation)
-        return await binding.invoke(operation, data, metadata)
+        return await self._guarded(
+            name, lambda: binding.invoke(operation, data, metadata))
 
     # -- service invocation ----------------------------------------------
 
@@ -300,31 +334,67 @@ class Runtime:
             return _spanned(await self.app_channel.request(
                 http_method, path, query=query, headers=headers, body=body))
 
+        policy = (self.resiliency.for_app(target_app_id)
+                  if self.resiliency is not None else None)
+
         if target_app_id in self.peers:
-            return _spanned(await self.peers[target_app_id].request(
-                http_method, path, query=query, headers=headers, body=body))
+            channel = self.peers[target_app_id]
+
+            async def _peer_attempt():
+                return await channel.request(
+                    http_method, path, query=query, headers=headers, body=body)
+
+            if policy is not None:
+                try:
+                    return _spanned(await policy.execute(
+                        _peer_attempt, retriable=(OSError,)))
+                except InvocationError:
+                    raise
+                except (OSError, TimeoutError) as exc:
+                    # identical error shape to the sidecar-HTTP branch
+                    # below — the two transports must stay behaviorally
+                    # interchangeable
+                    raise InvocationError(
+                        f"cannot reach {target_app_id!r}: {exc}") from exc
+            return _spanned(await _peer_attempt())
 
         if self._session is None:
             import aiohttp
             self._session = aiohttp.ClientSession()
-        import os
-        token = os.environ.get("TASKSRUNNER_API_TOKEN")
+        token = os.environ.get(TOKEN_ENV)
         if token:
             # peer sidecars in a token-protected cluster share the token
-            headers.setdefault("tr-api-token", token)
+            headers.setdefault(TOKEN_HEADER, token)
+
+        async def _attempt():
+            # re-resolve each attempt: the peer may have crashed,
+            # unregistered, and come back on a new port
+            addr = self.resolver.resolve(target_app_id)
+            url = f"{addr.base_url}/v1.0/invoke/{target_app_id}/method{path}"
+            if query:
+                url += f"?{query}"
+            async with self._session.request(http_method, url, headers=headers,
+                                             data=body) as resp:
+                return resp.status, dict(resp.headers), await resp.read()
+
+        if policy is not None:
+            # declarative policy replaces the builtin transport retries
+            try:
+                return _spanned(await policy.execute(
+                    _attempt, retriable=(OSError, AppNotFound)))
+            except (AppNotFound, InvocationError):
+                raise
+            except (OSError, TimeoutError) as exc:
+                # exhausted budget: surface the same clean error shape
+                # as the builtin loop (mapped to HTTP 500, not an
+                # unhandled traceback)
+                raise InvocationError(
+                    f"cannot reach sidecar of {target_app_id!r}: {exc}") from exc
+
         last_exc: Exception | None = None
         for attempt in range(self.invoke_retries):
             try:
-                # re-resolve each attempt: the peer may have crashed,
-                # unregistered, and come back on a new port
-                addr = self.resolver.resolve(target_app_id)
-                url = f"{addr.base_url}/v1.0/invoke/{target_app_id}/method{path}"
-                if query:
-                    url += f"?{query}"
-                async with self._session.request(http_method, url, headers=headers,
-                                                 data=body) as resp:
-                    return _spanned(
-                        (resp.status, dict(resp.headers), await resp.read()))
+                return _spanned(await _attempt())
             except (OSError, AppNotFound) as exc:
                 last_exc = exc
                 if attempt + 1 < self.invoke_retries:

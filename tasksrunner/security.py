@@ -1,0 +1,10 @@
+"""Shared security constants.
+
+App↔sidecar API-token auth ≙ Dapr's ``dapr-api-token`` / the
+reference's identity posture (SURVEY.md §5.10). One definition so the
+sidecar (verifier), the client SDK, and peer-sidecar invocation (both
+senders) can never drift apart.
+"""
+
+TOKEN_ENV = "TASKSRUNNER_API_TOKEN"
+TOKEN_HEADER = "tr-api-token"

@@ -48,8 +48,7 @@ def _json_error(exc: Exception) -> web.Response:
     return web.json_response({"error": str(exc) or type(exc).__name__}, status=status)
 
 
-TOKEN_ENV = "TASKSRUNNER_API_TOKEN"
-TOKEN_HEADER = "tr-api-token"
+from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER  # noqa: E402 (re-export)
 
 
 def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None) -> web.Application:
@@ -204,7 +203,10 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None) -> web.
         return web.Response(status=204)
 
     @routes.get("/v1.0/metadata")
+    @_traced
     async def metadata(request: web.Request):
+        # token-gated like every building-block route: the component
+        # inventory and metrics are exactly what the token protects
         return web.json_response(runtime.metadata())
 
     app = web.Application(client_max_size=16 * 1024 * 1024)

@@ -62,6 +62,12 @@ async def test_sidecar_api_token(tmp_path, monkeypatch):
             # healthz stays open for probes
             async with s.get(f"{base}/v1.0/healthz") as r:
                 assert r.status == 204
+            # metadata (component inventory, metrics) is token-gated too
+            async with s.get(f"{base}/v1.0/metadata") as r:
+                assert r.status == 401
+            async with s.get(f"{base}/v1.0/metadata",
+                             headers={"tr-api-token": "sekrit"}) as r:
+                assert r.status == 200
         # the app's own client carries the token from env automatically
         result = await host.client.invoke_json("secured", "ping")
         assert result == {"ok": True}

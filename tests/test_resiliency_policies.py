@@ -1,0 +1,454 @@
+"""Declarative resiliency: timeouts, retries, circuit breakers.
+
+The reference inherits these from its platform (Dapr 1.14 sidecar
+retries, broker redelivery, ACA restarts — SURVEY.md §5.3); here they
+are first-class, declarative, and tested per policy type.
+"""
+
+import asyncio
+
+import pytest
+
+from tasksrunner import App, InProcCluster, parse_resiliency
+from tasksrunner.component.loader import load_component_file
+from tasksrunner.component.spec import parse_component
+from tasksrunner.errors import CircuitOpenError, ComponentError
+from tasksrunner.resiliency.policy import (
+    CircuitBreaker,
+    CircuitBreakerSpec,
+    ResiliencyPolicies,
+    RetrySpec,
+    parse_duration,
+    parse_trip,
+)
+from tasksrunner.resiliency.spec import load_resiliency
+
+# ---------------------------------------------------------------------------
+# spec parsing
+
+
+RESILIENCY_YAML = {
+    "apiVersion": "dapr.io/v1alpha1",
+    "kind": "Resiliency",
+    "metadata": {"name": "tasks-resiliency"},
+    "spec": {
+        "policies": {
+            "timeouts": {"fast": "250ms", "general": "5s"},
+            "retries": {
+                "important": {
+                    "policy": "exponential",
+                    "duration": "10ms",
+                    "maxInterval": "80ms",
+                    "maxRetries": 3,
+                },
+            },
+            "circuitBreakers": {
+                "simpleCB": {
+                    "maxRequests": 1,
+                    "timeout": "100ms",
+                    "trip": "consecutiveFailures >= 3",
+                },
+            },
+        },
+        "targets": {
+            "apps": {
+                "backend": {
+                    "timeout": "fast",
+                    "retry": "important",
+                    "circuitBreaker": "simpleCB",
+                },
+            },
+            "components": {
+                "statestore": {"outbound": {"retry": "important"}},
+            },
+        },
+    },
+}
+
+
+def test_parse_durations():
+    assert parse_duration("500ms") == 0.5
+    assert parse_duration("5s") == 5.0
+    assert parse_duration("1m30s") == 90.0
+    assert parse_duration(2) == 2.0
+    with pytest.raises(ComponentError):
+        parse_duration("soon")
+
+
+def test_parse_trip_expressions():
+    assert parse_trip("consecutiveFailures >= 5") == 5
+    assert parse_trip("consecutiveFailures > 5") == 6
+    with pytest.raises(ComponentError):
+        parse_trip("errorRate > 0.5")
+
+
+def test_parse_resiliency_document():
+    spec = parse_resiliency(RESILIENCY_YAML)
+    assert spec.name == "tasks-resiliency"
+    assert spec.timeouts == {"fast": 0.25, "general": 5.0}
+    retry = spec.retries["important"]
+    assert retry.policy == "exponential" and retry.max_retries == 3
+    cb = spec.breakers["simpleCB"]
+    assert cb.trip_threshold == 3 and cb.timeout == pytest.approx(0.1)
+    assert "backend" in spec.app_targets
+    assert "outbound" in spec.component_targets["statestore"]
+
+
+def test_load_resiliency_beside_components(tmp_path):
+    """Resiliency docs share the resources dir; the component loader
+    skips them and load_resiliency collects them."""
+    import yaml
+
+    comp = {"componentType": "state.in-memory"}
+    (tmp_path / "statestore.yaml").write_text(yaml.dump(comp))
+    (tmp_path / "resiliency.yaml").write_text(yaml.dump(RESILIENCY_YAML))
+
+    specs = load_component_file(tmp_path / "resiliency.yaml")
+    assert specs == []  # skipped, not an error
+    res = load_resiliency(tmp_path)
+    assert len(res) == 1 and res[0].name == "tasks-resiliency"
+
+
+def test_resolution_and_scoping():
+    spec = parse_resiliency(RESILIENCY_YAML)
+    pols = ResiliencyPolicies([spec])
+    p = pols.for_app("backend")
+    assert p.timeout == 0.25 and p.retry.max_retries == 3
+    assert p.breaker is not None
+    assert pols.for_app("unknown") is None
+    assert pols.for_component("statestore").retry is not None
+    assert pols.for_component("statestore").breaker is None
+    # breaker instance is shared across resolutions (state persists)
+    assert pols.for_app("backend").breaker is pols.for_app("backend").breaker
+
+    scoped = parse_resiliency({**RESILIENCY_YAML, "scopes": ["other-app"]})
+    assert ResiliencyPolicies([scoped], app_id="not-other").for_app("backend") is None
+    assert ResiliencyPolicies([scoped], app_id="other-app").for_app("backend") is not None
+
+
+def test_dangling_policy_refs_rejected_at_parse_time():
+    """A typo'd policy name must fail at load, not on the first call."""
+    doc = {
+        "kind": "Resiliency",
+        "metadata": {"name": "r"},
+        "spec": {
+            "policies": {"retries": {"fast": {"duration": "1ms"}}},
+            "targets": {"apps": {"api": {"retry": "fsat"}}},
+        },
+    }
+    with pytest.raises(ComponentError, match="unknown retry 'fsat'"):
+        parse_resiliency(doc)
+
+
+# ---------------------------------------------------------------------------
+# policy engine
+
+
+def test_retry_delays():
+    constant = RetrySpec(policy="constant", duration=0.5, max_retries=2)
+    assert list(constant.delays()) == [0.5, 0.5]
+    expo = RetrySpec(policy="exponential", duration=0.1, max_interval=0.35,
+                     max_retries=4)
+    assert list(expo.delays()) == [0.1, 0.2, 0.35, 0.35]
+
+
+@pytest.mark.asyncio
+async def test_retry_until_success():
+    from tasksrunner.resiliency.policy import TargetPolicy
+
+    calls = 0
+
+    async def flaky():
+        nonlocal calls
+        calls += 1
+        if calls < 3:
+            raise OSError("connection refused")
+        return "ok"
+
+    policy = TargetPolicy(
+        target="t", retry=RetrySpec(duration=0.001, max_retries=5))
+    assert await policy.execute(flaky) == "ok"
+    assert calls == 3
+
+
+@pytest.mark.asyncio
+async def test_retry_budget_exhausted():
+    from tasksrunner.resiliency.policy import TargetPolicy
+
+    async def always_down():
+        raise OSError("connection refused")
+
+    policy = TargetPolicy(
+        target="t", retry=RetrySpec(duration=0.001, max_retries=2))
+    with pytest.raises(OSError):
+        await policy.execute(always_down)
+
+
+@pytest.mark.asyncio
+async def test_timeout_policy():
+    from tasksrunner.resiliency.policy import TargetPolicy
+
+    async def slow():
+        await asyncio.sleep(5)
+
+    policy = TargetPolicy(target="t", timeout=0.05)
+    with pytest.raises(TimeoutError):
+        await policy.execute(slow)
+
+
+@pytest.mark.asyncio
+async def test_circuit_breaker_state_machine():
+    spec = CircuitBreakerSpec(name="cb", trip_threshold=3, timeout=0.08,
+                              max_requests=1)
+    cb = CircuitBreaker(spec, target="t")
+
+    for _ in range(3):
+        cb.before_call()
+        cb.record_failure()
+    assert cb.state == CircuitBreaker.OPEN
+    with pytest.raises(CircuitOpenError):
+        cb.before_call()
+
+    await asyncio.sleep(0.1)  # open → half-open after timeout
+    cb.before_call()
+    assert cb.state == CircuitBreaker.HALF_OPEN
+    with pytest.raises(CircuitOpenError):  # probe limit: maxRequests=1
+        cb.before_call()
+    cb.record_success()
+    assert cb.state == CircuitBreaker.CLOSED
+
+    # a failed probe reopens immediately
+    for _ in range(3):
+        cb.before_call()
+        cb.record_failure()
+    await asyncio.sleep(0.1)
+    cb.before_call()
+    cb.record_failure()
+    assert cb.state == CircuitBreaker.OPEN
+
+
+# ---------------------------------------------------------------------------
+# runtime integration
+
+
+@pytest.mark.asyncio
+async def test_invoke_circuit_breaker_fails_fast():
+    """After trip_threshold consecutive transport failures, the breaker
+    opens: further invokes get CircuitOpenError WITHOUT touching the
+    peer, and the breaker closes again once a probe succeeds."""
+    from tasksrunner.component.registry import ComponentRegistry
+    from tasksrunner.runtime import AppChannel, Runtime
+
+    class FlakyChannel(AppChannel):
+        def __init__(self):
+            self.calls = 0
+            self.down = True
+
+        async def request(self, method, path, *, query="", headers=None, body=b""):
+            self.calls += 1
+            if self.down:
+                raise OSError("connection refused")
+            return 200, {}, b"{}"
+
+    doc = {
+        "kind": "Resiliency",
+        "metadata": {"name": "r"},
+        "spec": {
+            "policies": {
+                "circuitBreakers": {
+                    "cb": {"timeout": "50ms", "trip": "consecutiveFailures >= 2"},
+                },
+            },
+            "targets": {"apps": {"backend": {"circuitBreaker": "cb"}}},
+        },
+    }
+    channel = FlakyChannel()
+    runtime = Runtime(
+        "caller", ComponentRegistry([], app_id="caller"),
+        resiliency=ResiliencyPolicies([parse_resiliency(doc)], app_id="caller"))
+    runtime.peers["backend"] = channel
+
+    from tasksrunner.errors import InvocationError
+
+    for _ in range(2):
+        with pytest.raises(InvocationError):
+            await runtime.invoke("backend", "work", http_method="GET")
+    assert channel.calls == 2
+
+    # breaker now open: rejected without reaching the channel
+    with pytest.raises(CircuitOpenError):
+        await runtime.invoke("backend", "work", http_method="GET")
+    assert channel.calls == 2
+
+    # after the open timeout, a successful probe closes the breaker
+    channel.down = False
+    await asyncio.sleep(0.07)
+    status, _, _ = await runtime.invoke("backend", "work", http_method="GET")
+    assert status == 200
+    status, _, _ = await runtime.invoke("backend", "work", http_method="GET")
+    assert status == 200
+    assert channel.calls == 4
+
+
+@pytest.mark.asyncio
+async def test_output_binding_retry_via_policy(tmp_path):
+    """A component outbound retry policy re-runs a failing binding
+    operation until it succeeds."""
+    from tasksrunner.component.registry import ComponentRegistry
+    from tasksrunner.runtime import Runtime
+    from tasksrunner.bindings.base import BindingResponse, OutputBinding
+
+    class FlakyBinding(OutputBinding):
+        def __init__(self):
+            self.name = "flaky"
+            self.calls = 0
+
+        async def invoke(self, operation, data, metadata=None):
+            self.calls += 1
+            if self.calls < 3:
+                raise OSError("backend down")
+            return BindingResponse(data={"ok": True}, metadata={})
+
+    doc = {
+        "kind": "Resiliency",
+        "metadata": {"name": "r"},
+        "spec": {
+            "policies": {
+                "retries": {"fast": {"duration": "1ms", "maxRetries": 5}},
+            },
+            "targets": {"components": {"flaky": {"retry": "fast"}}},
+        },
+    }
+    binding = FlakyBinding()
+    registry = ComponentRegistry([], app_id="app")
+    runtime = Runtime(
+        "app", registry,
+        resiliency=ResiliencyPolicies([parse_resiliency(doc)], app_id="app"))
+    registry._instances["flaky"] = binding
+    registry._specs["flaky"] = parse_component(
+        {"componentType": "bindings.noop"}, default_name="flaky")
+
+    resp = await runtime.invoke_output_binding("flaky", "create", {"x": 1})
+    assert resp.data == {"ok": True}
+    assert binding.calls == 3
+
+
+@pytest.mark.asyncio
+async def test_cancelled_half_open_probe_releases_slot():
+    """A cancelled probe is not a verdict: its slot must be freed or
+    the breaker would stay half-open (rejecting everything) forever."""
+    from tasksrunner.resiliency.policy import TargetPolicy
+
+    spec = CircuitBreakerSpec(name="cb", trip_threshold=1, timeout=0.01,
+                              max_requests=1)
+    breaker = CircuitBreaker(spec, target="t")
+    policy = TargetPolicy(target="t", breaker=breaker)
+
+    async def failing():
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        await policy.execute(failing)
+    assert breaker.state == CircuitBreaker.OPEN
+    await asyncio.sleep(0.02)
+
+    async def hang():
+        await asyncio.sleep(30)
+
+    task = asyncio.ensure_future(policy.execute(hang))
+    await asyncio.sleep(0.01)  # let it enter half-open and occupy the slot
+    task.cancel()
+    with pytest.raises(asyncio.CancelledError):
+        await task
+    assert breaker.state == CircuitBreaker.HALF_OPEN
+
+    # the slot is free again: a successful probe closes the breaker
+    async def ok():
+        return "up"
+
+    assert await policy.execute(ok) == "up"
+    assert breaker.state == CircuitBreaker.CLOSED
+
+
+@pytest.mark.asyncio
+async def test_save_state_retry_is_per_item():
+    """A transient failure on item N must re-run only item N — replaying
+    earlier etag-guarded writes (whose etags already rotated) would turn
+    the blip into a spurious 409 conflict."""
+    from tasksrunner.component.registry import ComponentRegistry
+    from tasksrunner.runtime import Runtime
+
+    doc = {
+        "kind": "Resiliency",
+        "metadata": {"name": "r"},
+        "spec": {
+            "policies": {"retries": {"fast": {"duration": "1ms", "maxRetries": 3}}},
+            "targets": {"components": {"statestore": {"retry": "fast"}}},
+        },
+    }
+    registry = ComponentRegistry(
+        [parse_component({"componentType": "state.in-memory"},
+                         default_name="statestore")],
+        app_id="app")
+    runtime = Runtime(
+        "app", registry,
+        resiliency=ResiliencyPolicies([parse_resiliency(doc)], app_id="app"))
+
+    await runtime.save_state("statestore", [{"key": "a", "value": 1}])
+    etag_a = (await runtime.get_state("statestore", "a")).etag
+
+    store = registry.get("statestore")
+    real_set = store.set
+    set_calls = {"a": 0, "b": 0}
+    failed = {"b": False}
+
+    async def flaky_set(key, value, *, etag=None):
+        short = key.rsplit("||", 1)[-1]
+        set_calls[short] += 1
+        if short == "b" and not failed["b"]:
+            failed["b"] = True
+            raise OSError("transient store blip")
+        return await real_set(key, value, etag=etag)
+
+    store.set = flaky_set
+    await runtime.save_state("statestore", [
+        {"key": "a", "value": 2, "etag": etag_a},
+        {"key": "b", "value": 3},
+    ])
+    # item a wrote exactly once (its etag would be stale on a replay);
+    # item b failed once, retried once
+    assert set_calls == {"a": 1, "b": 2}
+    assert (await runtime.get_state("statestore", "a")).value == 2
+    assert (await runtime.get_state("statestore", "b")).value == 3
+
+
+@pytest.mark.asyncio
+async def test_invoke_timeout_policy_fails_slow_target(tmp_path):
+    """An app-target timeout bounds a hung handler."""
+    doc = {
+        "kind": "Resiliency",
+        "metadata": {"name": "r"},
+        "spec": {
+            "policies": {"timeouts": {"fast": "100ms"}},
+            "targets": {"apps": {"backend": {"timeout": "fast"}}},
+        },
+    }
+    backend = App("backend")
+
+    @backend.get("/hang")
+    async def hang(req):
+        await asyncio.sleep(10)
+        return 200
+
+    caller = App("caller")
+    cluster = InProcCluster([], resiliency_specs=[parse_resiliency(doc)])
+    cluster.add_app(backend)
+    cluster.add_app(caller)
+    await cluster.start()
+    try:
+        from tasksrunner.errors import InvocationError
+        with pytest.raises(InvocationError):
+            await cluster.client("caller").invoke_method(
+                "backend", "hang", http_method="GET")
+    finally:
+        await cluster.stop()
