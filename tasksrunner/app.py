@@ -241,6 +241,9 @@ class App:
 
     # -- dispatch --------------------------------------------------------
 
+    def _matches_user_route(self, method: str, path: str) -> bool:
+        return any(r.match(method, path) is not None for r in self._routes)
+
     def openapi(self) -> dict:
         """Minimal OpenAPI 3.1 document generated from the route table
         (≙ the reference API's AddOpenApi/MapOpenApi, Backend.Api
@@ -288,7 +291,16 @@ class App:
 
         if method.upper() == "GET" and clean_path in ("/tasksrunner/subscribe", "/dapr/subscribe"):
             return Response(body=self.subscription_doc())
-        if clean_path == "/healthz":
+        if clean_path == "/tasksrunner/healthz":
+            # non-shadowable liveness: the sidecar's startup handshake
+            # must not be gated on an app's custom /healthz (an app that
+            # reports 503 until warm would otherwise never finish
+            # starting — readiness and liveness are different questions)
+            return Response(status=204)
+        if clean_path == "/healthz" and not self._matches_user_route(method, clean_path):
+            # builtin liveness default; an app may register its own
+            # /healthz to report real health (the orchestrator's
+            # liveness probe then sees it)
             return Response(status=204)
         if method.upper() == "GET" and clean_path == "/openapi.json":
             return Response(body=self.openapi())

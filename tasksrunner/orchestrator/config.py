@@ -58,6 +58,23 @@ class ScaleSpec:
 
 
 @dataclass
+class HealthSpec:
+    """Liveness probing (≙ ACA's container probes; the platform-side
+    restart behavior in SURVEY.md §5.3). The orchestrator GETs the
+    app's ``/healthz`` — apps may register their own ``/healthz`` route
+    to report real health; the builtin one always returns 204."""
+
+    enabled: bool = True
+    interval_seconds: float = 5.0
+    #: consecutive failures before the replica is killed + restarted
+    failure_threshold: int = 3
+    #: grace period after start before the first probe
+    initial_delay_seconds: float = 2.0
+    #: per-probe timeout
+    timeout_seconds: float = 2.0
+
+
+@dataclass
 class AppSpec:
     app_id: str
     module: str  # "pkg.mod:factory"
@@ -69,6 +86,7 @@ class AppSpec:
     host: str = "127.0.0.1"
     env: dict[str, str] = field(default_factory=dict)
     scale: ScaleSpec = field(default_factory=ScaleSpec)
+    health: HealthSpec = field(default_factory=HealthSpec)
 
 
 @dataclass
@@ -99,6 +117,23 @@ def load_run_config(path: str | pathlib.Path) -> RunConfig:
             })
             for r in scale_raw.get("rules") or []
         ]
+        health_raw = raw.get("health", {})
+        if health_raw is None or health_raw is True:
+            # bare "health:" / "health: true" = probing with defaults
+            health_raw = {}
+        if health_raw is False:
+            health = HealthSpec(enabled=False)
+        elif isinstance(health_raw, dict):
+            health = HealthSpec(
+                enabled=bool(health_raw.get("enabled", True)),
+                interval_seconds=float(health_raw.get("interval_seconds", 5.0)),
+                failure_threshold=int(health_raw.get("failure_threshold", 3)),
+                initial_delay_seconds=float(
+                    health_raw.get("initial_delay_seconds", 2.0)),
+                timeout_seconds=float(health_raw.get("timeout_seconds", 2.0)),
+            )
+        else:
+            raise ComponentError("health must be a mapping or false")
         apps.append(AppSpec(
             app_id=str(raw["app_id"]),
             module=str(raw["module"]),
@@ -112,6 +147,7 @@ def load_run_config(path: str | pathlib.Path) -> RunConfig:
                 rules=rules,
                 cooldown_seconds=float(scale_raw.get("cooldown_seconds", 5.0)),
             ),
+            health=health,
         ))
     if not apps:
         raise ComponentError(f"run config {path} declares no apps")

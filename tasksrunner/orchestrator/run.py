@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import re
 import signal
 import sys
 import time
@@ -31,6 +32,11 @@ logger = logging.getLogger(__name__)
 
 RESTART_BACKOFF = [0.2, 0.5, 1.0, 2.0, 5.0]
 
+#: emitted by ``tasksrunner host`` once its servers are listening;
+#: parsed here so the orchestrator learns ephemeral replica ports
+_READY_RE = re.compile(
+    r"ready app=\S+ app_port=(\d+) sidecar_port=(\d+)")
+
 
 class Replica:
     def __init__(self, app: AppSpec, index: int, config: RunConfig):
@@ -39,8 +45,14 @@ class Replica:
         self.config = config
         self.proc: asyncio.subprocess.Process | None = None
         self._pump: asyncio.Task | None = None
+        self._prober: asyncio.Task | None = None
         self.restarts = 0
+        #: restarts forced by failed liveness probes (vs. crashes)
+        self.health_restarts = 0
         self.stopping = False
+        #: (app_port, sidecar_port) parsed from the host's ready line
+        self.ports: tuple[int, int] | None = None
+        self.ready = asyncio.Event()
 
     @property
     def tag(self) -> str:
@@ -63,6 +75,19 @@ class Replica:
         return cmd
 
     async def start(self) -> None:
+        # retire the previous incarnation's log pump first — a stale
+        # pump could deliver the old buffered ready line into the new
+        # incarnation's readiness state (wrong ports)
+        if self._pump is not None:
+            self._pump.cancel()
+            try:
+                await self._pump
+            except asyncio.CancelledError:
+                pass
+            self._pump = None
+        # fresh readiness state per incarnation (ports may change)
+        self.ports = None
+        self.ready = asyncio.Event()
         env = dict(os.environ)
         env.update(self.app.env)
         env["TASKSRUNNER_APP_ID"] = self.app.app_id
@@ -79,16 +104,76 @@ class Replica:
             cwd=self.config.base_dir,
         )
         self._pump = asyncio.create_task(self._pump_logs())
+        if self.app.health.enabled:
+            if self._prober is not None:
+                self._prober.cancel()
+            self._prober = asyncio.create_task(self._probe_liveness())
         logger.info("started replica %s (pid %d)", self.tag, self.proc.pid)
 
     async def _pump_logs(self) -> None:
         assert self.proc is not None and self.proc.stdout is not None
         async for line in self.proc.stdout:
-            print(f"[{self.tag}] {line.decode('utf-8', 'replace').rstrip()}",
-                  flush=True)
+            text = line.decode("utf-8", "replace").rstrip()
+            m = _READY_RE.search(text)
+            if m:
+                self.ports = (int(m.group(1)), int(m.group(2)))
+                self.ready.set()
+            print(f"[{self.tag}] {text}", flush=True)
+
+    async def _probe_liveness(self) -> None:
+        """GET the app's /healthz; kill the process after N consecutive
+        failures so supervise() restarts it (≙ ACA liveness probes +
+        restart-on-unhealthy, SURVEY.md §5.3)."""
+        import aiohttp
+
+        health = self.app.health
+        try:
+            await asyncio.wait_for(self.ready.wait(), timeout=60.0)
+        except asyncio.TimeoutError:
+            logger.warning("replica %s never reported ready; liveness "
+                           "probing disabled for this incarnation", self.tag)
+            return
+        app_port = self.ports[0]
+        probe_host = ("127.0.0.1" if self.app.host in ("", "0.0.0.0")
+                      else self.app.host)
+        url = f"http://{probe_host}:{app_port}/healthz"
+        failures = 0
+        await asyncio.sleep(health.initial_delay_seconds)
+        timeout = aiohttp.ClientTimeout(total=health.timeout_seconds)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            while not self.stopping:
+                try:
+                    async with session.get(url) as resp:
+                        healthy = resp.status < 500
+                except (OSError, asyncio.TimeoutError, aiohttp.ClientError):
+                    # ClientError covers aiohttp failures that are NOT
+                    # OSErrors (e.g. ServerDisconnectedError) — any of
+                    # them is a failed probe, never a dead prober
+                    healthy = False
+                if healthy:
+                    failures = 0
+                else:
+                    failures += 1
+                    logger.warning("liveness probe failed for %s (%d/%d)",
+                                   self.tag, failures, health.failure_threshold)
+                    if failures >= health.failure_threshold:
+                        logger.warning(
+                            "replica %s unhealthy — killing for restart", self.tag)
+                        self.health_restarts += 1
+                        if self.proc is not None and self.proc.returncode is None:
+                            self.proc.kill()
+                        return  # supervise() restarts us with a new prober
+                await asyncio.sleep(health.interval_seconds)
 
     async def stop(self) -> None:
         self.stopping = True
+        if self._prober is not None:
+            self._prober.cancel()
+            try:
+                await self._prober
+            except asyncio.CancelledError:
+                pass
+            self._prober = None
         if self.proc is not None and self.proc.returncode is None:
             self.proc.terminate()
             try:

@@ -417,7 +417,11 @@ class Runtime:
         deadline = asyncio.get_running_loop().time() + timeout
         while True:
             try:
-                status, _, _ = await self.app_channel.request("GET", "/healthz")
+                # the builtin, non-shadowable liveness path: an app's
+                # custom /healthz may report unhealthy until warm, which
+                # must not block the subscribe handshake
+                status, _, _ = await self.app_channel.request(
+                    "GET", "/tasksrunner/healthz")
                 if status < 500:
                     return
             except Exception:

@@ -231,3 +231,142 @@ async def test_replica_restart_on_crash(tmp_path):
     finally:
         del os.environ["PYTHONPATH"]
         await orch.stop()
+
+
+@pytest.mark.asyncio
+async def test_liveness_probe_restarts_unhealthy_replica(tmp_path):
+    """≙ ACA liveness probes: a replica whose /healthz starts failing
+    (process alive, app sick) is killed and restarted; the restarted
+    incarnation is healthy again."""
+    import aiohttp
+
+    from tasksrunner.orchestrator.config import HealthSpec, RunConfig
+    from tasksrunner.orchestrator.run import Orchestrator
+
+    pkg = tmp_path / "sickpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "sick.py").write_text(textwrap.dedent("""
+        from tasksrunner import App, Response
+
+        def make_app():
+            app = App("sickapp")
+            state = {"sick": False}
+
+            @app.post("/poison")
+            async def poison(req):
+                state["sick"] = True
+                return 200
+
+            @app.get("/healthz")
+            async def healthz(req):
+                return Response(status=503 if state["sick"] else 204)
+
+            return app
+    """))
+    config = RunConfig(
+        apps=[AppSpec(
+            app_id="sickapp", module="sickpkg.sick:make_app",
+            app_port=0, sidecar_port=0,
+            health=HealthSpec(interval_seconds=0.15, failure_threshold=2,
+                              initial_delay_seconds=0.1, timeout_seconds=1.0),
+        )],
+        registry_file=str(tmp_path / "apps.json"),
+        base_dir=tmp_path,
+    )
+    import os
+    os.environ["PYTHONPATH"] = f"{tmp_path}{os.pathsep}{REPO}"
+    orch = Orchestrator(config)
+    try:
+        await orch.start()
+        replica = orch.replicas["sickapp"][0]
+        await asyncio.wait_for(replica.ready.wait(), timeout=30)
+        app_port = replica.ports[0]
+
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"http://127.0.0.1:{app_port}/poison") as r:
+                assert r.status == 200
+
+        deadline = asyncio.get_running_loop().time() + 20
+        while replica.health_restarts < 1:
+            assert asyncio.get_running_loop().time() < deadline, \
+                "liveness probe never restarted the replica"
+            await asyncio.sleep(0.1)
+
+        # the new incarnation comes up healthy on (possibly) new ports
+        deadline = asyncio.get_running_loop().time() + 20
+        while True:
+            assert asyncio.get_running_loop().time() < deadline
+            if replica.ready.is_set() and replica.ports is not None:
+                try:
+                    async with aiohttp.ClientSession() as s:
+                        async with s.get(
+                            f"http://127.0.0.1:{replica.ports[0]}/healthz") as r:
+                            if r.status == 204:
+                                break
+                except OSError:
+                    pass
+            await asyncio.sleep(0.1)
+    finally:
+        del os.environ["PYTHONPATH"]
+        await orch.stop()
+
+
+def test_health_config_variants(tmp_path):
+    from tasksrunner.orchestrator.config import load_run_config
+
+    cfg = tmp_path / "run.yaml"
+    cfg.write_text(textwrap.dedent("""
+        apps:
+          - app_id: a
+            module: m:make_app
+            health: true
+          - app_id: b
+            module: m:make_app
+            health: false
+          - app_id: c
+            module: m:make_app
+            health:
+          - app_id: d
+            module: m:make_app
+            health:
+              interval_seconds: 0.5
+              failure_threshold: 7
+    """))
+    apps = {a.app_id: a for a in load_run_config(cfg).apps}
+    assert apps["a"].health.enabled and apps["a"].health.failure_threshold == 3
+    assert not apps["b"].health.enabled
+    assert apps["c"].health.enabled
+    assert apps["d"].health.interval_seconds == 0.5
+    assert apps["d"].health.failure_threshold == 7
+
+
+@pytest.mark.asyncio
+async def test_custom_unhealthy_healthz_does_not_block_startup():
+    """An app may report 503 on its own /healthz from the first moment
+    (not yet warm) — the sidecar's startup handshake must still finish,
+    because it checks liveness, not app health."""
+    from tasksrunner import App, InProcCluster, Response
+
+    app = App("coldstart")
+
+    @app.get("/healthz")
+    async def healthz(req):
+        return Response(status=503)
+
+    @app.get("/work")
+    async def work(req):
+        return {"ok": True}
+
+    cluster = InProcCluster([])
+    cluster.add_app(app)
+    await cluster.start()  # previously would hang/raise on the handshake
+    try:
+        resp = await cluster.client("coldstart").invoke_method(
+            "coldstart", "work", http_method="GET")
+        assert resp.status == 200
+        health = await cluster.client("coldstart").invoke_method(
+            "coldstart", "healthz", http_method="GET")
+        assert health.status == 503  # the custom route is really served
+    finally:
+        await cluster.stop()
