@@ -11,6 +11,9 @@ Commands mirror the reference's local workflow surface:
   snippets/dapr-run-backend-api.md:4-16)
 * ``tasksrunner run``     — multi-app orchestrator from a run config
   (≙ the VS Code compound launcher), with KEDA-style autoscaling
+* ``tasksrunner ps``      — live status of registered apps
+  (≙ ``dapr list`` / ``az containerapp replica list``,
+  docs/aca/09-aca-autoscale-keda/index.md:170-200)
 * ``tasksrunner components`` — validate/list a resources directory
   (≙ the sidecar's component loading report)
 """
@@ -249,6 +252,127 @@ def _cmd_traces(args) -> None:
                   f"{e['calls']:>5} calls  avg {e['avg_ms']} ms")
 
 
+def _cmd_ps(args) -> None:
+    """Live status of registered apps (≙ `dapr list` + `az containerapp
+    replica list`, docs/aca/09-aca-autoscale-keda/index.md:170-200):
+    reads the name-registry file, then probes each sidecar for health
+    and metadata."""
+    import json as json_mod
+    import os
+    import pathlib
+    import time
+
+    from tasksrunner.invoke.resolver import NameResolver
+    from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER
+
+    registry_path = pathlib.Path(args.registry_file)
+    if not registry_path.is_file():
+        raise SystemExit(f"no registry file at {registry_path} "
+                         "(is anything running? check run.yaml's registry_file)")
+    resolver = NameResolver(registry_file=registry_path)
+    app_ids = resolver.known_apps()
+    if not app_ids:
+        print("no apps registered")
+        return
+
+    async def probe_all():
+        import aiohttp
+
+        timeout = aiohttp.ClientTimeout(total=2.0)
+        headers = {}
+        token = os.environ.get(TOKEN_ENV)
+        if token:
+            headers[TOKEN_HEADER] = token
+
+        net_errors = (OSError, asyncio.TimeoutError, aiohttp.ClientError)
+
+        async def probe(s, app_id):
+            from tasksrunner.errors import AppNotFound
+
+            try:
+                addr = resolver.resolve(app_id)
+            except AppNotFound:
+                # unregistered between listing and probing — report it,
+                # don't abort the other rows
+                return {"app_id": app_id, "pid": None, "app_port": None,
+                        "sidecar_port": None, "host": None,
+                        "up_seconds": None, "health": "gone",
+                        "components": None, "subscriptions": None}
+            row = {
+                "app_id": app_id,
+                "pid": addr.pid,
+                "app_port": addr.app_port,
+                "sidecar_port": addr.sidecar_port,
+                "host": addr.host,
+                "up_seconds": (round(time.time() - addr.registered_at)
+                               if addr.registered_at else None),
+                "health": "down",
+                "components": None,
+                "subscriptions": None,
+            }
+            try:
+                async with s.get(f"{addr.base_url}/v1.0/healthz") as r:
+                    row["health"] = "ok" if r.status < 500 else "unhealthy"
+            except net_errors:
+                return row
+            # the sidecar's healthz is pure liveness; the app's own
+            # /healthz (possibly user-registered) is the real signal —
+            # same endpoint the orchestrator's liveness probe uses
+            if addr.app_port:
+                try:
+                    async with s.get(
+                        f"http://{addr.host}:{addr.app_port}/healthz") as r:
+                        if r.status >= 500:
+                            row["health"] = "unhealthy"
+                except net_errors:
+                    row["health"] = "app-down"
+            try:
+                async with s.get(f"{addr.base_url}/v1.0/metadata",
+                                 headers=headers) as r:
+                    if r.status == 200:
+                        meta = await r.json()
+                        row["components"] = len(meta.get("components") or [])
+                        row["subscriptions"] = len(
+                            meta.get("subscriptions") or [])
+                    elif r.status == 401:
+                        row["components"] = "auth"
+                        row["subscriptions"] = "auth"
+            except net_errors:
+                pass
+            return row
+
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            return await asyncio.gather(*(probe(session, a) for a in app_ids))
+
+    rows = asyncio.run(probe_all())
+    any_down = any(r["health"] in ("down", "app-down", "gone") for r in rows)
+    if args.json:
+        print(json_mod.dumps(rows, indent=2))
+        if any_down:
+            raise SystemExit(2)
+        return
+
+    def fmt_up(seconds):
+        if seconds is None:
+            return "-"
+        m, s = divmod(int(seconds), 60)
+        h, m = divmod(m, 60)
+        return f"{h}h{m:02d}m" if h else f"{m}m{s:02d}s"
+
+    width = max(6, max(len(r["app_id"]) for r in rows))
+    print(f"{'APP-ID':<{width}}  {'PID':>7}  {'APP':>5}  {'SIDECAR':>7}  "
+          f"{'HEALTH':<9}  {'COMPS':>5}  {'SUBS':>4}  UP")
+    for r in rows:
+        print(f"{r['app_id']:<{width}}  {r['pid'] or '-':>7}  "
+              f"{r['app_port'] or '-':>5}  {r['sidecar_port'] or '-':>7}  "
+              f"{r['health']:<9}  "
+              f"{'-' if r['components'] is None else r['components']:>5}  "
+              f"{'-' if r['subscriptions'] is None else r['subscriptions']:>4}  "
+              f"{fmt_up(r['up_seconds'])}")
+    if any_down:
+        raise SystemExit(2)
+
+
 def _cmd_components(args) -> None:
     from tasksrunner.component.loader import load_components
     from tasksrunner.component.registry import registered_types
@@ -333,6 +457,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--limit", type=int, default=20)
     p.set_defaults(fn=_cmd_traces)
 
+    p = sub.add_parser(
+        "ps", help="live status of registered apps (health, ports, components)")
+    p.add_argument("--registry-file", default=".tasksrunner/apps.json")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.set_defaults(fn=_cmd_ps)
+
     p = sub.add_parser("components", help="validate a components directory")
     p.add_argument("path")
     p.add_argument("--app-id", default=None,
@@ -351,6 +482,11 @@ def main(argv: list[str] | None = None) -> None:
         # user-facing errors (bad manifest path, unresolved secret...)
         # exit cleanly instead of dumping a traceback
         raise SystemExit(f"ERROR: {exc}") from exc
+    except BrokenPipeError:
+        # stdout consumer went away (e.g. `tasksrunner ps | head`)
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        raise SystemExit(0) from None
 
 
 if __name__ == "__main__":

@@ -100,3 +100,53 @@ async def test_frontend_direct_http_fallback(tmp_path, monkeypatch):
         monkeypatch.delenv("BACKENDAPICONFIG__BASEURLEXTERNALHTTP")
         await frontend_host.stop()
         await api_host.stop()
+
+
+@pytest.mark.asyncio
+async def test_ps_command(tmp_path):
+    """`tasksrunner ps` reports live apps from the registry (health,
+    ports, component counts) and flags dead registrations."""
+    import asyncio as aio
+    import json
+    import sys
+
+    registry = str(tmp_path / "apps.json")
+    app = App("psapp")
+
+    @app.get("/ping")
+    async def ping(req):
+        return {}
+
+    host = AppHost(app, specs=[parse_component(
+        {"componentType": "state.in-memory"}, default_name="statestore")],
+        registry_file=registry)
+    await host.start()
+    try:
+        proc = await aio.create_subprocess_exec(
+            sys.executable, "-m", "tasksrunner", "ps",
+            "--registry-file", registry, "--json",
+            stdout=aio.subprocess.PIPE, stderr=aio.subprocess.PIPE)
+        out, err = await proc.communicate()
+        assert proc.returncode == 0, err.decode()
+        rows = json.loads(out)
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["app_id"] == "psapp"
+        assert row["health"] == "ok"
+        assert row["components"] == 1
+        assert row["sidecar_port"] == host.sidecar_port
+    finally:
+        await host.stop()
+
+    # after the host is gone, re-register a dead address: ps exits 2
+    from tasksrunner import AppAddress, NameResolver
+    NameResolver(registry_file=registry).register(AppAddress(
+        app_id="psapp", host="127.0.0.1",
+        sidecar_port=host.sidecar_port, app_port=host.app_port))
+    proc = await aio.create_subprocess_exec(
+        sys.executable, "-m", "tasksrunner", "ps",
+        "--registry-file", registry, "--json",
+        stdout=aio.subprocess.PIPE, stderr=aio.subprocess.PIPE)
+    out, _ = await proc.communicate()
+    assert proc.returncode == 2
+    assert json.loads(out)[0]["health"] == "down"
