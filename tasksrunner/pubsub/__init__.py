@@ -1,5 +1,6 @@
 from tasksrunner.pubsub.base import Message, PubSubBroker, Subscription
 from tasksrunner.pubsub.memory import InMemoryBroker
+from tasksrunner.pubsub.redis import RedisStreamsBroker
 from tasksrunner.pubsub.sqlite import SqliteBroker
 
 __all__ = [
@@ -7,5 +8,6 @@ __all__ = [
     "PubSubBroker",
     "Subscription",
     "InMemoryBroker",
+    "RedisStreamsBroker",
     "SqliteBroker",
 ]

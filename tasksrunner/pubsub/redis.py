@@ -1,0 +1,230 @@
+"""Redis Streams pub/sub broker (``pubsub.redis``).
+
+Parity slot: components/dapr-pubsub-redis.yaml:1-12 — the unscoped
+local broker that stands in for Service Bus during dev (taught in
+docs/aca/05-aca-dapr-pubsubapi; Dapr's redis pub/sub is itself built
+on Streams + consumer groups). Contract honored, matching
+tasksrunner/pubsub/base.py:
+
+* one stream per topic; one consumer group per subscribing app-id
+  (≙ the Service Bus subscription named after the app,
+  bicep/modules/service-bus.bicep:55-57);
+* competing consumers: replicas share the group via XREADGROUP ``>``;
+* at-least-once: a nack leaves the entry in the group's pending list;
+  a reclaim loop XPENDINGs entries idle past ``redeliverInterval`` and
+  XCLAIMs them for another attempt, carrying the server-side delivery
+  count into ``Message.attempt``;
+* durable groups: ``ensure_group`` XGROUP-CREATEs at id 0 before any
+  consumer exists, so messages published while the app is down are
+  delivered on startup (docs/aca/05-aca-dapr-pubsubapi/index.md:27-29);
+* poison messages: past ``maxRetries`` attempts the entry is acked out
+  of the group and parked on ``<stream>:dead`` for inspection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import uuid
+from typing import Any
+
+from tasksrunner.component.registry import driver
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.errors import PubSubError
+from tasksrunner.pubsub.base import Handler, Message, PubSubBroker, Subscription
+from tasksrunner.redisproto import RedisClient, RedisReplyError, as_str
+
+logger = logging.getLogger(__name__)
+
+_STREAM_PREFIX = "tasksrunner:topic:"
+
+
+class RedisStreamsBroker(PubSubBroker):
+    def __init__(self, name: str, host: str, *,
+                 max_attempts: int = 3,
+                 redeliver_interval: float = 0.5,
+                 block_ms: int = 200,
+                 max_stream_len: int = 10_000):
+        super().__init__(name)
+        self.client = RedisClient(host)
+        self.max_attempts = max_attempts
+        self.redeliver_interval = redeliver_interval
+        self.block_ms = block_ms
+        #: approximate MAXLEN cap per stream — acked entries never
+        #: leave the stream otherwise, so an uncapped XADD grows until
+        #: the server's maxmemory (same reason Dapr's redis pubsub trims)
+        self.max_stream_len = max_stream_len
+        self._tasks: list[asyncio.Task] = []
+        self._closed = False
+
+    @staticmethod
+    def _stream(topic: str) -> str:
+        return _STREAM_PREFIX + topic
+
+    # -- PubSubBroker API
+
+    async def publish(self, topic: str, data: Any, *,
+                      metadata: dict[str, str] | None = None) -> str:
+        entry_id = await self.client.execute(
+            "XADD", self._stream(topic),
+            "MAXLEN", "~", self.max_stream_len, "*",
+            "data", json.dumps(data),
+            "metadata", json.dumps(metadata or {}))
+        return as_str(entry_id)
+
+    async def ensure_group(self, topic: str, group: str) -> None:
+        try:
+            await self.client.execute(
+                "XGROUP", "CREATE", self._stream(topic), group, "0", "MKSTREAM")
+        except RedisReplyError as exc:
+            if exc.code != "BUSYGROUP":
+                raise PubSubError(
+                    f"{self.name}: cannot create group {group!r} "
+                    f"on {topic!r}: {exc}") from exc
+
+    async def subscribe(self, topic: str, group: str, handler: Handler) -> Subscription:
+        if self._closed:
+            raise PubSubError(f"broker {self.name!r} is closed")
+        await self.ensure_group(topic, group)
+        consumer = uuid.uuid4().hex[:12]
+        read_task = asyncio.create_task(
+            self._read_loop(topic, group, consumer, handler),
+            name=f"redis-read:{topic}:{group}")
+        reclaim_task = asyncio.create_task(
+            self._reclaim_loop(topic, group, consumer, handler),
+            name=f"redis-reclaim:{topic}:{group}")
+        self._tasks += [read_task, reclaim_task]
+
+        async def cancel() -> None:
+            for task in (read_task, reclaim_task):
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
+                if task in self._tasks:
+                    self._tasks.remove(task)
+
+        return Subscription(topic=topic, group=group, _cancel=cancel)
+
+    async def aclose(self) -> None:
+        self._closed = True
+        for task in self._tasks:
+            task.cancel()
+        for task in self._tasks:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+        await self.client.aclose()
+
+    # -- delivery machinery
+
+    def _to_message(self, topic: str, entry_id: str, fields: list, *,
+                    attempt: int) -> Message:
+        kv = {as_str(fields[i]): as_str(fields[i + 1])
+              for i in range(0, len(fields) - 1, 2)}
+        return Message(
+            id=entry_id,
+            topic=topic,
+            data=json.loads(kv.get("data", "null")),
+            metadata=json.loads(kv.get("metadata", "{}")),
+            attempt=attempt,
+        )
+
+    async def _read_loop(self, topic: str, group: str, consumer: str,
+                         handler: Handler) -> None:
+        stream = self._stream(topic)
+        async with self.client.acquire() as conn:
+            while True:
+                try:
+                    reply = await conn.execute(
+                        "XREADGROUP", "GROUP", group, consumer,
+                        "COUNT", 16, "BLOCK", self.block_ms,
+                        "STREAMS", stream, ">")
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    logger.warning("broker %s read loop error: %s", self.name, exc)
+                    await asyncio.sleep(self.redeliver_interval)
+                    continue
+                if not reply:
+                    continue
+                for _, entries in reply:
+                    for raw_id, fields in entries:
+                        msg = self._to_message(
+                            topic, as_str(raw_id), fields, attempt=1)
+                        await self._deliver(stream, group, msg, handler)
+
+    async def _deliver(self, stream: str, group: str, msg: Message,
+                       handler: Handler) -> None:
+        try:
+            ok = await handler(msg)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            logger.warning("broker %s: handler raised on %s: %s",
+                           self.name, msg.id, exc)
+            ok = False
+        if ok:
+            await self.client.execute("XACK", stream, group, msg.id)
+        elif msg.attempt >= self.max_attempts:
+            logger.warning(
+                "broker %s: message %s on %s exhausted %d attempts; "
+                "parking on dead-letter", self.name, msg.id, msg.topic,
+                msg.attempt)
+            await self.client.execute(
+                "XADD", stream + ":dead",
+                "MAXLEN", "~", self.max_stream_len, "*",
+                "data", json.dumps(msg.data),
+                "metadata", json.dumps(msg.metadata),
+                "origin_id", msg.id, "group", group,
+                "attempts", str(msg.attempt))
+            await self.client.execute("XACK", stream, group, msg.id)
+        # else: stays pending for the reclaim loop
+
+    async def _reclaim_loop(self, topic: str, group: str, consumer: str,
+                            handler: Handler) -> None:
+        stream = self._stream(topic)
+        idle_ms = int(self.redeliver_interval * 1000)
+        while True:
+            await asyncio.sleep(self.redeliver_interval)
+            try:
+                rows = await self.client.execute(
+                    "XPENDING", stream, group, "IDLE", idle_ms, "-", "+", 32)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                logger.warning("broker %s reclaim error: %s", self.name, exc)
+                continue
+            for row in rows or []:
+                entry_id, delivery_count = as_str(row[0]), int(row[3])
+                claimed = await self.client.execute(
+                    "XCLAIM", stream, group, consumer, idle_ms, entry_id)
+                for raw_id, fields in claimed or []:
+                    # XCLAIM bumped the server-side counter by one
+                    msg = self._to_message(
+                        topic, as_str(raw_id), fields,
+                        attempt=delivery_count + 1)
+                    await self._deliver(stream, group, msg, handler)
+
+
+@driver("pubsub.redis", "pubsub.redis-streams")
+def _redis_pubsub(spec: ComponentSpec, metadata: dict[str, str]) -> PubSubBroker:
+    """The backend follows the YAML, reference-style: a component file
+    with ``redisHost`` (components/dapr-pubsub-redis.yaml:10-11) talks
+    RESP to that server; without one, the durable sqlite broker stands
+    in so local dev needs no Redis at all."""
+    host = metadata.get("redisHost")
+    if not host:
+        from tasksrunner.pubsub.sqlite import _sqlite_pubsub
+        return _sqlite_pubsub(spec, metadata)
+    return RedisStreamsBroker(
+        spec.name, host,
+        max_attempts=int(metadata.get("maxRetries", 3)),
+        redeliver_interval=float(metadata.get("redeliverIntervalSeconds", 0.5)),
+        block_ms=int(metadata.get("blockMilliseconds", 200)),
+        max_stream_len=int(metadata.get("maxLenApprox", 10_000)),
+    )

@@ -350,11 +350,12 @@ class SqliteBroker(PubSubBroker):
         self._conn.close()
 
 
-@driver("pubsub.sqlite", "pubsub.azure.servicebus", "pubsub.redis")
+@driver("pubsub.sqlite", "pubsub.azure.servicebus")
 def _sqlite_pubsub(spec: ComponentSpec, metadata: dict[str, str]) -> SqliteBroker:
-    """Durable local broker; cloud/redis-typed component files (the
-    reference's dapr-pubsub-svcbus.yaml / dapr-pubsub-redis.yaml shapes)
-    run unchanged against it. `brokerPath` picks the shared db file."""
+    """Durable local broker; cloud-typed component files (the
+    reference's dapr-pubsub-svcbus.yaml shape) run unchanged against
+    it. `brokerPath` picks the shared db file. ``pubsub.redis`` files
+    land here too when they carry no redisHost (see pubsub/redis.py)."""
     return SqliteBroker(
         spec.name,
         metadata.get("brokerPath", ".tasksrunner/pubsub-" + spec.name + ".db"),

@@ -1,0 +1,24 @@
+"""Minimal asyncio RESP2 (Redis serialization protocol) client.
+
+Why this exists: the reference's local-dev story is "Redis stands in
+for the cloud backends" (`dapr init` starts a Redis container;
+components/dapr-pubsub-redis.yaml:1-12 points the pub/sub block at it,
+docs/aca/04-aca-dapr-stateapi/index.md:29-33). To honor that parity
+slot with a *real wire protocol* — not just a type alias onto the
+sqlite engines — the framework speaks RESP itself. No third-party
+redis package is required (none is installed in this image); the
+protocol is simple enough that a ~200-line client is the honest
+dependency-free implementation.
+
+Used by: tasksrunner/state/redis.py (state.redis driver),
+tasksrunner/pubsub/redis.py (pubsub.redis streams broker), and the
+hermetic test server tasksrunner/testing/redislite.py.
+"""
+
+from tasksrunner.redisproto.client import (  # noqa: F401
+    RedisClient,
+    RedisConnection,
+    RedisProtocolError,
+    RedisReplyError,
+    as_str,
+)

@@ -1,0 +1,212 @@
+"""RESP2 wire client: encode command arrays, parse reply trees.
+
+Reply mapping: simple string → ``str``; error → raised
+``RedisReplyError``; integer → ``int``; bulk string → ``bytes`` (or
+``None`` for the null bulk); array → ``list`` (or ``None`` for the
+null array, e.g. a WATCH-aborted ``EXEC``).
+
+Concurrency model: a ``RedisConnection`` is a single socket and must
+not be shared by interleaving tasks. ``RedisClient`` pools
+connections — ``execute()`` grabs a free one per command;
+``acquire()`` checks one out for multi-command sequences that need
+connection affinity (WATCH/MULTI/EXEC transactions, blocking
+XREADGROUP consumer loops).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+from typing import Any
+
+from tasksrunner.errors import TasksRunnerError
+
+
+class RedisProtocolError(TasksRunnerError):
+    """Malformed RESP frame or connection failure."""
+
+
+class RedisReplyError(TasksRunnerError):
+    """The server answered with a ``-ERR``-class reply."""
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.code = message.split(" ", 1)[0] if message else ""
+
+
+def as_str(value: Any) -> str:
+    """Bulk strings arrive as bytes; normalize for comparisons."""
+    if isinstance(value, bytes):
+        return value.decode("utf-8", "replace")
+    return str(value)
+
+
+def encode_command(*parts: Any) -> bytes:
+    """RESP array of bulk strings: ``*N\\r\\n$len\\r\\n<part>\\r\\n...``"""
+    out = [b"*%d\r\n" % len(parts)]
+    for part in parts:
+        if isinstance(part, bytes):
+            raw = part
+        elif isinstance(part, str):
+            raw = part.encode()
+        elif isinstance(part, bool):  # before int: bool is an int subtype
+            raw = b"1" if part else b"0"
+        elif isinstance(part, (int, float)):
+            raw = repr(part).encode()
+        else:
+            raise TypeError(f"cannot send {type(part).__name__} as a command part")
+        out.append(b"$%d\r\n%s\r\n" % (len(raw), raw))
+    return b"".join(out)
+
+
+async def read_reply(reader: asyncio.StreamReader) -> Any:
+    line = await reader.readline()
+    if not line:
+        raise RedisProtocolError("connection closed mid-reply")
+    if not line.endswith(b"\r\n"):
+        raise RedisProtocolError(f"unterminated reply line: {line!r}")
+    kind, payload = line[:1], line[1:-2]
+    if kind == b"+":
+        return payload.decode()
+    if kind == b"-":
+        raise RedisReplyError(payload.decode())
+    if kind == b":":
+        return int(payload)
+    if kind == b"$":
+        length = int(payload)
+        if length == -1:
+            return None
+        body = await reader.readexactly(length + 2)
+        return body[:-2]
+    if kind == b"*":
+        count = int(payload)
+        if count == -1:
+            return None
+        return [await read_reply(reader) for _ in range(count)]
+    raise RedisProtocolError(f"unknown reply type {kind!r}")
+
+
+class RedisConnection:
+    """One socket. Owns request/reply framing, nothing else."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+
+    @property
+    def connected(self) -> bool:
+        return self._writer is not None and not self._writer.is_closing()
+
+    async def connect(self) -> None:
+        try:
+            self._reader, self._writer = await asyncio.open_connection(
+                self.host, self.port)
+        except OSError as exc:
+            raise RedisProtocolError(
+                f"cannot reach redis at {self.host}:{self.port}: {exc}") from exc
+
+    async def execute(self, *parts: Any) -> Any:
+        if not self.connected:
+            await self.connect()
+        assert self._reader is not None and self._writer is not None
+        self._writer.write(encode_command(*parts))
+        await self._writer.drain()
+        return await read_reply(self._reader)
+
+    async def aclose(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            with contextlib.suppress(Exception):
+                await self._writer.wait_closed()
+            self._writer = None
+            self._reader = None
+
+
+class RedisClient:
+    """Connection pool over ``RedisConnection``.
+
+    ``host`` accepts the reference's component-metadata shape
+    ``"localhost:6379"`` (components/dapr-pubsub-redis.yaml `redisHost`)
+    or a bare hostname plus an explicit ``port``.
+    """
+
+    def __init__(self, host: str = "localhost", port: int = 6379, *,
+                 max_connections: int = 16):
+        if ":" in host:
+            host, _, port_s = host.rpartition(":")
+            port = int(port_s)
+        self.host = host
+        self.port = port
+        self._free: list[RedisConnection] = []
+        self._sem = asyncio.Semaphore(max_connections)
+        self._all: list[RedisConnection] = []
+        self._closed = False
+
+    async def _checkout(self) -> RedisConnection:
+        if self._closed:
+            raise RedisProtocolError("client closed")
+        await self._sem.acquire()
+        while self._free:
+            conn = self._free.pop()
+            if conn.connected:
+                return conn
+        conn = RedisConnection(self.host, self.port)
+        try:
+            await conn.connect()
+        except Exception:
+            self._sem.release()
+            raise
+        self._all.append(conn)
+        return conn
+
+    def _checkin(self, conn: RedisConnection, *, broken: bool = False) -> None:
+        if broken or self._closed or not conn.connected:
+            asyncio.get_running_loop().create_task(conn.aclose())
+            if conn in self._all:
+                self._all.remove(conn)
+        else:
+            self._free.append(conn)
+        self._sem.release()
+
+    async def execute(self, *parts: Any) -> Any:
+        conn = await self._checkout()
+        # Any non-protocol failure — including cancellation while a
+        # reply is in flight (BLOCK'd XREADGROUP being torn down) —
+        # must retire the socket: an unread reply would desync RESP
+        # framing for the next borrower.
+        broken = True
+        try:
+            reply = await conn.execute(*parts)
+            broken = False
+            return reply
+        except RedisReplyError:
+            broken = False  # server replied; the stream is in sync
+            raise
+        finally:
+            self._checkin(conn, broken=broken)
+
+    @contextlib.asynccontextmanager
+    async def acquire(self):
+        """Dedicated connection for WATCH/MULTI/EXEC or blocking reads."""
+        conn = await self._checkout()
+        broken = True
+        try:
+            yield conn
+            broken = False
+        except RedisReplyError:
+            broken = False
+            raise
+        finally:
+            self._checkin(conn, broken=broken)
+
+    async def ping(self) -> bool:
+        return await self.execute("PING") == "PONG"
+
+    async def aclose(self) -> None:
+        self._closed = True
+        for conn in list(self._all):
+            await conn.aclose()
+        self._all.clear()
+        self._free.clear()

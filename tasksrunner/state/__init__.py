@@ -1,6 +1,7 @@
 from tasksrunner.state.base import StateItem, StateStore, TransactionOp
 from tasksrunner.state.keyprefix import KeyPrefixer
 from tasksrunner.state.memory import InMemoryStateStore
+from tasksrunner.state.redis import RedisStateStore
 from tasksrunner.state.sqlite import SqliteStateStore
 
 __all__ = [
@@ -9,5 +10,6 @@ __all__ = [
     "TransactionOp",
     "KeyPrefixer",
     "InMemoryStateStore",
+    "RedisStateStore",
     "SqliteStateStore",
 ]

@@ -1,0 +1,151 @@
+"""Redis-backed state store (``state.redis``).
+
+Parity slot: the reference's default local state store is the Redis
+container that ``dapr init`` installs (docs/aca/04-aca-dapr-stateapi/
+index.md:29-33); module 4 swaps it for Cosmos by editing component
+YAML only. This driver fills the same slot over a real RESP socket.
+
+Two behaviors the reference pins down:
+
+* **plain Redis cannot serve the filter-query dialect** — the workshop
+  calls this out explicitly (docs/aca/04-aca-dapr-stateapi/
+  index.md:166-168: querying "requires Cosmos DB"); so
+  ``supports_query = False`` here and ``query()`` raises, exactly the
+  failure a user of the reference would hit.
+* **etag concurrency**: each document carries an etag; compare-and-set
+  runs as WATCH/MULTI/EXEC so a concurrent writer aborts the EXEC and
+  the mismatch is detected, never lost (fixes the read-modify-write
+  window SURVEY.md §5.2 notes in TasksStoreManager.cs:84-101).
+
+Document layout: one Redis string per key holding
+``{"v": <value>, "etag": "<n>"}``.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+from typing import Any
+
+from tasksrunner.component.registry import driver
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.errors import EtagMismatch, QueryError, StateError
+from tasksrunner.redisproto import RedisClient, as_str
+from tasksrunner.state.base import QueryResponse, StateItem, StateStore
+
+
+def _new_etag() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class RedisStateStore(StateStore):
+    supports_query = False
+
+    def __init__(self, name: str, host: str):
+        super().__init__(name)
+        self.client = RedisClient(host)
+
+    # -- helpers
+
+    @staticmethod
+    def _decode(raw: bytes | None, key: str) -> StateItem | None:
+        if raw is None:
+            return None
+        doc = json.loads(raw)
+        return StateItem(key=key, value=doc["v"], etag=doc["etag"])
+
+    @staticmethod
+    def _encode(value: Any, etag: str) -> str:
+        return json.dumps({"v": value, "etag": etag}, separators=(",", ":"))
+
+    # -- StateStore API
+
+    async def get(self, key: str) -> StateItem | None:
+        return self._decode(await self.client.execute("GET", key), key)
+
+    async def set(self, key: str, value: Any, *, etag: str | None = None) -> str:
+        new_etag = _new_etag()
+        if etag is None:
+            await self.client.execute("SET", key, self._encode(value, new_etag))
+            return new_etag
+        # CAS: WATCH the key so a concurrent write voids the EXEC
+        while True:
+            async with self.client.acquire() as conn:
+                await conn.execute("WATCH", key)
+                current = self._decode(await conn.execute("GET", key), key)
+                if current is None or current.etag != etag:
+                    await conn.execute("UNWATCH")
+                    raise EtagMismatch(
+                        f"{self.name}: etag mismatch on {key!r}")
+                await conn.execute("MULTI")
+                await conn.execute("SET", key, self._encode(value, new_etag))
+                if await conn.execute("EXEC") is not None:
+                    return new_etag
+            # EXEC aborted → someone wrote between WATCH and EXEC; re-read
+
+    async def delete(self, key: str, *, etag: str | None = None) -> bool:
+        if etag is None:
+            return await self.client.execute("DEL", key) > 0
+        while True:
+            async with self.client.acquire() as conn:
+                await conn.execute("WATCH", key)
+                current = self._decode(await conn.execute("GET", key), key)
+                if current is None:
+                    await conn.execute("UNWATCH")
+                    return False
+                if current.etag != etag:
+                    await conn.execute("UNWATCH")
+                    raise EtagMismatch(
+                        f"{self.name}: etag mismatch on {key!r}")
+                await conn.execute("MULTI")
+                await conn.execute("DEL", key)
+                if await conn.execute("EXEC") is not None:
+                    return True
+
+    async def bulk_get(self, keys: list[str]) -> list[StateItem | None]:
+        if not keys:
+            return []
+        raws = await self.client.execute("MGET", *keys)
+        return [self._decode(raw, key) for key, raw in zip(keys, raws)]
+
+    async def query(self, query: dict, *, key_prefix: str = "") -> QueryResponse:
+        raise QueryError(
+            f"state store {self.name!r} (state.redis) does not support the "
+            "filter-query dialect; use a query-capable store "
+            "(state.sqlite / state.azure.cosmosdb) — the reference "
+            "documents the same limitation for plain Redis "
+            "(docs/aca/04-aca-dapr-stateapi/index.md:166-168)")
+
+    async def keys(self, *, prefix: str = "") -> list[str]:
+        # escape every MATCH metacharacter so the prefix is literal
+        literal = (prefix.replace("\\", "\\\\").replace("*", "\\*")
+                   .replace("?", "\\?").replace("[", "\\["))
+        pattern = literal + "*" if prefix else "*"
+        cursor, out = "0", []
+        while True:
+            reply = await self.client.execute(
+                "SCAN", cursor, "MATCH", pattern, "COUNT", 512)
+            cursor = as_str(reply[0])
+            out.extend(as_str(k) for k in reply[1])
+            if cursor == "0":
+                break
+        return sorted(out)
+
+    def close(self) -> None:
+        # pool sockets are torn down by GC/loop close; async close is
+        # available for callers holding a loop
+        pass
+
+    async def aclose(self) -> None:
+        await self.client.aclose()
+
+
+@driver("state.redis")
+def _redis_state(spec: ComponentSpec, metadata: dict[str, str]) -> RedisStateStore:
+    """`redisHost` metadata (the reference's component shape,
+    components/dapr-pubsub-redis.yaml:10-11) names the server."""
+    host = metadata.get("redisHost")
+    if not host:
+        raise StateError(
+            f"component {spec.name!r}: state.redis requires redisHost metadata")
+    return RedisStateStore(spec.name, host)

@@ -1,0 +1,13 @@
+"""Test doubles shipped with the framework.
+
+The reference's QA story leans on *local-parity backends instead of
+mocks* — `dapr init` drops a real Redis container next to the apps
+(docs/aca/04-aca-dapr-stateapi/index.md:29-33) so the same component
+YAML runs against a live wire protocol in dev. This image has no Redis
+server, so the framework ships ``redislite``: a hermetic in-process
+RESP2 server implementing the command subset the redis drivers speak.
+Tests (and users without a Redis) get real-socket coverage of the
+redis backends; against a genuine Redis the same drivers run unchanged.
+"""
+
+from tasksrunner.testing.redislite import RedisLiteServer  # noqa: F401

@@ -1,0 +1,570 @@
+"""RedisLite: an in-process asyncio RESP2 server (test double).
+
+Implements exactly the command subset the framework's redis drivers
+use — strings (SET/GET/DEL/MGET/SCAN), optimistic transactions
+(WATCH/MULTI/EXEC/DISCARD), and streams with consumer groups
+(XADD/XGROUP/XREADGROUP/XACK/XPENDING/XCLAIM/XRANGE/XLEN) — with
+real Redis semantics for the parts the drivers' correctness depends
+on: WATCH aborting EXEC after a concurrent write, '>' delivery
+advancing the group cursor, per-entry pending lists with delivery
+counts, claim-on-idle redelivery.
+
+This is a TEST DOUBLE, not a database: single-process, in-memory,
+no persistence, no AUTH/SELECT/cluster. It exists so the redis
+state/pubsub drivers are exercised over a real TCP socket in this
+image (no redis-server installed); see tasksrunner/testing/__init__.py
+for the parity rationale.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+CRLF = b"\r\n"
+
+
+# ---------------------------------------------------------------- replies
+
+def _simple(s: str) -> bytes:
+    return b"+" + s.encode() + CRLF
+
+
+def _error(s: str) -> bytes:
+    return b"-" + s.encode() + CRLF
+
+
+def _int(n: int) -> bytes:
+    return b":%d" % n + CRLF
+
+
+def _bulk(v: bytes | str | None) -> bytes:
+    if v is None:
+        return b"$-1" + CRLF
+    if isinstance(v, str):
+        v = v.encode()
+    return b"$%d" % len(v) + CRLF + v + CRLF
+
+
+def _array(items: list | None) -> bytes:
+    if items is None:
+        return b"*-1" + CRLF
+    out = [b"*%d" % len(items) + CRLF]
+    for item in items:
+        if isinstance(item, (bytes, str)):
+            out.append(_bulk(item))
+        elif isinstance(item, int):
+            out.append(_int(item))
+        elif isinstance(item, list):
+            out.append(_array(item))
+        elif item is None:
+            out.append(_bulk(None))
+        else:
+            raise TypeError(f"cannot encode {item!r}")
+    return b"".join(out)
+
+
+def _glob_match(pattern: str, value: str) -> bool:
+    """Redis MATCH globbing: ``*``, ``?``, ``[...]``, and ``\\x``
+    escaping a metacharacter to a literal (fnmatch has no escapes, so
+    drivers that escape prefixes would diverge from a live server)."""
+    out, i = [], 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == "\\" and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "*":
+            out.append(".*")
+        elif ch == "?":
+            out.append(".")
+        elif ch == "[":
+            j = pattern.find("]", i + 1)
+            if j == -1:
+                out.append(re.escape(ch))
+            else:
+                out.append(pattern[i:j + 1])
+                i = j
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return re.fullmatch("".join(out), value) is not None
+
+
+# ---------------------------------------------------------------- streams
+
+def _parse_id(raw: bytes, *, default_seq: int = 0) -> tuple[int, int]:
+    s = raw.decode()
+    if "-" in s:
+        ms, seq = s.split("-", 1)
+        return int(ms), int(seq)
+    return int(s), default_seq
+
+
+def _fmt_id(ms: int, seq: int) -> bytes:
+    return b"%d-%d" % (ms, seq)
+
+
+@dataclass
+class PendingEntry:
+    consumer: bytes
+    delivered_at: float
+    delivery_count: int = 1
+
+
+@dataclass
+class Group:
+    #: id of the last entry handed out via '>' reads
+    last_delivered: tuple[int, int] = (0, 0)
+    #: entry-id → pending bookkeeping (the PEL)
+    pending: dict[bytes, PendingEntry] = field(default_factory=dict)
+
+
+@dataclass
+class Stream:
+    entries: list[tuple[bytes, list[bytes]]] = field(default_factory=list)
+    last_id: tuple[int, int] = (0, 0)
+    groups: dict[bytes, Group] = field(default_factory=dict)
+    #: wakes blocked XREADGROUP waiters on append
+    appended: asyncio.Event = field(default_factory=asyncio.Event)
+
+    def entry(self, entry_id: bytes) -> list[bytes] | None:
+        for eid, fields in self.entries:
+            if eid == entry_id:
+                return fields
+        return None
+
+
+# ---------------------------------------------------------------- server
+
+class _ConnState:
+    def __init__(self) -> None:
+        self.watched: dict[bytes, int] = {}
+        self.multi: list[list[bytes]] | None = None
+
+
+class RedisLiteServer:
+    """``async with RedisLiteServer() as srv: ... srv.port``"""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+        self.strings: dict[bytes, bytes] = {}
+        self.streams: dict[bytes, Stream] = {}
+        #: key → version counter, drives WATCH invalidation
+        self._versions: dict[bytes, int] = {}
+        self._version_ctr = itertools.count(1)
+        self._id_clock = 0
+
+    # -- lifecycle
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._serve_connection, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def aclose(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def __aenter__(self) -> "RedisLiteServer":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.aclose()
+
+    # -- wire handling
+
+    async def _serve_connection(self, reader: asyncio.StreamReader,
+                                writer: asyncio.StreamWriter) -> None:
+        state = _ConnState()
+        try:
+            while True:
+                try:
+                    parts = await self._read_command(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    break
+                if parts is None:
+                    break
+                reply = await self._dispatch(parts, state)
+                if reply is _CLOSE:
+                    writer.write(_simple("OK"))
+                    break
+                writer.write(reply)
+                await writer.drain()
+        finally:
+            writer.close()
+
+    async def _read_command(self, reader: asyncio.StreamReader) -> list[bytes] | None:
+        line = await reader.readline()
+        if not line:
+            return None
+        if not line.startswith(b"*"):
+            # inline commands (redis-cli convenience) — not needed
+            return [line.strip()]
+        count = int(line[1:].strip())
+        parts: list[bytes] = []
+        for _ in range(count):
+            header = await reader.readline()
+            length = int(header[1:].strip())
+            body = await reader.readexactly(length + 2)
+            parts.append(body[:-2])
+        return parts
+
+    # -- bookkeeping
+
+    def _touch(self, key: bytes) -> None:
+        self._versions[key] = next(self._version_ctr)
+
+    def _version(self, key: bytes) -> int:
+        return self._versions.get(key, 0)
+
+    def _next_stream_id(self, stream: Stream) -> tuple[int, int]:
+        now_ms = int(time.time() * 1000)
+        self._id_clock = max(self._id_clock, now_ms)
+        ms, seq = stream.last_id
+        if self._id_clock > ms:
+            return (self._id_clock, 0)
+        return (ms, seq + 1)
+
+    # -- dispatch
+
+    async def _dispatch(self, parts: list[bytes], state: _ConnState) -> bytes:
+        cmd = parts[0].upper().decode()
+        args = parts[1:]
+
+        if cmd == "QUIT":
+            return _CLOSE
+        if cmd == "MULTI":
+            if state.multi is not None:
+                return _error("ERR MULTI calls can not be nested")
+            state.multi = []
+            return _simple("OK")
+        if cmd == "DISCARD":
+            state.multi = None
+            state.watched.clear()
+            return _simple("OK")
+        if cmd == "EXEC":
+            if state.multi is None:
+                return _error("ERR EXEC without MULTI")
+            queued, state.multi = state.multi, None
+            aborted = any(
+                self._version(k) != v for k, v in state.watched.items())
+            state.watched.clear()
+            if aborted:
+                return _array(None)
+            replies = []
+            for q in queued:
+                replies.append(await self._run(q[0].upper().decode(), q[1:], state))
+            return b"*%d" % len(replies) + CRLF + b"".join(replies)
+        if state.multi is not None:
+            # blocking commands may not be queued in this double
+            if cmd in ("XREADGROUP",):
+                return _error("ERR XREADGROUP inside MULTI is not supported")
+            state.multi.append(parts)
+            return _simple("QUEUED")
+        if cmd == "WATCH":
+            for key in args:
+                state.watched[key] = self._version(key)
+            return _simple("OK")
+        if cmd == "UNWATCH":
+            state.watched.clear()
+            return _simple("OK")
+        return await self._run(cmd, args, state)
+
+    async def _run(self, cmd: str, args: list[bytes], state: _ConnState) -> bytes:
+        handler = getattr(self, "_cmd_" + cmd.lower(), None)
+        if handler is None:
+            return _error(f"ERR unknown command '{cmd}'")
+        try:
+            return await handler(args)
+        except RedisLiteBadArgs as exc:
+            return _error(f"ERR {exc}")
+
+    # -- string commands
+
+    async def _cmd_ping(self, args: list[bytes]) -> bytes:
+        return _simple(args[0].decode()) if args else _simple("PONG")
+
+    async def _cmd_flushall(self, args: list[bytes]) -> bytes:
+        self.strings.clear()
+        self.streams.clear()
+        for key in list(self._versions):
+            self._touch(key)
+        return _simple("OK")
+
+    async def _cmd_set(self, args: list[bytes]) -> bytes:
+        if len(args) < 2:
+            raise RedisLiteBadArgs("wrong number of arguments for 'set'")
+        self.strings[args[0]] = args[1]
+        self._touch(args[0])
+        return _simple("OK")
+
+    async def _cmd_get(self, args: list[bytes]) -> bytes:
+        return _bulk(self.strings.get(args[0]))
+
+    async def _cmd_del(self, args: list[bytes]) -> bytes:
+        n = 0
+        for key in args:
+            if key in self.strings:
+                del self.strings[key]
+                n += 1
+            elif key in self.streams:
+                del self.streams[key]
+                n += 1
+            self._touch(key)
+        return _int(n)
+
+    async def _cmd_exists(self, args: list[bytes]) -> bytes:
+        return _int(sum(1 for k in args if k in self.strings or k in self.streams))
+
+    async def _cmd_mget(self, args: list[bytes]) -> bytes:
+        return _array([self.strings.get(k) for k in args])
+
+    async def _cmd_keys(self, args: list[bytes]) -> bytes:
+        pat = args[0].decode() if args else "*"
+        keys = sorted(k for k in (set(self.strings) | set(self.streams))
+                      if _glob_match(pat, k.decode()))
+        return _array(list(keys))
+
+    async def _cmd_scan(self, args: list[bytes]) -> bytes:
+        # single-shot scan: always returns cursor 0 with the full match set
+        pat = "*"
+        for i in range(1, len(args) - 1):
+            if args[i].upper() == b"MATCH":
+                pat = args[i + 1].decode()
+        keys = sorted(k for k in (set(self.strings) | set(self.streams))
+                      if _glob_match(pat, k.decode()))
+        return b"*2" + CRLF + _bulk(b"0") + _array(list(keys))
+
+    async def _cmd_type(self, args: list[bytes]) -> bytes:
+        key = args[0]
+        if key in self.strings:
+            return _simple("string")
+        if key in self.streams:
+            return _simple("stream")
+        return _simple("none")
+
+    # -- stream commands
+
+    async def _cmd_xadd(self, args: list[bytes]) -> bytes:
+        key, rest = args[0], args[1:]
+        maxlen = None
+        if rest and rest[0].upper() == b"MAXLEN":
+            rest = rest[1:]
+            if rest and rest[0] in (b"~", b"="):
+                rest = rest[1:]
+            if not rest:
+                raise RedisLiteBadArgs("MAXLEN needs a count")
+            maxlen = int(rest[0])
+            rest = rest[1:]
+        if len(rest) < 3 or len(rest) % 2 != 1:
+            raise RedisLiteBadArgs("wrong number of arguments for 'xadd'")
+        raw_id, fields = rest[0], rest[1:]
+        stream = self.streams.setdefault(key, Stream())
+        if raw_id == b"*":
+            entry_id = self._next_stream_id(stream)
+        else:
+            entry_id = _parse_id(raw_id)
+            if entry_id <= stream.last_id:
+                return _error(
+                    "ERR The ID specified in XADD is equal or smaller than "
+                    "the target stream top item")
+        stream.last_id = entry_id
+        eid = _fmt_id(*entry_id)
+        stream.entries.append((eid, list(fields)))
+        if maxlen is not None and len(stream.entries) > maxlen:
+            stream.entries = stream.entries[-maxlen:]
+        self._touch(key)
+        stream.appended.set()
+        stream.appended = asyncio.Event()  # fresh event for next waiters
+        return _bulk(eid)
+
+    async def _cmd_xlen(self, args: list[bytes]) -> bytes:
+        stream = self.streams.get(args[0])
+        return _int(len(stream.entries) if stream else 0)
+
+    async def _cmd_xrange(self, args: list[bytes]) -> bytes:
+        stream = self.streams.get(args[0])
+        if stream is None:
+            return _array([])
+        lo = (0, 0) if args[1] == b"-" else _parse_id(args[1])
+        hi = (2**62, 2**62) if args[2] == b"+" else _parse_id(args[2], default_seq=2**62)
+        count = None
+        if len(args) >= 5 and args[3].upper() == b"COUNT":
+            count = int(args[4])
+        out = []
+        for eid, fields in stream.entries:
+            if lo <= _parse_id(eid) <= hi:
+                out.append(b"*2" + CRLF + _bulk(eid) + _array(list(fields)))
+                if count is not None and len(out) >= count:
+                    break
+        return b"*%d" % len(out) + CRLF + b"".join(out)
+
+    async def _cmd_xgroup(self, args: list[bytes]) -> bytes:
+        sub = args[0].upper()
+        if sub != b"CREATE":
+            raise RedisLiteBadArgs(f"unsupported XGROUP subcommand {sub!r}")
+        key, group, start = args[1], args[2], args[3]
+        mkstream = any(a.upper() == b"MKSTREAM" for a in args[4:])
+        stream = self.streams.get(key)
+        if stream is None:
+            if not mkstream:
+                return _error(
+                    "ERR The XGROUP subcommand requires the key to exist. "
+                    "Note that for CREATE you may want to use the MKSTREAM "
+                    "option to create an empty stream automatically.")
+            stream = self.streams.setdefault(key, Stream())
+        if group in stream.groups:
+            return _error("BUSYGROUP Consumer Group name already exists")
+        if start == b"$":
+            last = stream.last_id
+        elif start == b"0":
+            last = (0, 0)
+        else:
+            last = _parse_id(start)
+        stream.groups[group] = Group(last_delivered=last)
+        return _simple("OK")
+
+    async def _cmd_xreadgroup(self, args: list[bytes]) -> bytes:
+        # XREADGROUP GROUP g consumer [COUNT n] [BLOCK ms] STREAMS key id
+        if args[0].upper() != b"GROUP":
+            raise RedisLiteBadArgs("expected GROUP")
+        group_name, consumer = args[1], args[2]
+        count, block_ms = 16, None
+        i = 3
+        while i < len(args) and args[i].upper() != b"STREAMS":
+            opt = args[i].upper()
+            if opt == b"COUNT":
+                count = int(args[i + 1]); i += 2
+            elif opt == b"BLOCK":
+                block_ms = int(args[i + 1]); i += 2
+            elif opt == b"NOACK":
+                i += 1
+            else:
+                raise RedisLiteBadArgs(f"unknown XREADGROUP option {opt!r}")
+        key, read_id = args[i + 1], args[i + 2]
+        if read_id != b">":
+            raise RedisLiteBadArgs("this double only supports the '>' id")
+        deadline = None if block_ms is None else (
+            asyncio.get_running_loop().time() + block_ms / 1000.0)
+        while True:
+            stream = self.streams.get(key)
+            group = stream.groups.get(group_name) if stream else None
+            if group is None:
+                return _error(
+                    f"NOGROUP No such consumer group '{group_name.decode()}' "
+                    f"for key name '{key.decode()}'")
+            fresh = [(eid, fields) for eid, fields in stream.entries
+                     if _parse_id(eid) > group.last_delivered][:count]
+            if fresh:
+                now = time.monotonic()
+                for eid, _ in fresh:
+                    group.last_delivered = max(
+                        group.last_delivered, _parse_id(eid))
+                    group.pending[eid] = PendingEntry(consumer, now)
+                entries = b"".join(
+                    b"*2" + CRLF + _bulk(eid) + _array(list(fields))
+                    for eid, fields in fresh)
+                inner = b"*2" + CRLF + _bulk(key) + \
+                    b"*%d" % len(fresh) + CRLF + entries
+                return b"*1" + CRLF + inner
+            if deadline is None:
+                return _array(None)
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                return _array(None)
+            event = stream.appended
+            try:
+                await asyncio.wait_for(event.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                return _array(None)
+
+    async def _cmd_xack(self, args: list[bytes]) -> bytes:
+        stream = self.streams.get(args[0])
+        if stream is None:
+            return _int(0)
+        group = stream.groups.get(args[1])
+        if group is None:
+            return _int(0)
+        n = 0
+        for eid in args[2:]:
+            if eid in group.pending:
+                del group.pending[eid]
+                n += 1
+        return _int(n)
+
+    async def _cmd_xpending(self, args: list[bytes]) -> bytes:
+        # extended form: XPENDING key group [IDLE ms] start end count [consumer]
+        stream = self.streams.get(args[0])
+        group = stream.groups.get(args[1]) if stream else None
+        if group is None:
+            return _array([])
+        rest = args[2:]
+        min_idle_ms = 0
+        if rest and rest[0].upper() == b"IDLE":
+            min_idle_ms = int(rest[1])
+            rest = rest[2:]
+        if len(rest) < 3:
+            raise RedisLiteBadArgs("this double only supports extended XPENDING")
+        lo = (0, 0) if rest[0] == b"-" else _parse_id(rest[0])
+        hi = (2**62, 2**62) if rest[1] == b"+" else _parse_id(rest[1], default_seq=2**62)
+        count = int(rest[2])
+        now = time.monotonic()
+        rows = []
+        for eid in sorted(group.pending, key=_parse_id):
+            if not (lo <= _parse_id(eid) <= hi):
+                continue
+            pe = group.pending[eid]
+            idle_ms = int((now - pe.delivered_at) * 1000)
+            if idle_ms < min_idle_ms:
+                continue
+            rows.append(
+                b"*4" + CRLF + _bulk(eid) + _bulk(pe.consumer)
+                + _int(idle_ms) + _int(pe.delivery_count))
+            if len(rows) >= count:
+                break
+        return b"*%d" % len(rows) + CRLF + b"".join(rows)
+
+    async def _cmd_xclaim(self, args: list[bytes]) -> bytes:
+        key, group_name, consumer, min_idle = args[0], args[1], args[2], int(args[3])
+        stream = self.streams.get(key)
+        group = stream.groups.get(group_name) if stream else None
+        if group is None:
+            return _error(
+                f"NOGROUP No such consumer group '{group_name.decode()}' "
+                f"for key name '{key.decode()}'")
+        now = time.monotonic()
+        out = []
+        for eid in args[4:]:
+            pe = group.pending.get(eid)
+            if pe is None:
+                continue
+            if (now - pe.delivered_at) * 1000 < min_idle:
+                continue
+            fields = stream.entry(eid)
+            if fields is None:
+                del group.pending[eid]  # entry trimmed: drop from PEL
+                continue
+            pe.consumer = consumer
+            pe.delivered_at = now
+            pe.delivery_count += 1
+            out.append(b"*2" + CRLF + _bulk(eid) + _array(list(fields)))
+        return b"*%d" % len(out) + CRLF + b"".join(out)
+
+
+class RedisLiteBadArgs(Exception):
+    pass
+
+
+#: sentinel: close the connection after replying OK
+_CLOSE = object()

@@ -1,0 +1,379 @@
+"""Redis-protocol backends over a real TCP socket.
+
+Server side is the hermetic RedisLite double (tasksrunner/testing/
+redislite.py); the drivers under test are the same ones a live Redis
+would get. Contract coverage mirrors the reference's semantics:
+etag CAS (SURVEY.md §5.2), no-query-on-plain-redis
+(docs/aca/04-aca-dapr-stateapi/index.md:166-168), durable groups +
+competing consumers + at-least-once (docs module 5, SURVEY.md §5.8).
+"""
+
+import asyncio
+
+import pytest
+
+from tasksrunner.component.registry import resolve_driver
+from tasksrunner.component.spec import parse_component
+from tasksrunner.errors import EtagMismatch, QueryError
+from tasksrunner.pubsub.redis import RedisStreamsBroker
+from tasksrunner.pubsub.sqlite import SqliteBroker
+from tasksrunner.redisproto import RedisClient, RedisReplyError
+from tasksrunner.state.redis import RedisStateStore
+from tasksrunner.testing import RedisLiteServer
+
+
+async def wait_until(predicate, timeout=5.0, interval=0.01):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if predicate():
+            return True
+        await asyncio.sleep(interval)
+    return predicate()
+
+
+# ------------------------------------------------------------- protocol
+
+
+@pytest.mark.asyncio
+async def test_resp_roundtrip_and_errors():
+    async with RedisLiteServer() as srv:
+        client = RedisClient("127.0.0.1", srv.port)
+        try:
+            assert await client.ping()
+            assert await client.execute("SET", "k", "v") == "OK"
+            assert await client.execute("GET", "k") == b"v"
+            assert await client.execute("GET", "missing") is None
+            assert await client.execute("DEL", "k", "missing") == 1
+            with pytest.raises(RedisReplyError):
+                await client.execute("NOPE")
+            # concurrent commands share the pool without interleaving
+            await client.execute("SET", "n", "0")
+            replies = await asyncio.gather(
+                *[client.execute("SET", f"k{i}", str(i)) for i in range(20)])
+            assert replies == ["OK"] * 20
+            got = await client.execute("MGET", *[f"k{i}" for i in range(20)])
+            assert got == [str(i).encode() for i in range(20)]
+        finally:
+            await client.aclose()
+
+
+@pytest.mark.asyncio
+async def test_watch_multi_exec_conflict_detection():
+    async with RedisLiteServer() as srv:
+        c1 = RedisClient("127.0.0.1", srv.port)
+        c2 = RedisClient("127.0.0.1", srv.port)
+        try:
+            await c1.execute("SET", "key", "a")
+            async with c1.acquire() as conn:
+                await conn.execute("WATCH", "key")
+                assert await conn.execute("GET", "key") == b"a"
+                # interloper writes between WATCH and EXEC
+                await c2.execute("SET", "key", "b")
+                await conn.execute("MULTI")
+                await conn.execute("SET", "key", "c")
+                assert await conn.execute("EXEC") is None  # aborted
+            assert await c1.execute("GET", "key") == b"b"
+        finally:
+            await c1.aclose()
+            await c2.aclose()
+
+
+# ------------------------------------------------------------- state
+
+
+@pytest.mark.asyncio
+async def test_redis_state_crud_and_etags():
+    async with RedisLiteServer() as srv:
+        store = RedisStateStore("statestore", f"127.0.0.1:{srv.port}")
+        try:
+            assert await store.get("t1") is None
+            etag = await store.set("t1", {"taskName": "wash car"})
+            item = await store.get("t1")
+            assert item.value == {"taskName": "wash car"}
+            assert item.etag == etag
+
+            # matching etag wins, returns a fresh etag
+            etag2 = await store.set("t1", {"taskName": "updated"}, etag=etag)
+            assert etag2 != etag
+            # stale etag loses
+            with pytest.raises(EtagMismatch):
+                await store.set("t1", {"taskName": "stale"}, etag=etag)
+            with pytest.raises(EtagMismatch):
+                await store.delete("t1", etag=etag)
+            assert await store.delete("t1", etag=etag2) is True
+            assert await store.get("t1") is None
+            assert await store.delete("t1") is False
+        finally:
+            await store.aclose()
+
+
+@pytest.mark.asyncio
+async def test_redis_state_bulk_keys_and_query_refusal():
+    async with RedisLiteServer() as srv:
+        store = RedisStateStore("statestore", f"127.0.0.1:{srv.port}")
+        try:
+            for i in range(5):
+                await store.set(f"app||{i}", {"n": i})
+            items = await store.bulk_get(["app||0", "nope", "app||4"])
+            assert [it.value if it else None for it in items] == \
+                [{"n": 0}, None, {"n": 4}]
+            assert await store.keys(prefix="app||") == \
+                [f"app||{i}" for i in range(5)]
+            # the reference's documented limitation: plain redis can't query
+            assert store.supports_query is False
+            with pytest.raises(QueryError):
+                await store.query({"filter": {"EQ": {"taskCreatedBy": "x"}}})
+        finally:
+            await store.aclose()
+
+
+@pytest.mark.asyncio
+async def test_redis_state_concurrent_cas_single_winner():
+    """N racers CAS from the same etag; exactly one must win."""
+    async with RedisLiteServer() as srv:
+        store = RedisStateStore("statestore", f"127.0.0.1:{srv.port}")
+        try:
+            etag = await store.set("slot", {"owner": None})
+
+            async def racer(i):
+                try:
+                    await store.set("slot", {"owner": i}, etag=etag)
+                    return True
+                except EtagMismatch:
+                    return False
+
+            results = await asyncio.gather(*[racer(i) for i in range(8)])
+            assert sum(results) == 1
+        finally:
+            await store.aclose()
+
+
+# ------------------------------------------------------------- pub/sub
+
+
+@pytest.mark.asyncio
+async def test_redis_pubsub_publish_subscribe_ack():
+    async with RedisLiteServer() as srv:
+        broker = RedisStreamsBroker(
+            "taskspubsub", f"127.0.0.1:{srv.port}",
+            redeliver_interval=0.05, block_ms=30)
+        try:
+            got = []
+
+            async def handler(msg):
+                got.append(msg)
+                return True
+
+            await broker.subscribe("tasksavedtopic", "processor", handler)
+            mid = await broker.publish(
+                "tasksavedtopic", {"taskName": "t"}, metadata={"k": "v"})
+            assert await wait_until(lambda: len(got) == 1)
+            assert got[0].id == mid
+            assert got[0].data == {"taskName": "t"}
+            assert got[0].metadata == {"k": "v"}
+            assert got[0].attempt == 1
+            # acked: nothing pending, no redelivery
+            await asyncio.sleep(0.2)
+            assert len(got) == 1
+        finally:
+            await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_redis_pubsub_durable_group_delivers_offline_messages():
+    """≙ docs/aca/05-aca-dapr-pubsubapi/index.md:27-29: consumers need
+    not be up when messages arrive."""
+    async with RedisLiteServer() as srv:
+        broker = RedisStreamsBroker(
+            "taskspubsub", f"127.0.0.1:{srv.port}",
+            redeliver_interval=0.05, block_ms=30)
+        try:
+            await broker.ensure_group("topic", "app")
+            await broker.publish("topic", {"n": 1})
+            await broker.publish("topic", {"n": 2})
+            got = []
+
+            async def handler(msg):
+                got.append(msg.data["n"])
+                return True
+
+            await broker.subscribe("topic", "app", handler)
+            assert await wait_until(lambda: sorted(got) == [1, 2])
+        finally:
+            await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_redis_pubsub_competing_consumers_split_work():
+    async with RedisLiteServer() as srv:
+        broker = RedisStreamsBroker(
+            "taskspubsub", f"127.0.0.1:{srv.port}",
+            redeliver_interval=0.2, block_ms=30)
+        try:
+            seen_a, seen_b = [], []
+
+            async def mk(bucket):
+                async def handler(msg):
+                    bucket.append(msg.data["n"])
+                    return True
+                return handler
+
+            await broker.subscribe("topic", "app", await mk(seen_a))
+            await broker.subscribe("topic", "app", await mk(seen_b))
+            for i in range(12):
+                await broker.publish("topic", {"n": i})
+            assert await wait_until(
+                lambda: len(seen_a) + len(seen_b) == 12)
+            # each message delivered exactly once across the group
+            assert sorted(seen_a + seen_b) == list(range(12))
+        finally:
+            await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_redis_pubsub_fanout_to_independent_groups():
+    """Two app-ids (groups) each get every message — the Service Bus
+    subscription-per-app model (bicep/modules/service-bus.bicep:55-57)."""
+    async with RedisLiteServer() as srv:
+        broker = RedisStreamsBroker(
+            "p", f"127.0.0.1:{srv.port}", redeliver_interval=0.2, block_ms=30)
+        try:
+            a, b = [], []
+
+            async def ha(msg):
+                a.append(msg.data["n"]); return True
+
+            async def hb(msg):
+                b.append(msg.data["n"]); return True
+
+            await broker.subscribe("topic", "app-a", ha)
+            await broker.subscribe("topic", "app-b", hb)
+            for i in range(5):
+                await broker.publish("topic", {"n": i})
+            assert await wait_until(
+                lambda: sorted(a) == list(range(5)) and sorted(b) == list(range(5)))
+        finally:
+            await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_redis_pubsub_nack_redelivers_with_attempt_count():
+    async with RedisLiteServer() as srv:
+        broker = RedisStreamsBroker(
+            "p", f"127.0.0.1:{srv.port}",
+            max_attempts=5, redeliver_interval=0.05, block_ms=30)
+        try:
+            attempts = []
+
+            async def flaky(msg):
+                attempts.append(msg.attempt)
+                return msg.attempt >= 3  # fail twice, then ack
+
+            await broker.subscribe("topic", "app", flaky)
+            await broker.publish("topic", {"n": 1})
+            assert await wait_until(lambda: 3 in attempts)
+            assert attempts[:3] == [1, 2, 3]
+            await asyncio.sleep(0.2)  # no further redelivery after ack
+            assert len(attempts) == 3
+        finally:
+            await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_redis_pubsub_poison_message_parks_on_dead_letter():
+    async with RedisLiteServer() as srv:
+        broker = RedisStreamsBroker(
+            "p", f"127.0.0.1:{srv.port}",
+            max_attempts=2, redeliver_interval=0.05, block_ms=30)
+        try:
+            calls = []
+
+            async def poison(msg):
+                calls.append(msg.attempt)
+                raise RuntimeError("boom")
+
+            await broker.subscribe("topic", "app", poison)
+            await broker.publish("topic", {"bad": True})
+            assert await wait_until(lambda: len(calls) >= 2)
+            # parked: the dead-letter stream holds it, group drained
+            assert await wait_until(lambda: bool(
+                srv.streams.get(b"tasksrunner:topic:topic:dead")))
+            await asyncio.sleep(0.2)
+            assert len(calls) == 2
+        finally:
+            await broker.aclose()
+
+
+# ------------------------------------------------------------- wiring
+
+
+def test_driver_dispatch_follows_the_yaml(tmp_path):
+    """Reference invariant: the YAML (not code) picks the backend."""
+    with_host = parse_component({
+        "componentType": "pubsub.redis",
+        "metadata": [{"name": "redisHost", "value": "localhost:6399"}],
+    }, default_name="taskspubsub")
+    without_host = parse_component({
+        "componentType": "pubsub.redis",
+        "metadata": [{"name": "brokerPath",
+                      "value": str(tmp_path / "b.db")}],
+    }, default_name="taskspubsub")
+    factory = resolve_driver("pubsub.redis")
+    real = factory(with_host, {"redisHost": "localhost:6399"})
+    local = factory(without_host, {"brokerPath": str(tmp_path / "b.db")})
+    assert isinstance(real, RedisStreamsBroker)
+    assert isinstance(local, SqliteBroker)
+
+    state_factory = resolve_driver("state.redis")
+    store = state_factory(with_host, {"redisHost": "localhost:6399"})
+    assert isinstance(store, RedisStateStore)
+
+
+@pytest.mark.asyncio
+async def test_redis_state_keys_with_glob_metacharacters():
+    """MATCH metacharacters in an app-id prefix must stay literal."""
+    async with RedisLiteServer() as srv:
+        store = RedisStateStore("s", f"127.0.0.1:{srv.port}")
+        try:
+            await store.set("app[1]||x", {"n": 1})
+            await store.set("app1||y", {"n": 2})
+            assert await store.keys(prefix="app[1]||") == ["app[1]||x"]
+        finally:
+            await store.aclose()
+
+
+@pytest.mark.asyncio
+async def test_redis_pubsub_stream_capped_by_maxlen():
+    async with RedisLiteServer() as srv:
+        broker = RedisStreamsBroker(
+            "p", f"127.0.0.1:{srv.port}", max_stream_len=5, block_ms=30)
+        try:
+            for i in range(20):
+                await broker.publish("topic", {"n": i})
+            stream = srv.streams[b"tasksrunner:topic:topic"]
+            assert len(stream.entries) <= 5
+        finally:
+            await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_redis_pubsub_cancel_does_not_poison_pool():
+    """Tearing down a blocked consumer must retire its socket, not
+    return it to the pool with an unread XREADGROUP reply in flight."""
+    async with RedisLiteServer() as srv:
+        broker = RedisStreamsBroker(
+            "p", f"127.0.0.1:{srv.port}", block_ms=5_000)
+        try:
+            async def handler(msg):
+                return True
+
+            sub = await broker.subscribe("topic", "app", handler)
+            await asyncio.sleep(0.05)  # consumer is now blocked in XREADGROUP
+            await sub.cancel()
+            # a poisoned pool would hand back the stale reply here
+            for i in range(5):
+                mid = await broker.publish("topic", {"n": i})
+                assert "-" in mid, mid  # well-formed stream id
+        finally:
+            await broker.aclose()
