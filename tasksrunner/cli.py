@@ -16,6 +16,11 @@ Commands mirror the reference's local workflow surface:
   docs/aca/09-aca-autoscale-keda/index.md:170-200)
 * ``tasksrunner components`` — validate/list a resources directory
   (≙ the sidecar's component loading report)
+* ``tasksrunner invoke / publish / state / secret`` — one-shot probes
+  against a running app's sidecar (≙ ``dapr invoke`` / ``dapr
+  publish`` / the workshop's curl checkpoints,
+  docs/aca/04-aca-dapr-stateapi/index.md:41-75)
+* ``tasksrunner stop``    — SIGTERM a registered host (≙ ``dapr stop``)
 """
 
 from __future__ import annotations
@@ -392,6 +397,145 @@ def _cmd_components(args) -> None:
         raise SystemExit(f"{problems} component(s) have no registered driver")
 
 
+def _sidecar_request(args, method: str, path: str, body=None,
+                     *, query: str = ""):
+    """Shared plumbing for the probe commands: resolve ``--app-id``'s
+    sidecar from the registry and issue one /v1.0 request against it —
+    the same raw probes the workshop runs with curl at its manual
+    verification checkpoints (docs/aca/04-aca-dapr-stateapi/
+    index.md:41-75, docs/aca/05-aca-dapr-pubsubapi/index.md:60-88)."""
+    import json as json_mod
+    import os
+
+    from tasksrunner.errors import AppNotFound
+    from tasksrunner.invoke.resolver import NameResolver
+    from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER
+
+    resolver = NameResolver(registry_file=args.registry_file)
+    try:
+        addr = resolver.resolve(args.app_id)
+    except AppNotFound:
+        known = ", ".join(resolver.known_apps()) or "(none registered)"
+        raise SystemExit(
+            f"app {args.app_id!r} is not registered; running apps: {known}")
+
+    async def go():
+        import aiohttp
+
+        headers = {"Content-Type": "application/json"}
+        token = os.environ.get(TOKEN_ENV)
+        if token:
+            headers[TOKEN_HEADER] = token
+        url = f"{addr.base_url}/v1.0/{path}"
+        if query:
+            url += "?" + query
+        timeout = aiohttp.ClientTimeout(total=30.0)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            async with s.request(method, url, headers=headers,
+                                 data=None if body is None
+                                 else json_mod.dumps(body)) as r:
+                raw = await r.read()
+                return r.status, raw
+
+    status, raw = asyncio.run(go())
+    text = raw.decode("utf-8", "replace")
+    try:
+        parsed = json_mod.loads(text) if text else None
+    except ValueError:
+        parsed = None
+    if parsed is not None:
+        print(json_mod.dumps(parsed, indent=2))
+    elif text:
+        print(text)
+    if status >= 400:
+        raise SystemExit(f"HTTP {status}")
+    return status
+
+
+def _parse_data(raw: str | None):
+    """--data accepts inline JSON or @file (curl convention)."""
+    import json as json_mod
+
+    if raw is None:
+        return None
+    if raw.startswith("@"):
+        with open(raw[1:]) as f:
+            raw = f.read()
+    try:
+        return json_mod.loads(raw)
+    except ValueError as exc:
+        raise SystemExit(f"--data is not valid JSON: {exc}")
+
+
+def _cmd_invoke(args) -> None:
+    """≙ `dapr invoke` / the workshop's service-invocation probes
+    (docs/aca/03-aca-dapr-integration/index.md:107-127): call
+    /v1.0/invoke/{app-id}/method/{path} via the app's own sidecar."""
+    method = args.verb.upper()
+    path, _, query = args.method.partition("?")
+    _sidecar_request(args, method, f"invoke/{args.app_id}/method/{path}",
+                     _parse_data(args.data), query=query)
+
+
+def _cmd_publish(args) -> None:
+    """≙ `dapr publish`: POST /v1.0/publish/{pubsub}/{topic} through
+    the sidecar of --app-id (scope decides which broker it sees)."""
+    _sidecar_request(args, "POST", f"publish/{args.pubsub}/{args.topic}",
+                     _parse_data(args.data))
+
+
+def _cmd_state(args) -> None:
+    """Raw state probes against a sidecar: the module-4 manual
+    verification flow (POST /v1.0/state/{store}, GET by key) as a
+    first-class command."""
+    store = args.store
+    if args.action == "get":
+        if not args.key:
+            raise SystemExit("state get needs a KEY")
+        _sidecar_request(args, "GET", f"state/{store}/{args.key}")
+    elif args.action == "set":
+        if not args.key or args.data is None:
+            raise SystemExit("state set needs a KEY and --data")
+        _sidecar_request(args, "POST", f"state/{store}",
+                         [{"key": args.key, "value": _parse_data(args.data)}])
+    elif args.action == "delete":
+        if not args.key:
+            raise SystemExit("state delete needs a KEY")
+        _sidecar_request(args, "DELETE", f"state/{store}/{args.key}")
+    elif args.action == "query":
+        _sidecar_request(args, "POST", f"state/{store}/query",
+                         _parse_data(args.data) or {})
+
+
+def _cmd_secret(args) -> None:
+    """GET /v1.0/secrets/{store}/{key} (docs module 9 probe shape)."""
+    _sidecar_request(args, "GET", f"secrets/{args.store}/{args.key}")
+
+
+def _cmd_stop(args) -> None:
+    """≙ `dapr stop --app-id X`: SIGTERM the registered host process."""
+    import os
+    import signal
+
+    from tasksrunner.errors import AppNotFound
+    from tasksrunner.invoke.resolver import NameResolver
+
+    resolver = NameResolver(registry_file=args.registry_file)
+    try:
+        addr = resolver.resolve(args.app_id)
+    except AppNotFound:
+        known = ", ".join(resolver.known_apps()) or "(none registered)"
+        raise SystemExit(
+            f"app {args.app_id!r} is not registered; running apps: {known}")
+    if not addr.pid:
+        raise SystemExit(f"registry has no pid for {args.app_id!r}")
+    try:
+        os.kill(addr.pid, signal.SIGTERM)
+    except ProcessLookupError:
+        raise SystemExit(f"{args.app_id}: pid {addr.pid} is already gone")
+    print(f"sent SIGTERM to {args.app_id} (pid {addr.pid})")
+
+
 def _run_until_interrupt(coro) -> None:
     try:
         asyncio.run(coro)
@@ -469,6 +613,54 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--app-id", default=None,
                    help="show only components in this app's scope")
     p.set_defaults(fn=_cmd_components)
+
+    registry_arg = dict(default=".tasksrunner/apps.json",
+                        help="name-registry file written by running hosts")
+
+    p = sub.add_parser(
+        "invoke", help="call a method on a running app via its sidecar")
+    p.add_argument("app_id")
+    p.add_argument("method", help='route, e.g. "api/tasks?createdBy=a@x.com"')
+    p.add_argument("--verb", default="GET",
+                   choices=["GET", "POST", "PUT", "DELETE", "PATCH",
+                            "get", "post", "put", "delete", "patch"])
+    p.add_argument("--data", default=None, help="JSON body or @file")
+    p.add_argument("--registry-file", **registry_arg)
+    p.set_defaults(fn=_cmd_invoke)
+
+    p = sub.add_parser(
+        "publish", help="publish an event through a running app's sidecar")
+    p.add_argument("pubsub", help="pub/sub component name")
+    p.add_argument("topic")
+    p.add_argument("--app-id", required=True,
+                   help="whose sidecar to publish through (decides scope)")
+    p.add_argument("--data", default=None, help="JSON payload or @file")
+    p.add_argument("--registry-file", **registry_arg)
+    p.set_defaults(fn=_cmd_publish)
+
+    p = sub.add_parser(
+        "state", help="raw state-store probes via a running app's sidecar")
+    p.add_argument("action", choices=["get", "set", "delete", "query"])
+    p.add_argument("store", help="state component name, e.g. statestore")
+    p.add_argument("key", nargs="?", default=None)
+    p.add_argument("--app-id", required=True)
+    p.add_argument("--data", default=None,
+                   help="JSON value (set) or query document (query)")
+    p.add_argument("--registry-file", **registry_arg)
+    p.set_defaults(fn=_cmd_state)
+
+    p = sub.add_parser(
+        "secret", help="read a secret via a running app's sidecar")
+    p.add_argument("store")
+    p.add_argument("key")
+    p.add_argument("--app-id", required=True)
+    p.add_argument("--registry-file", **registry_arg)
+    p.set_defaults(fn=_cmd_secret)
+
+    p = sub.add_parser("stop", help="SIGTERM a registered app host")
+    p.add_argument("app_id")
+    p.add_argument("--registry-file", **registry_arg)
+    p.set_defaults(fn=_cmd_stop)
 
     return parser
 

@@ -33,7 +33,12 @@ from tasksrunner.component.registry import driver
 from tasksrunner.component.spec import ComponentSpec
 from tasksrunner.errors import PubSubError
 from tasksrunner.pubsub.base import Handler, Message, PubSubBroker, Subscription
-from tasksrunner.redisproto import RedisClient, RedisReplyError, as_str
+from tasksrunner.redisproto import (
+    RedisClient,
+    RedisConnection,
+    RedisReplyError,
+    as_str,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -136,8 +141,12 @@ class RedisStreamsBroker(PubSubBroker):
 
     async def _read_loop(self, topic: str, group: str, consumer: str,
                          handler: Handler) -> None:
+        # A blocked XREADGROUP parks this socket for up to block_ms at a
+        # time, so the loop owns a DEDICATED connection — pooled sockets
+        # stay free for publish/ack even with many subscriptions.
         stream = self._stream(topic)
-        async with self.client.acquire() as conn:
+        conn = RedisConnection(self.client.host, self.client.port)
+        try:
             while True:
                 try:
                     reply = await conn.execute(
@@ -147,7 +156,9 @@ class RedisStreamsBroker(PubSubBroker):
                 except asyncio.CancelledError:
                     raise
                 except Exception as exc:
-                    logger.warning("broker %s read loop error: %s", self.name, exc)
+                    logger.warning("broker %s read loop error: %s",
+                                   self.name, exc)
+                    conn.close_now()  # reconnects on next execute
                     await asyncio.sleep(self.redeliver_interval)
                     continue
                 if not reply:
@@ -157,9 +168,15 @@ class RedisStreamsBroker(PubSubBroker):
                         msg = self._to_message(
                             topic, as_str(raw_id), fields, attempt=1)
                         await self._deliver(stream, group, msg, handler)
+        finally:
+            conn.close_now()
 
     async def _deliver(self, stream: str, group: str, msg: Message,
                        handler: Handler) -> None:
+        """Run the handler and settle the entry. Never raises (except
+        cancellation): a redis hiccup while acking/parking just leaves
+        the entry pending, and the reclaim loop redelivers it — the
+        at-least-once contract holds either way."""
         try:
             ok = await handler(msg)
         except asyncio.CancelledError:
@@ -168,22 +185,29 @@ class RedisStreamsBroker(PubSubBroker):
             logger.warning("broker %s: handler raised on %s: %s",
                            self.name, msg.id, exc)
             ok = False
-        if ok:
-            await self.client.execute("XACK", stream, group, msg.id)
-        elif msg.attempt >= self.max_attempts:
+        try:
+            if ok:
+                await self.client.execute("XACK", stream, group, msg.id)
+            elif msg.attempt >= self.max_attempts:
+                logger.warning(
+                    "broker %s: message %s on %s exhausted %d attempts; "
+                    "parking on dead-letter", self.name, msg.id, msg.topic,
+                    msg.attempt)
+                await self.client.execute(
+                    "XADD", stream + ":dead",
+                    "MAXLEN", "~", self.max_stream_len, "*",
+                    "data", json.dumps(msg.data),
+                    "metadata", json.dumps(msg.metadata),
+                    "origin_id", msg.id, "group", group,
+                    "attempts", str(msg.attempt))
+                await self.client.execute("XACK", stream, group, msg.id)
+            # else: stays pending for the reclaim loop
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
             logger.warning(
-                "broker %s: message %s on %s exhausted %d attempts; "
-                "parking on dead-letter", self.name, msg.id, msg.topic,
-                msg.attempt)
-            await self.client.execute(
-                "XADD", stream + ":dead",
-                "MAXLEN", "~", self.max_stream_len, "*",
-                "data", json.dumps(msg.data),
-                "metadata", json.dumps(msg.metadata),
-                "origin_id", msg.id, "group", group,
-                "attempts", str(msg.attempt))
-            await self.client.execute("XACK", stream, group, msg.id)
-        # else: stays pending for the reclaim loop
+                "broker %s: could not settle %s on %s (%s); entry stays "
+                "pending for redelivery", self.name, msg.id, msg.topic, exc)
 
     async def _reclaim_loop(self, topic: str, group: str, consumer: str,
                             handler: Handler) -> None:
@@ -201,8 +225,15 @@ class RedisStreamsBroker(PubSubBroker):
                 continue
             for row in rows or []:
                 entry_id, delivery_count = as_str(row[0]), int(row[3])
-                claimed = await self.client.execute(
-                    "XCLAIM", stream, group, consumer, idle_ms, entry_id)
+                try:
+                    claimed = await self.client.execute(
+                        "XCLAIM", stream, group, consumer, idle_ms, entry_id)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    logger.warning("broker %s: XCLAIM %s failed: %s",
+                                   self.name, entry_id, exc)
+                    continue
                 for raw_id, fields in claimed or []:
                     # XCLAIM bumped the server-side counter by one
                     msg = self._to_message(

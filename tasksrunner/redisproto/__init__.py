@@ -16,6 +16,7 @@ hermetic test server tasksrunner/testing/redislite.py.
 """
 
 from tasksrunner.redisproto.client import (  # noqa: F401
+    CleanExit,
     RedisClient,
     RedisConnection,
     RedisProtocolError,

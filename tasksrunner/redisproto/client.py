@@ -34,6 +34,17 @@ class RedisReplyError(TasksRunnerError):
         self.code = message.split(" ", 1)[0] if message else ""
 
 
+class CleanExit(Exception):
+    """Raise inside ``RedisClient.acquire`` to leave the block with an
+    application-level error while certifying the connection is in a
+    clean, pool-safe state (no armed WATCH, no open MULTI, no unread
+    reply). ``acquire`` re-raises the wrapped ``error``."""
+
+    def __init__(self, error: BaseException):
+        super().__init__(str(error))
+        self.error = error
+
+
 def as_str(value: Any) -> str:
     """Bulk strings arrive as bytes; normalize for comparisons."""
     if isinstance(value, bytes):
@@ -115,13 +126,21 @@ class RedisConnection:
         await self._writer.drain()
         return await read_reply(self._reader)
 
-    async def aclose(self) -> None:
+    def close_now(self) -> None:
+        """Synchronous close: schedules the transport teardown without
+        awaiting it (safe from non-async cleanup paths)."""
         if self._writer is not None:
-            self._writer.close()
             with contextlib.suppress(Exception):
-                await self._writer.wait_closed()
+                self._writer.close()
             self._writer = None
             self._reader = None
+
+    async def aclose(self) -> None:
+        if self._writer is not None:
+            writer = self._writer
+            self.close_now()
+            with contextlib.suppress(Exception):
+                await writer.wait_closed()
 
 
 class RedisClient:
@@ -152,6 +171,9 @@ class RedisClient:
             conn = self._free.pop()
             if conn.connected:
                 return conn
+            conn.close_now()
+            if conn in self._all:
+                self._all.remove(conn)
         conn = RedisConnection(self.host, self.port)
         try:
             await conn.connect()
@@ -163,7 +185,7 @@ class RedisClient:
 
     def _checkin(self, conn: RedisConnection, *, broken: bool = False) -> None:
         if broken or self._closed or not conn.connected:
-            asyncio.get_running_loop().create_task(conn.aclose())
+            conn.close_now()
             if conn in self._all:
                 self._all.remove(conn)
         else:
@@ -189,17 +211,39 @@ class RedisClient:
 
     @contextlib.asynccontextmanager
     async def acquire(self):
-        """Dedicated connection for WATCH/MULTI/EXEC or blocking reads."""
+        """Dedicated connection for WATCH/MULTI/EXEC or blocking reads.
+
+        Exit classification: a clean exit or a ``CleanExit``-wrapped
+        error returns the connection to the pool as-is; a server reply
+        error sanitizes possible WATCH/MULTI leftovers first (an armed
+        WATCH on a pooled connection would spuriously abort the next
+        borrower's EXEC); anything else — including cancellation mid-
+        reply — retires the socket."""
         conn = await self._checkout()
         broken = True
         try:
             yield conn
             broken = False
-        except RedisReplyError:
+        except CleanExit as exc:
             broken = False
+            raise exc.error from None
+        except RedisReplyError:
+            broken = not await self._sanitize(conn)
             raise
         finally:
             self._checkin(conn, broken=broken)
+
+    @staticmethod
+    async def _sanitize(conn: RedisConnection) -> bool:
+        """Best-effort DISCARD + UNWATCH; False if the socket is gone."""
+        for cmd in ("DISCARD", "UNWATCH"):
+            try:
+                await conn.execute(cmd)
+            except RedisReplyError:
+                pass  # "DISCARD without MULTI" — nothing was open
+            except Exception:
+                return False
+        return True
 
     async def ping(self) -> bool:
         return await self.execute("PING") == "PONG"

@@ -30,7 +30,7 @@ from typing import Any
 from tasksrunner.component.registry import driver
 from tasksrunner.component.spec import ComponentSpec
 from tasksrunner.errors import EtagMismatch, QueryError, StateError
-from tasksrunner.redisproto import RedisClient, as_str
+from tasksrunner.redisproto import CleanExit, RedisClient, as_str
 from tasksrunner.state.base import QueryResponse, StateItem, StateStore
 
 
@@ -68,15 +68,17 @@ class RedisStateStore(StateStore):
         if etag is None:
             await self.client.execute("SET", key, self._encode(value, new_etag))
             return new_etag
-        # CAS: WATCH the key so a concurrent write voids the EXEC
+        # CAS: WATCH the key so a concurrent write voids the EXEC. A
+        # mismatch exits via CleanExit — the UNWATCH already ran, so the
+        # pooled connection is reused, not retired.
         while True:
             async with self.client.acquire() as conn:
                 await conn.execute("WATCH", key)
                 current = self._decode(await conn.execute("GET", key), key)
                 if current is None or current.etag != etag:
                     await conn.execute("UNWATCH")
-                    raise EtagMismatch(
-                        f"{self.name}: etag mismatch on {key!r}")
+                    raise CleanExit(EtagMismatch(
+                        f"{self.name}: etag mismatch on {key!r}"))
                 await conn.execute("MULTI")
                 await conn.execute("SET", key, self._encode(value, new_etag))
                 if await conn.execute("EXEC") is not None:
@@ -95,8 +97,8 @@ class RedisStateStore(StateStore):
                     return False
                 if current.etag != etag:
                     await conn.execute("UNWATCH")
-                    raise EtagMismatch(
-                        f"{self.name}: etag mismatch on {key!r}")
+                    raise CleanExit(EtagMismatch(
+                        f"{self.name}: etag mismatch on {key!r}"))
                 await conn.execute("MULTI")
                 await conn.execute("DEL", key)
                 if await conn.execute("EXEC") is not None:

@@ -1,0 +1,163 @@
+"""CLI probe commands against a live multi-process cluster.
+
+``tasksrunner state/invoke/publish/secret`` are the workshop's manual
+verification checkpoints (docs/aca/04-aca-dapr-stateapi/index.md:41-75
+curl probes; docs/aca/05-aca-dapr-pubsubapi/index.md:60-88 publish +
+watch consumer) promoted to first-class commands, ≙ `dapr invoke` /
+`dapr publish` / `dapr stop`.
+"""
+
+import asyncio
+import json
+import os
+import pathlib
+import sys
+
+import pytest
+
+from tasksrunner.orchestrator import AppSpec
+from tasksrunner.orchestrator.config import RunConfig
+from tasksrunner.orchestrator.run import Orchestrator
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+async def run_cli(*argv, registry, cwd):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)
+    proc = await asyncio.create_subprocess_exec(
+        sys.executable, "-m", "tasksrunner", *argv,
+        "--registry-file", str(registry),
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.PIPE,
+        env=env, cwd=str(cwd))
+    out, err = await asyncio.wait_for(proc.communicate(), timeout=30)
+    return proc.returncode, out.decode(), err.decode()
+
+
+@pytest.mark.asyncio
+async def test_cli_probes_against_running_cluster(tmp_path):
+    registry = tmp_path / "apps.json"
+    config = RunConfig(
+        apps=[
+            AppSpec(app_id="tasksmanager-backend-api",
+                    module="samples.tasks_tracker.backend_api:make_app",
+                    env={"TASKS_MANAGER": "store"}),
+            AppSpec(app_id="tasksmanager-backend-processor",
+                    module="samples.tasks_tracker.processor:make_app"),
+        ],
+        resources_path=str(REPO / "samples" / "tasks_tracker" / "components"),
+        registry_file=str(registry),
+        base_dir=tmp_path,
+    )
+    orch = Orchestrator(config)
+    await orch.start()
+    try:
+        deadline = asyncio.get_running_loop().time() + 30
+        while True:
+            entries = json.loads(registry.read_text() or "{}") \
+                if registry.is_file() else {}
+            if len(entries) == 2:
+                break
+            assert asyncio.get_running_loop().time() < deadline, \
+                "apps never registered"
+            await asyncio.sleep(0.2)
+
+        api = "tasksmanager-backend-api"
+
+        # state set / get / query / delete (module-4 probe flow)
+        rc, out, err = await run_cli(
+            "state", "set", "statestore", "probe-1",
+            "--app-id", api, "--data",
+            '{"taskName": "cli-probe", "taskCreatedBy": "cli@x.com"}',
+            registry=registry, cwd=tmp_path)
+        assert rc == 0, err
+        rc, out, err = await run_cli(
+            "state", "get", "statestore", "probe-1",
+            "--app-id", api, registry=registry, cwd=tmp_path)
+        assert rc == 0 and "cli-probe" in out, (out, err)
+        rc, out, err = await run_cli(
+            "state", "query", "statestore",
+            "--app-id", api, "--data",
+            '{"filter": {"EQ": {"taskCreatedBy": "cli@x.com"}}}',
+            registry=registry, cwd=tmp_path)
+        assert rc == 0 and "probe-1" in out, (out, err)
+        rc, out, err = await run_cli(
+            "state", "delete", "statestore", "probe-1",
+            "--app-id", api, registry=registry, cwd=tmp_path)
+        assert rc == 0, err
+
+        # invoke: the REST surface through the sidecar
+        rc, out, err = await run_cli(
+            "invoke", api, "api/tasks?createdBy=cli@x.com",
+            registry=registry, cwd=tmp_path)
+        assert rc == 0 and out.strip().startswith("["), (out, err)
+        rc, out, err = await run_cli(
+            "invoke", api, "api/tasks", "--verb", "POST", "--data",
+            '{"taskName": "via-invoke", "taskCreatedBy": "cli@x.com",'
+            ' "taskDueDate": "2026-08-09", "taskAssignedTo": "a@x.com"}',
+            registry=registry, cwd=tmp_path)
+        assert rc == 0, (out, err)
+
+        # publish: event lands at the processor (sendgrid outbox file)
+        rc, out, err = await run_cli(
+            "publish", "dapr-pubsub-servicebus", "tasksavedtopic",
+            "--app-id", api, "--data",
+            '{"taskId": "pub-1", "taskName": "published",'
+            ' "taskAssignedTo": "p@x.com"}',
+            registry=registry, cwd=tmp_path)
+        assert rc == 0, (out, err)
+        outbox = tmp_path / ".tasksrunner" / "outbox"
+        deadline = asyncio.get_running_loop().time() + 15
+        while not (outbox.is_dir() and list(outbox.glob("*.json"))):
+            assert asyncio.get_running_loop().time() < deadline, \
+                "published event never reached the processor"
+            await asyncio.sleep(0.2)
+
+        # unknown app id → helpful error, nonzero exit
+        rc, out, err = await run_cli(
+            "state", "get", "statestore", "x", "--app-id", "nope",
+            registry=registry, cwd=tmp_path)
+        assert rc != 0 and "not registered" in err, (out, err)
+    finally:
+        await orch.stop()
+
+
+@pytest.mark.asyncio
+async def test_cli_stop_unknown_app_errors(tmp_path):
+    registry = tmp_path / "apps.json"
+    registry.write_text("{}")
+    rc, out, err = await run_cli("stop", "ghost",
+                                 registry=registry, cwd=tmp_path)
+    assert rc != 0 and "not registered" in err
+
+
+@pytest.mark.asyncio
+async def test_cli_stop_terminates_host(tmp_path):
+    registry = tmp_path / "apps.json"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)
+    host = await asyncio.create_subprocess_exec(
+        sys.executable, "-m", "tasksrunner", "host",
+        "samples.tasks_tracker.processor:make_app",
+        "--registry-file", str(registry),
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT,
+        env=env, cwd=str(tmp_path))
+    try:
+        deadline = asyncio.get_running_loop().time() + 30
+        while True:
+            entries = json.loads(registry.read_text() or "{}") \
+                if registry.is_file() else {}
+            if entries:
+                break
+            assert asyncio.get_running_loop().time() < deadline, \
+                "host never registered"
+            await asyncio.sleep(0.2)
+        rc, out, err = await run_cli(
+            "stop", "tasksmanager-backend-processor",
+            registry=registry, cwd=tmp_path)
+        assert rc == 0 and "SIGTERM" in out, (out, err)
+        await asyncio.wait_for(host.wait(), timeout=15)
+    finally:
+        if host.returncode is None:
+            host.kill()
+            await host.wait()

@@ -377,3 +377,49 @@ async def test_redis_pubsub_cancel_does_not_poison_pool():
                 assert "-" in mid, mid  # well-formed stream id
         finally:
             await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_redis_pubsub_many_subscriptions_do_not_starve_pool():
+    """20 subscriptions exceed the client pool size; publishes must
+    still flow because read loops own dedicated sockets."""
+    async with RedisLiteServer() as srv:
+        broker = RedisStreamsBroker(
+            "p", f"127.0.0.1:{srv.port}", block_ms=5_000)
+        try:
+            got = {}
+
+            def mk(i):
+                async def handler(msg):
+                    got.setdefault(i, []).append(msg.data["n"])
+                    return True
+                return handler
+
+            for i in range(20):
+                await broker.subscribe(f"topic-{i}", "app", mk(i))
+            for i in range(20):
+                await broker.publish(f"topic-{i}", {"n": i})
+            assert await wait_until(
+                lambda: sum(len(v) for v in got.values()) == 20)
+            assert all(got[i] == [i] for i in range(20))
+        finally:
+            await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_redis_cas_conflict_reuses_pooled_connection():
+    """An etag mismatch is an application outcome, not a transport
+    fault: the pooled socket must survive it."""
+    async with RedisLiteServer() as srv:
+        store = RedisStateStore("s", f"127.0.0.1:{srv.port}")
+        try:
+            etag = await store.set("k", {"v": 1})
+            await store.set("k", {"v": 2})  # invalidates etag
+            for _ in range(5):
+                with pytest.raises(EtagMismatch):
+                    await store.set("k", {"v": 3}, etag=etag)
+            # one reusable connection in the pool, not five corpses
+            assert len(store.client._all) == 1
+            assert len(store.client._free) == 1
+        finally:
+            await store.aclose()
