@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import json
 import time
-import uuid
+from tasksrunner.ids import hex16
 from typing import Any
 
 CONTENT_TYPE = "application/cloudevents+json"
@@ -28,7 +28,7 @@ def wrap(
 ) -> dict:
     return {
         "specversion": "1.0",
-        "id": event_id or str(uuid.uuid4()),
+        "id": event_id or hex16(),
         "source": source,
         "type": "com.tasksrunner.event.sent",
         "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),

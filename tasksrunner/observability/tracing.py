@@ -12,9 +12,10 @@ attached to structured logs so logs from all services correlate.
 from __future__ import annotations
 
 import contextvars
-import secrets
 from contextlib import contextmanager
 from dataclasses import dataclass, field, replace
+
+from tasksrunner.ids import hex8, hex16
 
 TRACEPARENT_HEADER = "traceparent"
 
@@ -33,7 +34,7 @@ class TraceContext:
 
     @classmethod
     def new(cls) -> "TraceContext":
-        return cls(trace_id=secrets.token_hex(16), span_id=secrets.token_hex(8))
+        return cls(trace_id=hex16(), span_id=hex8())
 
     @classmethod
     def parse(cls, header: str | None) -> "TraceContext | None":
@@ -45,7 +46,7 @@ class TraceContext:
         return cls(trace_id=parts[1], span_id=parts[2], flags=parts[3])
 
     def child(self) -> "TraceContext":
-        return replace(self, span_id=secrets.token_hex(8), parent_id=self.span_id)
+        return replace(self, span_id=hex8(), parent_id=self.span_id)
 
     @property
     def header(self) -> str:

@@ -12,12 +12,12 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
-import uuid
 from collections import defaultdict
 from typing import Any
 
 from tasksrunner.component.registry import driver
 from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.ids import hex16
 from tasksrunner.pubsub.base import Handler, Message, PubSubBroker, Subscription
 
 logger = logging.getLogger(__name__)
@@ -45,7 +45,7 @@ class InMemoryBroker(PubSubBroker):
         self._closed = False
 
     async def publish(self, topic: str, data: Any, *, metadata=None) -> str:
-        msg_id = str(uuid.uuid4())
+        msg_id = hex16()
         for group in self._groups.get(topic, {}).values():
             group.queue.put_nowait(
                 Message(id=msg_id, topic=topic, data=data, metadata=dict(metadata or {}))

@@ -24,11 +24,11 @@ import pathlib
 import sqlite3
 import threading
 import time
-import uuid
 from typing import Any
 
 from tasksrunner.component.registry import driver
 from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.ids import hex16
 from tasksrunner.pubsub.base import Handler, Message, PubSubBroker, Subscription
 
 logger = logging.getLogger(__name__)
@@ -107,6 +107,13 @@ class SqliteBroker(PubSubBroker):
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix=f"broker-{name}")
         self._db_lock = threading.Lock()
+        # Group-commit publish queue: concurrent publishers enqueue here
+        # and one flush job on the db thread drains whatever accumulated
+        # into a single transaction — commits amortise across the burst
+        # (same reason the consumer side claims/acks in batches).
+        self._pub_lock = threading.Lock()
+        self._pub_pending: list[tuple] = []
+        self._pub_flushing = False
 
     async def _run(self, fn, *args):
         return await asyncio.get_running_loop().run_in_executor(
@@ -115,27 +122,107 @@ class SqliteBroker(PubSubBroker):
     # -- publish ---------------------------------------------------------
 
     async def publish(self, topic: str, data: Any, *, metadata=None) -> str:
-        msg_id = str(uuid.uuid4())
-        await self._run(self._publish_sync, topic, data, metadata, msg_id)
+        msg_id = hex16()
+        # serialize on the caller so a bad payload fails its own publish,
+        # never the shared flush batch
+        doc = json.dumps(data)
+        meta = json.dumps(dict(metadata or {}))
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        row = (msg_id, topic, doc, meta, loop, fut)
+        with self._pub_lock:
+            self._pub_pending.append(row)
+            if not self._pub_flushing:
+                try:
+                    self._executor.submit(self._flush_publishes)
+                except RuntimeError:
+                    # executor shut down (publish after aclose): fail this
+                    # publish cleanly and leave the flag consistent
+                    self._pub_pending.remove(row)
+                    raise
+                self._pub_flushing = True
+        await fut
         return msg_id
 
-    @_locked
-    def _publish_sync(self, topic: str, data: Any, metadata, msg_id: str) -> None:
+    def _flush_publishes(self) -> None:
+        """Flush one accumulated publish batch in a single transaction
+        (db thread). Re-submits itself if more arrived meanwhile, so
+        consumer-side jobs (claim/ack) interleave FIFO on the shared
+        single-thread executor instead of starving behind a drain loop."""
+        with self._pub_lock:
+            batch = self._pub_pending
+            if not batch:
+                self._pub_flushing = False
+                return
+            self._pub_pending = []
+        try:
+            with self._db_lock:
+                self._publish_rows([b[:4] for b in batch])
+        except BaseException:
+            # batch failed: retry each message alone so one poisoned
+            # row cannot fail its neighbours; report per-message
+            for row in batch:
+                try:
+                    with self._db_lock:
+                        self._publish_rows([row[:4]])
+                except BaseException as single_exc:
+                    self._resolve(row, single_exc)
+                else:
+                    self._resolve(row, None)
+        else:
+            for row in batch:
+                self._resolve(row, None)
+        with self._pub_lock:
+            if self._pub_pending:
+                try:
+                    self._executor.submit(self._flush_publishes)
+                except RuntimeError:  # shutdown race: fail the stragglers
+                    self._pub_flushing = False
+                    for row in self._pub_pending:
+                        self._resolve(row, RuntimeError("broker closed"))
+                    self._pub_pending = []
+            else:
+                self._pub_flushing = False
+
+    @staticmethod
+    def _resolve(row: tuple, exc: BaseException | None) -> None:
+        _, _, _, _, loop, fut = row
+        def _set() -> None:
+            if fut.done():
+                return
+            if exc is None:
+                fut.set_result(None)
+            else:
+                fut.set_exception(exc)
+        try:
+            loop.call_soon_threadsafe(_set)
+        except RuntimeError:  # caller's loop already closed (shutdown)
+            pass
+
+    def _publish_rows(self, rows: list[tuple]) -> None:
+        """One transaction inserting N messages + their delivery fan-out.
+        Caller holds _db_lock."""
         now = time.time()
         cur = self._conn.cursor()
         try:
             cur.execute("BEGIN IMMEDIATE")
-            cur.execute(
+            cur.executemany(
                 "INSERT INTO messages(id, topic, data, metadata, created) VALUES (?,?,?,?,?)",
-                (msg_id, topic, json.dumps(data), json.dumps(dict(metadata or {})), now),
+                [(msg_id, topic, doc, meta, now) for msg_id, topic, doc, meta in rows],
             )
-            groups = [r[0] for r in cur.execute(
-                "SELECT grp FROM groups WHERE topic = ?", (topic,)
-            ).fetchall()]
-            for grp in groups:
-                cur.execute(
+            groups_by_topic: dict[str, list[str]] = {}
+            deliveries = []
+            for msg_id, topic, _, _ in rows:
+                if topic not in groups_by_topic:
+                    groups_by_topic[topic] = [r[0] for r in cur.execute(
+                        "SELECT grp FROM groups WHERE topic = ?", (topic,)
+                    ).fetchall()]
+                for grp in groups_by_topic[topic]:
+                    deliveries.append((msg_id, topic, grp, now))
+            if deliveries:
+                cur.executemany(
                     "INSERT INTO deliveries(msg_id, topic, grp, visible_at) VALUES (?,?,?,?)",
-                    (msg_id, topic, grp, now),
+                    deliveries,
                 )
             self._conn.commit()
         except BaseException:
@@ -245,7 +332,7 @@ class SqliteBroker(PubSubBroker):
 
         async def poll_loop() -> None:
             while not stop.is_set() and not self._closed:
-                batch = await self._run(self._claim_batch, topic, group, 16)
+                batch = await self._run(self._claim_batch, topic, group, 64)
                 if not batch:
                     try:
                         await asyncio.wait_for(stop.wait(), timeout=self.poll_interval)

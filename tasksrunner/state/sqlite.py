@@ -124,18 +124,27 @@ class SqliteStateStore(StateStore):
             return None
         return StateItem(key=key, value=json.loads(row[0]), etag=row[1])
 
+    #: RETURNING needs sqlite >= 3.35 (2021); fall back to the
+    #: two-statement form on older system libsqlite3 builds
+    _HAS_RETURNING = sqlite3.sqlite_version_info >= (3, 35, 0)
+
     def _next_etag(self, cur: sqlite3.Cursor) -> str:
         # Store-global monotonic sequence: a deleted-and-recreated key
         # never reuses an old etag, so stale tokens from a previous
         # incarnation of the key can't validate.
-        cur.execute("UPDATE etag_seq SET n = n + 1 WHERE id = 1")
-        (n,) = cur.execute("SELECT n FROM etag_seq WHERE id = 1").fetchone()
+        if self._HAS_RETURNING:
+            (n,) = cur.execute(
+                "UPDATE etag_seq SET n = n + 1 WHERE id = 1 RETURNING n").fetchone()
+        else:
+            cur.execute("UPDATE etag_seq SET n = n + 1 WHERE id = 1")
+            (n,) = cur.execute("SELECT n FROM etag_seq WHERE id = 1").fetchone()
         return str(n)
 
     def _set_tx(self, cur: sqlite3.Cursor, key: str, value: Any, etag: str | None) -> str:
-        row = cur.execute("SELECT etag FROM state WHERE key = ?", (key,)).fetchone()
-        if etag is not None and (row is None or row[0] != etag):
-            raise EtagMismatch(f"etag mismatch for key {key!r}")
+        if etag is not None:
+            row = cur.execute("SELECT etag FROM state WHERE key = ?", (key,)).fetchone()
+            if row is None or row[0] != etag:
+                raise EtagMismatch(f"etag mismatch for key {key!r}")
         new_etag = self._next_etag(cur)
         try:
             # allow_nan=False: NaN/Infinity would poison json_extract for

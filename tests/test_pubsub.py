@@ -280,3 +280,37 @@ def test_pubsub_drivers_registered():
     assert "pubsub.azure.servicebus" in types  # reference file loads unchanged
     assert "pubsub.redis" in types
     assert "pubsub.in-memory" in types
+
+
+async def test_sqlite_publish_after_close_raises(tmp_path):
+    """Publish after aclose must fail fast, not hang on an unflushed
+    future (the group-commit queue has no flusher once the executor is
+    shut down)."""
+    broker = make_sqlite(tmp_path)
+    await broker.publish("t", {"n": 1})
+    await broker.aclose()
+    with pytest.raises(RuntimeError):
+        await asyncio.wait_for(broker.publish("t", {"n": 2}), timeout=2)
+    # and again: the failed attempt must not wedge the queue flag
+    with pytest.raises(RuntimeError):
+        await asyncio.wait_for(broker.publish("t", {"n": 3}), timeout=2)
+
+
+async def test_sqlite_concurrent_publish_batches(tmp_path):
+    """Group-commit: a concurrent burst lands every message exactly
+    once per group, in the broker, with futures all resolved."""
+    broker = make_sqlite(tmp_path)
+    got = []
+    done = asyncio.Event()
+
+    async def h(msg):
+        got.append(msg.data["n"])
+        if len(got) >= 200:
+            done.set()
+        return True
+
+    await broker.subscribe("t", "g", h)
+    await asyncio.gather(*(broker.publish("t", {"n": i}) for i in range(200)))
+    await asyncio.wait_for(done.wait(), timeout=10)
+    assert sorted(got) == list(range(200))
+    await broker.aclose()
