@@ -512,6 +512,133 @@ def _cmd_secret(args) -> None:
     _sidecar_request(args, "GET", f"secrets/{args.store}/{args.key}")
 
 
+def _admin_request(registry_file: str, method: str, path: str,
+                   body: dict | None = None) -> dict:
+    """Talk to the orchestrator's control plane (the `az containerapp`
+    verbs surface). Its address comes from orchestrator.json next to
+    the registry file."""
+    import json as json_mod
+    import os
+    import urllib.error
+    import urllib.request
+
+    from tasksrunner.orchestrator.admin import info_path
+    from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER
+
+    info_file = info_path(registry_file)
+    if not info_file.is_file():
+        raise SystemExit(
+            f"no orchestrator control file at {info_file} — is "
+            "`tasksrunner run` running with this registry_file?")
+    info = json_mod.loads(info_file.read_text())
+    url = info["admin_url"] + path
+    headers = {"content-type": "application/json"}
+    token = os.environ.get(TOKEN_ENV)
+    if token:
+        headers[TOKEN_HEADER] = token
+    req = urllib.request.Request(
+        url, method=method, headers=headers,
+        data=json_mod.dumps(body).encode() if body is not None else None)
+    # generous timeout: a rolling restart legitimately takes up to
+    # ~40s per replica before the orchestrator responds
+    timeout = 300
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json_mod.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as exc:
+        detail = exc.read().decode("utf-8", "replace")
+        try:
+            detail = json_mod.loads(detail).get("error", detail)
+        except (ValueError, AttributeError):
+            pass
+        raise SystemExit(f"orchestrator returned {exc.code}: {detail}")
+    except TimeoutError:
+        raise SystemExit(
+            f"orchestrator did not answer within {timeout}s — the operation "
+            "may still be running; check `tasksrunner ps` / `revisions`")
+    except OSError as exc:
+        raise SystemExit(f"cannot reach orchestrator at {url}: {exc} "
+                         "(stale orchestrator.json after a crash?)")
+
+
+def _cmd_restart(args) -> None:
+    """≙ `az containerapp revision restart`: rolling-restart an app's
+    replicas through the orchestrator."""
+    out = _admin_request(args.registry_file, "POST",
+                        f"/admin/apps/{args.app_id}/restart")
+    rev = out.get("revision", {})
+    print(f"restarted {args.app_id} (revision {rev.get('revision')})")
+
+
+def _cmd_logs(args) -> None:
+    """≙ `az containerapp logs show --tail N`."""
+    query = f"?tail={args.tail}"
+    if args.replica is not None:
+        query += f"&replica={args.replica}"
+    out = _admin_request(args.registry_file, "GET",
+                        f"/admin/apps/{args.app_id}/logs{query}")
+    for entry in out.get("lines", []):
+        print(f"[{args.app_id}·{entry['replica']}] {entry['line']}")
+
+
+def _cmd_scale(args) -> None:
+    """≙ `az containerapp update --min-replicas/--max-replicas`."""
+    if args.min_replicas is None and args.max_replicas is None:
+        raise SystemExit("nothing to do: pass --min-replicas and/or --max-replicas")
+    body = {}
+    if args.min_replicas is not None:
+        body["min_replicas"] = args.min_replicas
+    if args.max_replicas is not None:
+        body["max_replicas"] = args.max_replicas
+    out = _admin_request(args.registry_file, "POST",
+                        f"/admin/apps/{args.app_id}/scale", body)
+    rev = out.get("revision", {})
+    print(f"scaled {args.app_id}: min={rev.get('min_replicas')} "
+          f"max={rev.get('max_replicas')} (revision {rev.get('revision')})")
+
+
+def _cmd_update(args) -> None:
+    """≙ `az containerapp update --set-env-vars K=V --remove-env-vars K`:
+    apply an env change as a new revision (rolling restart)."""
+    set_env = {}
+    for pair in args.set_env or []:
+        if "=" not in pair:
+            raise SystemExit(f"--set-env needs KEY=VALUE, got {pair!r}")
+        key, _, value = pair.partition("=")
+        set_env[key] = value
+    remove = args.remove_env or []
+    if not set_env and not remove:
+        raise SystemExit("nothing to do: pass --set-env and/or --remove-env")
+    out = _admin_request(args.registry_file, "POST",
+                        f"/admin/apps/{args.app_id}/env",
+                        {"set": set_env, "remove": remove})
+    rev = out.get("revision", {})
+    print(f"updated {args.app_id} env (revision {rev.get('revision')}): "
+          f"set={sorted(set_env) or '-'} removed={remove or '-'}")
+
+
+def _cmd_revisions(args) -> None:
+    """≙ `az containerapp revision list`: the app's config-change
+    history; the newest revision is the active one."""
+    import time as time_mod
+
+    out = _admin_request(args.registry_file, "GET",
+                        f"/admin/apps/{args.app_id}/revisions")
+    revisions = out.get("revisions", [])
+    if not revisions:
+        print(f"no revisions recorded for {args.app_id}")
+        return
+    print(f"{'REV':>4} {'CREATED':<20} {'ACTIVE':<7} REASON")
+    for rev in revisions:
+        created = time_mod.strftime("%Y-%m-%d %H:%M:%S",
+                                    time_mod.localtime(rev["created"]))
+        details = {k: v for k, v in rev.items()
+                   if k not in ("revision", "created", "active", "reason")}
+        suffix = f"  {details}" if details else ""
+        print(f"{rev['revision']:>4} {created:<20} "
+              f"{'yes' if rev['active'] else 'no':<7} {rev['reason']}{suffix}")
+
+
 def _cmd_stop(args) -> None:
     """≙ `dapr stop --app-id X`: SIGTERM the registered host process."""
     import os
@@ -661,6 +788,47 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("app_id")
     p.add_argument("--registry-file", **registry_arg)
     p.set_defaults(fn=_cmd_stop)
+
+    p = sub.add_parser("restart",
+                       help="rolling-restart an app via the orchestrator "
+                            "(≙ az containerapp revision restart)")
+    p.add_argument("app_id")
+    p.add_argument("--registry-file", **registry_arg)
+    p.set_defaults(fn=_cmd_restart)
+
+    p = sub.add_parser("logs",
+                       help="recent output of an app's replicas "
+                            "(≙ az containerapp logs show)")
+    p.add_argument("app_id")
+    p.add_argument("--tail", type=int, default=100)
+    p.add_argument("--replica", type=int, default=None)
+    p.add_argument("--registry-file", **registry_arg)
+    p.set_defaults(fn=_cmd_logs)
+
+    p = sub.add_parser("scale",
+                       help="change an app's replica bounds "
+                            "(≙ az containerapp update --min/--max-replicas)")
+    p.add_argument("app_id")
+    p.add_argument("--min-replicas", type=int, default=None)
+    p.add_argument("--max-replicas", type=int, default=None)
+    p.add_argument("--registry-file", **registry_arg)
+    p.set_defaults(fn=_cmd_scale)
+
+    p = sub.add_parser("update",
+                       help="apply an env change as a new revision "
+                            "(≙ az containerapp update --set-env-vars)")
+    p.add_argument("app_id")
+    p.add_argument("--set-env", action="append", metavar="KEY=VALUE")
+    p.add_argument("--remove-env", action="append", metavar="KEY")
+    p.add_argument("--registry-file", **registry_arg)
+    p.set_defaults(fn=_cmd_update)
+
+    p = sub.add_parser("revisions",
+                       help="an app's config-change history "
+                            "(≙ az containerapp revision list)")
+    p.add_argument("app_id")
+    p.add_argument("--registry-file", **registry_arg)
+    p.set_defaults(fn=_cmd_revisions)
 
     return parser
 

@@ -1,0 +1,190 @@
+"""Orchestrator control-plane API + the file that advertises it.
+
+The reference operates running apps through `az containerapp` verbs —
+`update --set-env-vars` / `--min-replicas` (docs/aca/02-aca-comm/
+index.md:238-300, docs/aca/09-aca-autoscale-keda/index.md:100-145),
+`revision restart` / `revision list` (used across modules 2 and 8),
+`logs show`, and `replica list`. This module is that surface for the
+local orchestrator: a localhost-only HTTP API the `tasksrunner`
+CLI (`restart` / `update` / `scale` / `logs` / `revisions` / `ps`)
+drives.
+
+Discovery: the server writes ``orchestrator.json`` next to the
+name-registry file (pid + admin URL); the CLI reads it. If
+``TASKSRUNNER_API_TOKEN`` is set for the orchestrator, every admin
+request must carry it in the same header the sidecars require —
+one token protects the whole control plane.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pathlib
+import typing
+
+from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER
+
+if typing.TYPE_CHECKING:  # import cycle: run.py starts the AdminServer
+    from tasksrunner.orchestrator.run import Orchestrator
+
+logger = logging.getLogger(__name__)
+
+INFO_FILENAME = "orchestrator.json"
+
+
+def info_path(registry_file: str | pathlib.Path) -> pathlib.Path:
+    return pathlib.Path(registry_file).parent / INFO_FILENAME
+
+
+class AdminServer:
+    def __init__(self, orch: "Orchestrator", *, port: int = 0,
+                 host: str = "127.0.0.1"):
+        self.orch = orch
+        self.host = host
+        self.port = port
+        self._runner = None
+        self._site = None
+        self._info_file: pathlib.Path | None = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def start(self) -> None:
+        from aiohttp import web
+
+        @web.middleware
+        async def auth_middleware(request, handler):
+            token = os.environ.get(TOKEN_ENV)
+            if token and request.headers.get(TOKEN_HEADER) != token:
+                return web.json_response(
+                    {"error": "missing or bad api token"}, status=401)
+            return await handler(request)
+
+        app = web.Application(middlewares=[auth_middleware])
+        app.router.add_get("/admin/apps", self._apps)
+        app.router.add_get("/admin/apps/{app_id}/logs", self._logs)
+        app.router.add_get("/admin/apps/{app_id}/revisions", self._revisions)
+        app.router.add_post("/admin/apps/{app_id}/restart", self._restart)
+        app.router.add_post("/admin/apps/{app_id}/env", self._env)
+        app.router.add_post("/admin/apps/{app_id}/scale", self._scale)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, self.host, self.port)
+        await self._site.start()
+        actual_port = self._site._server.sockets[0].getsockname()[1]
+        self.port = actual_port
+
+        registry = pathlib.Path(self.orch.config.registry_file)
+        if not registry.is_absolute():
+            registry = self.orch.config.base_dir / registry
+        self._info_file = info_path(registry)
+        self._info_file.parent.mkdir(parents=True, exist_ok=True)
+        self._info_file.write_text(json.dumps({
+            "admin_url": f"http://{self.host}:{actual_port}",
+            "pid": os.getpid(),
+        }))
+        logger.info("orchestrator admin API on http://%s:%d", self.host, actual_port)
+
+    async def stop(self) -> None:
+        if self._info_file is not None:
+            try:
+                self._info_file.unlink()
+            except OSError:
+                pass
+            self._info_file = None
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # -- handlers --------------------------------------------------------
+
+    def _resolve_app(self, request):
+        from aiohttp import web
+
+        app_id = request.match_info["app_id"]
+        if app_id not in self.orch.replicas:
+            known = ", ".join(sorted(self.orch.replicas)) or "(none)"
+            raise web.HTTPNotFound(
+                text=json.dumps({"error": f"unknown app {app_id!r}; "
+                                          f"running: {known}"}),
+                content_type="application/json")
+        return app_id
+
+    async def _apps(self, request):
+        from aiohttp import web
+
+        return web.json_response(self.orch.status())
+
+    async def _logs(self, request):
+        from aiohttp import web
+
+        app_id = self._resolve_app(request)
+        try:
+            tail = int(request.query.get("tail", "100"))
+            replica = (int(request.query["replica"])
+                       if "replica" in request.query else None)
+        except ValueError:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "tail and replica must be integers"}),
+                content_type="application/json")
+        return web.json_response(
+            {"lines": self.orch.app_logs(app_id, tail=tail, replica=replica)})
+
+    async def _revisions(self, request):
+        from aiohttp import web
+
+        app_id = self._resolve_app(request)
+        return web.json_response(
+            {"revisions": self.orch.revisions.get(app_id, [])})
+
+    async def _restart(self, request):
+        from aiohttp import web
+
+        app_id = self._resolve_app(request)
+        entry = await self.orch.restart_app(app_id)
+        return web.json_response({"restarted": app_id, "revision": entry})
+
+    async def _env(self, request):
+        from aiohttp import web
+
+        app_id = self._resolve_app(request)
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "body must be JSON"}),
+                content_type="application/json")
+        set_env = body.get("set") or {}
+        remove = body.get("remove") or []
+        if not isinstance(set_env, dict) or not isinstance(remove, list):
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "expected {set: {..}, remove: [..]}"}),
+                content_type="application/json")
+        entry = await self.orch.update_env(
+            app_id, set_env=set_env, remove=[str(k) for k in remove])
+        return web.json_response({"updated": app_id, "revision": entry})
+
+    async def _scale(self, request):
+        from aiohttp import web
+
+        app_id = self._resolve_app(request)
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": "body must be JSON"}),
+                content_type="application/json")
+        try:
+            entry = await self.orch.update_scale(
+                app_id,
+                min_replicas=(int(body["min_replicas"])
+                              if "min_replicas" in body else None),
+                max_replicas=(int(body["max_replicas"])
+                              if "max_replicas" in body else None),
+            )
+        except (ValueError, TypeError) as exc:
+            raise web.HTTPBadRequest(
+                text=json.dumps({"error": str(exc)}),
+                content_type="application/json")
+        return web.json_response({"updated": app_id, "revision": entry})

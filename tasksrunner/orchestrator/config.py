@@ -95,6 +95,11 @@ class RunConfig:
     resources_path: str | None = None
     registry_file: str = ".tasksrunner/apps.json"
     base_dir: pathlib.Path = field(default_factory=pathlib.Path.cwd)
+    #: localhost control-plane port (0 = ephemeral). The admin API is
+    #: the `az containerapp update / revision restart / logs show`
+    #: surface of the orchestrator; its address is advertised in
+    #: ``<registry dir>/orchestrator.json`` for the CLI.
+    admin_port: int = 0
 
 
 def load_run_config(path: str | pathlib.Path) -> RunConfig:
@@ -161,4 +166,5 @@ def load_run_config(path: str | pathlib.Path) -> RunConfig:
         resources_path=resources,
         registry_file=str(doc.get("registry_file", ".tasksrunner/apps.json")),
         base_dir=base,
+        admin_port=int(doc.get("admin_port", 0)),
     )

@@ -17,6 +17,7 @@ is exactly how extra ACA replicas of the processor participate.
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
 import os
 import re
@@ -50,9 +51,16 @@ class Replica:
         #: restarts forced by failed liveness probes (vs. crashes)
         self.health_restarts = 0
         self.stopping = False
+        #: set by the admin API before terminating: supervise() then
+        #: restarts immediately without counting it as a crash
+        self.manual_restart = False
         #: (app_port, sidecar_port) parsed from the host's ready line
         self.ports: tuple[int, int] | None = None
         self.ready = asyncio.Event()
+        self.started_at: float | None = None
+        #: recent output lines, served by `tasksrunner logs`
+        #: (≙ `az containerapp logs show`)
+        self.log_buffer: collections.deque[str] = collections.deque(maxlen=2000)
 
     @property
     def tag(self) -> str:
@@ -103,6 +111,7 @@ class Replica:
             env=env,
             cwd=self.config.base_dir,
         )
+        self.started_at = time.time()
         self._pump = asyncio.create_task(self._pump_logs())
         if self.app.health.enabled:
             if self._prober is not None:
@@ -118,6 +127,7 @@ class Replica:
             if m:
                 self.ports = (int(m.group(1)), int(m.group(2)))
                 self.ready.set()
+            self.log_buffer.append(text)
             print(f"[{self.tag}] {text}", flush=True)
 
     async def _probe_liveness(self) -> None:
@@ -195,6 +205,13 @@ class Replica:
             code = await self.proc.wait()
             if self.stopping:
                 return
+            if self.manual_restart:
+                # operator-requested (admin restart / env update): not a
+                # crash — no backoff, no crash-counter increment
+                self.manual_restart = False
+                logger.info("replica %s restarting on request", self.tag)
+                await self.start()
+                continue
             backoff = RESTART_BACKOFF[min(self.restarts, len(RESTART_BACKOFF) - 1)]
             logger.warning("replica %s exited with %s; restarting in %.1fs",
                            self.tag, code, backoff)
@@ -213,10 +230,25 @@ class Orchestrator:
         self._components = (
             load_components(config.resources_path) if config.resources_path else []
         )
+        #: per-app config-change history (≙ ACA revisions: every env or
+        #: scale template change makes a new numbered revision; the
+        #: newest is the active one — single-revision mode, SURVEY §5.3)
+        self.revisions: dict[str, list[dict]] = {}
+        self._admin = None
+
+    def _record_revision(self, app_id: str, reason: str, **details) -> dict:
+        history = self.revisions.setdefault(app_id, [])
+        for rev in history:
+            rev["active"] = False
+        entry = {"revision": len(history) + 1, "created": time.time(),
+                 "reason": reason, "active": True, **details}
+        history.append(entry)
+        return entry
 
     async def start(self) -> None:
         for app in self.config.apps:
             self.replicas[app.app_id] = []
+            self._record_revision(app.app_id, "initial deploy")
             for i in range(app.scale.min_replicas):
                 await self._add_replica(app)
             if app.scale.rules:
@@ -227,6 +259,9 @@ class Orchestrator:
                 )
                 scaler.start()
                 self._scalers.append(scaler)
+        from tasksrunner.orchestrator.admin import AdminServer
+        self._admin = AdminServer(self, port=self.config.admin_port)
+        await self._admin.start()
 
     async def _add_replica(self, app: AppSpec) -> None:
         replica = Replica(app, len(self.replicas[app.app_id]), self.config)
@@ -245,6 +280,146 @@ class Orchestrator:
     def replica_count(self, app_id: str) -> int:
         return len(self.replicas.get(app_id, []))
 
+    # -- admin operations (≙ the `az containerapp` verbs the workshop
+    # -- uses: update / revision restart / revision list / logs show) --
+
+    def _app_spec(self, app_id: str) -> AppSpec:
+        for app in self.config.apps:
+            if app.app_id == app_id:
+                return app
+        raise KeyError(app_id)
+
+    async def _rolling_restart(self, app_id: str) -> None:
+        """Restart replicas one at a time, waiting for each to come
+        back ready, so at least one replica keeps serving throughout."""
+        for replica in list(self.replicas[app_id]):
+            # a replica already down is mid-crash-restart: setting the
+            # manual flag now would mis-classify its NEXT crash as a
+            # requested restart (no backoff, no counter) — skip it
+            if replica.stopping or replica.proc is None \
+                    or replica.proc.returncode is not None:
+                continue
+            old_pid = replica.proc.pid
+            replica.manual_restart = True
+            replica.proc.terminate()
+            deadline = asyncio.get_running_loop().time() + 30
+            killed = False
+            while (replica.proc is None or replica.proc.pid == old_pid
+                   or not replica.ready.is_set()):
+                if asyncio.get_running_loop().time() > deadline:
+                    if not killed and replica.proc is not None \
+                            and replica.proc.pid == old_pid \
+                            and replica.proc.returncode is None:
+                        # SIGTERM trapped/ignored: escalate so the flag
+                        # can't go stale on a process that never exits
+                        logger.warning("replica %s ignored SIGTERM for 30s; "
+                                       "killing", replica.tag)
+                        replica.proc.kill()
+                        killed = True
+                        deadline = asyncio.get_running_loop().time() + 10
+                        continue
+                    logger.warning("replica %s did not come back ready "
+                                   "in time", replica.tag)
+                    break
+                if replica.stopping:
+                    return
+                await asyncio.sleep(0.1)
+
+    async def restart_app(self, app_id: str) -> dict:
+        """≙ `az containerapp revision restart`."""
+        entry = self._record_revision(app_id, "manual restart")
+        await self._rolling_restart(app_id)
+        return entry
+
+    async def update_env(self, app_id: str, *, set_env: dict[str, str],
+                         remove: list[str]) -> dict:
+        """≙ `az containerapp update --set-env-vars/--remove-env-vars`:
+        a config change makes a new revision; replicas restart into it."""
+        app = self._app_spec(app_id)
+        for key in remove:
+            app.env.pop(key, None)
+        app.env.update({str(k): str(v) for k, v in set_env.items()})
+        entry = self._record_revision(
+            app_id, "env update",
+            env_set=sorted(set_env), env_removed=sorted(remove))
+        await self._rolling_restart(app_id)
+        return entry
+
+    async def update_scale(self, app_id: str, *, min_replicas: int | None,
+                           max_replicas: int | None) -> dict:
+        """≙ `az containerapp update --min-replicas/--max-replicas`.
+        No restart needed — the bounds steer the autoscaler and the
+        floor is applied immediately."""
+        app = self._app_spec(app_id)
+        new_min = app.scale.min_replicas if min_replicas is None else min_replicas
+        new_max = app.scale.max_replicas if max_replicas is None else max_replicas
+        if new_min < 1:
+            raise ValueError("min_replicas must be >= 1 (scale-to-zero "
+                             "would starve cron/input bindings)")
+        if new_min > new_max:
+            raise ValueError(
+                f"min_replicas {new_min} exceeds max_replicas {new_max}; "
+                "pass both to raise the ceiling")
+        app.scale.min_replicas = new_min
+        app.scale.max_replicas = new_max
+        entry = self._record_revision(
+            app_id, "scale update",
+            min_replicas=app.scale.min_replicas,
+            max_replicas=app.scale.max_replicas)
+        current = len(self.replicas[app_id])
+        floor = app.scale.min_replicas
+        ceil = app.scale.max_replicas
+        desired = min(max(current, floor), ceil)
+        if desired != current:
+            await self._set_replicas(app, desired)
+        return entry
+
+    def status(self) -> dict:
+        now = time.time()
+        apps = []
+        for app in self.config.apps:
+            group = self.replicas.get(app.app_id, [])
+            active = next(
+                (r for r in self.revisions.get(app.app_id, []) if r["active"]),
+                None)
+            apps.append({
+                "app_id": app.app_id,
+                "module": app.module,
+                "revision": active["revision"] if active else None,
+                "scale": {"min": app.scale.min_replicas,
+                          "max": app.scale.max_replicas},
+                "env_keys": sorted(app.env),
+                "replicas": [
+                    {
+                        "index": r.index,
+                        "pid": r.proc.pid if r.proc else None,
+                        "running": bool(r.proc and r.proc.returncode is None),
+                        "app_port": r.ports[0] if r.ports else None,
+                        "sidecar_port": r.ports[1] if r.ports else None,
+                        "restarts": r.restarts,
+                        "health_restarts": r.health_restarts,
+                        "uptime_seconds": (round(now - r.started_at, 1)
+                                           if r.started_at else None),
+                    }
+                    for r in group
+                ],
+            })
+        return {"apps": apps}
+
+    def app_logs(self, app_id: str, *, tail: int = 100,
+                 replica: int | None = None) -> list[dict]:
+        """≙ `az containerapp logs show --tail N`."""
+        group = self.replicas.get(app_id)
+        if group is None:
+            raise KeyError(app_id)
+        out = []
+        for r in group:
+            if replica is not None and r.index != replica:
+                continue
+            for line in list(r.log_buffer)[-tail:]:
+                out.append({"replica": r.index, "line": line})
+        return out
+
     async def wait(self) -> None:
         """Run until interrupted."""
         stop = asyncio.Event()
@@ -257,6 +432,9 @@ class Orchestrator:
         await stop.wait()
 
     async def stop(self) -> None:
+        if self._admin is not None:
+            await self._admin.stop()
+            self._admin = None
         for scaler in self._scalers:
             await scaler.stop()
         for group in self.replicas.values():
@@ -274,7 +452,13 @@ class Orchestrator:
 
 async def run_from_config(config: RunConfig) -> None:
     orch = Orchestrator(config)
-    await orch.start()
+    try:
+        await orch.start()
+    except BaseException:
+        # e.g. a fixed admin_port already bound: replicas are already
+        # spawned by now — stop them rather than orphaning children
+        await orch.stop()
+        raise
     apps = ", ".join(a.app_id for a in config.apps)
     logger.info("orchestrator running apps: %s (ctrl-c to stop)", apps)
     try:

@@ -1,0 +1,173 @@
+"""Orchestrator control-plane tests: the `az containerapp` verbs the
+workshop operates with (SURVEY.md §2.6 / docs modules 2, 8, 9) mapped
+to the admin API — status, rolling restart, env update as a new
+revision, live scale bounds, log tail, revision history.
+"""
+
+import asyncio
+import json
+import os
+import pathlib
+import textwrap
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tasksrunner.orchestrator.config import AppSpec, RunConfig, ScaleSpec
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _write_env_echo_app(tmp_path):
+    pkg = tmp_path / "envpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "echo.py").write_text(textwrap.dedent("""
+        import os
+        from tasksrunner import App
+
+        def make_app():
+            app = App("echo")
+
+            @app.get("/greeting")
+            async def greeting(req):
+                return {"greeting": os.environ.get("GREETING", "unset"),
+                        "pid": os.getpid()}
+
+            return app
+    """))
+
+
+async def _admin(url, method="GET", body=None):
+    def call():
+        req = urllib.request.Request(
+            url, method=method,
+            headers={"content-type": "application/json"},
+            data=json.dumps(body).encode() if body is not None else None)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    return await asyncio.get_running_loop().run_in_executor(None, call)
+
+
+async def _app_get(port, path):
+    def call():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+            return json.loads(resp.read())
+    return await asyncio.get_running_loop().run_in_executor(None, call)
+
+
+@pytest.mark.asyncio
+async def test_admin_api_full_lifecycle(tmp_path):
+    from tasksrunner.orchestrator.admin import info_path
+    from tasksrunner.orchestrator.run import Orchestrator
+
+    _write_env_echo_app(tmp_path)
+    config = RunConfig(
+        apps=[AppSpec(app_id="echo", module="envpkg.echo:make_app",
+                      env={"GREETING": "hello"},
+                      scale=ScaleSpec(min_replicas=1, max_replicas=3))],
+        registry_file=str(tmp_path / "apps.json"),
+        base_dir=tmp_path,
+    )
+    os.environ["PYTHONPATH"] = f"{tmp_path}{os.pathsep}{REPO}"
+    orch = Orchestrator(config)
+    await orch.start()
+    try:
+        info_file = info_path(tmp_path / "apps.json")
+        assert info_file.is_file(), "orchestrator.json must advertise the admin API"
+        admin_url = json.loads(info_file.read_text())["admin_url"]
+
+        replica = orch.replicas["echo"][0]
+        await asyncio.wait_for(replica.ready.wait(), timeout=30)
+        app_port = replica.ports[0]
+
+        # -- status (az containerapp replica list analog)
+        status, out = await _admin(f"{admin_url}/admin/apps")
+        assert status == 200
+        (app,) = out["apps"]
+        assert app["app_id"] == "echo"
+        assert app["revision"] == 1
+        assert app["replicas"][0]["running"] is True
+        first_pid = app["replicas"][0]["pid"]
+
+        # the app really runs with its configured env
+        doc = await _app_get(app_port, "/greeting")
+        assert doc == {"greeting": "hello", "pid": first_pid}
+
+        # -- unknown app → 404 with the known set
+        with pytest.raises(urllib.error.HTTPError):
+            await _admin(f"{admin_url}/admin/apps/nope/restart", "POST")
+
+        # -- manual restart: new pid, same config, new revision, and
+        # -- NOT counted as a crash
+        status, out = await _admin(f"{admin_url}/admin/apps/echo/restart", "POST")
+        assert status == 200 and out["revision"]["revision"] == 2
+        await asyncio.wait_for(replica.ready.wait(), timeout=30)
+        doc = await _app_get(replica.ports[0], "/greeting")
+        assert doc["pid"] != first_pid
+        assert doc["greeting"] == "hello"
+        assert replica.restarts == 0, "manual restart must not count as crash"
+
+        # -- env update: new revision, replicas restarted into new env
+        status, out = await _admin(
+            f"{admin_url}/admin/apps/echo/env", "POST",
+            {"set": {"GREETING": "bonjour"}, "remove": []})
+        assert status == 200 and out["revision"]["revision"] == 3
+        await asyncio.wait_for(replica.ready.wait(), timeout=30)
+        doc = await _app_get(replica.ports[0], "/greeting")
+        assert doc["greeting"] == "bonjour"
+
+        # -- scale up the floor: replicas appear without restart
+        status, out = await _admin(
+            f"{admin_url}/admin/apps/echo/scale", "POST", {"min_replicas": 2})
+        assert status == 200
+        assert len(orch.replicas["echo"]) == 2
+        # scale-to-zero refused (workshop rejects it: starves bindings)
+        with pytest.raises(urllib.error.HTTPError):
+            await _admin(f"{admin_url}/admin/apps/echo/scale", "POST",
+                         {"min_replicas": 0})
+        # min above the current max refused (invariant min <= max)
+        with pytest.raises(urllib.error.HTTPError):
+            await _admin(f"{admin_url}/admin/apps/echo/scale", "POST",
+                         {"min_replicas": 9})
+
+        # -- revision history reflects every change, newest active
+        status, out = await _admin(f"{admin_url}/admin/apps/echo/revisions")
+        reasons = [r["reason"] for r in out["revisions"]]
+        assert reasons == ["initial deploy", "manual restart",
+                           "env update", "scale update"]
+        actives = [r for r in out["revisions"] if r["active"]]
+        assert len(actives) == 1 and actives[0]["revision"] == 4
+
+        # -- logs: every replica's recent lines, tail-limited
+        second = orch.replicas["echo"][1]
+        await asyncio.wait_for(second.ready.wait(), timeout=30)
+        status, out = await _admin(
+            f"{admin_url}/admin/apps/echo/logs?tail=50")
+        assert status == 200
+        lines = out["lines"]
+        assert any("ready app=" in e["line"] for e in lines)
+        assert {e["replica"] for e in lines} == {0, 1}
+    finally:
+        await orch.stop()
+    assert not info_path(tmp_path / "apps.json").is_file(), \
+        "orchestrator.json must be cleaned up on stop"
+
+
+def test_admin_cli_parser_wiring():
+    from tasksrunner.cli import build_parser
+
+    parser = build_parser()
+    args = parser.parse_args(["restart", "echo"])
+    assert args.app_id == "echo"
+    args = parser.parse_args(["logs", "echo", "--tail", "5", "--replica", "1"])
+    assert (args.tail, args.replica) == (5, 1)
+    args = parser.parse_args(["scale", "echo", "--min-replicas", "2"])
+    assert args.min_replicas == 2 and args.max_replicas is None
+    args = parser.parse_args(
+        ["update", "echo", "--set-env", "A=1", "--remove-env", "B"])
+    assert args.set_env == ["A=1"] and args.remove_env == ["B"]
+    args = parser.parse_args(["revisions", "echo"])
+    assert args.fn is not None
