@@ -11,12 +11,17 @@ TPU-first design notes:
   throughput; params are kept in float32 and cast per-step;
 * static shapes everywhere; the whole train step is one ``jax.jit``
   region — no Python control flow inside;
-* parallelism is expressed as shardings over a 2-D
-  ``Mesh(("dp","tp"))``: batch on ``dp``, feature/head dimensions on
-  ``tp``; XLA inserts the collectives (psum for tp-reduced matmuls,
-  gradient all-reduce over dp) — nothing is hand-scheduled;
+* parallelism is expressed as shardings over a ``Mesh(("dp","tp"))``
+  or ``Mesh(("dp","sp","tp"))``: batch on ``dp``, feature/head
+  dimensions on ``tp``, sequence on ``sp``; XLA inserts the
+  collectives (psum for tp-reduced matmuls, gradient all-reduce over
+  dp) — nothing is hand-scheduled;
 * attention uses plain ``jnp.einsum`` so XLA can fuse QK^T → softmax
-  → V into its flash-style schedule on TPU.
+  → V into its flash-style schedule on TPU — except under sequence
+  parallelism (an ``sp`` axis of size > 1), where the attention core
+  switches to ring attention (tasksrunner/ml/ring.py): K/V blocks
+  rotate by ``ppermute`` over the ICI ring and no device ever holds
+  the full sequence.
 """
 
 from __future__ import annotations
@@ -86,7 +91,13 @@ def _layernorm(x: jax.Array, scale: jax.Array) -> jax.Array:
     return (x - mu) * jax.lax.rsqrt(var + 1e-6) * scale
 
 
-def _attention(x: jax.Array, layer: dict, cfg: ModelConfig) -> jax.Array:
+def _use_ring(mesh: Mesh | None) -> bool:
+    return (mesh is not None and "sp" in mesh.axis_names
+            and mesh.shape["sp"] > 1)
+
+
+def _attention(x: jax.Array, layer: dict, cfg: ModelConfig,
+               mesh: Mesh | None = None) -> jax.Array:
     b, s, _ = x.shape
     h, dh = cfg.n_heads, cfg.d_head
 
@@ -94,21 +105,30 @@ def _attention(x: jax.Array, layer: dict, cfg: ModelConfig) -> jax.Array:
         return _matmul(x, w).reshape(b, s, h, dh)
 
     q, k, v = heads(layer["wq"]), heads(layer["wk"]), heads(layer["wv"])
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.bfloat16),
-                        k.astype(jnp.bfloat16),
-                        preferred_element_type=jnp.float32)
-    probs = jax.nn.softmax(logits / jnp.sqrt(jnp.float32(dh)), axis=-1)
-    ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(jnp.bfloat16),
-                     v.astype(jnp.bfloat16),
-                     preferred_element_type=jnp.float32)
+    if _use_ring(mesh):
+        from tasksrunner.ml.ring import ring_attention
+        ctx = ring_attention(q, k, v, mesh=mesh)          # [b, s, h, dh]
+    else:
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.bfloat16),
+                            k.astype(jnp.bfloat16),
+                            preferred_element_type=jnp.float32)
+        probs = jax.nn.softmax(logits / jnp.sqrt(jnp.float32(dh)), axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(jnp.bfloat16),
+                         v.astype(jnp.bfloat16),
+                         preferred_element_type=jnp.float32)
     return _matmul(ctx.reshape(b, s, h * dh), layer["wo"])
 
 
-def forward(params: dict, tokens: jax.Array, *, cfg: ModelConfig) -> jax.Array:
-    """tokens [batch, seq] int32 → class logits [batch, n_classes]."""
+def forward(params: dict, tokens: jax.Array, *, cfg: ModelConfig,
+            mesh: Mesh | None = None) -> jax.Array:
+    """tokens [batch, seq] int32 → class logits [batch, n_classes].
+
+    ``mesh`` only changes which attention core runs (ring under an
+    ``sp`` axis); everything else is plain GSPMD — the same code jits
+    single-chip and multi-chip."""
     x = params["embed"][tokens] + params["pos"][None, :, :]
     for layer in params["layers"]:
-        x = x + _attention(_layernorm(x, layer["ln1"]), layer, cfg)
+        x = x + _attention(_layernorm(x, layer["ln1"]), layer, cfg, mesh)
         y = _layernorm(x, layer["ln2"])
         y = _matmul(jax.nn.gelu(_matmul(y, layer["w1"])), layer["w2"])
         x = x + y
@@ -117,8 +137,8 @@ def forward(params: dict, tokens: jax.Array, *, cfg: ModelConfig) -> jax.Array:
 
 
 def loss_fn(params: dict, tokens: jax.Array, labels: jax.Array, *,
-            cfg: ModelConfig) -> jax.Array:
-    logits = forward(params, tokens, cfg=cfg)
+            cfg: ModelConfig, mesh: Mesh | None = None) -> jax.Array:
+    logits = forward(params, tokens, cfg=cfg, mesh=mesh)
     logp = jax.nn.log_softmax(logits, axis=-1)
     return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
 
@@ -154,12 +174,13 @@ def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
 def make_train_step(cfg: ModelConfig, mesh: Mesh | None = None, *,
                     learning_rate: float = 1e-3):
     """One SGD step as a single jit region. With a mesh, inputs are
-    batch-sharded over dp and params tp-sharded; XLA inserts the
-    collectives."""
+    batch-sharded over dp (and sequence-sharded over sp when the mesh
+    has that axis), params tp-sharded; XLA inserts the collectives —
+    except the ring attention core, which hand-places its ppermutes."""
 
     def step(params, tokens, labels):
         loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, tokens, labels, cfg=cfg))(params)
+            lambda p: loss_fn(p, tokens, labels, cfg=cfg, mesh=mesh))(params)
         new_params = jax.tree.map(
             lambda p, g: (p - learning_rate * g).astype(p.dtype), params, grads)
         return new_params, loss
@@ -170,7 +191,8 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh | None = None, *,
     specs = param_specs(cfg)
     param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                             is_leaf=lambda x: isinstance(x, P))
-    data_sh = NamedSharding(mesh, P("dp", None))
+    seq_axis = "sp" if "sp" in mesh.axis_names else None
+    data_sh = NamedSharding(mesh, P("dp", seq_axis))
     label_sh = NamedSharding(mesh, P("dp"))
     return jax.jit(
         step,

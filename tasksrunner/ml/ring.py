@@ -1,0 +1,102 @@
+"""Ring attention: sequence-parallel attention over a mesh axis.
+
+EXTENSION ONLY (see tasksrunner/ml/model.py) — the reference has no
+sequence dimension anywhere (SURVEY.md §5.7); this exists so the demo
+workload's multi-chip path exercises a real long-context strategy.
+
+The TPU-native design (after the published ring-attention recipe):
+each device holds one sequence block of Q, K, V. K/V blocks rotate
+around the ring with ``lax.ppermute`` (neighbor exchange rides the ICI
+torus — never a global all-gather), while each device accumulates its
+Q-block's attention over every visiting K/V block using the
+numerically-stable flash-style running (max, numerator, denominator)
+triple. Peak memory per device is O(block²) instead of O(seq²), and
+compute overlaps the ppermute transfers under XLA's async collectives.
+
+Composition with the other axes: batch stays on ``dp``, heads stay on
+``tp`` — the ring runs over ``sp`` only, so head-parallel and
+sequence-parallel compose orthogonally (each device ring-exchanges
+only its local heads' K/V slices).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _block_update(q, k_blk, v_blk, m, num, den, *, scale):
+    """Fold one visiting K/V block into the running softmax state.
+
+    q:            [b, sq, h, dh]   this device's queries (fixed)
+    k_blk/v_blk:  [b, sk, h, dh]   the visiting block
+    m/num/den:    running max [b,h,sq], numerator [b,h,sq,dh],
+                  denominator [b,h,sq]
+    """
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.bfloat16), k_blk.astype(jnp.bfloat16),
+        preferred_element_type=jnp.float32) * scale
+    m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+    correction = jnp.exp(m - m_new)
+    probs = jnp.exp(logits - m_new[..., None])
+    num = num * correction[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", probs.astype(jnp.bfloat16),
+        v_blk.astype(jnp.bfloat16), preferred_element_type=jnp.float32)
+    den = den * correction + jnp.sum(probs, axis=-1)
+    return m_new, num, den
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, scale: float):
+    """Per-device body (runs under shard_map): q/k/v are the local
+    [b, s_block, h, dh] shards; returns the local context block."""
+    n = jax.lax.axis_size(axis_name)
+    b, sq, h, dh = q.shape
+    init = (
+        k, v,
+        jnp.full((b, h, sq), -jnp.inf, jnp.float32),
+        jnp.zeros((b, h, sq, dh), jnp.float32),
+        jnp.zeros((b, h, sq), jnp.float32),
+    )
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        k_blk, v_blk, m, num, den = carry
+        m, num, den = _block_update(q, k_blk, v_blk, m, num, den, scale=scale)
+        # rotate AFTER consuming: after n steps every device has seen
+        # every block exactly once and K/V are home again
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m, num, den), None
+
+    (_, _, _, num, den), _ = jax.lax.scan(step, init, None, length=n)
+    ctx = num / den[..., None]                      # [b, h, sq, dh]
+    return jnp.transpose(ctx, (0, 2, 1, 3))         # [b, sq, h, dh]
+
+
+def ring_attention(q, k, v, *, mesh: Mesh, axis_name: str = "sp",
+                   scale: float | None = None):
+    """Bidirectional (encoder) ring attention.
+
+    q/k/v: [batch, seq, heads, d_head] — global arrays; batch may be
+    sharded on "dp", heads on "tp"; seq is sharded on ``axis_name``
+    and never materialised whole on any device.
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    if axis_name not in mesh.axis_names:
+        raise ValueError(f"mesh has no {axis_name!r} axis: {mesh.axis_names}")
+    # only name axes the mesh actually has; absent ones replicate
+    batch_axis = "dp" if "dp" in mesh.axis_names else None
+    head_axis = "tp" if "tp" in mesh.axis_names else None
+    spec = P(batch_axis, axis_name, head_axis, None)
+    body = functools.partial(_ring_attention_local,
+                             axis_name=axis_name, scale=scale)
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
