@@ -397,14 +397,9 @@ def _cmd_components(args) -> None:
         raise SystemExit(f"{problems} component(s) have no registered driver")
 
 
-def _sidecar_request(args, method: str, path: str, body=None,
-                     *, query: str = ""):
-    """Shared plumbing for the probe commands: resolve ``--app-id``'s
-    sidecar from the registry and issue one /v1.0 request against it —
-    the same raw probes the workshop runs with curl at its manual
-    verification checkpoints (docs/aca/04-aca-dapr-stateapi/
-    index.md:41-75, docs/aca/05-aca-dapr-pubsubapi/index.md:60-88)."""
-    import json as json_mod
+def _resolve_sidecar(args):
+    """Resolve ``--app-id``'s sidecar address + the auth headers every
+    probe/flood command sends (one place for the token scheme)."""
     import os
 
     from tasksrunner.errors import AppNotFound
@@ -418,20 +413,33 @@ def _sidecar_request(args, method: str, path: str, body=None,
         known = ", ".join(resolver.known_apps()) or "(none registered)"
         raise SystemExit(
             f"app {args.app_id!r} is not registered; running apps: {known}")
+    headers = {"Content-Type": "application/json"}
+    token = os.environ.get(TOKEN_ENV)
+    if token:
+        headers[TOKEN_HEADER] = token
+    return addr, headers
+
+
+def _sidecar_request(args, method: str, path: str, body=None,
+                     *, query: str = ""):
+    """Shared plumbing for the probe commands: resolve ``--app-id``'s
+    sidecar from the registry and issue one /v1.0 request against it —
+    the same raw probes the workshop runs with curl at its manual
+    verification checkpoints (docs/aca/04-aca-dapr-stateapi/
+    index.md:41-75, docs/aca/05-aca-dapr-pubsubapi/index.md:60-88)."""
+    import json as json_mod
+
+    addr, base_headers = _resolve_sidecar(args)
 
     async def go():
         import aiohttp
 
-        headers = {"Content-Type": "application/json"}
-        token = os.environ.get(TOKEN_ENV)
-        if token:
-            headers[TOKEN_HEADER] = token
         url = f"{addr.base_url}/v1.0/{path}"
         if query:
             url += "?" + query
         timeout = aiohttp.ClientTimeout(total=30.0)
         async with aiohttp.ClientSession(timeout=timeout) as s:
-            async with s.request(method, url, headers=headers,
+            async with s.request(method, url, headers=base_headers,
                                  data=None if body is None
                                  else json_mod.dumps(body)) as r:
                 raw = await r.read()
@@ -479,9 +487,64 @@ def _cmd_invoke(args) -> None:
 
 def _cmd_publish(args) -> None:
     """≙ `dapr publish`: POST /v1.0/publish/{pubsub}/{topic} through
-    the sidecar of --app-id (scope decides which broker it sees)."""
-    _sidecar_request(args, "POST", f"publish/{args.pubsub}/{args.topic}",
-                     _parse_data(args.data))
+    the sidecar of --app-id (scope decides which broker it sees).
+
+    ``--count N`` floods N copies concurrently — the workshop's KEDA
+    load test (Service Bus Explorer message floods + replica-list
+    polling, docs/aca/09-aca-autoscale-keda/index.md:170-200) as one
+    command; watch the scale-out with `tasksrunner ps`."""
+    if args.count <= 1:
+        _sidecar_request(args, "POST", f"publish/{args.pubsub}/{args.topic}",
+                         _parse_data(args.data))
+        return
+
+    import time
+
+    addr, headers = _resolve_sidecar(args)
+    payload = _parse_data(args.data)
+
+    async def flood():
+        import aiohttp
+
+        url = f"{addr.base_url}/v1.0/publish/{args.pubsub}/{args.topic}"
+        sem = asyncio.Semaphore(32)
+        failures = 0
+
+        async def one(i):
+            nonlocal failures
+            async with sem:
+                if isinstance(payload, dict):
+                    body = dict(payload)
+                    body.setdefault("floodSeq", i)
+                elif payload is None:
+                    body = {"floodSeq": i}
+                else:
+                    body = payload
+                try:
+                    async with session.post(url, json=body,
+                                            headers=headers) as resp:
+                        if resp.status >= 400:
+                            failures += 1
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    # any transport/timeout failure is one failed
+                    # publish, never a crashed flood
+                    failures += 1
+
+        start = time.perf_counter()
+        timeout = aiohttp.ClientTimeout(total=30.0)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            await asyncio.gather(*(one(i) for i in range(args.count)))
+        elapsed = time.perf_counter() - start
+        print(f"published {args.count - failures}/{args.count} to "
+              f"{args.pubsub}/{args.topic} in {elapsed:.2f}s "
+              f"({(args.count - failures) / max(elapsed, 1e-9):.0f}/s)"
+              + (f", {failures} FAILED" if failures else ""))
+        if failures:
+            raise SystemExit(2)
+
+    asyncio.run(flood())
 
 
 def _cmd_state(args) -> None:
@@ -762,6 +825,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--app-id", required=True,
                    help="whose sidecar to publish through (decides scope)")
     p.add_argument("--data", default=None, help="JSON payload or @file")
+    p.add_argument("--count", type=int, default=1,
+                   help="flood N copies concurrently (KEDA load test)")
     p.add_argument("--registry-file", **registry_arg)
     p.set_defaults(fn=_cmd_publish)
 

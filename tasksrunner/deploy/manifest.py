@@ -73,6 +73,10 @@ class AppManifest:
     max_replicas: int = 1
     scale_rules: list[dict] = field(default_factory=list)
     cooldown_seconds: float = 5.0
+    #: liveness-probe block passed through to the run config
+    #: (≙ the ACA container probes section); None = defaults,
+    #: False = probing off
+    health: object = None
 
 
 @dataclass
@@ -81,6 +85,11 @@ class EnvironmentManifest:
     apps: list[AppManifest]
     components: list[ComponentRef] = field(default_factory=list)
     registry_file: str = ".tasksrunner/apps.json"
+    #: when true, `apply` refuses to emit a run config unless the
+    #: sidecar/control-plane API token is configured in the deploying
+    #: environment — the secure-baseline posture (≙ the landing zone's
+    #: "no unauthenticated data plane" rule)
+    require_api_token: bool = False
     source_path: pathlib.Path | None = None
 
     @property
@@ -115,6 +124,7 @@ def load_manifest(path: str | pathlib.Path) -> EnvironmentManifest:
             max_replicas=int(scale.get("max_replicas", 1)),
             scale_rules=list(scale.get("rules") or []),
             cooldown_seconds=float(scale.get("cooldown_seconds", 5.0)),
+            health=raw.get("health"),
         ))
 
     components = [
@@ -128,6 +138,7 @@ def load_manifest(path: str | pathlib.Path) -> EnvironmentManifest:
         apps=apps,
         components=components,
         registry_file=str(env.get("registry_file", ".tasksrunner/apps.json")),
+        require_api_token=bool(env.get("require_api_token", False)),
         source_path=path.resolve(),
     )
 
@@ -171,6 +182,12 @@ def validate_manifest(manifest: EnvironmentManifest, *,
                             "(scale-to-zero starves cron/input bindings)")
         if app.max_replicas < app.min_replicas:
             problems.append(f"{where}: max_replicas < min_replicas")
+        if app.health is not None:
+            from tasksrunner.orchestrator.config import parse_health
+            try:
+                parse_health(app.health)
+            except ComponentError as exc:
+                problems.append(f"{where}: {exc}")
         for port in (app.app_port, app.sidecar_port):
             if port:
                 if port in seen_ports:

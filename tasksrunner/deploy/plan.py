@@ -87,10 +87,14 @@ def _resolve_secret(name: str, spec: object, *, app_id: str) -> str:
         return spec
     if isinstance(spec, dict) and "env" in spec:
         var = str(spec["env"])
-        if var not in os.environ:
-            raise ComponentError(
-                f"app {app_id!r}: secret {name!r} references unset env var {var!r}")
-        return os.environ[var]
+        if var in os.environ:
+            return os.environ[var]
+        if "default" in spec:
+            # ≙ the reference's `'dummy'` fallback for the sendgrid key
+            # (secrets/processor-backend-service-secrets.bicep:36)
+            return str(spec["default"])
+        raise ComponentError(
+            f"app {app_id!r}: secret {name!r} references unset env var {var!r}")
     raise ComponentError(f"app {app_id!r}: secret {name!r} must be a string or {{env: VAR}}")
 
 
@@ -106,6 +110,13 @@ def apply_manifest(manifest: EnvironmentManifest) -> dict:
     if not preview["valid"]:
         raise ComponentError(
             "manifest is invalid:\n  - " + "\n  - ".join(preview["problems"]))
+    if manifest.require_api_token:
+        from tasksrunner.security import TOKEN_ENV
+        if not os.environ.get(TOKEN_ENV):
+            raise ComponentError(
+                f"manifest requires an API token but {TOKEN_ENV} is not set "
+                "in the deploying environment (the secure-baseline posture: "
+                "no unauthenticated sidecar/control-plane access)")
 
     out_dir = manifest.base_dir / ".tasksrunner"
     out_dir.mkdir(parents=True, exist_ok=True)
@@ -134,6 +145,8 @@ def apply_manifest(manifest: EnvironmentManifest) -> dict:
                 "cooldown_seconds": app.cooldown_seconds,
                 "rules": app.scale_rules,
             }
+        if app.health is not None:
+            entry["health"] = app.health
         apps_block.append(entry)
 
     # components land in a generated resources dir, one local-dialect
@@ -149,11 +162,22 @@ def apply_manifest(manifest: EnvironmentManifest) -> dict:
     for spec in specs:
         (resources_dir / f"{spec.name}.yaml").write_text(dump_components([spec]))
 
+    # anchor the registry at the manifest's own directory: the emitted
+    # run config lives under .tasksrunner/, and a relative registry
+    # path would otherwise nest a second .tasksrunner/ inside it
+    registry = pathlib.Path(manifest.registry_file)
+    if not registry.is_absolute():
+        registry = manifest.base_dir / registry
     run_config = {
         "resources_path": str(resources_dir),
-        "registry_file": manifest.registry_file,
+        "registry_file": str(registry),
         "apps": apps_block,
     }
+    if manifest.require_api_token:
+        # the posture travels with the artifact: the orchestrator will
+        # refuse to start this config unauthenticated even from a
+        # fresh shell (deploy-time check alone would not survive CI)
+        run_config["require_api_token"] = True
     run_path = out_dir / f"{manifest.name}-run.yaml"
     run_path.write_text(yaml.safe_dump(run_config, sort_keys=False))
 

@@ -100,6 +100,34 @@ class RunConfig:
     #: surface of the orchestrator; its address is advertised in
     #: ``<registry dir>/orchestrator.json`` for the CLI.
     admin_port: int = 0
+    #: stamped by `deploy apply` for require_api_token manifests: the
+    #: orchestrator refuses to start unauthenticated, no matter which
+    #: shell launches the emitted run config
+    require_api_token: bool = False
+
+
+def parse_health(health_raw: object) -> HealthSpec:
+    """Parse a manifest/run-config ``health:`` block; raises
+    ComponentError on bad shape OR bad inner values, so `deploy
+    validate` catches what would otherwise crash at run time."""
+    if health_raw is None or health_raw is True:
+        # bare "health:" / "health: true" = probing with defaults
+        health_raw = {}
+    if health_raw is False:
+        return HealthSpec(enabled=False)
+    if not isinstance(health_raw, dict):
+        raise ComponentError("health must be a mapping or boolean")
+    try:
+        return HealthSpec(
+            enabled=bool(health_raw.get("enabled", True)),
+            interval_seconds=float(health_raw.get("interval_seconds", 5.0)),
+            failure_threshold=int(health_raw.get("failure_threshold", 3)),
+            initial_delay_seconds=float(
+                health_raw.get("initial_delay_seconds", 2.0)),
+            timeout_seconds=float(health_raw.get("timeout_seconds", 2.0)),
+        )
+    except (TypeError, ValueError) as exc:
+        raise ComponentError(f"bad health block value: {exc}") from exc
 
 
 def load_run_config(path: str | pathlib.Path) -> RunConfig:
@@ -122,23 +150,7 @@ def load_run_config(path: str | pathlib.Path) -> RunConfig:
             })
             for r in scale_raw.get("rules") or []
         ]
-        health_raw = raw.get("health", {})
-        if health_raw is None or health_raw is True:
-            # bare "health:" / "health: true" = probing with defaults
-            health_raw = {}
-        if health_raw is False:
-            health = HealthSpec(enabled=False)
-        elif isinstance(health_raw, dict):
-            health = HealthSpec(
-                enabled=bool(health_raw.get("enabled", True)),
-                interval_seconds=float(health_raw.get("interval_seconds", 5.0)),
-                failure_threshold=int(health_raw.get("failure_threshold", 3)),
-                initial_delay_seconds=float(
-                    health_raw.get("initial_delay_seconds", 2.0)),
-                timeout_seconds=float(health_raw.get("timeout_seconds", 2.0)),
-            )
-        else:
-            raise ComponentError("health must be a mapping or false")
+        health = parse_health(raw.get("health", {}))
         apps.append(AppSpec(
             app_id=str(raw["app_id"]),
             module=str(raw["module"]),
@@ -167,4 +179,5 @@ def load_run_config(path: str | pathlib.Path) -> RunConfig:
         registry_file=str(doc.get("registry_file", ".tasksrunner/apps.json")),
         base_dir=base,
         admin_port=int(doc.get("admin_port", 0)),
+        require_api_token=bool(doc.get("require_api_token", False)),
     )

@@ -451,6 +451,14 @@ class Orchestrator:
 
 
 async def run_from_config(config: RunConfig) -> None:
+    if config.require_api_token:
+        from tasksrunner.security import TOKEN_ENV
+        if not os.environ.get(TOKEN_ENV):
+            raise SystemExit(
+                f"this run config requires an API token but {TOKEN_ENV} is "
+                "not set — the manifest was deployed with "
+                "require_api_token: true (secure baseline); refusing to "
+                "start unauthenticated")
     orch = Orchestrator(config)
     try:
         await orch.start()

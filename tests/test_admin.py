@@ -171,3 +171,6 @@ def test_admin_cli_parser_wiring():
     assert args.set_env == ["A=1"] and args.remove_env == ["B"]
     args = parser.parse_args(["revisions", "echo"])
     assert args.fn is not None
+    args = parser.parse_args(
+        ["publish", "ps", "topic", "--app-id", "a", "--count", "50"])
+    assert args.count == 50

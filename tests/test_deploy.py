@@ -177,3 +177,62 @@ def test_apply_rejects_invalid(tmp_path, monkeypatch):
     manifest = load_manifest(_write_manifest(tmp_path, doc))
     with pytest.raises(ComponentError, match="invalid"):
         apply_manifest(manifest)
+
+
+def test_prod_manifest_secure_baseline(tmp_path, monkeypatch):
+    """environment.prod.yaml (≙ module 11 landing-zone baseline):
+    valid, refuses to apply without the API token, emits health blocks
+    and the secret default fallback."""
+    from tasksrunner.security import TOKEN_ENV
+
+    prod = REPO / "samples" / "tasks_tracker" / "environment.prod.yaml"
+    manifest = load_manifest(prod)
+    assert manifest.require_api_token is True
+    assert validate_manifest(manifest) == []
+
+    monkeypatch.chdir(tmp_path)
+    # re-point at a scratch dir so apply writes under tmp
+    import shutil
+    workdir = tmp_path / "sample"
+    shutil.copytree(prod.parent, workdir)
+    manifest = load_manifest(workdir / "environment.prod.yaml")
+
+    monkeypatch.delenv(TOKEN_ENV, raising=False)
+    with pytest.raises(ComponentError, match="API token"):
+        apply_manifest(manifest)
+
+    monkeypatch.setenv(TOKEN_ENV, "testtoken")
+    monkeypatch.delenv("SENDGRID_API_KEY", raising=False)
+    result = apply_manifest(manifest)
+    run_cfg = yaml.safe_load(pathlib.Path(result["run_config"]).read_text())
+    apps = {a["app_id"]: a for a in run_cfg["apps"]}
+    # health blocks pass through to the orchestrator config
+    assert apps["tasksmanager-backend-api"]["health"]["failure_threshold"] == 3
+    # secret default fallback (≙ the reference's 'dummy' sendgrid key)
+    assert apps["tasksmanager-backend-processor"]["env"]["SENDGRID_API_KEY"] == "dummy"
+    # only the frontend is externally reachable
+    assert apps["tasksmanager-frontend-webapp"]["host"] == "0.0.0.0"
+    assert apps["tasksmanager-backend-api"]["host"] == "127.0.0.1"
+    # the posture travels with the artifact...
+    assert run_cfg["require_api_token"] is True
+
+    # ...and the orchestrator refuses to start it unauthenticated
+    import asyncio as aio
+
+    from tasksrunner.orchestrator import load_run_config
+    from tasksrunner.orchestrator.run import run_from_config
+
+    cfg = load_run_config(result["run_config"])
+    assert cfg.require_api_token is True
+    monkeypatch.delenv(TOKEN_ENV, raising=False)
+    with pytest.raises(SystemExit, match="API token"):
+        aio.run(run_from_config(cfg))
+
+
+def test_health_block_validation(tmp_path):
+    doc = {"environment": {"name": "x"},
+           "apps": [{"app_id": "a", "module": "tasksrunner:App",
+                     "health": "often"}]}
+    manifest = load_manifest(_write_manifest(tmp_path, doc))
+    problems = validate_manifest(manifest, check_imports=False)
+    assert any("health" in p for p in problems)
