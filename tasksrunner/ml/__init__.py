@@ -11,10 +11,14 @@ runtime like any other app.
 
 The workload is a small JAX transformer that scores task priority from
 the task's text fields, written TPU-first (bfloat16 matmuls for the
-MXU, static shapes, jit-compiled, dp×tp sharding over a
-``jax.sharding.Mesh``). It exists to exercise the framework's harness
-contract (__graft_entry__.py, bench.py) and as the pattern for users
-who want to host models on tasksrunner.
+MXU, static shapes, jit-compiled, dp×sp×tp sharding over a
+``jax.sharding.Mesh`` with ring attention on the sp axis —
+tasksrunner/ml/ring.py). ``tasksrunner.ml.service.make_app`` hosts it
+as a real runtime app: ``POST /score`` over service invocation, async
+scoring of ``tasksavedtopic`` events into a state store. It exists to
+exercise the framework's harness contract (__graft_entry__.py,
+bench.py) and as the pattern for users who want to host models on
+tasksrunner.
 """
 
 from tasksrunner.ml.model import (

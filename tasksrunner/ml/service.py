@@ -1,0 +1,105 @@
+"""The demo workload service: the scorer hosted as a runtime app.
+
+EXTENSION ONLY (see package docstring) — this is the pattern for
+hosting compute on tasksrunner: a model served by an ordinary ``App``
+that participates in the same building blocks as every other service.
+
+* ``POST /score`` — synchronous inference: task JSON in, priority
+  class + confidence out (service-invocation callable:
+  ``client.invoke_method("priority-scorer", "score", ...)``).
+* subscribes to ``tasksavedtopic`` — every saved task is scored
+  asynchronously and the score written to the ``scores`` state
+  component, exactly how the Tasks Tracker processor consumes the
+  same topic.
+* ``GET /scores/{task_id}`` — read a stored score back.
+
+The model jits once at startup (TPU: first call compiles, the rest
+replay the executable); scoring batches of one are still MXU matmuls
+in bfloat16.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from tasksrunner.app import App
+
+logger = logging.getLogger(__name__)
+
+PRIORITY_LABELS = ["backlog", "low", "normal", "high", "urgent"]
+
+
+def make_app(*, pubsub: str = "taskspubsub", topic: str = "tasksavedtopic",
+             state_store: str = "scores") -> App:
+    import jax
+
+    from tasksrunner.ml.model import (
+        ModelConfig, forward, hash_tokens, init_params,
+    )
+
+    cfg = ModelConfig(n_classes=len(PRIORITY_LABELS))
+    app = App("priority-scorer")
+    compiled = {}
+
+    @app.on_startup
+    async def load_model():
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        fn = jax.jit(lambda p, t: forward(p, t, cfg=cfg))
+        # warm the cache so the first request doesn't pay compilation
+        fn(params, hash_tokens(["warmup"], cfg)).block_until_ready()
+        compiled["params"], compiled["fn"] = params, fn
+
+    def _score_sync(task: dict) -> dict:
+        text = " ".join(
+            str(task.get(k, "")) for k in
+            ("taskName", "taskCreatedBy", "taskAssignedTo") if task.get(k))
+        logits = compiled["fn"](compiled["params"], hash_tokens([text or "empty"], cfg))
+        probs = jax.nn.softmax(logits[0])
+        idx = int(logits[0].argmax())
+        return {
+            "priority": PRIORITY_LABELS[idx],
+            "confidence": round(float(probs[idx]), 4),
+        }
+
+    async def _score(task: dict) -> dict:
+        # off the event loop: with a real model an inference takes long
+        # enough to stall every concurrent request/delivery/probe on
+        # this app (JAX releases the GIL during device compute)
+        return await asyncio.to_thread(_score_sync, task)
+
+    @app.post("/score")
+    async def score(req):
+        if not compiled:
+            # registered and serving, but the jit warmup hasn't
+            # finished: a retryable not-ready, never an opaque 500
+            return 503, {"error": "model loading, retry shortly"}
+        try:
+            task = req.json()
+        except ValueError:
+            return 400, {"error": "body must be JSON"}
+        if not isinstance(task, dict):
+            return 400, {"error": "body must be a task object"}
+        return await _score(task)
+
+    @app.subscribe(pubsub=pubsub, topic=topic, route="/on-task-saved")
+    async def on_task_saved(req):
+        if not compiled:
+            return 503  # non-2xx: broker redelivers after the warmup
+        task = req.data  # CloudEvents envelope unwrapped
+        if not isinstance(task, dict) or not task.get("taskId"):
+            return 200  # not a task event; ack and move on
+        result = await _score(task)
+        await app.client.save_state(state_store, str(task["taskId"]), result)
+        logger.info("scored task %s: %s (%.2f)", task["taskId"],
+                    result["priority"], result["confidence"])
+        return 200
+
+    @app.get("/scores/{task_id}")
+    async def get_score(req):
+        value = await app.client.get_state(state_store, req.path_params["task_id"])
+        if value is None:
+            return 404, {"error": f"no score for {req.path_params['task_id']}"}
+        return value
+
+    return app

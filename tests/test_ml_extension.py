@@ -2,6 +2,7 @@
 contract (__graft_entry__.py). See tasksrunner/ml/__init__.py for why
 this is an extension, not ported capability."""
 
+import asyncio
 import pathlib
 import sys
 
@@ -157,3 +158,54 @@ def test_sequence_parallel_train_step_matches_single_device():
     assert abs(float(loss) - float(single_loss)) < 2e-2
     np.testing.assert_allclose(np.asarray(single_params["head"]),
                                np.asarray(new_params["head"]), atol=2e-2)
+
+
+@pytest.mark.asyncio
+async def test_scorer_service_on_the_runtime():
+    """The workload service slots into the building blocks like any
+    other app: invoke /score synchronously, and saved-task events get
+    scored via the subscription and written to the scores state."""
+    from tasksrunner import App, InProcCluster
+    from tasksrunner.component.spec import parse_component
+    from tasksrunner.ml.service import PRIORITY_LABELS, make_app
+
+    specs = [
+        parse_component({"componentType": "state.in-memory"},
+                        default_name="scores"),
+        parse_component({"componentType": "pubsub.in-memory"},
+                        default_name="taskspubsub"),
+    ]
+    scorer = make_app()
+    publisher = App("some-api")
+
+    cluster = InProcCluster(specs)
+    cluster.add_app(scorer)
+    cluster.add_app(publisher)
+    await cluster.start()
+    try:
+        client = cluster.client("some-api")
+        # synchronous inference over service invocation
+        resp = await client.invoke_method(
+            "priority-scorer", "score", data={"taskName": "fix prod outage"})
+        assert resp.status == 200
+        doc = resp.json()
+        assert doc["priority"] in PRIORITY_LABELS
+        assert 0.0 < doc["confidence"] <= 1.0
+
+        # async scoring through the pub/sub block
+        await client.publish_event(
+            "taskspubsub", "tasksavedtopic",
+            {"taskId": "t-42", "taskName": "water the plants"})
+        deadline = asyncio.get_running_loop().time() + 10
+        score = None
+        while score is None:
+            assert asyncio.get_running_loop().time() < deadline
+            r = await client.invoke_method("priority-scorer", "scores/t-42",
+                                           http_method="GET")
+            if r.status == 200:
+                score = r.json()
+            else:
+                await asyncio.sleep(0.05)
+        assert score["priority"] in PRIORITY_LABELS
+    finally:
+        await cluster.stop()
