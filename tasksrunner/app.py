@@ -139,6 +139,10 @@ class App:
     def __init__(self, app_id: str):
         self.app_id = app_id
         self._routes: list[_Route] = []
+        #: (METHOD, "/lowercased/path") → route, for routes without
+        #: path params — O(1) dispatch on the hot path; param routes
+        #: fall back to the match loop
+        self._exact_routes: dict[tuple[str, str], _Route] = {}
         self.subscriptions: list[SubscriptionEntry] = []
         self.binding_routes: list[BindingEntry] = []
         self._startup_hooks: list[Callable[[], Awaitable[None]]] = []
@@ -162,10 +166,13 @@ class App:
                     s if s.startswith("{") else s.lower()
                     for s in path.split("/") if s != ""
                 ]
-                self._routes.append(
-                    _Route(method=method.upper(), segments=segments,
-                           handler=handler, kind=kind)
-                )
+                route = _Route(method=method.upper(), segments=segments,
+                               handler=handler, kind=kind)
+                self._routes.append(route)
+                if not any(s.startswith("{") for s in segments) \
+                        and route.method != "*":
+                    self._exact_routes.setdefault(
+                        (route.method, "/" + "/".join(segments)), route)
             return handler
 
         return register
@@ -305,10 +312,19 @@ class App:
         if method.upper() == "GET" and clean_path == "/openapi.json":
             return Response(body=self.openapi())
 
-        for route in self._routes:
-            params = route.match(method, clean_path)
-            if params is None:
-                continue
+        # static routes dispatch O(1) and take precedence over
+        # parameterised ones (standard router precedence)
+        route = self._exact_routes.get((
+            method.upper(),
+            "/" + "/".join(p.lower() for p in clean_path.split("/") if p)))
+        params: dict[str, str] | None = {} if route is not None else None
+        if route is None:
+            for candidate in self._routes:
+                params = candidate.match(method, clean_path)
+                if params is not None:
+                    route = candidate
+                    break
+        if route is not None and params is not None:
             request = Request(
                 method=method.upper(), path=clean_path,
                 query=dict(parse_qsl(query)), headers=headers,

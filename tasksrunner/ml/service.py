@@ -44,11 +44,17 @@ def make_app(*, pubsub: str = "taskspubsub", topic: str = "tasksavedtopic",
 
     @app.on_startup
     async def load_model():
-        params = init_params(cfg, jax.random.PRNGKey(0))
-        fn = jax.jit(lambda p, t: forward(p, t, cfg=cfg))
-        # warm the cache so the first request doesn't pay compilation
-        fn(params, hash_tokens(["warmup"], cfg)).block_until_ready()
-        compiled["params"], compiled["fn"] = params, fn
+        def build():
+            params = init_params(cfg, jax.random.PRNGKey(0))
+            fn = jax.jit(lambda p, t: forward(p, t, cfg=cfg))
+            # warm the cache so the first request doesn't pay compilation
+            fn(params, hash_tokens(["warmup"], cfg)).block_until_ready()
+            return params, fn
+
+        # compile off the event loop: the server/sidecar are already up,
+        # and probes + the 503 not-ready paths must answer during the
+        # (potentially tens of seconds) XLA compile
+        compiled["params"], compiled["fn"] = await asyncio.to_thread(build)
 
     def _score_sync(task: dict) -> dict:
         text = " ".join(

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import contextvars
 from contextlib import contextmanager
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 
 from tasksrunner.ids import hex8, hex16
 
@@ -46,7 +46,14 @@ class TraceContext:
         return cls(trace_id=parts[1], span_id=parts[2], flags=parts[3])
 
     def child(self) -> "TraceContext":
-        return replace(self, span_id=hex8(), parent_id=self.span_id)
+        # hot path (2-3 children per handled request): explicit
+        # construction is ~3x cheaper than dataclasses.replace. The
+        # field list is pinned by test_child_preserves_all_fields —
+        # adding a TraceContext field fails that test until it is
+        # propagated here.
+        return TraceContext(trace_id=self.trace_id, span_id=hex8(),
+                            flags=self.flags, parent_id=self.span_id,
+                            baggage=self.baggage)
 
     @property
     def header(self) -> str:

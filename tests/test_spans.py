@@ -88,3 +88,24 @@ def test_recording_disabled_is_noop(tmp_path):
     assert spans_mod.recorder() is None
     spans_mod.record_span(kind="server", name="x", status=200,
                           start=0.0, duration=0.1)  # must not raise
+
+
+def test_child_preserves_all_fields():
+    """TraceContext.child constructs explicitly (hot path); this pins
+    the field set so a new field cannot be silently dropped from
+    children — extend child() AND this test together."""
+    import dataclasses
+
+    from tasksrunner.observability.tracing import TraceContext
+
+    assert {f.name for f in dataclasses.fields(TraceContext)} == {
+        "trace_id", "span_id", "flags", "parent_id", "baggage"}
+
+    ctx = dataclasses.replace(TraceContext.new(), flags="00",
+                              baggage={"k": 1})
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.flags == ctx.flags
+    assert child.baggage == ctx.baggage
+    assert child.parent_id == ctx.span_id
+    assert child.span_id != ctx.span_id
