@@ -702,6 +702,62 @@ def _cmd_revisions(args) -> None:
               f"{'yes' if rev['active'] else 'no':<7} {rev['reason']}{suffix}")
 
 
+def _open_shared_broker(args):
+    """Open the shared broker file a pubsub component points at —
+    the out-of-band operator position (KEDA reads the broker the same
+    way; the autoscaler's read_backlog does too)."""
+    from tasksrunner.component.loader import load_components
+    from tasksrunner.errors import ComponentError
+    from tasksrunner.pubsub.sqlite import open_for_inspection
+
+    specs = load_components(args.resources)
+    spec = next((s for s in specs if s.name == args.component), None)
+    if spec is None:
+        known = ", ".join(sorted(s.name for s in specs)) or "(none)"
+        raise SystemExit(
+            f"no component {args.component!r} in {args.resources}; found: {known}")
+    try:
+        # base_dir anchors relative brokerPath the way the serving apps
+        # do: against the run-config's directory
+        return open_for_inspection(spec, args.base_dir)
+    except ComponentError as exc:
+        raise SystemExit(str(exc))
+
+
+def _cmd_dlq(args) -> None:
+    """Dead-letter queue operations (≙ peeking/resubmitting a Service
+    Bus subscription's DLQ; SURVEY §5.3's bounded-redelivery contract
+    parks exhausted messages here)."""
+    import json as json_mod
+
+    broker = _open_shared_broker(args)
+    try:
+        group = args.group or args.app_id
+        if not group:
+            raise SystemExit("pass --group (the consumer group; by convention "
+                             "the subscriber's app-id)")
+        if args.action == "list":
+            entries = broker.dead_letter_detail(args.topic, group)
+            if not entries:
+                print(f"no dead letters on {args.topic}/{group}")
+                return
+            print(f"{'ID':<34} {'ATTEMPTS':>8}  DATA")
+            for e in entries:
+                preview = json_mod.dumps(e["data"])
+                if len(preview) > 60:
+                    preview = preview[:57] + "..."
+                print(f"{e['id']:<34} {e['attempts']:>8}  {preview}")
+        elif args.action == "show":
+            entries = broker.dead_letter_detail(args.topic, group)
+            print(json_mod.dumps(entries, indent=2, default=str))
+        elif args.action == "requeue":
+            n = broker.requeue_dead_letters(args.topic, group,
+                                            msg_ids=args.id or None)
+            print(f"requeued {n} message(s) on {args.topic}/{group}")
+    finally:
+        broker.close_sync()
+
+
 def _cmd_stop(args) -> None:
     """≙ `dapr stop --app-id X`: SIGTERM the registered host process."""
     import os
@@ -853,6 +909,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("app_id")
     p.add_argument("--registry-file", **registry_arg)
     p.set_defaults(fn=_cmd_stop)
+
+    p = sub.add_parser("dlq",
+                       help="inspect / requeue a pubsub consumer group's "
+                            "dead letters (Service Bus DLQ analog)")
+    p.add_argument("action", choices=["list", "show", "requeue"])
+    p.add_argument("component", help="pubsub component name")
+    p.add_argument("topic")
+    p.add_argument("--group", default=None,
+                   help="consumer group (defaults to --app-id)")
+    p.add_argument("--app-id", default=None)
+    p.add_argument("--id", action="append",
+                   help="requeue only these message ids (repeatable)")
+    p.add_argument("--resources", default="components",
+                   help="components directory holding the pubsub YAML")
+    p.add_argument("--base-dir", default=".",
+                   help="directory relative brokerPath resolves against "
+                        "(the run-config's directory)")
+    p.set_defaults(fn=_cmd_dlq)
 
     p = sub.add_parser("restart",
                        help="rolling-restart an app via the orchestrator "

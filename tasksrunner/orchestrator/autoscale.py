@@ -48,17 +48,17 @@ def read_backlog(rule: ScaleRule, *, app_id: str,
     if rule.type == "pubsub-backlog":
         if spec is None:
             raise ComponentError(f"scale rule references unknown component {comp_name!r}")
-        broker_path = spec.metadata.get("brokerPath")
-        if not isinstance(broker_path, str):
-            broker_path = ".tasksrunner/pubsub-" + spec.name + ".db"
         topic = meta.get("topic", "")
         group = meta.get("group", app_id)  # subscription named after the app
-        broker = SqliteBroker(spec.name, _path(broker_path))
+        from tasksrunner.pubsub.sqlite import open_for_inspection
+        # must_exist=False: nothing published yet just means backlog 0
+        # (a redisHost component still raises — that broker's backlog
+        # is not in any local file and silence would mask the misconfig)
+        broker = open_for_inspection(spec, base_dir, must_exist=False)
         try:
             return broker.backlog(topic, group)
         finally:
-            broker._conn.close()
-            broker._executor.shutdown(wait=False)
+            broker.close_sync()
     if rule.type == "queue-backlog":
         if spec is None:
             raise ComponentError(f"scale rule references unknown component {comp_name!r}")

@@ -406,6 +406,42 @@ class SqliteBroker(PubSubBroker):
         return [r[0] for r in rows]
 
     @_locked
+    def dead_letter_detail(self, topic: str, group: str) -> list[dict]:
+        """Full dead-letter records, for operator inspection (≙ peeking
+        a Service Bus subscription's dead-letter queue)."""
+        rows = self._conn.execute(
+            "SELECT d.msg_id, d.attempts, m.data, m.metadata, m.created "
+            "FROM deliveries d JOIN messages m ON m.id = d.msg_id "
+            "WHERE d.topic = ? AND d.grp = ? AND d.done = 2 "
+            "ORDER BY m.created",
+            (topic, group),
+        ).fetchall()
+        return [
+            {"id": msg_id, "attempts": attempts, "data": json.loads(data),
+             "metadata": json.loads(metadata), "created": created}
+            for msg_id, attempts, data, metadata, created in rows
+        ]
+
+    @_locked
+    def requeue_dead_letters(self, topic: str, group: str,
+                             msg_ids: list[str] | None = None) -> int:
+        """Return dead-letters to the pending queue with a fresh
+        attempt budget (≙ Service Bus dead-letter resubmission)."""
+        now = time.time()
+        sql = ("UPDATE deliveries SET done = 0, attempts = 0, "
+               "visible_at = ?, claimed_until = 0 "
+               "WHERE topic = ? AND grp = ? AND done = 2")
+        params: list = [now, topic, group]
+        if msg_ids is not None:
+            if not msg_ids:
+                return 0
+            sql += f" AND msg_id IN ({', '.join('?' for _ in msg_ids)})"
+            params.extend(msg_ids)
+        cur = self._conn.execute(sql, params)
+        self._conn.commit()
+        return cur.rowcount
+
+    @_locked
     def gc(self, *, older_than: float = 3600.0) -> int:
         """Drop messages fully settled in every group."""
         cutoff = time.time() - older_than
@@ -420,6 +456,13 @@ class SqliteBroker(PubSubBroker):
         )
         self._conn.commit()
         return cur.rowcount
+
+    def close_sync(self) -> None:
+        """Synchronous close for out-of-band (no event loop) users —
+        inspection CLIs and the autoscaler's backlog reader."""
+        self._closed = True
+        self._executor.shutdown(wait=False)
+        self._conn.close()
 
     async def aclose(self) -> None:
         self._closed = True
@@ -437,6 +480,50 @@ class SqliteBroker(PubSubBroker):
         self._conn.close()
 
 
+def default_broker_path(name: str) -> str:
+    """The brokerPath a component gets when its YAML names none —
+    shared by the driver, the autoscaler's out-of-band reader, and the
+    dlq CLI so they can never desynchronize."""
+    return ".tasksrunner/pubsub-" + name + ".db"
+
+
+def open_for_inspection(spec: ComponentSpec,
+                        base_dir: pathlib.Path | str | None = None,
+                        *, must_exist: bool = True) -> SqliteBroker:
+    """Open a component's shared broker file out-of-band (the position
+    KEDA occupies: read the broker, not the app). Relative brokerPath
+    resolves against ``base_dir`` — the run-config's directory, which
+    is what the serving apps resolve against. Close with
+    :meth:`SqliteBroker.close_sync`.
+
+    Raises ComponentError for components whose broker is NOT the
+    shared sqlite file (a ``pubsub.redis`` with a live ``redisHost``
+    keeps its dead letters in Redis streams — inspecting the sqlite
+    fallback file would silently answer from the wrong store).
+    """
+    from tasksrunner.errors import ComponentError
+
+    if not spec.type.startswith("pubsub."):
+        raise ComponentError(f"component {spec.name!r} is {spec.type}, not a pubsub")
+    if isinstance(spec.metadata.get("redisHost"), str):
+        raise ComponentError(
+            f"component {spec.name!r} is served by the Redis streams broker "
+            f"(redisHost set); its dead letters live on the "
+            f"'<topic>:dead' streams in Redis, not in a local broker file")
+    broker_path = spec.metadata.get("brokerPath")
+    if not isinstance(broker_path, str):
+        broker_path = default_broker_path(spec.name)
+    path = pathlib.Path(broker_path)
+    if not path.is_absolute():
+        path = pathlib.Path(base_dir or pathlib.Path.cwd()) / path
+    if must_exist and not path.is_file():
+        raise ComponentError(
+            f"broker file {path} does not exist — has anything published "
+            "through this component yet? (relative brokerPath resolves "
+            "against the run-config's directory; pass --base-dir)")
+    return SqliteBroker(spec.name, path)
+
+
 @driver("pubsub.sqlite", "pubsub.azure.servicebus")
 def _sqlite_pubsub(spec: ComponentSpec, metadata: dict[str, str]) -> SqliteBroker:
     """Durable local broker; cloud-typed component files (the
@@ -445,7 +532,7 @@ def _sqlite_pubsub(spec: ComponentSpec, metadata: dict[str, str]) -> SqliteBroke
     land here too when they carry no redisHost (see pubsub/redis.py)."""
     return SqliteBroker(
         spec.name,
-        metadata.get("brokerPath", ".tasksrunner/pubsub-" + spec.name + ".db"),
+        metadata.get("brokerPath", default_broker_path(spec.name)),
         max_attempts=int(metadata.get("maxRetries", 3)),
         retry_delay=float(metadata.get("retryDelaySeconds", 0.2)),
         poll_interval=float(metadata.get("pollIntervalSeconds", 0.05)),

@@ -314,3 +314,36 @@ async def test_sqlite_concurrent_publish_batches(tmp_path):
     await asyncio.wait_for(done.wait(), timeout=10)
     assert sorted(got) == list(range(200))
     await broker.aclose()
+
+
+async def test_dead_letter_detail_and_requeue(tmp_path):
+    """DLQ operator surface: exhausted messages are inspectable with
+    full payloads and can be returned to the queue with a fresh
+    attempt budget (Service Bus dead-letter resubmission)."""
+    broker = make_sqlite(tmp_path)
+    calls = []
+    healthy = False
+
+    async def handler(msg):
+        calls.append(msg.data["n"])
+        return healthy
+
+    await broker.subscribe("t", "g", handler)
+    await broker.publish("t", {"n": 1})
+    await wait_until(lambda: broker.dead_letters("t", "g") != [])
+
+    detail = broker.dead_letter_detail("t", "g")
+    assert len(detail) == 1
+    assert detail[0]["data"] == {"n": 1}
+    assert detail[0]["attempts"] == broker.max_attempts
+
+    # selective requeue with a wrong id touches nothing
+    assert broker.requeue_dead_letters("t", "g", msg_ids=["nope"]) == 0
+    assert broker.requeue_dead_letters("t", "g", msg_ids=[]) == 0
+
+    healthy = True
+    seen = len(calls)
+    assert broker.requeue_dead_letters("t", "g") == 1
+    await wait_until(lambda: len(calls) > seen)
+    assert broker.dead_letters("t", "g") == []
+    await broker.aclose()
