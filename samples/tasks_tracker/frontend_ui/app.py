@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import html
 import os
+import pathlib
 from http.cookies import SimpleCookie
 from urllib.parse import urlencode
 
@@ -44,23 +45,24 @@ def _redirect(location: str, *, set_cookie: str | None = None) -> Response:
 
 
 def _page(title: str, body: str) -> Response:
+    """Shared layout (≙ Pages/Shared/_Layout.cshtml): site header +
+    stylesheet from the wwwroot asset tree served at /static."""
     doc = f"""<!doctype html>
 <html><head><meta charset="utf-8"><title>{html.escape(title)} — Tasks Tracker</title>
-<style>
- body {{ font-family: system-ui, sans-serif; margin: 2rem auto; max-width: 56rem; }}
- table {{ border-collapse: collapse; width: 100%; }}
- th, td {{ border: 1px solid #ccc; padding: .4rem .6rem; text-align: left; }}
- .overdue {{ color: #b00; font-weight: 600; }} .done {{ color: #080; }}
- form.inline {{ display: inline; }}
- input, button {{ padding: .3rem .5rem; margin: .15rem 0; }}
-</style></head>
-<body><h1>Tasks Tracker</h1>{body}</body></html>"""
+<link rel="stylesheet" href="/static/site.css"></head>
+<body>
+<header class="site"><a href="/tasks">Tasks Tracker</a>
+<span class="sub">{html.escape(title)}</span></header>
+<main><div class="card">{body}</div></main>
+</body></html>"""
     return Response(status=200, body=doc,
                     headers={"content-type": "text/html; charset=utf-8"})
 
 
 def make_app() -> App:
     app = App(APP_ID)
+    # the wwwroot asset tree (≙ UseStaticFiles over wwwroot/)
+    app.static("/static", pathlib.Path(__file__).parent / "wwwroot")
     # one reused session for the direct-HTTP fallback path, like the
     # reference's named HttpClient "BackEndApiExternal" (a factory-
     # managed, reused client — Frontend Program.cs:15-27)

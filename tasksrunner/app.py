@@ -21,6 +21,7 @@ str | bytes | int | None | (status, body)``.
 
 from __future__ import annotations
 
+import asyncio
 import inspect
 import json
 import logging
@@ -143,6 +144,8 @@ class App:
         #: path params — O(1) dispatch on the hot path; param routes
         #: fall back to the match loop
         self._exact_routes: dict[tuple[str, str], _Route] = {}
+        #: ("/prefix/", reader) mounts from App.static
+        self._static_mounts: list[tuple[str, Any]] = []
         self.subscriptions: list[SubscriptionEntry] = []
         self.binding_routes: list[BindingEntry] = []
         self._startup_hooks: list[Callable[[], Awaitable[None]]] = []
@@ -188,6 +191,35 @@ class App:
 
     def delete(self, path: str):
         return self.route(path, methods="DELETE")
+
+    def static(self, prefix: str, directory) -> None:
+        """Serve files under ``directory`` at ``prefix`` (≙ ASP.NET's
+        UseStaticFiles over wwwroot/, which the reference frontend
+        relies on for its asset tree). GET/HEAD only. Like
+        UseStaticFiles, a miss falls through to route dispatch, so
+        routes under the prefix stay reachable."""
+        import mimetypes
+        import pathlib
+
+        root = pathlib.Path(directory).resolve()
+        prefix = "/" + prefix.strip("/")
+        mount_key = prefix if prefix == "/" else prefix + "/"
+
+        async def read_file(rel: str) -> Response | None:
+            target = (root / rel).resolve()
+            # resolve() collapses any ../ — anything that escapes the
+            # root is a traversal attempt, treated as a plain miss
+            if not target.is_relative_to(root) or not target.is_file():
+                return None
+            ctype = (mimetypes.guess_type(target.name)[0]
+                     or "application/octet-stream")
+            # disk I/O off the event loop: a multi-MB asset must not
+            # stall concurrent requests/probes on this app
+            data = await asyncio.to_thread(target.read_bytes)
+            return Response(status=200, body=data,
+                            headers={"content-type": ctype})
+
+        self._static_mounts.append((mount_key, read_file))
 
     def subscribe(self, pubsub: str, topic: str, route: str | None = None):
         """≙ [Topic(pubsub, topic)] on an action method. Multiple
@@ -311,6 +343,13 @@ class App:
             return Response(status=204)
         if method.upper() == "GET" and clean_path == "/openapi.json":
             return Response(body=self.openapi())
+
+        if method.upper() in ("GET", "HEAD"):
+            for mount_prefix, read_file in self._static_mounts:
+                if clean_path.startswith(mount_prefix):
+                    resp = await read_file(clean_path[len(mount_prefix):])
+                    if resp is not None:
+                        return resp  # miss falls through to routing
 
         # static routes dispatch O(1) and take precedence over
         # parameterised ones (standard router precedence)

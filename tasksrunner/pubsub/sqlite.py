@@ -505,7 +505,11 @@ def open_for_inspection(spec: ComponentSpec,
 
     if not spec.type.startswith("pubsub."):
         raise ComponentError(f"component {spec.name!r} is {spec.type}, not a pubsub")
-    if isinstance(spec.metadata.get("redisHost"), str):
+    # mirror the redis driver's decision (pubsub/redis.py: empty host →
+    # sqlite fallback): a non-empty string, or a secretRef (resolves to
+    # a real host), means the live broker is Redis streams
+    host = spec.metadata.get("redisHost")
+    if host is not None and (not isinstance(host, str) or host.strip()):
         raise ComponentError(
             f"component {spec.name!r} is served by the Redis streams broker "
             f"(redisHost set); its dead letters live on the "

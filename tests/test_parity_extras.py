@@ -150,3 +150,63 @@ async def test_ps_command(tmp_path):
     out, _ = await proc.communicate()
     assert proc.returncode == 2
     assert json.loads(out)[0]["health"] == "down"
+
+
+async def test_static_file_serving(tmp_path):
+    """App.static (≙ UseStaticFiles over wwwroot): content-type by
+    extension, 404 for missing files, traversal attempts blocked."""
+    from tasksrunner import App
+
+    (tmp_path / "site.css").write_text("body { color: red; }")
+    (tmp_path / "sub").mkdir()
+    (tmp_path / "sub" / "x.js").write_text("var a=1;")
+    secret = tmp_path.parent / "secret.txt"
+    secret.write_text("do not serve")
+
+    app = App("static-test")
+    app.static("/static", tmp_path)
+
+    resp = await app.handle("GET", "/static/site.css")
+    status, headers, body = resp.encode()
+    assert status == 200
+    assert headers["content-type"] == "text/css"
+    assert b"color: red" in body
+
+    resp = await app.handle("GET", "/static/sub/x.js")
+    assert resp.status == 200
+
+    resp = await app.handle("GET", "/static/missing.css")
+    assert resp.status == 404
+
+    resp = await app.handle("GET", "/static/../secret.txt")
+    assert resp.status == 404
+
+    # non-GET methods never reach the mount
+    resp = await app.handle("POST", "/static/site.css")
+    assert resp.status == 404
+
+    # a miss falls through to routing (UseStaticFiles semantics):
+    # routes under the mounted prefix stay reachable
+    @app.get("/static/health")
+    async def health_route(req):
+        return {"ok": True}
+
+    resp = await app.handle("GET", "/static/health")
+    assert resp.status == 200 and resp.body == {"ok": True}
+
+    # root mount works too
+    root_app = App("root-static")
+    root_app.static("/", tmp_path)
+    resp = await root_app.handle("GET", "/site.css")
+    assert resp.status == 200
+
+
+async def test_frontend_serves_asset_tree():
+    from samples.tasks_tracker.frontend_ui.app import make_app
+
+    app = make_app()
+    resp = await app.handle("GET", "/static/site.css")
+    assert resp.status == 200
+    resp = await app.handle("GET", "/")
+    _, _, body = resp.encode()
+    assert b'href="/static/site.css"' in body

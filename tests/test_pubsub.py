@@ -347,3 +347,27 @@ async def test_dead_letter_detail_and_requeue(tmp_path):
     await wait_until(lambda: len(calls) > seen)
     assert broker.dead_letters("t", "g") == []
     await broker.aclose()
+
+
+async def test_open_for_inspection_mirrors_driver_choice(tmp_path):
+    """The inspection guard must agree with the redis driver: empty
+    redisHost → sqlite fallback is the live store (inspectable);
+    non-empty → Redis streams (refused)."""
+    from tasksrunner.component.spec import parse_component
+    from tasksrunner.errors import ComponentError
+    from tasksrunner.pubsub.sqlite import open_for_inspection
+
+    sqlite_backed = parse_component({
+        "componentType": "pubsub.redis",
+        "metadata": [{"name": "redisHost", "value": ""},
+                     {"name": "brokerPath", "value": str(tmp_path / "b.db")}],
+    }, default_name="ps")
+    broker = open_for_inspection(sqlite_backed, tmp_path, must_exist=False)
+    broker.close_sync()
+
+    redis_backed = parse_component({
+        "componentType": "pubsub.redis",
+        "metadata": [{"name": "redisHost", "value": "localhost:6379"}],
+    }, default_name="ps")
+    with pytest.raises(ComponentError, match="Redis streams"):
+        open_for_inspection(redis_backed, tmp_path)
