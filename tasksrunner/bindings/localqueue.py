@@ -139,8 +139,65 @@ class SqliteQueue:
         ).fetchone()
         return n
 
+    @_locked
+    def dead_letter_detail(self) -> list[dict]:
+        """Parked messages with payloads, for operator inspection
+        (≙ peeking a Storage-queue poison queue)."""
+        rows = self._conn.execute(
+            "SELECT id, data, attempts, enqueued FROM queue "
+            "WHERE done = 2 ORDER BY enqueued").fetchall()
+        return [
+            {"id": msg_id, "attempts": attempts, "data": json.loads(data),
+             "created": enqueued}
+            for msg_id, data, attempts, enqueued in rows
+        ]
+
+    @_locked
+    def requeue_dead_letters(self, msg_ids: list[str] | None = None) -> int:
+        """Return dead-letters to the queue with a fresh attempt budget."""
+        now = time.time()
+        sql = ("UPDATE queue SET done = 0, attempts = 0, visible_at = ? "
+               "WHERE done = 2")
+        params: list = [now]
+        if msg_ids is not None:
+            if not msg_ids:
+                return 0
+            sql += f" AND id IN ({', '.join('?' for _ in msg_ids)})"
+            params.extend(msg_ids)
+        cur = self._conn.execute(sql, params)
+        self._conn.commit()
+        return cur.rowcount
+
     def close(self) -> None:
         self._conn.close()
+
+
+def open_queue_for_inspection(spec: ComponentSpec,
+                              base_dir: pathlib.Path | str | None = None,
+                              *, must_exist: bool = True) -> SqliteQueue:
+    """Open a queue-binding component's shared queue file out-of-band
+    (same position as pubsub.sqlite.open_for_inspection). Metadata
+    defaults mirror the driver exactly."""
+    from tasksrunner.errors import ComponentError
+
+    if spec.type not in QUEUE_BINDING_TYPES:
+        raise ComponentError(
+            f"component {spec.name!r} is {spec.type}, not a queue binding "
+            f"backed by a shared queue file ({', '.join(sorted(QUEUE_BINDING_TYPES))})")
+    root = spec.metadata.get("queuePath", ".tasksrunner/queues")
+    qname = spec.metadata.get("queueName", spec.name)
+    if not isinstance(root, str) or not isinstance(qname, str):
+        raise ComponentError(
+            f"component {spec.name!r} has secret-typed queue path metadata")
+    path = pathlib.Path(root) / f"{qname}.db"
+    if not path.is_absolute():
+        path = pathlib.Path(base_dir or pathlib.Path.cwd()) / path
+    if must_exist and not path.is_file():
+        raise ComponentError(
+            f"queue file {path} does not exist — has anything been sent to "
+            "this queue yet? (relative queuePath resolves against the "
+            "run-config's directory; pass --base-dir)")
+    return SqliteQueue(path)
 
 
 class LocalQueueBinding(InputBinding, OutputBinding):
@@ -216,7 +273,13 @@ class LocalQueueBinding(InputBinding, OutputBinding):
         return BindingResponse(metadata={"messageId": msg_id})
 
 
-@driver("bindings.localqueue", "bindings.azure.storagequeues")
+#: component types served by the shared-queue-file binding — the
+#: driver registration below and open_queue_for_inspection's guard
+#: must always agree
+QUEUE_BINDING_TYPES = ("bindings.localqueue", "bindings.azure.storagequeues")
+
+
+@driver(*QUEUE_BINDING_TYPES)
 def _localqueue_binding(spec: ComponentSpec, metadata: dict[str, str]) -> LocalQueueBinding:
     # `queueName` (reference metadata) maps to a db file under queuePath's
     # directory so the azure-typed component file works unchanged.

@@ -575,6 +575,41 @@ def _cmd_secret(args) -> None:
     _sidecar_request(args, "GET", f"secrets/{args.store}/{args.key}")
 
 
+def _cmd_metrics(args) -> None:
+    """An app's counters from its sidecar metadata (≙ the App
+    Insights metrics view, SURVEY §5.5): invokes, state ops,
+    publishes, deliveries — per label."""
+    import json as json_mod
+    import urllib.error
+    import urllib.request
+
+    addr, headers = _resolve_sidecar(args)
+    req = urllib.request.Request(f"{addr.base_url}/v1.0/metadata",
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            meta = json_mod.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        hint = (" (set TASKSRUNNER_API_TOKEN — the sidecar requires it)"
+                if exc.code == 401 else "")
+        raise SystemExit(f"sidecar of {args.app_id!r} returned "
+                         f"HTTP {exc.code}{hint}")
+    except OSError as exc:
+        raise SystemExit(f"cannot reach sidecar of {args.app_id!r}: {exc}")
+    metrics = meta.get("metrics") or {}
+    if args.json:
+        print(json_mod.dumps(metrics, indent=2))
+        return
+    if not metrics:
+        print(f"no metrics recorded for {args.app_id}")
+        return
+    width = max(len(k) for k in metrics)
+    for key in sorted(metrics):
+        value = metrics[key]
+        shown = int(value) if float(value).is_integer() else round(value, 3)
+        print(f"{key:<{width}}  {shown}")
+
+
 def _admin_request(registry_file: str, method: str, path: str,
                    body: dict | None = None) -> dict:
     """Talk to the orchestrator's control plane (the `az containerapp`
@@ -702,13 +737,35 @@ def _cmd_revisions(args) -> None:
               f"{'yes' if rev['active'] else 'no':<7} {rev['reason']}{suffix}")
 
 
-def _open_shared_broker(args):
-    """Open the shared broker file a pubsub component points at —
-    the out-of-band operator position (KEDA reads the broker the same
-    way; the autoscaler's read_backlog does too)."""
+def _print_dlq(action: str, get_entries, requeue, where: str, ids) -> None:
+    import json as json_mod
+
+    if action == "list":
+        entries = get_entries()
+        if not entries:
+            print(f"no dead letters on {where}")
+            return
+        print(f"{'ID':<36} {'ATTEMPTS':>8}  DATA")
+        for e in entries:
+            preview = json_mod.dumps(e["data"])
+            if len(preview) > 60:
+                preview = preview[:57] + "..."
+            print(f"{e['id']:<36} {e['attempts']:>8}  {preview}")
+    elif action == "show":
+        print(json_mod.dumps(get_entries(), indent=2, default=str))
+    elif action == "requeue":
+        print(f"requeued {requeue(ids or None)} message(s) on {where}")
+
+
+def _cmd_dlq(args) -> None:
+    """Dead-letter queue operations (≙ peeking/resubmitting a Service
+    Bus subscription's DLQ or a Storage-queue poison queue; SURVEY
+    §5.3's bounded-redelivery contract parks exhausted messages here).
+
+    Pub/sub components take TOPIC (+ --group/--app-id); queue-binding
+    components (bindings.azure.storagequeues etc.) take neither."""
     from tasksrunner.component.loader import load_components
     from tasksrunner.errors import ComponentError
-    from tasksrunner.pubsub.sqlite import open_for_inspection
 
     specs = load_components(args.resources)
     spec = next((s for s in specs if s.name == args.component), None)
@@ -716,44 +773,39 @@ def _open_shared_broker(args):
         known = ", ".join(sorted(s.name for s in specs)) or "(none)"
         raise SystemExit(
             f"no component {args.component!r} in {args.resources}; found: {known}")
+
+    if spec.type.startswith("bindings."):
+        from tasksrunner.bindings.localqueue import open_queue_for_inspection
+        try:
+            queue = open_queue_for_inspection(spec, args.base_dir)
+        except ComponentError as exc:
+            raise SystemExit(str(exc))
+        try:
+            _print_dlq(args.action, queue.dead_letter_detail,
+                       queue.requeue_dead_letters, args.component, args.id)
+        finally:
+            queue.close()
+        return
+
+    if not args.topic:
+        raise SystemExit("pub/sub dlq needs a TOPIC")
+    group = args.group or args.app_id
+    if not group:
+        raise SystemExit("pass --group (the consumer group; by convention "
+                         "the subscriber's app-id)")
+    from tasksrunner.pubsub.sqlite import open_for_inspection
     try:
         # base_dir anchors relative brokerPath the way the serving apps
         # do: against the run-config's directory
-        return open_for_inspection(spec, args.base_dir)
+        broker = open_for_inspection(spec, args.base_dir)
     except ComponentError as exc:
         raise SystemExit(str(exc))
-
-
-def _cmd_dlq(args) -> None:
-    """Dead-letter queue operations (≙ peeking/resubmitting a Service
-    Bus subscription's DLQ; SURVEY §5.3's bounded-redelivery contract
-    parks exhausted messages here)."""
-    import json as json_mod
-
-    broker = _open_shared_broker(args)
     try:
-        group = args.group or args.app_id
-        if not group:
-            raise SystemExit("pass --group (the consumer group; by convention "
-                             "the subscriber's app-id)")
-        if args.action == "list":
-            entries = broker.dead_letter_detail(args.topic, group)
-            if not entries:
-                print(f"no dead letters on {args.topic}/{group}")
-                return
-            print(f"{'ID':<34} {'ATTEMPTS':>8}  DATA")
-            for e in entries:
-                preview = json_mod.dumps(e["data"])
-                if len(preview) > 60:
-                    preview = preview[:57] + "..."
-                print(f"{e['id']:<34} {e['attempts']:>8}  {preview}")
-        elif args.action == "show":
-            entries = broker.dead_letter_detail(args.topic, group)
-            print(json_mod.dumps(entries, indent=2, default=str))
-        elif args.action == "requeue":
-            n = broker.requeue_dead_letters(args.topic, group,
-                                            msg_ids=args.id or None)
-            print(f"requeued {n} message(s) on {args.topic}/{group}")
+        _print_dlq(args.action,
+                   lambda: broker.dead_letter_detail(args.topic, group),
+                   lambda ids: broker.requeue_dead_letters(args.topic, group,
+                                                           msg_ids=ids),
+                   f"{args.topic}/{group}", args.id)
     finally:
         broker.close_sync()
 
@@ -910,12 +962,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--registry-file", **registry_arg)
     p.set_defaults(fn=_cmd_stop)
 
+    p = sub.add_parser("metrics",
+                       help="an app's request/publish/delivery counters "
+                            "(App Insights metrics view analog)")
+    p.add_argument("--app-id", required=True)
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--registry-file", **registry_arg)
+    p.set_defaults(fn=_cmd_metrics)
+
     p = sub.add_parser("dlq",
                        help="inspect / requeue a pubsub consumer group's "
                             "dead letters (Service Bus DLQ analog)")
     p.add_argument("action", choices=["list", "show", "requeue"])
-    p.add_argument("component", help="pubsub component name")
-    p.add_argument("topic")
+    p.add_argument("component", help="pubsub or queue-binding component name")
+    p.add_argument("topic", nargs="?", default=None,
+                   help="topic (pub/sub components only)")
     p.add_argument("--group", default=None,
                    help="consumer group (defaults to --app-id)")
     p.add_argument("--app-id", default=None)

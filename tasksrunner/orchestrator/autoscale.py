@@ -26,8 +26,6 @@ from typing import Callable
 from tasksrunner.component.spec import ComponentSpec
 from tasksrunner.errors import ComponentError
 from tasksrunner.orchestrator.config import AppSpec, ScaleRule
-from tasksrunner.pubsub.sqlite import SqliteBroker
-from tasksrunner.bindings.localqueue import SqliteQueue
 
 logger = logging.getLogger(__name__)
 
@@ -40,10 +38,6 @@ def read_backlog(rule: ScaleRule, *, app_id: str,
     meta = rule.metadata
     comp_name = meta.get("component")
     spec = next((s for s in components if s.name == comp_name), None)
-
-    def _path(raw: str) -> pathlib.Path:
-        p = pathlib.Path(raw)
-        return p if p.is_absolute() else base_dir / p
 
     if rule.type == "pubsub-backlog":
         if spec is None:
@@ -62,11 +56,8 @@ def read_backlog(rule: ScaleRule, *, app_id: str,
     if rule.type == "queue-backlog":
         if spec is None:
             raise ComponentError(f"scale rule references unknown component {comp_name!r}")
-        root = spec.metadata.get("queuePath", ".tasksrunner/queues")
-        qname = spec.metadata.get("queueName", spec.name)
-        if not isinstance(root, str) or not isinstance(qname, str):
-            raise ComponentError(f"scale rule component {comp_name!r} has secret-typed path metadata")
-        queue = SqliteQueue(_path(root) / f"{qname}.db")
+        from tasksrunner.bindings.localqueue import open_queue_for_inspection
+        queue = open_queue_for_inspection(spec, base_dir, must_exist=False)
         try:
             return queue.backlog()
         finally:

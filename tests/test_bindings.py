@@ -149,3 +149,51 @@ def test_binding_drivers_registered():
     assert "bindings.azure.storagequeues" in types
     assert "bindings.azure.blobstorage" in types
     assert "bindings.twilio.sendgrid" in types
+
+
+async def test_queue_dead_letter_detail_and_requeue(tmp_path):
+    """Queue-binding DLQ operator surface (Storage-queue poison-queue
+    analog): inspect parked messages, resubmit with fresh attempts."""
+    from tasksrunner.bindings.localqueue import (
+        LocalQueueBinding, SqliteQueue, open_queue_for_inspection,
+    )
+    from tasksrunner.bindings.base import BindingEvent
+    from tasksrunner.component.spec import parse_component
+
+    binding = LocalQueueBinding(
+        "extq", str(tmp_path / "queues" / "extq.db"),
+        poll_interval=0.01, max_attempts=2, retry_delay=0.02)
+    ok = False
+    seen = []
+
+    async def sink(event: BindingEvent) -> bool:
+        seen.append(event.data)
+        return ok
+
+    await binding.start(sink)
+    await binding.invoke("create", {"n": 9})
+    deadline = asyncio.get_running_loop().time() + 5
+    while not binding.queue.dead_letter_detail():
+        assert asyncio.get_running_loop().time() < deadline
+        await asyncio.sleep(0.02)
+
+    spec = parse_component({
+        "componentType": "bindings.azure.storagequeues",
+        "metadata": [{"name": "queuePath", "value": str(tmp_path / "queues")},
+                     {"name": "queueName", "value": "extq"}],
+    }, default_name="extq")
+    queue = open_queue_for_inspection(spec, tmp_path)
+    detail = queue.dead_letter_detail()
+    assert detail and detail[0]["data"] == {"n": 9}
+    assert queue.requeue_dead_letters(["bogus"]) == 0
+
+    ok = True
+    count = len(seen)
+    assert queue.requeue_dead_letters() == 1
+    queue.close()
+    deadline = asyncio.get_running_loop().time() + 5
+    while len(seen) <= count:
+        assert asyncio.get_running_loop().time() < deadline
+        await asyncio.sleep(0.02)
+    assert binding.queue.dead_letter_detail() == []
+    await binding.stop()
