@@ -540,4 +540,8 @@ def _sqlite_pubsub(spec: ComponentSpec, metadata: dict[str, str]) -> SqliteBroke
         max_attempts=int(metadata.get("maxRetries", 3)),
         retry_delay=float(metadata.get("retryDelaySeconds", 0.2)),
         poll_interval=float(metadata.get("pollIntervalSeconds", 0.05)),
+        # how long a claimed-but-unacked message stays invisible before
+        # a crashed consumer's claim expires into redelivery (≙ Service
+        # Bus lock duration)
+        claim_lease=float(metadata.get("claimLeaseSeconds", 30.0)),
     )

@@ -370,3 +370,111 @@ async def test_custom_unhealthy_healthz_does_not_block_startup():
         assert health.status == 503  # the custom route is really served
     finally:
         await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_no_message_loss_across_replica_crash(tmp_path):
+    """SURVEY §5.3 end-to-end: flood the broker, SIGKILL the consumer
+    replica mid-consumption, let the supervisor restart it, and assert
+    every message is eventually processed exactly the at-least-once
+    way (no loss; duplicates allowed)."""
+    import json
+    import os
+    import signal as sig
+
+    from tasksrunner.orchestrator.config import RunConfig
+    from tasksrunner.orchestrator.run import Orchestrator
+    from tasksrunner.pubsub.sqlite import SqliteBroker
+
+    N = 120
+    pkg = tmp_path / "crashconsumer"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "worker.py").write_text(textwrap.dedent("""
+        import json, os, pathlib
+        from tasksrunner import App
+
+        OUT = pathlib.Path(os.environ["SEEN_FILE"])
+
+        def make_app():
+            app = App("crashworker")
+
+            @app.subscribe(pubsub="bus", topic="jobs", route="/on-job")
+            async def on_job(req):
+                import asyncio
+                n = req.data["n"]
+                # slow enough that claims are in flight at kill time
+                await asyncio.sleep(0.01)
+                with open(OUT, "a") as f:
+                    f.write(f"{n}\\n")
+                return 200
+
+            return app
+    """))
+    components = tmp_path / "components"
+    components.mkdir()
+    (components / "bus.yaml").write_text(json.dumps({
+        "componentType": "pubsub.sqlite",
+        "metadata": [
+            {"name": "brokerPath", "value": str(tmp_path / "bus.db")},
+            {"name": "pollIntervalSeconds", "value": "0.01"},
+            # short lock duration: the killed replica's claims expire
+            # into redelivery quickly (≙ Service Bus lock duration)
+            {"name": "claimLeaseSeconds", "value": "2"},
+        ],
+    }))
+    seen_file = tmp_path / "seen.txt"
+    seen_file.write_text("")
+
+    config = RunConfig(
+        apps=[AppSpec(app_id="crashworker", module="crashconsumer.worker:make_app",
+                      env={"SEEN_FILE": str(seen_file)})],
+        resources_path=str(components),
+        registry_file=str(tmp_path / "apps.json"),
+        base_dir=tmp_path,
+    )
+    os.environ["PYTHONPATH"] = f"{tmp_path}{os.pathsep}{REPO}"
+
+    # publisher side: a broker handle on the shared file
+    broker = SqliteBroker("bus", tmp_path / "bus.db", poll_interval=0.01,
+                          claim_lease=2.0)
+    orch = Orchestrator(config)
+    await orch.start()
+    try:
+        replica = orch.replicas["crashworker"][0]
+        await asyncio.wait_for(replica.ready.wait(), timeout=30)
+
+        for i in range(N):
+            # raw payload straight onto the broker: mark it as plain
+            # JSON so delivery skips the CloudEvents unwrap
+            await broker.publish("jobs", {"n": i},
+                                 metadata={"content-type": "application/json"})
+
+        # wait until consumption is clearly underway, then SIGKILL.
+        # SIGKILL can tear a buffered write mid-line, concatenating two
+        # numbers — keep only in-range values (the torn ones are
+        # redelivered anyway, which is the property under test)
+        def seen() -> set[int]:
+            if not seen_file.exists():
+                return set()
+            return {int(x) for x in seen_file.read_text().split()
+                    if x.isdigit() and int(x) < N}
+
+        deadline = asyncio.get_running_loop().time() + 30
+        while len(seen()) < 5:
+            assert asyncio.get_running_loop().time() < deadline, "consumption never started"
+            await asyncio.sleep(0.02)
+        os.kill(replica.proc.pid, sig.SIGKILL)
+
+        # supervisor restarts the replica; claimed-but-unacked messages
+        # are redelivered after their lease expires — nothing is lost
+        deadline = asyncio.get_running_loop().time() + 90
+        while not seen() >= set(range(N)):
+            assert asyncio.get_running_loop().time() < deadline, \
+                f"lost messages: {sorted(set(range(N)) - seen())[:10]}"
+            await asyncio.sleep(0.1)
+        assert replica.restarts >= 1, "the crash must go through supervise()"
+    finally:
+        del os.environ["PYTHONPATH"]
+        await orch.stop()
+        await broker.aclose()
