@@ -153,6 +153,20 @@ class SqliteQueue:
         ]
 
     @_locked
+    def purge_dead_letters(self, msg_ids: list[str] | None = None) -> int:
+        """Explicitly discard dead letters."""
+        sql = "DELETE FROM queue WHERE done = 2"
+        params: list = []
+        if msg_ids is not None:
+            if not msg_ids:
+                return 0
+            sql += f" AND id IN ({', '.join('?' for _ in msg_ids)})"
+            params.extend(msg_ids)
+        cur = self._conn.execute(sql, params)
+        self._conn.commit()
+        return cur.rowcount
+
+    @_locked
     def requeue_dead_letters(self, msg_ids: list[str] | None = None) -> int:
         """Return dead-letters to the queue with a fresh attempt budget."""
         now = time.time()

@@ -737,7 +737,7 @@ def _cmd_revisions(args) -> None:
               f"{'yes' if rev['active'] else 'no':<7} {rev['reason']}{suffix}")
 
 
-def _print_dlq(action: str, get_entries, requeue, where: str, ids) -> None:
+def _print_dlq(action: str, get_entries, ops: dict, where: str, ids) -> None:
     import json as json_mod
 
     if action == "list":
@@ -753,8 +753,10 @@ def _print_dlq(action: str, get_entries, requeue, where: str, ids) -> None:
             print(f"{e['id']:<36} {e['attempts']:>8}  {preview}")
     elif action == "show":
         print(json_mod.dumps(get_entries(), indent=2, default=str))
-    elif action == "requeue":
-        print(f"requeued {requeue(ids or None)} message(s) on {where}")
+    else:  # requeue | purge
+        n = ops[action](ids or None)
+        verb = "requeued" if action == "requeue" else "purged"
+        print(f"{verb} {n} message(s) on {where}")
 
 
 def _cmd_dlq(args) -> None:
@@ -782,7 +784,9 @@ def _cmd_dlq(args) -> None:
             raise SystemExit(str(exc))
         try:
             _print_dlq(args.action, queue.dead_letter_detail,
-                       queue.requeue_dead_letters, args.component, args.id)
+                       {"requeue": queue.requeue_dead_letters,
+                        "purge": queue.purge_dead_letters},
+                       args.component, args.id)
         finally:
             queue.close()
         return
@@ -803,8 +807,10 @@ def _cmd_dlq(args) -> None:
     try:
         _print_dlq(args.action,
                    lambda: broker.dead_letter_detail(args.topic, group),
-                   lambda ids: broker.requeue_dead_letters(args.topic, group,
-                                                           msg_ids=ids),
+                   {"requeue": lambda ids: broker.requeue_dead_letters(
+                        args.topic, group, msg_ids=ids),
+                    "purge": lambda ids: broker.purge_dead_letters(
+                        args.topic, group, msg_ids=ids)},
                    f"{args.topic}/{group}", args.id)
     finally:
         broker.close_sync()
@@ -973,7 +979,7 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("dlq",
                        help="inspect / requeue a pubsub consumer group's "
                             "dead letters (Service Bus DLQ analog)")
-    p.add_argument("action", choices=["list", "show", "requeue"])
+    p.add_argument("action", choices=["list", "show", "requeue", "purge"])
     p.add_argument("component", help="pubsub or queue-binding component name")
     p.add_argument("topic", nargs="?", default=None,
                    help="topic (pub/sub components only)")

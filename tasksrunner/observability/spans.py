@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import atexit
 import json
+import logging
 import os
 import pathlib
 import sqlite3
@@ -44,6 +45,10 @@ CREATE INDEX IF NOT EXISTS idx_spans_start ON spans (start);
 """
 
 ENV_VAR = "TASKSRUNNER_TRACE_DB"
+RETENTION_ENV_VAR = "TASKSRUNNER_TRACE_RETENTION_SECONDS"
+#: default span retention ≙ the reference's Log Analytics 30-day
+#: retention (container-apps-environment.bicep:29-37)
+DEFAULT_RETENTION_SECONDS = 30 * 24 * 3600.0
 
 
 @dataclass
@@ -64,7 +69,8 @@ class SpanRecorder:
     """Buffered writer of spans into the shared trace db."""
 
     def __init__(self, role: str, path: str | pathlib.Path, *,
-                 flush_interval: float = 0.5, max_buffer: int = 256):
+                 flush_interval: float = 0.5, max_buffer: int = 256,
+                 retention_seconds: float | None = None):
         self.role = role
         self.path = str(path)
         pathlib.Path(self.path).parent.mkdir(parents=True, exist_ok=True)
@@ -74,6 +80,21 @@ class SpanRecorder:
         self._conn: sqlite3.Connection | None = None
         self.flush_interval = flush_interval
         self.max_buffer = max_buffer
+        if retention_seconds is None:
+            raw = os.environ.get(RETENTION_ENV_VAR)
+            try:
+                retention_seconds = (float(raw) if raw
+                                     else DEFAULT_RETENTION_SECONDS)
+            except ValueError:
+                # a telemetry knob must never crash app startup
+                logging.getLogger(__name__).warning(
+                    "ignoring bad %s=%r (want seconds as a number)",
+                    RETENTION_ENV_VAR, raw)
+                retention_seconds = DEFAULT_RETENTION_SECONDS
+        #: spans older than this are pruned (≙ Log Analytics 30-day
+        #: retention); <= 0 keeps everything
+        self.retention_seconds = retention_seconds
+        self._last_prune = 0.0
         self._timer: threading.Timer | None = None
         atexit.register(self.flush)
         self._schedule()
@@ -135,6 +156,14 @@ class SpanRecorder:
                   s.status, s.start, s.duration,
                   json.dumps(s.attrs, default=str)) for s in batch],
             )
+            now = time.time()
+            if self.retention_seconds > 0 and now - self._last_prune > 60:
+                # retention sweep at most once a minute, piggybacked on
+                # a flush so idle processes pay nothing
+                self._conn.execute(
+                    "DELETE FROM spans WHERE start < ?",
+                    (now - self.retention_seconds,))
+                self._last_prune = now
             self._conn.commit()
 
     def close(self) -> None:

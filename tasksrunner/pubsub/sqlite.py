@@ -80,6 +80,8 @@ class SqliteBroker(PubSubBroker):
         retry_delay: float = 0.2,
         claim_lease: float = 30.0,
         poll_interval: float = 0.05,
+        gc_interval: float = 300.0,
+        gc_retention: float = 3600.0,
     ):
         super().__init__(name)
         self.path = str(path)
@@ -89,6 +91,11 @@ class SqliteBroker(PubSubBroker):
         self.retry_delay = retry_delay
         self.claim_lease = claim_lease
         self.poll_interval = poll_interval
+        #: janitor cadence/age for dropping fully-settled messages; a
+        #: long-running broker file must not grow without bound
+        self.gc_interval = gc_interval
+        self.gc_retention = gc_retention
+        self._janitor: asyncio.Task | None = None
         self._conn = sqlite3.connect(self.path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
         # WAL + NORMAL: fsync at checkpoint, not per-commit — the
@@ -328,6 +335,11 @@ class SqliteBroker(PubSubBroker):
 
     async def subscribe(self, topic: str, group: str, handler: Handler) -> Subscription:
         await self.ensure_group(topic, group)
+        if self._janitor is None and self.gc_interval > 0:
+            # one janitor per broker instance, started with the first
+            # consumer (producers-only processes never mutate history)
+            self._janitor = asyncio.create_task(self._janitor_loop())
+            self._tasks.append(self._janitor)
         stop = asyncio.Event()
 
         async def poll_loop() -> None:
@@ -386,6 +398,24 @@ class SqliteBroker(PubSubBroker):
 
         return Subscription(topic=topic, group=group, _cancel=cancel)
 
+    async def _janitor_loop(self) -> None:
+        """Periodically drop messages settled in every group (≙ broker
+        retention: Service Bus removes completed messages; this file
+        would otherwise grow forever)."""
+        while not self._closed:
+            await asyncio.sleep(self.gc_interval)
+            if self._closed:
+                return
+            try:
+                dropped = await self._run(
+                    lambda: self.gc(older_than=self.gc_retention))
+            except Exception:  # pragma: no cover - defensive
+                logger.exception("broker %s gc failed", self.name)
+                continue
+            if dropped:
+                logger.info("broker %s gc dropped %d settled message(s)",
+                            self.name, dropped)
+
     # -- introspection ---------------------------------------------------
 
     @_locked
@@ -443,17 +473,37 @@ class SqliteBroker(PubSubBroker):
 
     @_locked
     def gc(self, *, older_than: float = 3600.0) -> int:
-        """Drop messages fully settled in every group."""
+        """Drop messages fully settled in every group. Pending (done=0)
+        AND dead-lettered (done=2) deliveries pin their message: the
+        DLQ retains payloads until an operator requeues or purges them
+        (Service Bus keeps DLQ messages until explicitly handled)."""
         cutoff = time.time() - older_than
         cur = self._conn.execute(
             "DELETE FROM messages WHERE created < ? AND NOT EXISTS "
-            "(SELECT 1 FROM deliveries d WHERE d.msg_id = messages.id AND d.done = 0)",
+            "(SELECT 1 FROM deliveries d WHERE d.msg_id = messages.id "
+            "AND d.done IN (0, 2))",
             (cutoff,),
         )
         self._conn.execute(
             "DELETE FROM deliveries WHERE done != 0 AND NOT EXISTS "
             "(SELECT 1 FROM messages m WHERE m.id = deliveries.msg_id)"
         )
+        self._conn.commit()
+        return cur.rowcount
+
+    @_locked
+    def purge_dead_letters(self, topic: str, group: str,
+                           msg_ids: list[str] | None = None) -> int:
+        """Explicitly discard dead letters (the operator's 'handled by
+        deletion' path); their message rows become gc-able."""
+        sql = ("DELETE FROM deliveries WHERE topic = ? AND grp = ? AND done = 2")
+        params: list = [topic, group]
+        if msg_ids is not None:
+            if not msg_ids:
+                return 0
+            sql += f" AND msg_id IN ({', '.join('?' for _ in msg_ids)})"
+            params.extend(msg_ids)
+        cur = self._conn.execute(sql, params)
         self._conn.commit()
         return cur.rowcount
 
@@ -544,4 +594,7 @@ def _sqlite_pubsub(spec: ComponentSpec, metadata: dict[str, str]) -> SqliteBroke
         # a crashed consumer's claim expires into redelivery (≙ Service
         # Bus lock duration)
         claim_lease=float(metadata.get("claimLeaseSeconds", 30.0)),
+        # settled-message retention (0 disables the janitor)
+        gc_interval=float(metadata.get("gcIntervalSeconds", 300.0)),
+        gc_retention=float(metadata.get("gcRetentionSeconds", 3600.0)),
     )

@@ -371,3 +371,63 @@ async def test_open_for_inspection_mirrors_driver_choice(tmp_path):
     }, default_name="ps")
     with pytest.raises(ComponentError, match="Redis streams"):
         open_for_inspection(redis_backed, tmp_path)
+
+
+async def test_broker_janitor_gc(tmp_path):
+    """Settled messages are dropped by the janitor so the shared file
+    never grows without bound (broker retention)."""
+    broker = SqliteBroker("b", tmp_path / "b.db", poll_interval=0.01,
+                          gc_interval=0.1, gc_retention=0.0)
+
+    async def h(msg):
+        return True
+
+    await broker.subscribe("t", "g", h)
+    for i in range(10):
+        await broker.publish("t", {"n": i})
+    await wait_until(lambda: broker.backlog("t", "g") == 0)
+
+    def rows():
+        return broker._conn.execute(
+            "SELECT COUNT(*) FROM messages").fetchone()[0]
+
+    await wait_until(lambda: rows() == 0, timeout=5)
+    # a message with no subscribing group is undeliverable: gc-able
+    await broker.publish("t2", {"n": 99})
+    # a pending delivery pins its message
+    await broker.ensure_group("t3", "g3")
+    await broker.publish("t3", {"n": 100})
+    await asyncio.sleep(0.3)
+    remaining = {r[0] for r in broker._conn.execute(
+        "SELECT topic FROM messages").fetchall()}
+    assert "t3" in remaining, "pending messages must never be dropped"
+    assert "t2" not in remaining, "undeliverable messages are gc-able"
+    await broker.aclose()
+
+
+async def test_janitor_retains_dead_letters_until_purged(tmp_path):
+    """The janitor must NEVER destroy dead letters — the DLQ keeps
+    payloads until an operator requeues or purges (Service Bus
+    semantics); purge makes them gc-able."""
+    broker = SqliteBroker("b", tmp_path / "b.db", poll_interval=0.01,
+                          max_attempts=1, retry_delay=0.01,
+                          gc_interval=0.1, gc_retention=0.0)
+
+    async def never(msg):
+        return False
+
+    await broker.subscribe("t", "g", never)
+    await broker.publish("t", {"n": 1})
+    await wait_until(lambda: broker.dead_letters("t", "g") != [])
+    await asyncio.sleep(0.3)  # several janitor cycles
+    detail = broker.dead_letter_detail("t", "g")
+    assert detail and detail[0]["data"] == {"n": 1}, \
+        "dead letters survived gc with full payload"
+
+    assert broker.purge_dead_letters("t", "g") == 1
+    assert broker.dead_letters("t", "g") == []
+    await wait_until(
+        lambda: broker._conn.execute(
+            "SELECT COUNT(*) FROM messages").fetchone()[0] == 0,
+        timeout=5)
+    await broker.aclose()

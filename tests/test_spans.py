@@ -109,3 +109,27 @@ def test_child_preserves_all_fields():
     assert child.baggage == ctx.baggage
     assert child.parent_id == ctx.span_id
     assert child.span_id != ctx.span_id
+
+
+def test_span_retention_prunes_old_spans(tmp_path, monkeypatch):
+    """Spans age out (≙ the reference's 30-day Log Analytics
+    retention), newest stay."""
+    import sqlite3
+    import time as time_mod
+
+    from tasksrunner.observability import spans as spans_mod
+    from tasksrunner.observability.tracing import TraceContext, trace_scope
+
+    db = tmp_path / "traces.db"
+    rec = spans_mod.SpanRecorder("api", db, flush_interval=999,
+                                 retention_seconds=3600)
+    with trace_scope(TraceContext.new()):
+        rec.record(kind="server", name="old", status=200,
+                   start=time_mod.time() - 7200, duration=0.01)
+        rec.record(kind="server", name="new", status=200,
+                   start=time_mod.time(), duration=0.01)
+    rec.flush()
+    rec.close()
+    names = [r[0] for r in sqlite3.connect(db).execute(
+        "SELECT name FROM spans").fetchall()]
+    assert names == ["new"]
