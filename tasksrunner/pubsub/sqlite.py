@@ -80,6 +80,7 @@ class SqliteBroker(PubSubBroker):
         retry_delay: float = 0.2,
         claim_lease: float = 30.0,
         poll_interval: float = 0.05,
+        claim_batch: int = 64,
         gc_interval: float = 300.0,
         gc_retention: float = 3600.0,
     ):
@@ -91,6 +92,12 @@ class SqliteBroker(PubSubBroker):
         self.retry_delay = retry_delay
         self.claim_lease = claim_lease
         self.poll_interval = poll_interval
+        #: messages claimed per poll. Large batches amortise commits
+        #: (throughput); small batches spread a backlog across
+        #: competing consumers (fairness) — with slow handlers, one
+        #: replica claiming 64 messages serialises 64×work while its
+        #: peers idle (≙ Service Bus prefetch count)
+        self.claim_batch = max(1, claim_batch)
         #: janitor cadence/age for dropping fully-settled messages; a
         #: long-running broker file must not grow without bound
         self.gc_interval = gc_interval
@@ -344,7 +351,8 @@ class SqliteBroker(PubSubBroker):
 
         async def poll_loop() -> None:
             while not stop.is_set() and not self._closed:
-                batch = await self._run(self._claim_batch, topic, group, 64)
+                batch = await self._run(self._claim_batch, topic, group,
+                                        self.claim_batch)
                 if not batch:
                     try:
                         await asyncio.wait_for(stop.wait(), timeout=self.poll_interval)
@@ -594,6 +602,9 @@ def _sqlite_pubsub(spec: ComponentSpec, metadata: dict[str, str]) -> SqliteBroke
         # a crashed consumer's claim expires into redelivery (≙ Service
         # Bus lock duration)
         claim_lease=float(metadata.get("claimLeaseSeconds", 30.0)),
+        # prefetch: messages claimed per poll (throughput ↔ competing-
+        # consumer fairness; ≙ Service Bus maxConcurrentHandlers/prefetch)
+        claim_batch=int(metadata.get("claimBatchSize", 64)),
         # settled-message retention (0 disables the janitor)
         gc_interval=float(metadata.get("gcIntervalSeconds", 300.0)),
         gc_retention=float(metadata.get("gcRetentionSeconds", 3600.0)),

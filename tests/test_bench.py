@@ -1,0 +1,40 @@
+"""Performance regression guard for the benchmark topology.
+
+Runs a scaled-down version of bench.py's headline measurement — the
+faithful cross-process topology (separate api/processor OS processes,
+every [PB] hop of SURVEY.md §3.1 over real localhost HTTP) — and fails
+if throughput or tail latency regress past conservative floors.
+
+The floors are ~5x below the measured numbers on this hardware
+(≈330 tasks/s, p99 ≈70 ms) so the test only trips on a real
+regression (a serialization bug, an accidental per-request reconnect,
+a broker poll pathology), not on host noise.
+"""
+
+import sys
+import pathlib
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from bench import run_xproc  # noqa: E402
+
+
+async def test_xproc_write_path_throughput_and_latency():
+    result = await run_xproc(
+        n_tasks=120, warmup=10, rounds=1, latency_probe=True)
+    assert result["throughput"] > 60, (
+        f"cross-process write path regressed: {result['throughput']} tasks/s")
+    assert result["p99_ms"] < 500, (
+        f"write-path p99 regressed: {result['p99_ms']} ms")
+
+
+async def test_xproc_competing_consumers_scale():
+    # with 25 ms of work per message one replica caps at ~40/s; three
+    # replicas must demonstrably beat one (competing-consumer contract,
+    # SURVEY.md §5.8) — floor at 1.5x to stay noise-proof
+    one = await run_xproc(n_tasks=60, warmup=5, rounds=1, work_ms=25.0)
+    three = await run_xproc(n_tasks=60, warmup=5, rounds=1,
+                            n_processors=3, work_ms=25.0)
+    assert three["throughput"] > 1.5 * one["throughput"], (
+        f"scale-out broken: 1 replica {one['throughput']} tasks/s, "
+        f"3 replicas {three['throughput']} tasks/s")
