@@ -136,10 +136,48 @@ class TasksStoreManager(TasksManager):
     ``client`` is the injected AppClient (≙ DaprClient). Publishes
     TaskSaved on create and on reassignment, exactly where the
     reference does (:36, :95-98).
+
+    Update paths EXCEED the reference: the reference's read-modify-
+    write has a lost-update race (TasksStoreManager.cs:84-101 does
+    get→modify→save with no etag; SURVEY.md §5.2). Here every
+    modification is an etag-guarded compare-and-swap with a bounded
+    retry-on-conflict loop (``_cas``), so concurrent writers serialize
+    instead of silently overwriting each other.
     """
+
+    #: conflict retries before giving up — each retry re-reads, so a
+    #: retry only loses if ANOTHER writer progressed (livelock-free)
+    CAS_ATTEMPTS = 8
 
     def __init__(self, client):
         self.client = client
+
+    async def _cas(self, task_id: str, mutate) -> bool:
+        """get→mutate→save-if-unchanged. ``mutate(task)`` edits the
+        TaskModel in place and may return a zero-arg async callable to
+        run after the commit (e.g. a publish — a callable, NOT a
+        coroutine, so a conflicting retry discards nothing un-awaited);
+        returns False when the key is gone."""
+        from tasksrunner.errors import EtagMismatch
+
+        for _ in range(self.CAS_ATTEMPTS):
+            item = await self.client.get_state_item(STORE_NAME, task_id)
+            if item is None:
+                return False
+            task = TaskModel.from_json(item.value)
+            after_commit = mutate(task)
+            try:
+                await self.client.save_state(
+                    STORE_NAME, task_id, task.to_json(), etag=item.etag)
+            except EtagMismatch:
+                logger.info("etag conflict on task %s; retrying", task_id)
+                continue
+            if after_commit is not None:
+                await after_commit()
+            return True
+        raise EtagMismatch(
+            f"task {task_id} kept changing under us "
+            f"({self.CAS_ATTEMPTS} attempts)")
 
     async def _publish_task_saved(self, task: TaskModel) -> None:
         # ≙ PublishTaskSavedEvent (TasksStoreManager.cs:151-156)
@@ -166,24 +204,23 @@ class TasksStoreManager(TasksManager):
         return task.task_id
 
     async def update_task(self, task_id, update_doc):
-        task = await self.get_task_by_id(task_id)
-        if task is None:
-            return False
-        previous_assignee = task.task_assigned_to  # :92
-        apply_update(task, update_doc)
-        await self.client.save_state(STORE_NAME, task_id, task.to_json())
-        if previous_assignee != task.task_assigned_to:
-            # reassignment republishes the saved event (:95-98)
-            await self._publish_task_saved(task)
-        return True
+        def mutate(task: TaskModel):
+            previous_assignee = task.task_assigned_to  # :92
+            apply_update(task, update_doc)
+            if previous_assignee != task.task_assigned_to:
+                # reassignment republishes the saved event (:95-98) —
+                # only after the CAS commits, so a conflicting retry
+                # can't emit an event for a version that never landed
+                return lambda: self._publish_task_saved(task)
+            return None
+
+        return await self._cas(task_id, mutate)
 
     async def mark_task_completed(self, task_id):
-        task = await self.get_task_by_id(task_id)
-        if task is None:
-            return False
-        task.is_completed = True
-        await self.client.save_state(STORE_NAME, task_id, task.to_json())
-        return True
+        def mutate(task: TaskModel):
+            task.is_completed = True
+
+        return await self._cas(task_id, mutate)
 
     async def delete_task(self, task_id):
         logger.info("Deleting task with id %s", task_id)
@@ -211,9 +248,11 @@ class TasksStoreManager(TasksManager):
         # (TasksStoreManager.cs:141-148) — the reference's only hot loop
         for doc in tasks:
             task_id = doc.get("taskId", "")
-            task = await self.get_task_by_id(task_id)
-            if task is None:
+            if not task_id:
                 continue
-            logger.info("Marking task %s as overdue", task_id)
-            task.is_over_due = True
-            await self.client.save_state(STORE_NAME, task_id, task.to_json())
+
+            def mutate(task: TaskModel):
+                logger.info("Marking task %s as overdue", task_id)
+                task.is_over_due = True
+
+            await self._cas(task_id, mutate)

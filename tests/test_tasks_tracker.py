@@ -275,3 +275,62 @@ async def test_full_http_stack_with_real_browser_flow(isolated_cwd):
     finally:
         for h in hosts:
             await h.stop()
+
+
+@pytest.mark.asyncio
+async def test_concurrent_update_keeps_both_changes(isolated_cwd):
+    """The lost-update race the reference HAS (TasksStoreManager.cs:
+    84-101: get→modify→save, no etag) must not reproduce here: a
+    rename racing a mark-completed keeps BOTH changes.
+
+    Deterministic interleave: writer A (rename) reads the task, then —
+    before A's save lands — writer B completes a full mark-completed.
+    With last-write-wins, A's stale save erases isCompleted. With the
+    etag CAS (managers.py TasksStoreManager._cas), A's save conflicts,
+    retries against the fresh version, and both changes land.
+    """
+    from samples.tasks_tracker.backend_api.managers import TasksStoreManager
+
+    cluster, api, frontend, processor = build_cluster()
+    await cluster.start()
+    try:
+        raw_client = cluster.client(API)
+        created = await raw_client.invoke_json(
+            API, "api/tasks", http_method="POST",
+            data={"taskName": "original", "taskCreatedBy": "race@x.com",
+                  "taskAssignedTo": "a@x.com",
+                  "taskDueDate": "2026-08-02T00:00:00"})
+        task_id = created["taskId"]
+
+        class RacingClient:
+            """Delegates to the real client, but the FIRST save_state
+            triggers a full competing write first."""
+
+            def __init__(self, inner, race_once):
+                self._inner = inner
+                self._race = race_once
+                self._raced = False
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            async def save_state(self, *args, **kwargs):
+                if not self._raced:
+                    self._raced = True
+                    await self._race()
+                return await self._inner.save_state(*args, **kwargs)
+
+        async def competing_write():
+            ok = await TasksStoreManager(raw_client).mark_task_completed(task_id)
+            assert ok
+
+        manager_a = TasksStoreManager(RacingClient(raw_client, competing_write))
+        assert await manager_a.update_task(task_id, {"taskName": "renamed"})
+
+        final = await raw_client.invoke_json(API, f"api/tasks/{task_id}")
+        assert final["taskName"] == "renamed", "A's rename was lost"
+        assert final["isCompleted"] is True, (
+            "B's completion was erased by A's stale save — the "
+            "reference's last-write-wins race reproduced")
+    finally:
+        await cluster.stop()
