@@ -241,9 +241,13 @@ async def run_xproc(n_tasks: int = N_TASKS, *, warmup: int = WARMUP,
     from tasksrunner.hosting import AppHost
 
     tmp = tempfile.mkdtemp(prefix="tasksrunner-bench-")
-    with sqlite3.connect(f"{tmp}/delivered.db") as conn:
-        conn.execute("PRAGMA journal_mode=WAL")
-        conn.execute("CREATE TABLE delivered (id TEXT PRIMARY KEY)")
+    setup = sqlite3.connect(f"{tmp}/delivered.db")
+    try:
+        setup.execute("PRAGMA journal_mode=WAL")
+        setup.execute("CREATE TABLE delivered (id TEXT PRIMARY KEY)")
+        setup.commit()
+    finally:
+        setup.close()
 
     workers = _Workers(tmp, n_processors, work_ms=work_ms)
     try:

@@ -77,6 +77,10 @@ class AppManifest:
     #: (≙ the ACA container probes section); None = defaults,
     #: False = probing off
     health: object = None
+    #: per-app component grants (≙ the per-app role assignments the
+    #: reference declares in Bicep, webapi-backend-service.bicep:146-165);
+    #: None = unrestricted
+    grants: dict | None = None
 
 
 @dataclass
@@ -90,6 +94,9 @@ class EnvironmentManifest:
     #: environment — the secure-baseline posture (≙ the landing zone's
     #: "no unauthenticated data plane" rule)
     require_api_token: bool = False
+    #: one generated token per app at run time (≙ one managed identity
+    #: per container app); travels into the emitted run config
+    per_app_tokens: bool = False
     source_path: pathlib.Path | None = None
 
     @property
@@ -125,6 +132,7 @@ def load_manifest(path: str | pathlib.Path) -> EnvironmentManifest:
             scale_rules=list(scale.get("rules") or []),
             cooldown_seconds=float(scale.get("cooldown_seconds", 5.0)),
             health=raw.get("health"),
+            grants=raw.get("grants"),
         ))
 
     components = [
@@ -139,6 +147,7 @@ def load_manifest(path: str | pathlib.Path) -> EnvironmentManifest:
         components=components,
         registry_file=str(env.get("registry_file", ".tasksrunner/apps.json")),
         require_api_token=bool(env.get("require_api_token", False)),
+        per_app_tokens=bool(env.get("per_app_tokens", False)),
         source_path=path.resolve(),
     )
 
@@ -225,6 +234,18 @@ def validate_manifest(manifest: EnvironmentManifest, *,
                 problems.append(
                     f"app {app.app_id!r}: scale rule references unknown "
                     f"component {comp!r}")
+        if app.grants is not None:
+            from tasksrunner.security import AppGrants
+            try:
+                parsed = AppGrants.parse(app.grants, app_id=app.app_id)
+            except ComponentError as exc:
+                problems.append(str(exc))
+            else:
+                for comp in parsed.components:
+                    if comp not in comp_names:
+                        problems.append(
+                            f"app {app.app_id!r}: grant references unknown "
+                            f"component {comp!r}")
     return problems
 
 

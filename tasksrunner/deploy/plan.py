@@ -147,6 +147,10 @@ def apply_manifest(manifest: EnvironmentManifest) -> dict:
             }
         if app.health is not None:
             entry["health"] = app.health
+        if app.grants is not None:
+            # least-privilege grants travel with the artifact (validated
+            # at load; ≙ role assignments deployed with the app's Bicep)
+            entry["grants"] = app.grants
         apps_block.append(entry)
 
     # components land in a generated resources dir, one local-dialect
@@ -178,6 +182,8 @@ def apply_manifest(manifest: EnvironmentManifest) -> dict:
         # refuse to start this config unauthenticated even from a
         # fresh shell (deploy-time check alone would not survive CI)
         run_config["require_api_token"] = True
+    if manifest.per_app_tokens:
+        run_config["per_app_tokens"] = True
     run_path = out_dir / f"{manifest.name}-run.yaml"
     run_path.write_text(yaml.safe_dump(run_config, sort_keys=False))
 

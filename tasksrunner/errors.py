@@ -44,6 +44,18 @@ class ComponentScopeError(TasksRunnerError):
     http_status = 403
 
 
+class PermissionDenied(TasksRunnerError):
+    """The app's grants do not allow this operation on this component.
+
+    ≙ a missing Azure role assignment in the reference — e.g. a service
+    without "Service Bus Data Sender" cannot publish even though the
+    component is in scope (webapi-backend-service.bicep:157-165,
+    processor-backend-service.bicep:190-198).
+    """
+
+    http_status = 403
+
+
 class DriverNotFound(ComponentError):
     """No driver registered for a component's `type` string."""
 

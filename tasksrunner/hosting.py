@@ -34,6 +34,7 @@ from tasksrunner.observability.tracing import (
 from tasksrunner.resiliency.policy import ResiliencyPolicies
 from tasksrunner.resiliency.spec import ResiliencySpec, load_resiliency
 from tasksrunner.runtime import HTTPAppChannel, InProcAppChannel, Runtime
+from tasksrunner.security import AppGrants, grants_from_env
 from tasksrunner.sidecar import Sidecar
 
 logger = logging.getLogger(__name__)
@@ -73,6 +74,7 @@ class AppHost:
         registry_file: str | None = None,
         resolver: NameResolver | None = None,
         register: bool = True,
+        grants: "AppGrants | None" = None,
     ):
         self.app = app
         #: where the sidecar binds and where peers reach this host
@@ -92,6 +94,9 @@ class AppHost:
         self.resiliency_specs: list[ResiliencySpec] = (
             load_resiliency(components_path) if components_path else [])
         self.resolver = resolver or NameResolver(registry_file=registry_file)
+        #: per-app component authorization; None = unrestricted, or set
+        #: TASKSRUNNER_GRANTS (the orchestrator does, per app spec)
+        self.grants = grants if grants is not None else grants_from_env()
         self._app_runner: web.AppRunner | None = None
         self.sidecar: Sidecar | None = None
         self.client: AppClient | None = None
@@ -116,6 +121,7 @@ class AppHost:
             resiliency=ResiliencyPolicies(
                 self.resiliency_specs, app_id=self.app.app_id)
             if self.resiliency_specs else None,
+            grants=self.grants,
         )
         self.sidecar = Sidecar(runtime, host=self.host, port=self.sidecar_port)
         await self.sidecar.start()
@@ -156,9 +162,17 @@ class InProcCluster:
     """
 
     def __init__(self, specs: list[ComponentSpec] | None = None, *,
-                 resiliency_specs: list[ResiliencySpec] | None = None):
+                 resiliency_specs: list[ResiliencySpec] | None = None,
+                 grants: dict[str, AppGrants | dict] | None = None):
         self.specs = specs or []
         self.resiliency_specs = resiliency_specs or []
+        #: optional per-app grants (app_id → AppGrants or raw mapping);
+        #: apps absent from the dict run unrestricted
+        self.grants = {
+            app_id: g if isinstance(g, AppGrants)
+            else AppGrants.parse(g, app_id=app_id)
+            for app_id, g in (grants or {}).items()
+        }
         self.apps: dict[str, App] = {}
         self.runtimes: dict[str, Runtime] = {}
         self._channels: dict[str, InProcAppChannel] = {}
@@ -195,7 +209,8 @@ class InProcCluster:
             runtime = Runtime(
                 app_id, self._make_registry(app_id), app_channel=channel,
                 resiliency=ResiliencyPolicies(self.resiliency_specs, app_id=app_id)
-                if self.resiliency_specs else None)
+                if self.resiliency_specs else None,
+                grants=self.grants.get(app_id))
             self.runtimes[app_id] = runtime
             app.client = AppClient.direct(runtime)
         # wire peers after all channels exist

@@ -87,6 +87,10 @@ class AppSpec:
     env: dict[str, str] = field(default_factory=dict)
     scale: ScaleSpec = field(default_factory=ScaleSpec)
     health: HealthSpec = field(default_factory=HealthSpec)
+    #: per-app component grants (≙ the reference's per-app role
+    #: assignments, SURVEY.md §5.10). None = unrestricted; a mapping =
+    #: least-privilege whitelist (see tasksrunner/security.py).
+    grants: dict | None = None
 
 
 @dataclass
@@ -104,6 +108,16 @@ class RunConfig:
     #: orchestrator refuses to start unauthenticated, no matter which
     #: shell launches the emitted run config
     require_api_token: bool = False
+    #: one generated token per app instead of a single shared secret
+    #: (≙ one managed identity per container app): each replica gets
+    #: only ITS app's token; sidecars accept peer tokens solely for
+    #: inbound service invocation
+    per_app_tokens: bool = False
+    #: filled by the orchestrator at start when per_app_tokens is on
+    #: (app_id → generated token); not read from YAML
+    app_tokens: dict[str, str] = field(default_factory=dict)
+    #: path of the emitted token map file (set with app_tokens)
+    tokens_file: str | None = None
 
 
 def parse_health(health_raw: object) -> HealthSpec:
@@ -151,6 +165,12 @@ def load_run_config(path: str | pathlib.Path) -> RunConfig:
             for r in scale_raw.get("rules") or []
         ]
         health = parse_health(raw.get("health", {}))
+        grants = raw.get("grants")
+        if grants is not None:
+            # parse now so `deploy validate` / startup rejects a bad
+            # grants block instead of the first denied call at runtime
+            from tasksrunner.security import AppGrants
+            grants = AppGrants.parse(grants, app_id=str(raw["app_id"])).to_json()
         apps.append(AppSpec(
             app_id=str(raw["app_id"]),
             module=str(raw["module"]),
@@ -165,6 +185,7 @@ def load_run_config(path: str | pathlib.Path) -> RunConfig:
                 cooldown_seconds=float(scale_raw.get("cooldown_seconds", 5.0)),
             ),
             health=health,
+            grants=grants,
         ))
     if not apps:
         raise ComponentError(f"run config {path} declares no apps")
@@ -180,4 +201,5 @@ def load_run_config(path: str | pathlib.Path) -> RunConfig:
         base_dir=base,
         admin_port=int(doc.get("admin_port", 0)),
         require_api_token=bool(doc.get("require_api_token", False)),
+        per_app_tokens=bool(doc.get("per_app_tokens", False)),
     )

@@ -100,6 +100,16 @@ class Replica:
         env.update(self.app.env)
         env["TASKSRUNNER_APP_ID"] = self.app.app_id
         env["TASKSRUNNER_REPLICA"] = str(self.index)
+        if self.app.grants is not None:
+            # least-privilege grants ride to the replica's runtime
+            # (security.py AppGrants; ≙ per-app role assignments)
+            import json as _json
+            env["TASKSRUNNER_GRANTS"] = _json.dumps(self.app.grants)
+        if self.config.app_tokens:
+            # per-app identity: the replica gets ONLY its own token;
+            # the map file lets its sidecar verify inbound peers
+            env["TASKSRUNNER_API_TOKEN"] = self.config.app_tokens[self.app.app_id]
+            env["TASKSRUNNER_TOKENS_FILE"] = self.config.tokens_file or ""
         # the orchestrator's import context must reach the replicas
         # (run configs may live outside the package root)
         env["PYTHONPATH"] = os.pathsep.join(
@@ -246,6 +256,8 @@ class Orchestrator:
         return entry
 
     async def start(self) -> None:
+        if self.config.per_app_tokens and not self.config.app_tokens:
+            self._issue_app_tokens()
         for app in self.config.apps:
             self.replicas[app.app_id] = []
             self._record_revision(app.app_id, "initial deploy")
@@ -262,6 +274,34 @@ class Orchestrator:
         from tasksrunner.orchestrator.admin import AdminServer
         self._admin = AdminServer(self, port=self.config.admin_port)
         await self._admin.start()
+
+    def _issue_app_tokens(self) -> None:
+        """Generate one token per app and write the app_id→token map
+        beside the name registry (mode 0600). Each replica receives
+        only its own token; sidecars read the map to authenticate
+        inbound peer invocations (≙ one managed identity per container
+        app instead of a shared secret, SURVEY.md §5.10)."""
+        import json as _json
+        import pathlib
+        import secrets as _secrets
+
+        self.config.app_tokens = {
+            app.app_id: _secrets.token_hex(16) for app in self.config.apps
+        }
+        registry = pathlib.Path(self.config.registry_file)
+        if not registry.is_absolute():
+            registry = self.config.base_dir / registry
+        tokens_path = registry.parent / "tokens.json"
+        tokens_path.parent.mkdir(parents=True, exist_ok=True)
+        # created 0600 from the first byte — chmod-after-write would
+        # leave a world-readable window for every app's token
+        fd = os.open(tokens_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(_json.dumps(self.config.app_tokens, indent=2))
+        tokens_path.chmod(0o600)  # pre-existing file: tighten it too
+        self.config.tokens_file = str(tokens_path)
+        logger.info("issued per-app tokens for %d apps -> %s",
+                    len(self.config.app_tokens), tokens_path)
 
     async def _add_replica(self, app: AppSpec) -> None:
         replica = Replica(app, len(self.replicas[app.app_id]), self.config)

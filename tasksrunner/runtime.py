@@ -53,7 +53,7 @@ from tasksrunner.observability.tracing import (
 )
 from tasksrunner.pubsub.base import Message, PubSubBroker
 from tasksrunner.resiliency.policy import ResiliencyPolicies
-from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER
+from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER, AppGrants
 from tasksrunner.state.base import StateStore, TransactionOp
 from tasksrunner.state.keyprefix import KeyPrefixer
 
@@ -124,6 +124,7 @@ class Runtime:
         invoke_retries: int = 3,
         invoke_retry_delay: float = 0.2,
         resiliency: ResiliencyPolicies | None = None,
+        grants: "AppGrants | None" = None,
     ):
         self.app_id = app_id
         self.registry = registry
@@ -139,6 +140,11 @@ class Runtime:
         #: when a target has one it replaces the builtin retry loop
         #: (tasksrunner/resiliency, ≙ Dapr 1.14 kind: Resiliency)
         self.resiliency = resiliency
+        #: per-app component authorization (≙ the reference's
+        #: least-privilege role assignments, SURVEY.md §5.10); None =
+        #: unrestricted. Enforced HERE, transport-neutrally, so the
+        #: HTTP sidecar and the in-proc client behave identically.
+        self.grants = grants
         self.app_channel = app_channel
         #: in-process peer channels (app-id → AppChannel); consulted
         #: before name resolution so a single-process cluster can route
@@ -162,6 +168,11 @@ class Runtime:
             return await fn()
         return await policy.execute(fn, retriable=retriable)
 
+    def _authorize(self, component: str, op: str, *,
+                   topic: str | None = None) -> None:
+        if self.grants is not None:
+            self.grants.check(component, op, topic=topic, app_id=self.app_id)
+
     def _state_store(self, name: str) -> tuple[StateStore, KeyPrefixer]:
         store = self.registry.get(name, block="state")
         spec: ComponentSpec = self.registry.spec(name)
@@ -173,6 +184,7 @@ class Runtime:
     # -- state -----------------------------------------------------------
 
     async def save_state(self, store_name: str, items: list[dict]) -> None:
+        self._authorize(store_name, "write")
         store, prefixer = self._state_store(store_name)
         for item in items:
             if "key" not in item:
@@ -190,11 +202,13 @@ class Runtime:
         metrics.inc("state_save", len(items), store=store_name)
 
     async def get_state(self, store_name: str, key: str):
+        self._authorize(store_name, "read")
         store, prefixer = self._state_store(store_name)
         metrics.inc("state_get", store=store_name)
         return await self._guarded(store_name, lambda: store.get(prefixer.apply(key)))
 
     async def delete_state(self, store_name: str, key: str, *, etag=None) -> bool:
+        self._authorize(store_name, "write")
         store, prefixer = self._state_store(store_name)
         metrics.inc("state_delete", store=store_name)
         return await self._guarded(
@@ -202,6 +216,7 @@ class Runtime:
 
     async def bulk_get_state(self, store_name: str, keys: list[str]) -> list[dict]:
         """≙ Dapr's POST /v1.0/state/{store}/bulk."""
+        self._authorize(store_name, "read")
         store, prefixer = self._state_store(store_name)
         items = await self._guarded(
             store_name,
@@ -217,6 +232,7 @@ class Runtime:
         return out
 
     async def query_state(self, store_name: str, query: dict) -> dict:
+        self._authorize(store_name, "read")
         store, prefixer = self._state_store(store_name)
         resp = await self._guarded(
             store_name, lambda: store.query(query, key_prefix=prefixer.prefix))
@@ -230,6 +246,7 @@ class Runtime:
         }
 
     async def transact_state(self, store_name: str, operations: list[dict]) -> None:
+        self._authorize(store_name, "write")
         store, prefixer = self._state_store(store_name)
         ops = []
         for op in operations:
@@ -251,10 +268,12 @@ class Runtime:
     # -- secrets ---------------------------------------------------------
 
     def get_secret(self, store_name: str, key: str) -> dict[str, str]:
+        self._authorize(store_name, "read")
         store = self.registry.get(store_name, block="secretstores")
         return {key: store.get(key)}
 
     def bulk_secrets(self, store_name: str) -> dict[str, str]:
+        self._authorize(store_name, "read")
         store = self.registry.get(store_name, block="secretstores")
         return store.bulk()
 
@@ -263,6 +282,7 @@ class Runtime:
     async def publish(self, pubsub_name: str, topic: str, data: Any, *,
                       metadata: dict[str, str] | None = None,
                       raw: bool = False) -> str:
+        self._authorize(pubsub_name, "publish", topic=topic)
         broker: PubSubBroker = self.registry.get(pubsub_name, block="pubsub")
         envelope = data if raw else cloudevents.wrap(
             data, source=self.app_id or "tasksrunner", topic=topic,
@@ -292,6 +312,7 @@ class Runtime:
 
     async def invoke_output_binding(self, name: str, operation: str, data: Any,
                                     metadata: dict[str, str] | None = None):
+        self._authorize(name, "invoke")
         binding = self.registry.get(name, block="bindings")
         if not isinstance(binding, OutputBinding):
             raise BindingError(f"component {name!r} is not an output binding")
@@ -446,9 +467,16 @@ class Runtime:
             try:
                 broker = self.registry.get(pubsub_name, block="pubsub")
             except ComponentNotFound:
+                # an absent component is skippable (the processor's
+                # local-only taskspubsub slot in cloud mode) ...
                 logger.warning("app %s subscribes to unknown pubsub %r — skipped",
                                self.app_id, pubsub_name)
                 continue
+            # ... but an EXISTING one without a subscribe grant fails
+            # fast, like a missing "Service Bus Data Receiver" role
+            # (processor-backend-service.bicep:190-198): an app must not
+            # start silently deaf to a subscription it declared
+            self._authorize(pubsub_name, "subscribe", topic=topic)
             handler = self._make_subscription_handler(route)
             self._subscriptions.append(
                 await broker.subscribe(topic, self.app_id or "default", handler))

@@ -1,10 +1,180 @@
-"""Shared security constants.
+"""Security: app↔sidecar auth tokens and per-app component grants.
 
-App↔sidecar API-token auth ≙ Dapr's ``dapr-api-token`` / the
-reference's identity posture (SURVEY.md §5.10). One definition so the
-sidecar (verifier), the client SDK, and peer-sidecar invocation (both
-senders) can never drift apart.
+Two layers, mirroring the reference's identity posture (SURVEY.md
+§5.10):
+
+* **AuthN — API tokens** ≙ Dapr's ``dapr-api-token``. One definition of
+  header/env names so the sidecar (verifier), the client SDK, and
+  peer-sidecar invocation (both senders) can never drift apart. With
+  ``per_app_tokens`` each app gets its OWN token (≙ one managed
+  identity per container app, webapi-backend-service.bicep:83-86): an
+  app can drive only its own sidecar; peer sidecars accept any cluster
+  app's token for inbound service invocation — and nothing else.
+
+* **AuthZ — grants** ≙ the reference's least-privilege role
+  assignments: Cosmos "Data Contributor" (state read+write,
+  webapi-backend-service.bicep:146-154), Service Bus "Data Sender"
+  (publish, :157-165), "Data Receiver" (subscribe,
+  processor-backend-service.bicep:190-198), Key Vault "Secrets User"
+  (secret read, secrets/processor-backend-service-secrets.bicep:66-74).
+  Declared per app in the run config / environment manifest:
+
+  .. code-block:: yaml
+
+      apps:
+        - app_id: tasksmanager-backend-api
+          grants:
+            statestore: [read, write]
+            dapr-pubsub-servicebus:
+              - publish: [tasksavedtopic]    # entity-scoped send
+            secretstoreakv: [read]
+
+  An app WITHOUT a ``grants`` block is unrestricted (the pre-grants
+  posture, like the workshop before module 10 introduces identities);
+  an app WITH one may only perform the listed operations.
+
+Operations per building block:
+
+=============  =============================================
+state          ``read`` (get/bulk/query), ``write`` (save/delete/transaction)
+pubsub         ``publish``, ``subscribe`` — optionally per-topic
+bindings       ``invoke`` (output bindings)
+secretstores   ``read``
+=============  =============================================
 """
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+from dataclasses import dataclass, field
+
+from tasksrunner.errors import ComponentError, PermissionDenied
 
 TOKEN_ENV = "TASKSRUNNER_API_TOKEN"
 TOKEN_HEADER = "tr-api-token"
+#: JSON file mapping app_id -> token; set for every replica when the
+#: orchestrator runs with ``per_app_tokens: true``
+TOKENS_FILE_ENV = "TASKSRUNNER_TOKENS_FILE"
+#: per-app grants for the hosted app, JSON-encoded (orchestrator →
+#: ``tasksrunner host`` hand-off)
+GRANTS_ENV = "TASKSRUNNER_GRANTS"
+
+_KNOWN_OPS = {"read", "write", "publish", "subscribe", "invoke"}
+
+
+@dataclass
+class AppGrants:
+    """Per-app component permissions.
+
+    ``components`` maps component name → {op → topic-allowlist or None}.
+    A ``None`` allowlist means the op is granted for every topic (ops
+    other than publish/subscribe ignore topics entirely).
+    """
+
+    components: dict[str, dict[str, list[str] | None]] = field(
+        default_factory=dict)
+
+    @classmethod
+    def parse(cls, raw: object, *, app_id: str = "?") -> "AppGrants":
+        """Parse the YAML/JSON ``grants:`` block. Accepts, per
+        component, a list whose items are either an op string or a
+        single-key ``{op: [topics]}`` mapping."""
+        if raw is None:
+            raw = {}
+        if not isinstance(raw, dict):
+            raise ComponentError(
+                f"grants for app {app_id!r} must be a mapping of "
+                f"component name to operation list, got {type(raw).__name__}")
+        components: dict[str, dict[str, list[str] | None]] = {}
+        for comp, ops_raw in raw.items():
+            if ops_raw is None:
+                ops_raw = []
+            if isinstance(ops_raw, str):
+                ops_raw = [ops_raw]
+            if not isinstance(ops_raw, list):
+                raise ComponentError(
+                    f"grants[{comp}] for app {app_id!r} must be a list "
+                    f"of operations")
+            ops: dict[str, list[str] | None] = {}
+            for entry in ops_raw:
+                if isinstance(entry, str):
+                    op, topics = entry, None
+                elif isinstance(entry, dict) and len(entry) == 1:
+                    op, topic_list = next(iter(entry.items()))
+                    if isinstance(topic_list, str):
+                        topic_list = [topic_list]
+                    if not isinstance(topic_list, list):
+                        raise ComponentError(
+                            f"grants[{comp}] for app {app_id!r}: topic "
+                            f"restriction for {op!r} must be a list")
+                    topics = [str(t) for t in topic_list]
+                else:
+                    raise ComponentError(
+                        f"grants[{comp}] for app {app_id!r}: each entry "
+                        "must be an op string or {op: [topics]}")
+                op = str(op)
+                if op not in _KNOWN_OPS:
+                    raise ComponentError(
+                        f"grants[{comp}] for app {app_id!r}: unknown "
+                        f"operation {op!r} (known: {sorted(_KNOWN_OPS)})")
+                if op in ops and topics is not None and ops[op] is not None:
+                    ops[op] = (ops[op] or []) + topics
+                else:
+                    # an unrestricted grant absorbs a restricted one
+                    ops[op] = None if (op in ops and ops[op] is None) else topics
+            components[str(comp)] = ops
+        return cls(components=components)
+
+    def to_json(self) -> dict:
+        return {
+            comp: [op if topics is None else {op: topics}
+                   for op, topics in ops.items()]
+            for comp, ops in self.components.items()
+        }
+
+    def check(self, component: str, op: str, *,
+              topic: str | None = None, app_id: str | None = None) -> None:
+        """Raise PermissionDenied unless ``op`` (optionally on
+        ``topic``) is granted for ``component``."""
+        ops = self.components.get(component)
+        if ops is None or op not in ops:
+            raise PermissionDenied(
+                f"app {app_id or '?'} has no {op!r} grant on component "
+                f"{component!r} (granted: "
+                f"{sorted(self.components.get(component, {})) or 'nothing'})")
+        topics = ops[op]
+        if topics is not None and topic is not None and topic not in topics:
+            raise PermissionDenied(
+                f"app {app_id or '?'} may {op} on {component!r} only for "
+                f"topics {topics}, not {topic!r}")
+
+
+def grants_from_env() -> AppGrants | None:
+    """The orchestrator serialises each app's grants into
+    ``TASKSRUNNER_GRANTS`` for its replicas; absent = unrestricted."""
+    raw = os.environ.get(GRANTS_ENV)
+    if not raw:
+        return None
+    return AppGrants.parse(json.loads(raw), app_id=os.environ.get(
+        "TASKSRUNNER_APP_ID", "?"))
+
+
+def load_token_map(path: str | pathlib.Path | None = None) -> dict[str, str]:
+    """app_id → token map (``per_app_tokens`` mode). Empty when the
+    file env/argument is unset or unreadable-as-JSON is an error."""
+    if path is None:
+        path = os.environ.get(TOKENS_FILE_ENV)
+    if not path:
+        return {}
+    p = pathlib.Path(path)
+    try:
+        doc = json.loads(p.read_text())
+    except OSError as exc:
+        raise ComponentError(f"cannot read token map {p}: {exc}") from exc
+    except ValueError as exc:
+        raise ComponentError(f"token map {p} is not valid JSON: {exc}") from exc
+    if not isinstance(doc, dict):
+        raise ComponentError(f"token map {p} must be a JSON object")
+    return {str(k): str(v) for k, v in doc.items()}

@@ -48,12 +48,21 @@ def _json_error(exc: Exception) -> web.Response:
     return web.json_response({"error": str(exc) or type(exc).__name__}, status=status)
 
 
-from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER  # noqa: E402 (re-export)
+from tasksrunner.security import (  # noqa: E402 (re-export)
+    TOKEN_ENV,
+    TOKEN_HEADER,
+    load_token_map,
+)
 
 
-def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None) -> web.Application:
+def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
+                      peer_tokens: set[str] | None = None) -> web.Application:
     if api_token is None:
         api_token = os.environ.get(TOKEN_ENV) or None
+    if peer_tokens is None:
+        # per-app-token mode: the orchestrator's token map lets this
+        # sidecar authenticate inbound peers without sharing one secret
+        peer_tokens = set(load_token_map().values())
 
     routes = web.RouteTableDef()
 
@@ -62,10 +71,18 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None) -> web.
             # app↔sidecar API token (≙ Dapr's dapr-api-token / the
             # reference's identity posture, SURVEY.md §5.10): when a
             # token is configured, every building-block call must carry
-            # it — healthz stays open for probes
-            if api_token is not None and request.headers.get(TOKEN_HEADER) != api_token:
-                return web.json_response({"error": "missing or bad api token"},
-                                         status=401)
+            # it — healthz stays open for probes. A PEER app's token is
+            # honored only for inbound service invocation: another
+            # app's identity must not unlock this app's state, pub/sub,
+            # bindings, or secrets (≙ per-app least privilege).
+            if api_token is not None:
+                supplied = request.headers.get(TOKEN_HEADER)
+                if supplied != api_token and not (
+                    supplied in peer_tokens
+                    and request.path.startswith("/v1.0/invoke/")
+                ):
+                    return web.json_response(
+                        {"error": "missing or bad api token"}, status=401)
             ctx = ensure_trace(request.headers.get(TRACEPARENT_HEADER))
             with trace_scope(ctx):
                 try:

@@ -1,0 +1,328 @@
+"""Per-app authorization: component grants + per-app tokens.
+
+≙ the reference's least-privilege identity model (SURVEY.md §5.10):
+each app has its own managed identity with scoped role assignments —
+Cosmos Data Contributor (webapi-backend-service.bicep:146-154), SB Data
+Sender (:157-165), SB Data Receiver (processor-backend-service.bicep:
+190-198), KV Secrets User (secrets/...-secrets.bicep:66-74). Here:
+``grants:`` blocks in the run config / environment manifest, enforced
+transport-neutrally in the Runtime, plus per-app API tokens where a
+peer's token unlocks inbound invocation ONLY.
+"""
+
+import json
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tasksrunner import App, InProcCluster
+from tasksrunner.component.spec import parse_component
+from tasksrunner.errors import ComponentError, PermissionDenied
+from tasksrunner.security import AppGrants
+
+API = "tasksmanager-backend-api"
+FRONTEND = "tasksmanager-frontend-webapp"
+PROCESSOR = "tasksmanager-backend-processor"
+
+
+def specs(tmp_path):
+    return [
+        parse_component({
+            "componentType": "state.memory",
+        }, default_name="statestore"),
+        parse_component({
+            "componentType": "pubsub.memory",
+        }, default_name="dapr-pubsub-servicebus"),
+        parse_component({
+            "componentType": "bindings.localblob",
+            "metadata": [{"name": "rootPath", "value": str(tmp_path / "blobs")}],
+        }, default_name="externaltasksblobstore"),
+    ]
+
+
+SAMPLE_GRANTS = {
+    API: {
+        "statestore": ["read", "write"],
+        "dapr-pubsub-servicebus": [{"publish": ["tasksavedtopic"]}],
+    },
+    FRONTEND: {},  # the frontend holds no component roles
+    PROCESSOR: {
+        "dapr-pubsub-servicebus": [{"subscribe": ["tasksavedtopic"]}],
+        "externaltasksblobstore": ["invoke"],
+    },
+}
+
+
+def build_cluster(tmp_path, *, processor_subscribes=True):
+    cluster = InProcCluster(specs(tmp_path), grants=SAMPLE_GRANTS)
+    api, frontend, processor = App(API), App(FRONTEND), App(PROCESSOR)
+    if processor_subscribes:
+        @processor.subscribe(pubsub="dapr-pubsub-servicebus",
+                             topic="tasksavedtopic", route="/on-saved")
+        async def on_saved(req):
+            return 200
+    for a in (api, frontend, processor):
+        cluster.add_app(a)
+    return cluster
+
+
+# -- parsing -------------------------------------------------------------
+
+def test_parse_rejects_unknown_op():
+    with pytest.raises(ComponentError, match="unknown operation"):
+        AppGrants.parse({"statestore": ["fly"]})
+
+
+def test_parse_rejects_non_mapping():
+    with pytest.raises(ComponentError, match="must be a mapping"):
+        AppGrants.parse(["statestore"])
+
+
+def test_parse_topic_restriction_shapes():
+    g = AppGrants.parse({
+        "ps": ["subscribe", {"publish": ["a", "b"]}],
+        "store": "read",          # bare string promotes to [read]
+    })
+    g.check("ps", "subscribe", topic="anything")
+    g.check("ps", "publish", topic="a")
+    with pytest.raises(PermissionDenied):
+        g.check("ps", "publish", topic="c")
+    g.check("store", "read")
+    # round-trips through JSON (orchestrator → replica env hand-off)
+    again = AppGrants.parse(json.loads(json.dumps(g.to_json())))
+    with pytest.raises(PermissionDenied):
+        again.check("ps", "publish", topic="c")
+
+
+# -- runtime enforcement (the VERDICT's two named proofs) ---------------
+
+@pytest.mark.asyncio
+async def test_frontend_cannot_write_statestore(tmp_path):
+    cluster = build_cluster(tmp_path)
+    await cluster.start()
+    try:
+        frontend = cluster.client(FRONTEND)
+        with pytest.raises(PermissionDenied):
+            await frontend.save_state("statestore", "k", {"v": 1})
+        with pytest.raises(PermissionDenied):
+            await frontend.get_state("statestore", "k")
+        # the API, with its Data-Contributor-analog grant, can
+        api = cluster.client(API)
+        await api.save_state("statestore", "k", {"v": 1})
+        assert await api.get_state("statestore", "k") == {"v": 1}
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_processor_cannot_publish_ungranted_topic(tmp_path):
+    cluster = build_cluster(tmp_path)
+    await cluster.start()
+    try:
+        processor = cluster.client(PROCESSOR)
+        # no publish grant at all on the pubsub it subscribes to
+        with pytest.raises(PermissionDenied):
+            await processor.publish_event(
+                "dapr-pubsub-servicebus", "tasksavedtopic", {"x": 1})
+        # the API may publish — but only to its granted topic
+        api = cluster.client(API)
+        await api.publish_event(
+            "dapr-pubsub-servicebus", "tasksavedtopic", {"x": 1})
+        with pytest.raises(PermissionDenied):
+            await api.publish_event(
+                "dapr-pubsub-servicebus", "some-other-topic", {"x": 1})
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_binding_invoke_grant(tmp_path):
+    cluster = build_cluster(tmp_path)
+    await cluster.start()
+    try:
+        await cluster.client(PROCESSOR).invoke_binding(
+            "externaltasksblobstore", "create", {"a": 1},
+            {"blobName": "a.json"})
+        with pytest.raises(PermissionDenied):
+            await cluster.client(API).invoke_binding(
+                "externaltasksblobstore", "create", {"a": 1},
+                {"blobName": "b.json"})
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_ungranted_subscription_fails_startup(tmp_path):
+    """An app declaring a subscription it has no grant for must fail
+    fast (≙ missing SB Data Receiver role), not start silently deaf."""
+    grants = dict(SAMPLE_GRANTS)
+    grants[PROCESSOR] = {}  # revoke the receiver role
+    cluster = InProcCluster(specs(tmp_path), grants=grants)
+    processor = App(PROCESSOR)
+
+    @processor.subscribe(pubsub="dapr-pubsub-servicebus",
+                         topic="tasksavedtopic", route="/on-saved")
+    async def on_saved(req):
+        return 200
+
+    cluster.add_app(processor)
+    with pytest.raises(PermissionDenied):
+        await cluster.start()
+    await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_apps_without_grants_block_run_unrestricted(tmp_path):
+    cluster = InProcCluster(specs(tmp_path))  # no grants anywhere
+    app = App(API)
+    cluster.add_app(app)
+    await cluster.start()
+    try:
+        await cluster.client(API).save_state("statestore", "k", 1)
+        await cluster.client(API).publish_event(
+            "dapr-pubsub-servicebus", "any-topic", {})
+    finally:
+        await cluster.stop()
+
+
+# -- HTTP surface: PermissionDenied maps to 403 --------------------------
+
+@pytest.mark.asyncio
+async def test_denied_op_maps_to_403_over_http(tmp_path):
+    import aiohttp
+
+    from tasksrunner.hosting import AppHost
+
+    host = AppHost(App(FRONTEND), specs=specs(tmp_path),
+                   grants=AppGrants.parse(SAMPLE_GRANTS[FRONTEND],
+                                          app_id=FRONTEND),
+                   register=False)
+    await host.start()
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{host.sidecar_port}/v1.0/state/statestore",
+                json=[{"key": "k", "value": 1}],
+            ) as resp:
+                assert resp.status == 403
+                assert "grant" in (await resp.json())["error"]
+    finally:
+        await host.stop()
+
+
+# -- per-app tokens ------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_peer_token_unlocks_invoke_only(tmp_path, monkeypatch):
+    """With per-app tokens, another app's identity may invoke me but
+    may NOT read my state/secrets or publish as me."""
+    import aiohttp
+
+    from tasksrunner.hosting import AppHost
+
+    api_token, frontend_token = "tok-api-1", "tok-frontend-2"
+    tokens_file = tmp_path / "tokens.json"
+    tokens_file.write_text(json.dumps(
+        {API: api_token, FRONTEND: frontend_token}))
+    monkeypatch.setenv("TASKSRUNNER_TOKENS_FILE", str(tokens_file))
+    monkeypatch.setenv("TASKSRUNNER_API_TOKEN", api_token)
+
+    app = App(API)
+
+    @app.get("/ping")
+    async def ping(req):
+        return 200, {"pong": True}
+
+    host = AppHost(app, specs=specs(tmp_path), register=False)
+    await host.start()
+    base = f"http://127.0.0.1:{host.sidecar_port}"
+    try:
+        async with aiohttp.ClientSession() as session:
+            async def req(path, token, method="GET", **kw):
+                async with session.request(
+                    method, base + path,
+                    headers={"tr-api-token": token} if token else {},
+                    **kw,
+                ) as resp:
+                    return resp.status
+
+            # own token: everything works
+            assert await req("/v1.0/state/statestore/k", api_token) in (200, 204)
+            assert await req(f"/v1.0/invoke/{API}/method/ping", api_token) == 200
+            # peer token: invocation only
+            assert await req(f"/v1.0/invoke/{API}/method/ping",
+                             frontend_token) == 200
+            assert await req("/v1.0/state/statestore/k", frontend_token) == 401
+            assert await req("/v1.0/publish/dapr-pubsub-servicebus/t",
+                             frontend_token, method="POST", json={}) == 401
+            # unknown token: nothing
+            assert await req(f"/v1.0/invoke/{API}/method/ping", "bogus") == 401
+            assert await req(f"/v1.0/invoke/{API}/method/ping", None) == 401
+    finally:
+        await host.stop()
+
+
+# -- config / manifest plumbing ------------------------------------------
+
+def test_run_config_parses_and_validates_grants(tmp_path):
+    from tasksrunner.orchestrator.config import load_run_config
+
+    cfg = tmp_path / "run.yaml"
+    cfg.write_text("""
+apps:
+  - app_id: a
+    module: x:make_app
+    grants:
+      store: [read, bogus-op]
+""")
+    with pytest.raises(ComponentError, match="unknown operation"):
+        load_run_config(cfg)
+
+
+def test_manifest_validate_catches_grant_for_unknown_component(tmp_path):
+    from tasksrunner.deploy.manifest import load_manifest, validate_manifest
+
+    comp = tmp_path / "store.yaml"
+    comp.write_text(
+        "componentType: state.memory\nmetadata: []\n")
+    man = tmp_path / "env.yaml"
+    man.write_text(f"""
+environment:
+  name: t
+components:
+  - name: statestore
+    file: {comp}
+apps:
+  - app_id: a
+    module: tasksrunner:App
+    grants:
+      statestore: [read]
+      not-a-component: [write]
+""")
+    problems = validate_manifest(load_manifest(man), check_imports=False)
+    assert any("not-a-component" in p for p in problems), problems
+    assert not any("statestore" in p for p in problems), problems
+
+
+def test_orchestrator_issues_per_app_tokens(tmp_path):
+    from tasksrunner.orchestrator.config import AppSpec, RunConfig
+    from tasksrunner.orchestrator.run import Orchestrator
+
+    config = RunConfig(
+        apps=[AppSpec(app_id="a", module="x:y"),
+              AppSpec(app_id="b", module="x:y")],
+        registry_file=str(tmp_path / ".tasksrunner" / "apps.json"),
+        base_dir=tmp_path,
+        per_app_tokens=True,
+    )
+    orch = Orchestrator(config)
+    orch._issue_app_tokens()
+    assert set(config.app_tokens) == {"a", "b"}
+    assert config.app_tokens["a"] != config.app_tokens["b"]
+    written = json.loads(pathlib.Path(config.tokens_file).read_text())
+    assert written == config.app_tokens
+    mode = pathlib.Path(config.tokens_file).stat().st_mode & 0o777
+    assert mode == 0o600
