@@ -41,17 +41,36 @@ logger = logging.getLogger(__name__)
 
 
 def build_app_server(app: App) -> web.Application:
-    """aiohttp adapter serving an App over HTTP (the app's own port)."""
+    """aiohttp adapter serving an App over HTTP (the app's own port).
+
+    Tracks request concurrency and serves it at
+    ``GET /tasksrunner/stats`` — the measurement source for the
+    ``http-concurrency`` autoscale rule (the orchestrator polls each
+    replica, the way ACA's HTTP scaler watches concurrent requests,
+    docs/aca/09-aca-autoscale-keda/index.md:27-35)."""
+    inflight = 0
+    requests_total = 0
 
     async def dispatch(request: web.Request) -> web.Response:
-        ctx = ensure_trace(request.headers.get(TRACEPARENT_HEADER))
-        with trace_scope(ctx):
-            body = await request.read()
-            resp = await app.handle(
-                request.method, request.path, query=request.query_string,
-                headers=dict(request.headers), body=body)
-            status, headers, payload = resp.encode()
-            return web.Response(status=status, body=payload, headers=headers)
+        nonlocal inflight, requests_total
+        if request.method == "GET" and request.path == "/tasksrunner/stats":
+            # not counted as load: the scaler's own probe must not
+            # inflate the concurrency it measures
+            return web.json_response(
+                {"inflight": inflight, "requests_total": requests_total})
+        inflight += 1
+        requests_total += 1
+        try:
+            ctx = ensure_trace(request.headers.get(TRACEPARENT_HEADER))
+            with trace_scope(ctx):
+                body = await request.read()
+                resp = await app.handle(
+                    request.method, request.path, query=request.query_string,
+                    headers=dict(request.headers), body=body)
+                status, headers, payload = resp.encode()
+                return web.Response(status=status, body=payload, headers=headers)
+        finally:
+            inflight -= 1
 
     server = web.Application(client_max_size=16 * 1024 * 1024)
     server.router.add_route("*", "/{path:.*}", dispatch)

@@ -1,4 +1,4 @@
-"""KEDA-style backlog autoscaling for the local orchestrator.
+"""KEDA-style autoscaling for the local orchestrator.
 
 Replicates the reference's only parallelism mechanism (SURVEY.md §5.8):
 the processor scales 1→5 replicas, +1 per 10 messages of Service Bus
@@ -7,6 +7,23 @@ topic-subscription backlog
 Here the scaler watches the sqlite broker/queue files directly — the
 same out-of-band position KEDA occupies (it reads the broker, not the
 app) — and tells the orchestrator the desired replica count.
+
+The full ACA trigger taxonomy
+(docs/aca/09-aca-autoscale-keda/index.md:27-35) is covered:
+
+==================  ====================================================
+``pubsub-backlog``   ≙ the ``azure-servicebus`` custom scaler
+                     (+1 replica per ``messageCount`` backlog)
+``queue-backlog``    ≙ ``azure-queue`` custom scaler
+``http-concurrency`` ≙ the HTTP rule: +1 replica per
+                     ``concurrentRequests`` in flight, summed by
+                     polling each replica's ``/tasksrunner/stats``
+``cpu``              ≙ the CPU rule: replicas sized so per-replica
+                     CPU stays under ``utilization`` percent
+                     (measured from /proc/<pid>/stat deltas)
+``memory``           ≙ the Memory rule: +1 replica per ``megabytes``
+                     of total RSS (measured from /proc/<pid>/status)
+==================  ====================================================
 
 Scale-to-zero is deliberately NOT implemented, for the reason the
 workshop rejects it: it would starve cron and input bindings
@@ -65,6 +82,58 @@ def read_backlog(rule: ScaleRule, *, app_id: str,
     raise ComponentError(f"unknown scale rule type {rule.type!r}")
 
 
+RULE_TYPES = ("pubsub-backlog", "queue-backlog", "http-concurrency",
+              "cpu", "memory")
+
+
+def _read_inflight(replicas: list[dict], timeout: float = 0.5) -> int:
+    """Sum in-flight requests across replicas by polling each one's
+    ``/tasksrunner/stats`` (the position of ACA's HTTP scaler: it
+    watches traffic, not app internals). Unreachable replicas count 0
+    — mid-restart must not wedge the scaler."""
+    import json as _json
+    import urllib.request
+
+    total = 0
+    for info in replicas:
+        port = info.get("app_port")
+        if not port:
+            continue
+        host = info.get("host") or "127.0.0.1"
+        if host in ("", "0.0.0.0"):
+            host = "127.0.0.1"
+        try:
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/tasksrunner/stats", timeout=timeout
+            ) as resp:
+                total += int(_json.loads(resp.read()).get("inflight", 0))
+        except (OSError, ValueError):
+            continue
+    return total
+
+
+def _read_proc_cpu_ticks(pid: int) -> int | None:
+    """utime+stime clock ticks from /proc/<pid>/stat (Linux)."""
+    try:
+        text = pathlib.Path(f"/proc/{pid}/stat").read_text()
+    except OSError:
+        return None
+    # comm (field 2) may contain spaces — split after the closing paren
+    rest = text.rpartition(")")[2].split()
+    # rest[0] is field 3 (state); utime/stime are fields 14/15
+    return int(rest[11]) + int(rest[12])
+
+
+def _read_proc_rss_mb(pid: int) -> float:
+    try:
+        for line in pathlib.Path(f"/proc/{pid}/status").read_text().splitlines():
+            if line.startswith("VmRSS:"):
+                return int(line.split()[1]) / 1024.0  # kB -> MB
+    except OSError:
+        pass
+    return 0.0
+
+
 class AutoscaleController:
     """Computes desired replicas per app and drives a scaling callback."""
 
@@ -76,29 +145,87 @@ class AutoscaleController:
         *,
         base_dir: pathlib.Path | None = None,
         interval: float = 0.5,
+        replica_info: Callable[[], list[dict]] | None = None,
     ):
         self.app = app
         self.components = components
         self.set_replicas = set_replicas
         self.base_dir = base_dir or pathlib.Path.cwd()
         self.interval = interval
+        #: live replica inventory ({pid, app_port, host} per replica),
+        #: supplied by the orchestrator — the http/cpu/memory rules
+        #: measure the replicas themselves, not a shared broker file
+        self.replica_info = replica_info or (lambda: [])
         self.current = app.scale.min_replicas
         self._low_since: float | None = None
         self._task: asyncio.Task | None = None
+        #: pid -> (monotonic_time, cpu_ticks) from the previous poll,
+        #: for CPU-utilization deltas
+        self._cpu_prev: dict[int, tuple[float, int]] = {}
 
-    def desired_replicas(self) -> int:
-        """+1 replica per messageCount of backlog, clamped to bounds
-        (the KEDA azure-servicebus formula)."""
-        scale = self.app.scale
-        if not scale.rules:
-            return scale.min_replicas
-        desired = 0
-        for rule in scale.rules:
+    def _cpu_percent_total(self, replicas: list[dict]) -> float:
+        """Summed per-process CPU%, from /proc tick deltas between
+        polls (100 = one fully-busy core). First sight of a pid
+        contributes 0 — a delta needs two samples."""
+        import os
+
+        clk_tck = os.sysconf("SC_CLK_TCK")
+        now = time.monotonic()
+        total = 0.0
+        live: set[int] = set()
+        for info in replicas:
+            pid = info.get("pid")
+            if not pid:
+                continue
+            ticks = _read_proc_cpu_ticks(pid)
+            if ticks is None:
+                continue
+            live.add(pid)
+            prev = self._cpu_prev.get(pid)
+            self._cpu_prev[pid] = (now, ticks)
+            if prev is None:
+                continue
+            dt = now - prev[0]
+            if dt <= 0:
+                continue
+            total += 100.0 * (ticks - prev[1]) / clk_tck / dt
+        # drop exited pids so a recycled pid can't inherit stale ticks
+        for pid in list(self._cpu_prev):
+            if pid not in live:
+                del self._cpu_prev[pid]
+        return total
+
+    def _rule_desired(self, rule: ScaleRule) -> int:
+        meta = rule.metadata
+        if rule.type in ("pubsub-backlog", "queue-backlog"):
             backlog = read_backlog(rule, app_id=self.app.app_id,
                                    components=self.components,
                                    base_dir=self.base_dir)
-            per = max(int(rule.metadata.get("messageCount", 10)), 1)
-            desired = max(desired, math.ceil(backlog / per))
+            per = max(int(meta.get("messageCount", 10)), 1)
+            return math.ceil(backlog / per)
+        if rule.type == "http-concurrency":
+            per = max(int(meta.get("concurrentRequests", 10)), 1)
+            return math.ceil(_read_inflight(self.replica_info()) / per)
+        if rule.type == "cpu":
+            threshold = max(float(meta.get("utilization", 70)), 1.0)
+            return math.ceil(
+                self._cpu_percent_total(self.replica_info()) / threshold)
+        if rule.type == "memory":
+            per_mb = max(float(meta.get("megabytes", 512)), 1.0)
+            total_mb = sum(
+                _read_proc_rss_mb(info["pid"])
+                for info in self.replica_info() if info.get("pid"))
+            return math.ceil(total_mb / per_mb)
+        raise ComponentError(f"unknown scale rule type {rule.type!r} "
+                             f"(known: {RULE_TYPES})")
+
+    def desired_replicas(self) -> int:
+        """Max over all rules' desired counts, clamped to bounds —
+        the KEDA multi-trigger formula."""
+        scale = self.app.scale
+        if not scale.rules:
+            return scale.min_replicas
+        desired = max(self._rule_desired(rule) for rule in scale.rules)
         return max(scale.min_replicas, min(scale.max_replicas, desired))
 
     async def step(self) -> int:

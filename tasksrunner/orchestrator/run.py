@@ -268,6 +268,7 @@ class Orchestrator:
                     app, self._components,
                     lambda n, a=app: self._set_replicas(a, n),
                     base_dir=self.config.base_dir,
+                    replica_info=lambda a=app: self._replica_info(a.app_id),
                 )
                 scaler.start()
                 self._scalers.append(scaler)
@@ -319,6 +320,19 @@ class Orchestrator:
 
     def replica_count(self, app_id: str) -> int:
         return len(self.replicas.get(app_id, []))
+
+    def _replica_info(self, app_id: str) -> list[dict]:
+        """Live {pid, app_port, host} per replica — the measurement
+        inventory for the http/cpu/memory scale rules."""
+        out = []
+        for r in self.replicas.get(app_id, []):
+            running = r.proc is not None and r.proc.returncode is None
+            out.append({
+                "pid": r.proc.pid if running else None,
+                "app_port": r.ports[0] if r.ports else None,
+                "host": r.app.host,
+            })
+        return out
 
     # -- admin operations (≙ the `az containerapp` verbs the workshop
     # -- uses: update / revision restart / revision list / logs show) --

@@ -9,6 +9,7 @@ import asyncio
 import pathlib
 import sys
 import textwrap
+import time
 
 import pytest
 
@@ -478,3 +479,117 @@ async def test_no_message_loss_across_replica_crash(tmp_path):
         del os.environ["PYTHONPATH"]
         await orch.stop()
         await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_http_concurrency_rule_scales_out_and_back(tmp_path, monkeypatch):
+    """The ACA HTTP scale rule analog end-to-end
+    (docs/aca/09-aca-autoscale-keda/index.md:27-35): flood a slow app
+    with concurrent requests, watch replicas scale out to max, stop
+    the flood, watch them scale back within bounds."""
+    import aiohttp
+
+    from tasksrunner.orchestrator.config import RunConfig, ScaleSpec, ScaleRule
+    from tasksrunner.orchestrator.run import Orchestrator
+
+    pkg = tmp_path / "slowpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "slow.py").write_text(textwrap.dedent("""
+        import asyncio
+        from tasksrunner import App
+
+        def make_app():
+            app = App("slowapp")
+
+            @app.post("/work")
+            async def work(req):
+                await asyncio.sleep(0.25)
+                return 200, {"ok": True}
+
+            return app
+    """))
+    config = RunConfig(
+        apps=[AppSpec(
+            app_id="slowapp", module="slowpkg.slow:make_app",
+            scale=ScaleSpec(
+                min_replicas=1, max_replicas=3, cooldown_seconds=0.5,
+                rules=[ScaleRule(type="http-concurrency",
+                                 metadata={"concurrentRequests": "2"})]),
+        )],
+        registry_file=str(tmp_path / "apps.json"),
+        base_dir=tmp_path,
+    )
+    import os
+    monkeypatch.setenv("PYTHONPATH", f"{tmp_path}{os.pathsep}{REPO}")
+    orch = Orchestrator(config)
+    try:
+        await orch.start()
+        replica = orch.replicas["slowapp"][0]
+        await asyncio.wait_for(replica.ready.wait(), timeout=30)
+        app_port = replica.ports[0]
+
+        stop_flood = asyncio.Event()
+
+        async def flood_worker(session):
+            while not stop_flood.is_set():
+                try:
+                    async with session.post(
+                        f"http://127.0.0.1:{app_port}/work") as resp:
+                        await resp.read()
+                except (OSError, aiohttp.ClientError):
+                    await asyncio.sleep(0.05)
+
+        async with aiohttp.ClientSession() as session:
+            flood = [asyncio.create_task(flood_worker(session))
+                     for _ in range(12)]
+            try:
+                deadline = asyncio.get_running_loop().time() + 30
+                while orch.replica_count("slowapp") < 3:
+                    assert asyncio.get_running_loop().time() < deadline, (
+                        "never scaled out to max under sustained "
+                        f"concurrency (at {orch.replica_count('slowapp')})")
+                    await asyncio.sleep(0.1)
+            finally:
+                stop_flood.set()
+                for t in flood:
+                    t.cancel()
+                await asyncio.gather(*flood, return_exceptions=True)
+
+        # flood over: after the cooldown the app returns to min
+        deadline = asyncio.get_running_loop().time() + 30
+        while orch.replica_count("slowapp") > 1:
+            assert asyncio.get_running_loop().time() < deadline, \
+                "never scaled back in after the flood stopped"
+            await asyncio.sleep(0.1)
+    finally:
+        await orch.stop()
+
+
+@pytest.mark.asyncio
+async def test_cpu_and_memory_rules_measure_real_processes(tmp_path):
+    """The cpu/memory rules read real /proc numbers: memory of THIS
+    process trips a tiny threshold; cpu's first sample reports 0 (a
+    delta needs two polls) and never goes negative."""
+    import os
+
+    from tasksrunner.orchestrator.config import ScaleSpec, ScaleRule
+
+    me = [{"pid": os.getpid(), "app_port": None, "host": "127.0.0.1"}]
+    app = AppSpec(
+        app_id="w", module="x:y",
+        scale=ScaleSpec(min_replicas=1, max_replicas=9, rules=[
+            ScaleRule(type="memory", metadata={"megabytes": "1"}),
+        ]))
+    scaler = AutoscaleController(app, [], lambda n: None,
+                                 base_dir=tmp_path, replica_info=lambda: me)
+    # this test process holds far more than 2 MB RSS
+    assert scaler.desired_replicas() >= 2
+
+    app.scale.rules = [ScaleRule(type="cpu", metadata={"utilization": "50"})]
+    assert scaler._rule_desired(app.scale.rules[0]) == 0  # first sample
+    # burn some CPU so the second delta is visibly >= 0
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 0.05:
+        sum(i * i for i in range(1000))
+    assert scaler._rule_desired(app.scale.rules[0]) >= 0
