@@ -31,6 +31,65 @@ BACKEND_APP_ID = "tasksmanager-backend-api"
 COOKIE_NAME = "TasksCreatedByCookie"  # Pages/Index.cshtml.cs:27
 
 
+#: (field, display name, input type) ≙ the [Required]/[Display]
+#: annotations on TaskAddModel (Pages/Tasks/Models/TasksModel.cs:6-49)
+FORM_FIELDS = (
+    ("taskName", "Task Name", "text"),
+    ("taskDueDate", "Task Due Date", "date"),
+    ("taskAssignedTo", "Task Assigned To", "email"),
+)
+
+
+def _validate_task_form(form: dict[str, str]) -> dict[str, str]:
+    """Server-side DataAnnotations analog: per-field error messages in
+    the reference's wording (client `required` attrs are kept too, but
+    the server must not trust them). NORMALIZES in place — the values
+    validated here are exactly the values later sent to the backend,
+    so nothing can pass validation and still fail server-side."""
+    import datetime as dt
+
+    errors: dict[str, str] = {}
+    for name, display, kind in FORM_FIELDS:
+        value = (form.get(name) or "").strip()
+        form[name] = value
+        if not value:
+            errors[name] = f"The {display} field is required."
+        elif kind == "email" and ("@" not in value or " " in value):
+            errors[name] = f"The {display} field is not a valid e-mail address."
+        elif kind == "date":
+            try:
+                dt.date.fromisoformat(value)
+            except ValueError:
+                errors[name] = f"The {display} field must be a valid date."
+    return errors
+
+
+def _task_form_page(title: str, action: str, submit: str,
+                    values: dict[str, str],
+                    errors: dict[str, str]) -> Response:
+    """Render the create/edit form with preserved values and per-field
+    validation messages (≙ Razor's asp-validation-for spans)."""
+    rows = []
+    for name, display, kind in FORM_FIELDS:
+        value = html.escape((values.get(name) or "")[:10]
+                            if kind == "date" else values.get(name) or "")
+        err = (f'<span class="field-error">{html.escape(errors[name])}</span>'
+               if name in errors else "")
+        rows.append(
+            f'<p><label>{html.escape(display)} '
+            f'<input type="{kind}" name="{name}" value="{value}" required>'
+            f'</label>{err}</p>')
+    body = (f'<h2>{html.escape(title)}</h2>'
+            f'<form method="post" action="{html.escape(action)}">'
+            + "".join(rows)
+            + f'<button type="submit">{html.escape(submit)}</button> '
+              f'<a href="/tasks">Cancel</a></form>')
+    page = _page(title, body)
+    if errors:
+        page.status = 400  # invalid ModelState re-renders, not redirects
+    return page
+
+
 def _cookie_user(req) -> str | None:
     jar = SimpleCookie(req.headers.get("cookie", ""))
     morsel = jar.get(COOKIE_NAME)
@@ -171,14 +230,8 @@ def make_app() -> App:
     async def create_form(req):
         if not _cookie_user(req):
             return _redirect("/")
-        return _page("Create task", """
-<h2>New task</h2>
-<form method="post" action="/tasks/create">
-  <p><label>Name <input name="taskName" required></label></p>
-  <p><label>Due date <input type="date" name="taskDueDate" required></label></p>
-  <p><label>Assigned to <input type="email" name="taskAssignedTo" required></label></p>
-  <button type="submit">Create</button> <a href="/tasks">Cancel</a>
-</form>""")
+        return _task_form_page("Create task", "/tasks/create", "Create",
+                               values={}, errors={})
 
     @app.post("/tasks/create")
     async def create_post(req):
@@ -186,6 +239,12 @@ def make_app() -> App:
         if not user:
             return _redirect("/")
         form = _form(req)
+        errors = _validate_task_form(form)
+        if errors:
+            # invalid ModelState: re-render with per-field messages and
+            # the user's input preserved (≙ Page() on !ModelState.IsValid)
+            return _task_form_page("Create task", "/tasks/create", "Create",
+                                   values=form, errors=errors)
         resp = await app.client.invoke_method(
             BACKEND_APP_ID, "api/tasks", http_method="POST",
             data={
@@ -209,24 +268,21 @@ def make_app() -> App:
         if resp.status == 404:
             return Response(status=404, body="task not found")
         t = resp.raise_for_status().json()
-        due = html.escape((t.get("taskDueDate") or "")[:10])
-        return _page("Edit task", f"""
-<h2>Edit task</h2>
-<form method="post" action="/tasks/edit/{html.escape(tid)}">
-  <p><label>Name <input name="taskName" value="{html.escape(t.get('taskName', ''))}" required></label></p>
-  <p><label>Due date <input type="date" name="taskDueDate" value="{due}" required></label></p>
-  <p><label>Assigned to <input type="email" name="taskAssignedTo"
-       value="{html.escape(t.get('taskAssignedTo', ''))}" required></label></p>
-  <button type="submit">Save</button> <a href="/tasks">Cancel</a>
-</form>""")
+        return _task_form_page("Edit task", f"/tasks/edit/{tid}", "Save",
+                               values=t, errors={})
 
     @app.post("/tasks/edit/{task_id}")
     async def edit_post(req):
         if not _cookie_user(req):
             return _redirect("/")
+        tid = req.path_params["task_id"]
         form = _form(req)
+        errors = _validate_task_form(form)
+        if errors:
+            return _task_form_page("Edit task", f"/tasks/edit/{tid}", "Save",
+                                   values=form, errors=errors)
         resp = await app.client.invoke_method(
-            BACKEND_APP_ID, f"api/tasks/{req.path_params['task_id']}",
+            BACKEND_APP_ID, f"api/tasks/{tid}",
             http_method="PUT",
             data={
                 "taskName": form.get("taskName", ""),

@@ -175,6 +175,12 @@ def apply_manifest(manifest: EnvironmentManifest) -> dict:
     run_config = {
         "resources_path": str(resources_dir),
         "registry_file": str(registry),
+        # replicas run with cwd = base_dir, so relative component paths
+        # (.tasksrunner/statestore.db, the default broker/trace dbs)
+        # resolve against the MANIFEST's directory. Without this the
+        # orchestrator would anchor at the emitted config's parent —
+        # .tasksrunner/ itself — and nest a second .tasksrunner/ inside
+        "base_dir": str(manifest.base_dir),
         "apps": apps_block,
     }
     if manifest.require_api_token:

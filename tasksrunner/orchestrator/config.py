@@ -190,8 +190,15 @@ def load_run_config(path: str | pathlib.Path) -> RunConfig:
     if not apps:
         raise ComponentError(f"run config {path} declares no apps")
 
-    resources = doc.get("resources_path")
+    # an explicit base_dir (deploy-apply-emitted configs) anchors all
+    # relative paths at the manifest's directory; hand-written configs
+    # default to their own directory. A RELATIVE base_dir resolves
+    # against the config file, never the launch cwd.
     base = path.resolve().parent
+    if doc.get("base_dir"):
+        declared = pathlib.Path(doc["base_dir"])
+        base = declared if declared.is_absolute() else (base / declared).resolve()
+    resources = doc.get("resources_path")
     if resources is not None and not pathlib.Path(resources).is_absolute():
         resources = str(base / resources)
     return RunConfig(

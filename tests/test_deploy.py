@@ -236,3 +236,24 @@ def test_health_block_validation(tmp_path):
     manifest = load_manifest(_write_manifest(tmp_path, doc))
     problems = validate_manifest(manifest, check_imports=False)
     assert any("health" in p for p in problems)
+
+
+def test_emitted_run_config_anchors_base_dir_at_manifest(tmp_path, monkeypatch):
+    """Regression: the emitted run config lives in <manifest-dir>/
+    .tasksrunner/, and load_run_config's default base_dir (the config's
+    own parent) would make every relative component path —
+    .tasksrunner/statestore.db etc. — resolve to a NESTED
+    .tasksrunner/.tasksrunner/. The apply-emitted config must pin
+    base_dir to the manifest's directory instead."""
+    monkeypatch.chdir(tmp_path)
+    manifest_path = _write_manifest(tmp_path, BASE_DOC)
+    result = apply_manifest(load_manifest(manifest_path))
+
+    emitted = pathlib.Path(result["run_config"])
+    assert emitted.parent == tmp_path / ".tasksrunner"
+
+    from tasksrunner.orchestrator.config import load_run_config
+    parsed = load_run_config(emitted)
+    assert parsed.base_dir == tmp_path, (
+        f"base_dir {parsed.base_dir} would nest runtime state under "
+        f"{parsed.base_dir / '.tasksrunner'}")

@@ -334,3 +334,44 @@ async def test_concurrent_update_keeps_both_changes(isolated_cwd):
             "reference's last-write-wins race reproduced")
     finally:
         await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_frontend_per_field_validation(isolated_cwd):
+    """≙ the [Required]/[Display] DataAnnotations on TaskAddModel
+    (Pages/Tasks/Models/TasksModel.cs:6-49): an invalid submit
+    re-renders the form with PER-FIELD messages in the reference's
+    wording and the user's input preserved — not a redirect, not one
+    generic error."""
+    cluster, api, frontend, processor = build_cluster()
+    await cluster.start()
+    try:
+        cookie = {"cookie": "TasksCreatedByCookie=val@x.com"}
+
+        # missing name + bad email, valid date: two field errors
+        resp2 = await cluster.apps[FRONTEND].handle(
+            "POST", "/tasks/create",
+            headers={**cookie,
+                     "content-type": "application/x-www-form-urlencoded"},
+            body=b"taskName=&taskDueDate=2026-08-02&taskAssignedTo=not-an-email")
+        status, _, body = resp2.encode()
+        page = body.decode()
+        assert status == 400
+        assert "The Task Name field is required." in page
+        assert "not a valid e-mail address" in page
+        # valid field's value is preserved in the re-rendered form
+        assert 'value="2026-08-02"' in page
+        assert "not-an-email" in page
+
+        # a fully valid submit goes through and redirects
+        ok = await cluster.apps[FRONTEND].handle(
+            "POST", "/tasks/create",
+            headers={**cookie,
+                     "content-type": "application/x-www-form-urlencoded"},
+            body=b"taskName=Valid&taskDueDate=2026-08-02&taskAssignedTo=a%40x.com")
+        assert ok.status == 303
+        tasks = await cluster.client(API).invoke_json(
+            API, "api/tasks", query="createdBy=val@x.com")
+        assert [t["taskName"] for t in tasks] == ["Valid"]
+    finally:
+        await cluster.stop()
