@@ -174,8 +174,17 @@ class App:
                 self._routes.append(route)
                 if not any(s.startswith("{") for s in segments) \
                         and route.method != "*":
-                    self._exact_routes.setdefault(
-                        (route.method, "/" + "/".join(segments)), route)
+                    exact_path = "/" + "/".join(segments)
+                    # first-registered-wins, exactly like the scan
+                    # loop: if an EARLIER parameterised/wildcard route
+                    # already matches this literal path, the O(1) table
+                    # must not let the newer literal route shadow it
+                    shadowed = any(
+                        earlier.match(route.method, exact_path) is not None
+                        for earlier in self._routes[:-1])
+                    if not shadowed:
+                        self._exact_routes.setdefault(
+                            (route.method, exact_path), route)
             return handler
 
         return register
@@ -206,16 +215,22 @@ class App:
         mount_key = prefix if prefix == "/" else prefix + "/"
 
         async def read_file(rel: str) -> Response | None:
-            target = (root / rel).resolve()
-            # resolve() collapses any ../ — anything that escapes the
-            # root is a traversal attempt, treated as a plain miss
-            if not target.is_relative_to(root) or not target.is_file():
+            try:
+                target = (root / rel).resolve()
+                # resolve() collapses any ../ — anything that escapes
+                # the root is a traversal attempt, treated as a miss
+                if not target.is_relative_to(root) or not target.is_file():
+                    return None
+                ctype = (mimetypes.guess_type(target.name)[0]
+                         or "application/octet-stream")
+                # disk I/O off the event loop: a multi-MB asset must
+                # not stall concurrent requests/probes on this app
+                data = await asyncio.to_thread(target.read_bytes)
+            except (OSError, ValueError):
+                # TOCTOU (file deleted / permissions changed between
+                # check and read) or NUL bytes in a decoded path: a
+                # plain miss, not an unhandled 500
                 return None
-            ctype = (mimetypes.guess_type(target.name)[0]
-                     or "application/octet-stream")
-            # disk I/O off the event loop: a multi-MB asset must not
-            # stall concurrent requests/probes on this app
-            data = await asyncio.to_thread(target.read_bytes)
             return Response(status=200, body=data,
                             headers={"content-type": ctype})
 

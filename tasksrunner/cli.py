@@ -640,6 +640,9 @@ def _admin_request(registry_file: str, method: str, path: str,
     # generous timeout: a rolling restart legitimately takes up to
     # ~40s per replica before the orchestrator responds
     timeout = 300
+    timed_out = SystemExit(
+        f"orchestrator did not answer within {timeout}s — the operation "
+        "may still be running; check `tasksrunner ps` / `revisions`")
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             return json_mod.loads(resp.read() or b"{}")
@@ -651,10 +654,13 @@ def _admin_request(registry_file: str, method: str, path: str,
             pass
         raise SystemExit(f"orchestrator returned {exc.code}: {detail}")
     except TimeoutError:
-        raise SystemExit(
-            f"orchestrator did not answer within {timeout}s — the operation "
-            "may still be running; check `tasksrunner ps` / `revisions`")
+        raise timed_out
     except OSError as exc:
+        # a connect-phase timeout arrives as URLError(socket.timeout),
+        # an OSError — that's still "slow", not "unreachable", and the
+        # stale-file hint would mislead during a long rolling restart
+        if isinstance(getattr(exc, "reason", exc), TimeoutError):
+            raise timed_out
         raise SystemExit(f"cannot reach orchestrator at {url}: {exc} "
                          "(stale orchestrator.json after a crash?)")
 

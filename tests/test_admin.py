@@ -59,7 +59,7 @@ async def _app_get(port, path):
 
 
 @pytest.mark.asyncio
-async def test_admin_api_full_lifecycle(tmp_path):
+async def test_admin_api_full_lifecycle(tmp_path, monkeypatch):
     from tasksrunner.orchestrator.admin import info_path
     from tasksrunner.orchestrator.run import Orchestrator
 
@@ -71,7 +71,9 @@ async def test_admin_api_full_lifecycle(tmp_path):
         registry_file=str(tmp_path / "apps.json"),
         base_dir=tmp_path,
     )
-    os.environ["PYTHONPATH"] = f"{tmp_path}{os.pathsep}{REPO}"
+    # monkeypatch restores this after the test (a bare os.environ set
+    # leaked into every later test in the session)
+    monkeypatch.setenv("PYTHONPATH", f"{tmp_path}{os.pathsep}{REPO}")
     orch = Orchestrator(config)
     await orch.start()
     try:

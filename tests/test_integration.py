@@ -256,3 +256,45 @@ async def test_trace_propagates_across_invoke_and_pubsub(tmp_path):
         assert trace_id in processor_traces[0]
     finally:
         await cluster.stop()
+
+
+async def test_route_precedence_is_first_registered_wins():
+    """Dispatch order is strictly first-registered-wins: a literal
+    route registered AFTER a parameterised or wildcard route that also
+    matches must not shadow it via the O(1) exact-route table
+    (regression for the fast-path dispatch optimisation)."""
+    from tasksrunner.app import App
+
+    app = App("prec")
+    hits = []
+
+    @app.route("/items/{item_id}", methods="GET")
+    async def param_first(req):
+        hits.append(("param", req.path_params.get("item_id")))
+        return 200, {"via": "param"}
+
+    @app.get("/items/special")
+    async def literal_later(req):
+        hits.append(("literal", None))
+        return 200, {"via": "literal"}
+
+    resp = await app.handle("GET", "/items/special")
+    assert resp.encode()[0] == 200
+    assert hits == [("param", "special")]
+
+    # the reverse order: literal first, param later — literal wins and
+    # still uses the O(1) table
+    app2 = App("prec2")
+
+    @app2.get("/items/special")
+    async def literal_first(req):
+        return 200, {"via": "literal"}
+
+    @app2.route("/items/{item_id}", methods="GET")
+    async def param_later(req):
+        return 200, {"via": "param"}
+
+    resp2 = await app2.handle("GET", "/items/special")
+    import json as _json
+    assert _json.loads(resp2.encode()[2])["via"] == "literal"
+    assert ("GET", "/items/special") in app2._exact_routes
