@@ -1,0 +1,168 @@
+#!/usr/bin/env python3
+"""Measure the deployable footprint of each Tasks Tracker service image.
+
+≙ reference module 12's before/after table
+(docs/aca/12-optimize-containers/index.md:318-326: default 226 MB →
+chiseled 119 MB per service). This environment has no container
+daemon, so instead of `docker image ls` this measures — exactly and
+reproducibly — every byte the Dockerfiles COPY into the final layer,
+from the same sources the build would use:
+
+* framework + sample code (the `COPY tasksrunner/ samples/` layers,
+  byte-compiled for the optimized variant, as its `compileall` step
+  does);
+* third-party dependencies (aiohttp + pyyaml + their transitive
+  closure, measured from an actual installation);
+* build tooling (pip/setuptools/wheel) — present in the default
+  variant's site-packages copy, ABSENT from the optimized variant's
+  `--prefix=/install` copy;
+* the Python runtime (interpreter + stdlib) measured from the local
+  installation — the part of the base image a Python app actually
+  needs.
+
+Base OS layers (Debian bookworm full vs slim) cannot be measured
+without pulling images; the table reports the payload this repo
+controls and notes the base-image choice separately.
+
+Run: python scripts/measure_footprint.py  [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import compileall
+import importlib.metadata
+import json
+import pathlib
+import shutil
+import sys
+import sysconfig
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+#: the dependency closure of `pip install tasksrunner aiohttp pyyaml`
+RUNTIME_DEPS = ("aiohttp", "pyyaml", "aiosignal", "attrs", "frozenlist",
+                "multidict", "yarl", "propcache", "aiohappyeyeballs", "idna")
+#: in the default variant the whole site-packages is copied, which
+#: drags the installer stack along; the optimized variant's
+#: --prefix=/install copy has none of it
+BUILD_TOOLING = ("pip", "setuptools", "wheel")
+
+
+def du(path: pathlib.Path, *, exclude_pycache: bool = False) -> int:
+    if path.is_file():
+        return path.stat().st_size
+    total = 0
+    for p in path.rglob("*"):
+        if exclude_pycache and "__pycache__" in p.parts:
+            continue
+        if p.is_file() and not p.is_symlink():
+            total += p.stat().st_size
+    return total
+
+
+def dist_size(name: str) -> int:
+    """Installed size of one distribution, from its file manifest."""
+    try:
+        dist = importlib.metadata.distribution(name)
+    except importlib.metadata.PackageNotFoundError:
+        return 0
+    total = 0
+    for f in dist.files or []:
+        try:
+            p = pathlib.Path(dist.locate_file(f))
+            if p.is_file():
+                total += p.stat().st_size
+        except OSError:
+            continue
+    return total
+
+
+def compiled_size(tree: pathlib.Path) -> int:
+    """Size of ``tree`` after the optimized variant's `compileall`
+    (sources + .pyc), measured on a scratch copy."""
+    with tempfile.TemporaryDirectory() as tmp:
+        dst = pathlib.Path(tmp) / tree.name
+        shutil.copytree(tree, dst, ignore=shutil.ignore_patterns(
+            "__pycache__", ".tasksrunner", "*.db", "*.db-wal", "*.db-shm"))
+        compileall.compile_dir(str(dst), quiet=2)
+        return du(dst)
+
+
+def measure() -> dict:
+    mb = 1024.0 * 1024.0
+    stdlib = pathlib.Path(sysconfig.get_paths()["stdlib"])
+    interpreter = pathlib.Path(sys.executable).resolve()
+
+    framework_src = du(REPO / "tasksrunner", exclude_pycache=True)
+    samples_src = du(REPO / "samples", exclude_pycache=True)
+    framework_opt = compiled_size(REPO / "tasksrunner")
+    samples_opt = compiled_size(REPO / "samples")
+
+    deps = {name: dist_size(name) for name in RUNTIME_DEPS}
+    tooling = {name: dist_size(name) for name in BUILD_TOOLING}
+    runtime = du(stdlib, exclude_pycache=True) + interpreter.stat().st_size
+
+    default_payload = (framework_src + samples_src + sum(deps.values())
+                       + sum(tooling.values()))
+    optimized_payload = framework_opt + samples_opt + sum(deps.values())
+
+    return {
+        "method": "installed-footprint (no container daemon); bytes the "
+                  "Dockerfiles COPY, from live installations",
+        "python": sys.version.split()[0],
+        "mb": {
+            "framework_source": round(framework_src / mb, 2),
+            "samples_source": round(samples_src / mb, 2),
+            "framework_bytecompiled": round(framework_opt / mb, 2),
+            "samples_bytecompiled": round(samples_opt / mb, 2),
+            "runtime_deps": round(sum(deps.values()) / mb, 2),
+            "build_tooling": round(sum(tooling.values()) / mb, 2),
+            "python_runtime": round(runtime / mb, 2),
+            "default_payload": round(default_payload / mb, 2),
+            "optimized_payload": round(optimized_payload / mb, 2),
+            "default_total_with_runtime": round(
+                (default_payload + runtime) / mb, 2),
+            "optimized_total_with_runtime": round(
+                (optimized_payload + runtime) / mb, 2),
+        },
+        "deps_detail_mb": {k: round(v / mb, 2) for k, v in deps.items()},
+        "tooling_detail_mb": {k: round(v / mb, 2) for k, v in tooling.items()},
+        "payload_saving_pct": round(
+            100.0 * (1 - optimized_payload / default_payload), 1),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args()
+    result = measure()
+    if args.json:
+        print(json.dumps(result, indent=2))
+        return
+    m = result["mb"]
+    print(f"method: {result['method']}")
+    print(f"python: {result['python']}\n")
+    rows = [
+        ("framework (tasksrunner/, source)", m["framework_source"]),
+        ("samples (3 services, source)", m["samples_source"]),
+        ("runtime deps (aiohttp+pyyaml closure)", m["runtime_deps"]),
+        ("build tooling (pip/setuptools/wheel)", m["build_tooling"]),
+        ("python runtime (interpreter+stdlib)", m["python_runtime"]),
+        ("", None),
+        ("DEFAULT payload (site-packages copy)", m["default_payload"]),
+        ("OPTIMIZED payload (/install copy, byte-compiled)",
+         m["optimized_payload"]),
+        ("default + python runtime", m["default_total_with_runtime"]),
+        ("optimized + python runtime", m["optimized_total_with_runtime"]),
+    ]
+    for label, val in rows:
+        print(f"{label:<50} {'' if val is None else f'{val:>9.2f} MB'}")
+    print(f"\npayload saving, default -> optimized: "
+          f"{result['payload_saving_pct']}%")
+
+
+if __name__ == "__main__":
+    main()
