@@ -160,7 +160,10 @@ class _HTTPTransport(_Transport):
             async with self._session.request(
                 method, url, json=json_body, data=data,
                 headers=headers, params=params) as resp:
-                return resp.status, dict(resp.headers), await resp.read()
+                # lowercase header names: aiohttp preserves wire casing
+                # ("Etag"), and lookups below are lowercase
+                response_headers = {k.lower(): v for k, v in resp.headers.items()}
+                return resp.status, response_headers, await resp.read()
         except OSError as exc:
             raise InvocationError(f"sidecar unreachable at {url}: {exc}") from exc
 

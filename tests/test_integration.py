@@ -298,3 +298,42 @@ async def test_route_precedence_is_first_registered_wins():
     import json as _json
     assert _json.loads(resp2.encode()[2])["via"] == "literal"
     assert ("GET", "/items/special") in app2._exact_routes
+
+
+@pytest.mark.asyncio
+async def test_etag_cas_over_http_sidecar(tmp_path):
+    """Regression: the HTTP transport must round-trip etags.
+
+    aiohttp reports response headers with wire casing ("Etag"); the
+    transport once looked up "etag" against a case-preserving dict, so
+    every StateItem read over a real sidecar carried etag="" and every
+    etag-guarded save (the sample's CAS loop, the markoverdue path)
+    failed deterministically with EtagMismatch — while the in-proc
+    direct transport worked, hiding the bug from in-proc tests.
+    """
+    from tasksrunner.client import AppClient
+    from tasksrunner.errors import EtagMismatch
+
+    specs = specs_for(tmp_path)
+    host = AppHost(make_api_app(), specs=specs,
+                   registry_file=str(tmp_path / "apps.json"))
+    await host.start()
+    try:
+        client = AppClient.http(port=host.sidecar_port)
+        await client.save_state("statestore", "cas-key", {"n": 0})
+
+        item = await client.get_state_item("statestore", "cas-key")
+        assert item is not None
+        assert item.etag, "HTTP transport dropped the etag header"
+
+        # fresh etag → CAS succeeds
+        await client.save_state("statestore", "cas-key", {"n": 1},
+                                etag=item.etag)
+        # stale etag → CAS refused
+        with pytest.raises(EtagMismatch):
+            await client.save_state("statestore", "cas-key", {"n": 2},
+                                    etag=item.etag)
+        assert await client.get_state("statestore", "cas-key") == {"n": 1}
+        await client.close()
+    finally:
+        await host.stop()
