@@ -19,7 +19,7 @@ docs-serve:
 	mkdocs serve
 
 docs-build:
-	mkdocs build
+	mkdocs build --strict
 
 clean:
 	rm -rf .tasksrunner samples/tasks_tracker/.tasksrunner

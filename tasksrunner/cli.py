@@ -583,6 +583,10 @@ def _cmd_metrics(args) -> None:
     import urllib.error
     import urllib.request
 
+    args.app_id = args.app_id or args.app_id_pos
+    if not args.app_id:
+        raise SystemExit("metrics: an app id is required "
+                         "(tasksrunner metrics <app-id>)")
     addr, headers = _resolve_sidecar(args)
     req = urllib.request.Request(f"{addr.base_url}/v1.0/metadata",
                                  headers=headers)
@@ -977,7 +981,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("metrics",
                        help="an app's request/publish/delivery counters "
                             "(App Insights metrics view analog)")
-    p.add_argument("--app-id", required=True)
+    # positional like logs/stop/restart; --app-id kept for compatibility
+    p.add_argument("app_id_pos", nargs="?", default=None, metavar="app_id")
+    p.add_argument("--app-id", dest="app_id", default=None)
     p.add_argument("--json", action="store_true")
     p.add_argument("--registry-file", **registry_arg)
     p.set_defaults(fn=_cmd_metrics)

@@ -1,0 +1,271 @@
+"""Docs-as-tests: replay the workshop's own command blocks.
+
+The reference workshop's only QA is manual verification checkpoints in
+its module docs (SURVEY.md §4.1). This suite exceeds that the way §4
+prescribes: the checkpoints are *executable*. Each test extracts the
+```bash blocks from a module page (docs/modules/*.md), replays them in
+document order against a scratch directory, and asserts the outputs
+the page itself promises. A module whose commands or expected outputs
+rot fails here instead of in front of a reader.
+
+Covered end-to-end: module 1 (host + both front doors + CRUD + the
+decoupled two-process layout), module 4 (store swap, durability across
+restart, queries, etag 409, transactions, raw probes), module 5
+(orchestrator, invoke → broker → processor delivery, metrics, raw
+publish).
+
+Mechanics: commands run with the scratch dir as cwd (so `.tasksrunner/`
+state lands there) with `samples/` and `run.yaml` reachable, exactly as
+a reader at the repo root. Long-running server blocks are backgrounded;
+placeholders the docs tell the reader to fill (`<the id you got back>`)
+are filled the same way the reader would.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import socket
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+DOCS = REPO / "docs" / "modules"
+
+API = "tasksmanager-backend-api"
+
+
+def bash_blocks(doc_name: str) -> list[str]:
+    text = (DOCS / doc_name).read_text()
+    return re.findall(r"```bash\n(.*?)```", text, re.S)
+
+
+def block_with(blocks: list[str], needle: str) -> str:
+    """The first ```bash block containing `needle` — failing loudly when
+    the doc no longer contains the command the walkthrough promises."""
+    for b in blocks:
+        if needle in b:
+            return b
+    raise AssertionError(
+        f"no bash block containing {needle!r} — the doc changed; "
+        f"update this walkthrough test with it")
+
+
+class Scratch:
+    """A reader's terminal: scratch cwd wired like the repo root."""
+
+    def __init__(self, tmp: Path):
+        self.dir = tmp
+        (tmp / "samples").symlink_to(REPO / "samples")
+        (tmp / "run.yaml").write_text((REPO / "run.yaml").read_text())
+        self.env = {**os.environ, "PYTHONPATH": str(REPO)}
+        self.env.pop("TASKSRUNNER_API_TOKEN", None)
+        self.procs: list[subprocess.Popen] = []
+
+    def run(self, script: str, timeout: float = 60, check: bool = True) -> str:
+        p = subprocess.run(
+            ["bash", "-c", script], cwd=self.dir, env=self.env,
+            capture_output=True, text=True, timeout=timeout)
+        if check:
+            assert p.returncode == 0, (
+                f"block failed rc={p.returncode}\n--- script\n{script}\n"
+                f"--- stdout\n{p.stdout}\n--- stderr\n{p.stderr}")
+        return p.stdout + p.stderr
+
+    def spawn(self, script: str) -> subprocess.Popen:
+        p = subprocess.Popen(
+            ["bash", "-c", script], cwd=self.dir, env=self.env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            start_new_session=True)
+        self.procs.append(p)
+        return p
+
+    def wait_port(self, port: int, timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            proc_dead = self.procs and self.procs[-1].poll() is not None
+            try:
+                with socket.create_connection(("127.0.0.1", port), 0.25):
+                    return
+            except OSError:
+                if proc_dead:
+                    out = self.procs[-1].stdout.read()
+                    raise AssertionError(
+                        f"server exited before opening :{port}\n{out}")
+                time.sleep(0.1)
+        raise AssertionError(f"port {port} never opened")
+
+    def stop_proc(self, p: subprocess.Popen, sig=signal.SIGTERM) -> None:
+        if p.poll() is None:
+            try:
+                os.killpg(os.getpgid(p.pid), sig)
+            except ProcessLookupError:
+                pass
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            p.wait(timeout=5)
+
+    def close(self) -> None:
+        for p in self.procs:
+            self.stop_proc(p, signal.SIGKILL)
+
+
+@pytest.fixture
+def scratch(tmp_path):
+    s = Scratch(tmp_path)
+    yield s
+    s.close()
+
+
+def test_module_01_run_a_service(scratch):
+    blocks = bash_blocks("01-run-a-service.md")
+
+    # §2.1 start the host exactly as the doc says; leave it "running in
+    # this terminal"
+    host_cmd = block_with(blocks, "tasksrunner host")
+    assert "TASKS_MANAGER=fake" in host_cmd
+    host = scratch.spawn(host_cmd)
+    scratch.wait_port(5103)
+    scratch.wait_port(3500)
+
+    # §2.2 direct front door: ten seeded tasks for tempuser@mail.com
+    direct = scratch.run(block_with(blocks, "http://127.0.0.1:5103/api/tasks?createdBy"))
+    seeded = json.loads(direct)
+    assert len(seeded) == 10
+    assert all(t["taskCreatedBy"] == "tempuser@mail.com" for t in seeded)
+
+    # §2.3 sidecar front door: same list through the invoke address
+    via_sidecar = scratch.run(block_with(blocks, "/v1.0/invoke/tasksmanager-backend-api/method/api/tasks?createdBy"))
+    assert {t["taskId"] for t in json.loads(via_sidecar)} == \
+        {t["taskId"] for t in seeded}
+
+    # §3 CRUD through the sidecar: create...
+    created = scratch.run(block_with(blocks, '"taskName":"My first task"'))
+    task_id = json.loads(created)["taskId"]
+    # ...then the TASK_ID=<the id you got back> block, filled as the
+    # reader fills it
+    crud = block_with(blocks, "$TASK_ID/markcomplete")
+    crud = crud.replace("TASK_ID=<the id you got back>", f"TASK_ID={task_id}")
+    out = scratch.run(crud)
+    assert '"isCompleted": true' in out
+    assert out.count("200") >= 2  # markcomplete and delete both answer 200
+
+    # §4 the fully decoupled two-process layout, then the §2.3 re-probe
+    scratch.stop_proc(host)
+    two_proc = block_with(blocks, "tasksrunner sidecar")
+    assert "tasksrunner serve" in two_proc  # app process backgrounded with &
+    scratch.spawn(two_proc)
+    scratch.wait_port(3500)
+    re_probe = scratch.run(block_with(blocks, "/v1.0/invoke/tasksmanager-backend-api/method/api/tasks?createdBy"))
+    assert len(json.loads(re_probe)) == 10  # fake reseeded: identical behavior
+
+
+def test_module_04_state(scratch):
+    blocks = bash_blocks("04-state.md")
+
+    host_cmd = block_with(blocks, "TASKS_MANAGER=store")
+    host = scratch.spawn(host_cmd)
+    scratch.wait_port(5103)
+    scratch.wait_port(3500)
+
+    # §2.2 create a durable task
+    created = scratch.run(block_with(blocks, '"taskName":"Durable now"'))
+    task_id = json.loads(created[created.index("{"):])["taskId"]
+
+    # "kill the host, start it again with the same command, and list"
+    scratch.stop_proc(host)
+    scratch.spawn(host_cmd)
+    scratch.wait_port(5103)
+    listed = scratch.run(block_with(blocks, "api/tasks?createdBy=me@mail.com"))
+    tasks = json.loads(listed)
+    assert [t["taskId"] for t in tasks] == [task_id], \
+        "task must survive the restart (and no fake seeds may appear)"
+
+    # §3 key prefixing: the raw probe, with the reader's task id
+    probe = block_with(blocks, "state get statestore").replace(
+        "<your-task-id>", task_id)
+    out = scratch.run(probe)
+    assert "Durable now" in out
+
+    # §4 the EQ query through the sidecar returns the task with an etag
+    q = scratch.run(block_with(blocks, '"filter": {"EQ": {"taskCreatedBy"'))
+    results = json.loads(q)["results"]
+    assert results and results[0]["data"]["taskName"] == "Durable now"
+    assert results[0]["etag"]
+
+    # §5 stale etag bounces: the doc's two-step probe block
+    etag_block = block_with(blocks, '"etag": "0"')
+    out = scratch.run(etag_block)
+    assert "etag mismatch" in out
+
+    # §6 transaction: both ops or neither
+    scratch.run(block_with(blocks, '"operation": "upsert"'))
+    # probe key was deleted by the transaction; t1 exists
+    get_t1 = scratch.run(
+        f"curl -s http://127.0.0.1:3500/v1.0/state/statestore/t1")
+    assert json.loads(get_t1) == {"a": 1}
+    get_probe = scratch.run(
+        "curl -s -o /dev/null -w '%{http_code}' "
+        "http://127.0.0.1:3500/v1.0/state/statestore/probe")
+    assert get_probe.strip() == "204"  # gone
+
+    # §7 the reference's own raw probes
+    raw = scratch.run(block_with(blocks, '"key": "rawkey"'))
+    assert "204" in raw and "written raw" in raw
+
+
+def test_module_05_pubsub(scratch):
+    blocks = bash_blocks("05-pubsub.md")
+
+    # §3 one command runs the whole topology
+    orch = scratch.spawn(block_with(blocks, "tasksrunner run run.yaml"))
+    for port in (5103, 5189, 5217, 3500, 3502):
+        scratch.wait_port(port)
+    # registration is async after ports open; ps exits non-zero until
+    # all three registered
+    deadline = time.monotonic() + 30
+    while True:
+        ps = scratch.run(block_with(blocks, "tasksrunner ps"), check=False)
+        if ps.count("ok") >= 3:
+            break
+        assert time.monotonic() < deadline, f"apps never healthy:\n{ps}"
+        time.sleep(0.5)
+    assert "tasksmanager-backend-processor" in ps
+
+    # §4.1 create a task through the sidecar
+    created = scratch.run(block_with(blocks, '"taskName":"Ship module 5"'))
+    assert "taskId" in created
+
+    # §4.2 the processor logs the delivery
+    logs_cmd = block_with(blocks, "tasksrunner logs tasksmanager-backend-processor")
+    deadline = time.monotonic() + 20
+    while True:
+        logs = scratch.run(logs_cmd, check=False)
+        if "Started processing message with task name 'Ship module 5'" in logs:
+            break
+        assert time.monotonic() < deadline, f"delivery never logged:\n{logs}"
+        time.sleep(0.5)
+
+    # §4.3 counted in metrics
+    metrics = scratch.run(block_with(blocks, "tasksrunner metrics"))
+    assert re.search(r"pubsub_delivery\{.*status=200\}\s+\d", metrics)
+
+    # §6 the reference-style raw publish probe answers 200 and delivers
+    raw = scratch.run(block_with(blocks, "v1.0/publish/dapr-pubsub-servicebus"))
+    assert "200" in raw
+    deadline = time.monotonic() + 20
+    while True:
+        logs = scratch.run(logs_cmd, check=False)
+        if "raw publish" in logs:
+            break
+        assert time.monotonic() < deadline, "raw-published event never delivered"
+        time.sleep(0.5)
+
+    scratch.stop_proc(orch)

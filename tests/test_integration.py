@@ -318,6 +318,7 @@ async def test_etag_cas_over_http_sidecar(tmp_path):
     host = AppHost(make_api_app(), specs=specs,
                    registry_file=str(tmp_path / "apps.json"))
     await host.start()
+    client = None
     try:
         client = AppClient.http(port=host.sidecar_port)
         await client.save_state("statestore", "cas-key", {"n": 0})
@@ -334,6 +335,7 @@ async def test_etag_cas_over_http_sidecar(tmp_path):
             await client.save_state("statestore", "cas-key", {"n": 2},
                                     etag=item.etag)
         assert await client.get_state("statestore", "cas-key") == {"n": 1}
-        await client.close()
     finally:
+        if client is not None:
+            await client.close()
         await host.stop()
