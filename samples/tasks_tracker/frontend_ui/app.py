@@ -64,23 +64,54 @@ def _validate_task_form(form: dict[str, str]) -> dict[str, str]:
     return errors
 
 
+def _date_input_value(raw: str) -> tuple[str, str | None]:
+    """A stored datetime → the YYYY-MM-DD a date input needs.
+
+    Parses rather than slices: a malformed stored value must surface
+    as a visible field error, not render as a silently clipped
+    plausible-looking date (the same honesty the per-field validation
+    gives user input)."""
+    import datetime as dt
+
+    raw = (raw or "").strip()
+    if not raw:
+        return "", None
+    try:
+        return dt.datetime.fromisoformat(raw).date().isoformat(), None
+    except ValueError:
+        return "", (f"The stored value {raw!r} is not a valid date — "
+                    f"please pick the due date again.")
+
+
 def _task_form_page(title: str, action: str, submit: str,
                     values: dict[str, str],
                     errors: dict[str, str]) -> Response:
     """Render the create/edit form with preserved values and per-field
-    validation messages (≙ Razor's asp-validation-for spans)."""
+    validation messages (≙ Razor's asp-validation-for spans). Inputs
+    carry data-display so validation.js mirrors the exact server
+    messages client-side."""
+    errors = dict(errors)
     rows = []
     for name, display, kind in FORM_FIELDS:
-        value = html.escape((values.get(name) or "")[:10]
-                            if kind == "date" else values.get(name) or "")
-        err = (f'<span class="field-error">{html.escape(errors[name])}</span>'
+        raw = values.get(name) or ""
+        if kind == "date":
+            value, date_err = _date_input_value(raw)
+            if date_err and name not in errors:
+                errors[name] = date_err
+        else:
+            value = raw
+        err = (f'<span class="field-error" data-for="{name}">'
+               f'{html.escape(errors[name])}</span>'
                if name in errors else "")
+        invalid = " input-validation-error" if name in errors else ""
         rows.append(
             f'<p><label>{html.escape(display)} '
-            f'<input type="{kind}" name="{name}" value="{value}" required>'
+            f'<input type="{kind}" name="{name}" value="{html.escape(value)}"'
+            f' data-display="{html.escape(display)}"'
+            f' class="form-input{invalid}" required>'
             f'</label>{err}</p>')
     body = (f'<h2>{html.escape(title)}</h2>'
-            f'<form method="post" action="{html.escape(action)}">'
+            f'<form method="post" action="{html.escape(action)}" data-validate>'
             + "".join(rows)
             + f'<button type="submit">{html.escape(submit)}</button> '
               f'<a href="/tasks">Cancel</a></form>')
@@ -104,15 +135,26 @@ def _redirect(location: str, *, set_cookie: str | None = None) -> Response:
 
 
 def _page(title: str, body: str) -> Response:
-    """Shared layout (≙ Pages/Shared/_Layout.cshtml): site header +
-    stylesheet from the wwwroot asset tree served at /static."""
+    """Shared layout (≙ Pages/Shared/_Layout.cshtml:1-52): every page
+    renders through this one chrome — head with the stylesheet, a
+    header with nav, the page body, a footer, and the script includes
+    (site behaviors + client-side validation, ≙ the layout's
+    jquery/validation bundle from wwwroot/lib). Assets come from the
+    wwwroot tree served at /static."""
     doc = f"""<!doctype html>
-<html><head><meta charset="utf-8"><title>{html.escape(title)} — Tasks Tracker</title>
-<link rel="stylesheet" href="/static/site.css"></head>
+<html><head><meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>{html.escape(title)} — Tasks Tracker</title>
+<link rel="stylesheet" href="/static/css/site.css"></head>
 <body>
 <header class="site"><a href="/tasks">Tasks Tracker</a>
-<span class="sub">{html.escape(title)}</span></header>
+<span class="sub">{html.escape(title)}</span>
+<nav><a href="/tasks">Tasks</a><a href="/tasks/create">New task</a><a href="/">Switch user</a></nav>
+</header>
 <main><div class="card">{body}</div></main>
+<footer class="site">Tasks Tracker — tasksrunner workshop sample</footer>
+<script src="/static/js/site.js"></script>
+<script src="/static/js/validation.js"></script>
 </body></html>"""
     return Response(status=200, body=doc,
                     headers={"content-type": "text/html; charset=utf-8"})
@@ -205,8 +247,9 @@ def make_app() -> App:
 <td>{status}</td>
 <td><form class="inline" method="post" action="/tasks/complete/{tid}">
     <button {'disabled' if t.get('isCompleted') else ''}>Complete</button></form></td>
-<td><form class="inline" method="post" action="/tasks/delete/{tid}">
-    <button>Delete</button></form></td></tr>"""
+<td><form class="inline" method="post" action="/tasks/delete/{tid}"
+    data-confirm="Delete this task?">
+    <button class="danger">Delete</button></form></td></tr>"""
 
     @app.post("/tasks/complete/{task_id}")
     async def complete(req):

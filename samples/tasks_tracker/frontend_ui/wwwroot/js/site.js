@@ -1,0 +1,12 @@
+// Site-wide behaviors (≙ the reference's wwwroot/js/site.js slot).
+(function () {
+  "use strict";
+  // confirm destructive row actions — delete posts immediately, so
+  // give the pointer-click path one guard
+  document.addEventListener("submit", function (ev) {
+    var form = ev.target;
+    if (form.matches("form[data-confirm]") &&
+        !window.confirm(form.getAttribute("data-confirm")))
+      ev.preventDefault();
+  });
+})();

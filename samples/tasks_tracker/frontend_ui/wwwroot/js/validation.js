@@ -1,0 +1,60 @@
+// Client-side form validation for the Tasks Tracker frontend.
+// ≙ the reference's jquery-validation + unobtrusive bundle
+// (wwwroot/lib/, wired in Pages/Shared/_ValidationScriptsPartial.cshtml):
+// instant feedback in the browser, with MESSAGES IDENTICAL to the
+// server's DataAnnotations analog (app.py `_validate_task_form`) —
+// the server remains the authority; this only saves a round trip.
+(function () {
+  "use strict";
+
+  function message(kind, display) {
+    if (kind === "required") return "The " + display + " field is required.";
+    if (kind === "email")
+      return "The " + display + " field is not a valid e-mail address.";
+    return "The " + display + " field must be a valid date.";
+  }
+
+  function validateField(input) {
+    var display = input.getAttribute("data-display") || input.name;
+    var value = (input.value || "").trim();
+    if (!value) return message("required", display);
+    if (input.type === "email" &&
+        (value.indexOf("@") < 0 || value.indexOf(" ") >= 0))
+      return message("email", display);
+    if (input.type === "date" && isNaN(Date.parse(value)))
+      return message("date", display);
+    return null;
+  }
+
+  function show(input, error) {
+    var span = input.parentElement.parentElement
+      .querySelector(".field-error[data-for='" + input.name + "']");
+    if (!span) {
+      span = document.createElement("span");
+      span.className = "field-error";
+      span.setAttribute("data-for", input.name);
+      input.parentElement.insertAdjacentElement("afterend", span);
+    }
+    span.textContent = error || "";
+    input.classList.toggle("input-validation-error", !!error);
+  }
+
+  document.addEventListener("submit", function (ev) {
+    var form = ev.target;
+    if (!form.hasAttribute("data-validate")) return;
+    var ok = true;
+    form.querySelectorAll("input[data-display]").forEach(function (input) {
+      var error = validateField(input);
+      show(input, error);
+      if (error) ok = false;
+    });
+    if (!ok) ev.preventDefault();
+  });
+
+  // live re-validation once a field has been marked invalid
+  document.addEventListener("input", function (ev) {
+    var input = ev.target;
+    if (input.classList && input.classList.contains("input-validation-error"))
+      show(input, validateField(input));
+  });
+})();

@@ -155,6 +155,12 @@ class App:
         self.client: Any = None
         #: free-form per-app state (≙ DI singletons)
         self.state: dict[str, Any] = {}
+        #: live request counters, maintained by handle() itself so
+        #: every dispatch path (HTTP server, sidecar direct channel,
+        #: in-proc cluster) feeds the http-concurrency autoscale rule
+        #: identically (served at GET /tasksrunner/stats)
+        self.inflight = 0
+        self.requests_total = 0
 
     # -- registration ----------------------------------------------------
 
@@ -340,6 +346,17 @@ class App:
     async def handle(self, method: str, path: str, *, query: str = "",
                      headers: dict[str, str] | None = None,
                      body: bytes = b"") -> Response:
+        self.inflight += 1
+        self.requests_total += 1
+        try:
+            return await self._handle(method, path, query=query,
+                                      headers=headers, body=body)
+        finally:
+            self.inflight -= 1
+
+    async def _handle(self, method: str, path: str, *, query: str = "",
+                      headers: dict[str, str] | None = None,
+                      body: bytes = b"") -> Response:
         headers = {k.lower(): v for k, v in (headers or {}).items()}
         clean_path = path.split("?", 1)[0]
 

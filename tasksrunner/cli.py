@@ -93,7 +93,7 @@ def _cmd_host(args) -> None:
 def _cmd_serve(args) -> None:
     from aiohttp import web
     from tasksrunner.client import AppClient
-    from tasksrunner.hosting import build_app_server
+    from tasksrunner.hosting import _access_log, build_app_server
     from tasksrunner.observability.logging import configure_logging
 
     app = _make_app(args.module)
@@ -101,7 +101,7 @@ def _cmd_serve(args) -> None:
     app.client = AppClient.http(args.sidecar_port)
 
     async def main():
-        runner = web.AppRunner(build_app_server(app))
+        runner = web.AppRunner(build_app_server(app), access_log=_access_log())
         await runner.setup()
         site = web.TCPSite(runner, "127.0.0.1", args.port)
         await site.start()

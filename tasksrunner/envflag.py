@@ -1,0 +1,22 @@
+"""One parser for boolean environment knobs.
+
+Every on/off env toggle (TASKSRUNNER_ACCESS_LOG, TASKSRUNNER_FLASH,
+TASKSRUNNER_PERF_TESTS, ...) must accept the same spellings — a
+per-call-site tuple would drift the moment one copy learns a new
+spelling.
+"""
+
+from __future__ import annotations
+
+import os
+
+_FALSE = frozenset({"0", "false", "off", "no"})
+
+
+def env_flag(name: str, default: bool = True) -> bool:
+    """True unless the variable is set to an explicit disable value
+    (case-insensitive: 0 / false / off / no). Unset → ``default``."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in _FALSE

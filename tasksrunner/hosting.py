@@ -33,11 +33,23 @@ from tasksrunner.observability.tracing import (
 )
 from tasksrunner.resiliency.policy import ResiliencyPolicies
 from tasksrunner.resiliency.spec import ResiliencySpec, load_resiliency
-from tasksrunner.runtime import HTTPAppChannel, InProcAppChannel, Runtime
+from tasksrunner.runtime import InProcAppChannel, Runtime
 from tasksrunner.security import AppGrants, grants_from_env
 from tasksrunner.sidecar import Sidecar
 
 logger = logging.getLogger(__name__)
+
+
+def _access_log():
+    """aiohttp access logger, or None when TASKSRUNNER_ACCESS_LOG=0.
+    Returning the default logger keeps aiohttp's stock behavior."""
+    from tasksrunner.envflag import env_flag
+
+    if not env_flag("TASKSRUNNER_ACCESS_LOG"):
+        return None
+    from aiohttp.log import access_logger
+
+    return access_logger
 
 
 def build_app_server(app: App) -> web.Application:
@@ -48,29 +60,36 @@ def build_app_server(app: App) -> web.Application:
     ``http-concurrency`` autoscale rule (the orchestrator polls each
     replica, the way ACA's HTTP scaler watches concurrent requests,
     docs/aca/09-aca-autoscale-keda/index.md:27-35)."""
-    inflight = 0
-    requests_total = 0
-
     async def dispatch(request: web.Request) -> web.Response:
-        nonlocal inflight, requests_total
         if request.method == "GET" and request.path == "/tasksrunner/stats":
             # not counted as load: the scaler's own probe must not
-            # inflate the concurrency it measures
+            # inflate the concurrency it measures. Counters live on the
+            # App so sidecar-direct dispatch (AppHost) and this server
+            # feed the same numbers. The /tasksrunner/ prefix is a
+            # reserved namespace (healthz, subscribe, stats) — user
+            # routes cannot claim it. When the replica runs with an API
+            # token, the probe requires it: an ingress:external app must
+            # not leak load numbers to the world (the orchestrator's
+            # scaler sends the token).
+            import os as _os
+
+            from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER
+
+            required = _os.environ.get(TOKEN_ENV) or None
+            if required and request.headers.get(TOKEN_HEADER) != required:
+                return web.json_response(
+                    {"error": "missing or bad api token"}, status=401)
             return web.json_response(
-                {"inflight": inflight, "requests_total": requests_total})
-        inflight += 1
-        requests_total += 1
-        try:
-            ctx = ensure_trace(request.headers.get(TRACEPARENT_HEADER))
-            with trace_scope(ctx):
-                body = await request.read()
-                resp = await app.handle(
-                    request.method, request.path, query=request.query_string,
-                    headers=dict(request.headers), body=body)
-                status, headers, payload = resp.encode()
-                return web.Response(status=status, body=payload, headers=headers)
-        finally:
-            inflight -= 1
+                {"inflight": app.inflight,
+                 "requests_total": app.requests_total})
+        ctx = ensure_trace(request.headers.get(TRACEPARENT_HEADER))
+        with trace_scope(ctx):
+            body = await request.read()
+            resp = await app.handle(
+                request.method, request.path, query=request.query_string,
+                headers=dict(request.headers), body=body)
+            status, headers, payload = resp.encode()
+            return web.Response(status=status, body=payload, headers=headers)
 
     server = web.Application(client_max_size=16 * 1024 * 1024)
     server.router.add_route("*", "/{path:.*}", dispatch)
@@ -121,22 +140,30 @@ class AppHost:
         self.client: AppClient | None = None
 
     async def start(self) -> None:
-        # 1. the app's own HTTP server
-        self._app_runner = web.AppRunner(build_app_server(self.app))
+        # 1. the app's own HTTP server. Access logging is on by default
+        # (the workshop reads those lines); TASKSRUNNER_ACCESS_LOG=0
+        # disables it — measured at ~2x request throughput on the write
+        # path (see BASELINE.md), the first tuning for a hot deployment.
+        self._app_runner = web.AppRunner(
+            build_app_server(self.app), access_log=_access_log())
         await self._app_runner.setup()
         site = web.TCPSite(self._app_runner, self.bind, self.app_port)
         await site.start()
         if self.app_port == 0:
             self.app_port = self._app_runner.addresses[0][1]
 
-        # 2. the sidecar beside it
+        # 2. the sidecar beside it. App and sidecar share this process,
+        # so sidecar→app dispatch is a direct call — the process
+        # boundaries that remain HTTP are exactly the reference's [PB]
+        # hops (peer sidecars, other services). The two-process layout
+        # (`tasksrunner serve` + `tasksrunner sidecar`) keeps the
+        # HTTPAppChannel; both must stay behaviorally identical
+        # (SURVEY.md §7.4 hard part #1 — App.handle adopts trace
+        # context and feeds the same request counters either way).
         registry = ComponentRegistry(self.specs, app_id=self.app.app_id)
-        # the channel targets self.host: with bind=0.0.0.0 the app is
-        # reachable there too, and with a non-loopback host everything
-        # (app, sidecar, registration) consistently lives on that address
         runtime = Runtime(
             self.app.app_id, registry, resolver=self.resolver,
-            app_channel=HTTPAppChannel(self.host, self.app_port),
+            app_channel=InProcAppChannel(self.app),
             resiliency=ResiliencyPolicies(
                 self.resiliency_specs, app_id=self.app.app_id)
             if self.resiliency_specs else None,
@@ -154,7 +181,11 @@ class AppHost:
                 app_id=self.app.app_id, host=self.host,
                 sidecar_port=self.sidecar_port, app_port=self.app_port,
             ))
-        self.client = AppClient.http(self.sidecar_port, self.host)
+        # the app's client talks to its sidecar runtime directly — same
+        # process, same Runtime object the HTTP surface serves, same
+        # grant/scope enforcement (runtime.py is transport-neutral).
+        # Real HTTP starts at the first process boundary: peer invokes.
+        self.client = AppClient.direct(runtime)
         self.app.client = self.client
         await self.app.startup()
         logger.info("app %s on :%d, sidecar on :%d",

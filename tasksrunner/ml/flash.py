@@ -1,0 +1,218 @@
+"""Pallas flash-attention kernels for the ML extension.
+
+EXTENSION ONLY (see tasksrunner/ml/model.py) — the reference has no
+numerical workload (SURVEY.md §0); these kernels back the harness
+contract's compute path.
+
+Design (per /opt/skills/guides/pallas_guide.md):
+
+* One grid program per (batch, head): at the scorer's shapes
+  (seq ≤ 1k, d_head 64) a head's whole attention fits VMEM
+  comfortably (q/k/v/o ≈ 0.5 MB + one [S,S] f32 score tile ≈ 1 MB of
+  the ~16 MB/core budget), so the kernel is a single fused
+  QKᵀ → softmax → PV with no K-streaming loop — the flash recipe's
+  streaming only pays once S² no longer fits, and the blockwise ring
+  layer (ring.py) already bounds S per device before that point.
+* Internally arrays are laid out [batch, heads, seq, d_head] so each
+  block's minor-most two dims are the full (seq, d_head) tile —
+  Pallas TPU requires the last two block dims be tile-aligned or
+  whole; the (b, h) grid dims lead. The public interface stays the
+  model's [batch, seq, heads, d_head]; XLA fuses the transposes into
+  the surrounding reshapes.
+* Matmuls run bf16 × bf16 → f32 (`preferred_element_type`) on the
+  MXU; softmax stays f32 on the VPU; nothing round-trips to HBM
+  between the three stages (the win over dispatching three XLA ops).
+* Training needs gradients: `flash_attention` carries a custom VJP
+  whose backward pass is a second Pallas kernel implementing the
+  standard flash backward (recompute P from the saved row-logsumexp,
+  then dV = PᵀdO, dS = P∘(dO Vᵀ − Δ), dQ = dS·K, dK = dSᵀ·Q) — same
+  VMEM-residency argument, one kernel launch per (batch, head).
+* Off-TPU the kernels run in interpreter mode, so the correctness
+  suite (tests/test_ml_extension.py) exercises the exact kernel code
+  on CPU against the einsum reference.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _dot(a, b, *, trans_b: bool = False):
+    """bf16×bf16→f32 MXU contraction of 2-D operands."""
+    dims = (((1,), (1 if trans_b else 0,)), ((), ()))
+    return jax.lax.dot_general(
+        a.astype(jnp.bfloat16), b.astype(jnp.bfloat16), dims,
+        preferred_element_type=jnp.float32)
+
+
+def _specs(b, s, h, d):
+    """BlockSpecs over the internal [b, h, s, d] / [b, h, 1, s]
+    layouts: one (batch, head) per grid program, minor dims whole."""
+    qkv = pl.BlockSpec((1, 1, s, d), lambda i, j: (i, j, 0, 0),
+                       memory_space=pltpu.VMEM)
+    lse = pl.BlockSpec((1, 1, 1, s), lambda i, j: (i, j, 0, 0),
+                       memory_space=pltpu.VMEM)
+    return qkv, lse
+
+
+# -- forward --------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale):
+    q = q_ref[0, 0]                            # [S, D]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    s = _dot(q, k, trans_b=True) * scale       # [S, S] f32
+    m = jnp.max(s, axis=-1)                    # [S]
+    p = jnp.exp(s - m[:, None])                # f32, unnormalised
+    den = jnp.sum(p, axis=-1)                  # [S]
+    ctx = _dot(p, v) / den[:, None]            # [S, D]
+    o_ref[0, 0] = ctx
+    l_ref[0, 0, 0, :] = m + jnp.log(den)       # row logsumexp, for bwd
+
+
+def _flash_fwd(q, k, v, scale):
+    """q/k/v in internal [b, h, s, d] layout."""
+    b, h, s, d = q.shape
+    qkv_spec, lse_spec = _specs(b, s, h, d)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale),
+        grid=(b, h),
+        in_specs=[qkv_spec, qkv_spec, qkv_spec],
+        out_specs=[qkv_spec, lse_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1, s), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# -- backward -------------------------------------------------------------
+
+def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, l_ref,
+                dq_ref, dk_ref, dv_ref, *, scale):
+    q = q_ref[0, 0]
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
+    o = o_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = l_ref[0, 0, 0, :]                     # [S]
+    s = _dot(q, k, trans_b=True) * scale        # [S, S]
+    p = jnp.exp(s - lse[:, None])               # normalised probs, f32
+    dv = _dot(p.T, do)                          # [S, D]
+    dp = _dot(do, v, trans_b=True)              # [S, S]
+    delta = jnp.sum(do * o, axis=-1)            # [S]
+    ds = p * (dp - delta[:, None]) * scale      # [S, S]
+    dq_ref[0, 0] = _dot(ds, k)
+    dk_ref[0, 0] = _dot(ds.T, q)
+    dv_ref[0, 0] = dv
+
+
+def _flash_bwd_call(q, k, v, out, lse, dout, scale):
+    b, h, s, d = q.shape
+    qkv_spec, lse_spec = _specs(b, s, h, d)
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale),
+        grid=(b, h),
+        in_specs=[qkv_spec] * 5 + [lse_spec],
+        out_specs=[qkv_spec] * 3,
+        out_shape=[jax.ShapeDtypeStruct((b, h, s, d), jnp.float32)] * 3,
+        interpret=_interpret(),
+    )(q, k, v, out, dout.astype(jnp.float32), lse)
+
+
+# -- public op ------------------------------------------------------------
+
+def _to_internal(x):
+    return jnp.transpose(x, (0, 2, 1, 3))      # [b,s,h,d] -> [b,h,s,d]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(q, k, v, scale=None):
+    """Fused attention: q/k/v [batch, seq, heads, d_head] (f32) →
+    context [batch, seq, heads, d_head] (f32). Differentiable; the
+    VJP is the flash backward kernel."""
+    out, _ = _flash_fwd(_to_internal(q), _to_internal(k), _to_internal(v),
+                        _resolve_scale(q, scale))
+    return _to_internal(out)
+
+
+def _resolve_scale(q, scale):
+    return float(scale) if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+
+
+def _fwd_rule(q, k, v, scale):
+    qi, ki, vi = _to_internal(q), _to_internal(k), _to_internal(v)
+    out, lse = _flash_fwd(qi, ki, vi, _resolve_scale(q, scale))
+    return _to_internal(out), (qi, ki, vi, out, lse)
+
+
+def _bwd_rule(scale, res, dout):
+    qi, ki, vi, out, lse = res
+    dq, dk, dv = _flash_bwd_call(qi, ki, vi, out, lse, _to_internal(dout),
+                                 _resolve_scale(qi, scale))
+    return _to_internal(dq), _to_internal(dk), _to_internal(dv)
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)
+
+
+# -- ring block update ----------------------------------------------------
+
+def _ring_block_kernel(q_ref, k_ref, v_ref, m_ref, num_ref, den_ref,
+                       m_out, num_out, den_out, *, scale):
+    """One visiting K/V block folded into the running flash state —
+    the ring step's inner update (ring.py `_block_update`) as one
+    fused kernel: logits, running max, correction, and both
+    accumulators without leaving VMEM."""
+    q = q_ref[0, 0]                             # [Sq, D]
+    k = k_ref[0, 0]                             # [Sk, D]
+    v = v_ref[0, 0]
+    m = m_ref[0, 0, 0, :]                       # [Sq]
+    num = num_ref[0, 0]                         # [Sq, D]
+    den = den_ref[0, 0, 0, :]
+    s = _dot(q, k, trans_b=True) * scale        # [Sq, Sk]
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    m_out[0, 0, 0, :] = m_new
+    num_out[0, 0] = num * corr[:, None] + _dot(p, v)
+    den_out[0, 0, 0, :] = den * corr + jnp.sum(p, axis=-1)
+
+
+def ring_block_update(q, k_blk, v_blk, m, num, den, *, scale):
+    """Pallas twin of ring.py's `_block_update`.
+
+    Layouts match the ring's per-device state: q/k/v [b, sq|sk, h, dh],
+    m/den [b, h, sq], num [b, h, sq, dh]. Forward-only — the ring's
+    VJP differentiates the einsum block update instead.
+    """
+    b, sq, h, d = q.shape
+    sk = k_blk.shape[1]
+    qkv_spec, vec_spec = _specs(b, sq, h, d)
+    kv_spec = pl.BlockSpec((1, 1, sk, d), lambda i, j: (i, j, 0, 0),
+                           memory_space=pltpu.VMEM)
+    m_new, num_new, den_new = pl.pallas_call(
+        functools.partial(_ring_block_kernel, scale=scale),
+        grid=(b, h),
+        in_specs=[qkv_spec, kv_spec, kv_spec, vec_spec, qkv_spec, vec_spec],
+        out_specs=[vec_spec, qkv_spec, vec_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, 1, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1, sq), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(_to_internal(q), _to_internal(k_blk), _to_internal(v_blk),
+      m[:, :, None, :], num, den[:, :, None, :])
+    return m_new[:, :, 0, :], num_new, den_new[:, :, 0, :]

@@ -96,6 +96,16 @@ def _use_ring(mesh: Mesh | None) -> bool:
             and mesh.shape["sp"] > 1)
 
 
+def use_flash() -> bool:
+    """Single-chip attention core toggle: the Pallas flash kernel
+    (tasksrunner/ml/flash.py, default) vs the plain einsum pair.
+    Resolved at trace time — set TASKSRUNNER_FLASH=0 before jitting
+    to compare (bench.py reports both)."""
+    from tasksrunner.envflag import env_flag
+
+    return env_flag("TASKSRUNNER_FLASH")
+
+
 def _attention(x: jax.Array, layer: dict, cfg: ModelConfig,
                mesh: Mesh | None = None) -> jax.Array:
     b, s, _ = x.shape
@@ -108,6 +118,9 @@ def _attention(x: jax.Array, layer: dict, cfg: ModelConfig,
     if _use_ring(mesh):
         from tasksrunner.ml.ring import ring_attention
         ctx = ring_attention(q, k, v, mesh=mesh)          # [b, s, h, dh]
+    elif use_flash():
+        from tasksrunner.ml.flash import flash_attention
+        ctx = flash_attention(q, k, v)                    # Pallas kernel
     else:
         logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.bfloat16),
                             k.astype(jnp.bfloat16),

@@ -49,7 +49,35 @@ def _block_update(q, k_blk, v_blk, m, num, den, *, scale):
     return m_new, num, den
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, scale: float):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def _block_update_fast(q, k_blk, v_blk, m, num, den, scale):
+    """The block update with a fused Pallas forward (one kernel:
+    logits + running max + correction + both accumulators, all in
+    VMEM) and the einsum implementation's VJP for the backward —
+    numerically the same computation (both contract in bf16), so the
+    recompute-for-backward trade is sound and the ring stays fully
+    differentiable."""
+    from tasksrunner.ml.flash import ring_block_update
+
+    return ring_block_update(q, k_blk, v_blk, m, num, den, scale=scale)
+
+
+def _block_update_fwd(q, k_blk, v_blk, m, num, den, scale):
+    out = _block_update_fast(q, k_blk, v_blk, m, num, den, scale)
+    return out, (q, k_blk, v_blk, m, num, den)
+
+
+def _block_update_bwd(scale, res, cotangents):
+    _, vjp = jax.vjp(
+        lambda *args: _block_update(*args, scale=scale), *res)
+    return vjp(cotangents)
+
+
+_block_update_fast.defvjp(_block_update_fwd, _block_update_bwd)
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, scale: float,
+                          use_pallas: bool):
     """Per-device body (runs under shard_map): q/k/v are the local
     [b, s_block, h, dh] shards; returns the local context block."""
     n = jax.lax.axis_size(axis_name)
@@ -64,7 +92,12 @@ def _ring_attention_local(q, k, v, *, axis_name: str, scale: float):
 
     def step(carry, _):
         k_blk, v_blk, m, num, den = carry
-        m, num, den = _block_update(q, k_blk, v_blk, m, num, den, scale=scale)
+        if use_pallas:
+            m, num, den = _block_update_fast(
+                q, k_blk, v_blk, m, num, den, scale)
+        else:
+            m, num, den = _block_update(
+                q, k_blk, v_blk, m, num, den, scale=scale)
         # rotate AFTER consuming: after n steps every device has seen
         # every block exactly once and K/V are home again
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
@@ -92,8 +125,10 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis_name: str = "sp",
     batch_axis = "dp" if "dp" in mesh.axis_names else None
     head_axis = "tp" if "tp" in mesh.axis_names else None
     spec = P(batch_axis, axis_name, head_axis, None)
+    from tasksrunner.ml.model import use_flash
     body = functools.partial(_ring_attention_local,
-                             axis_name=axis_name, scale=scale)
+                             axis_name=axis_name, scale=scale,
+                             use_pallas=use_flash())
     return jax.shard_map(
         body, mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -86,13 +86,16 @@ RULE_TYPES = ("pubsub-backlog", "queue-backlog", "http-concurrency",
               "cpu", "memory")
 
 
-def _read_inflight(replicas: list[dict], timeout: float = 0.5) -> int:
+def _read_inflight(replicas: list[dict], timeout: float = 0.5,
+                   api_token: str | None = None) -> int:
     """Sum in-flight requests across replicas by polling each one's
     ``/tasksrunner/stats`` (the position of ACA's HTTP scaler: it
     watches traffic, not app internals). Unreachable replicas count 0
     — mid-restart must not wedge the scaler."""
     import json as _json
     import urllib.request
+
+    from tasksrunner.security import TOKEN_HEADER
 
     total = 0
     for info in replicas:
@@ -103,9 +106,10 @@ def _read_inflight(replicas: list[dict], timeout: float = 0.5) -> int:
         if host in ("", "0.0.0.0"):
             host = "127.0.0.1"
         try:
-            with urllib.request.urlopen(
-                f"http://{host}:{port}/tasksrunner/stats", timeout=timeout
-            ) as resp:
+            req = urllib.request.Request(
+                f"http://{host}:{port}/tasksrunner/stats",
+                headers={TOKEN_HEADER: api_token} if api_token else {})
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
                 total += int(_json.loads(resp.read()).get("inflight", 0))
         except (OSError, ValueError):
             continue
@@ -146,12 +150,16 @@ class AutoscaleController:
         base_dir: pathlib.Path | None = None,
         interval: float = 0.5,
         replica_info: Callable[[], list[dict]] | None = None,
+        api_token: str | None = None,
     ):
         self.app = app
         self.components = components
         self.set_replicas = set_replicas
         self.base_dir = base_dir or pathlib.Path.cwd()
         self.interval = interval
+        #: the app's API token — the stats probe is token-gated when
+        #: the replica runs with one (see hosting.build_app_server)
+        self.api_token = api_token
         #: live replica inventory ({pid, app_port, host} per replica),
         #: supplied by the orchestrator — the http/cpu/memory rules
         #: measure the replicas themselves, not a shared broker file
@@ -205,17 +213,34 @@ class AutoscaleController:
             return math.ceil(backlog / per)
         if rule.type == "http-concurrency":
             per = max(int(meta.get("concurrentRequests", 10)), 1)
-            return math.ceil(_read_inflight(self.replica_info()) / per)
+            return math.ceil(_read_inflight(
+                self.replica_info(), api_token=self.api_token) / per)
         if rule.type == "cpu":
             threshold = max(float(meta.get("utilization", 70)), 1.0)
             return math.ceil(
                 self._cpu_percent_total(self.replica_info()) / threshold)
         if rule.type == "memory":
+            # Per-replica memory budget, stable under BOTH memory
+            # shapes. The two naive formulas each fail one of them:
+            # KEDA's ceil(sum/budget) ratchets to max_replicas whenever
+            # a FIXED per-replica baseline exceeds the budget (each new
+            # replica adds its own baseline to the signal, so desired
+            # only grows); plain ceil(mean/budget) flip-flops for
+            # LOAD-PROPORTIONAL memory (scale-out halves the mean,
+            # which immediately argues for scale-in). Composite:
+            #   scale-out pressure from the mean (some replica over
+            #   budget), scale-in only if the whole footprint would
+            #   still fit the smaller fleet (sum), never exceeding the
+            #   current count on the sum term (breaks the ratchet).
             per_mb = max(float(meta.get("megabytes", 512)), 1.0)
-            total_mb = sum(
-                _read_proc_rss_mb(info["pid"])
-                for info in self.replica_info() if info.get("pid"))
-            return math.ceil(total_mb / per_mb)
+            rss = [_read_proc_rss_mb(info["pid"])
+                   for info in self.replica_info() if info.get("pid")]
+            if not rss:
+                return 0
+            n = len(rss)
+            mean_term = math.ceil((sum(rss) / n) / per_mb)
+            sum_term = min(n, math.ceil(sum(rss) / per_mb))
+            return max(mean_term, sum_term)
         raise ComponentError(f"unknown scale rule type {rule.type!r} "
                              f"(known: {RULE_TYPES})")
 

@@ -27,6 +27,7 @@ import time
 
 from tasksrunner.orchestrator.autoscale import AutoscaleController
 from tasksrunner.orchestrator.config import AppSpec, RunConfig
+from tasksrunner.security import TOKEN_ENV as _TOKEN_ENV
 from tasksrunner.component.loader import load_components
 
 logger = logging.getLogger(__name__)
@@ -108,8 +109,9 @@ class Replica:
         if self.config.app_tokens:
             # per-app identity: the replica gets ONLY its own token;
             # the map file lets its sidecar verify inbound peers
-            env["TASKSRUNNER_API_TOKEN"] = self.config.app_tokens[self.app.app_id]
-            env["TASKSRUNNER_TOKENS_FILE"] = self.config.tokens_file or ""
+            from tasksrunner.security import TOKENS_FILE_ENV
+            env[_TOKEN_ENV] = self.config.app_tokens[self.app.app_id]
+            env[TOKENS_FILE_ENV] = self.config.tokens_file or ""
         # the orchestrator's import context must reach the replicas
         # (run configs may live outside the package root)
         env["PYTHONPATH"] = os.pathsep.join(
@@ -269,6 +271,11 @@ class Orchestrator:
                     lambda n, a=app: self._set_replicas(a, n),
                     base_dir=self.config.base_dir,
                     replica_info=lambda a=app: self._replica_info(a.app_id),
+                    # replicas gate /tasksrunner/stats on their token;
+                    # the scaler must authenticate like any client
+                    api_token=(self.config.app_tokens.get(app.app_id)
+                               if self.config.app_tokens
+                               else os.environ.get(_TOKEN_ENV)),
                 )
                 scaler.start()
                 self._scalers.append(scaler)
@@ -277,17 +284,25 @@ class Orchestrator:
         await self._admin.start()
 
     def _issue_app_tokens(self) -> None:
-        """Generate one token per app and write the app_id→token map
-        beside the name registry (mode 0600). Each replica receives
-        only its own token; sidecars read the map to authenticate
-        inbound peer invocations (≙ one managed identity per container
-        app instead of a shared secret, SURVEY.md §5.10)."""
+        """Generate one token per app and write the app_id→sha256-digest
+        map beside the name registry (mode 0600). Each replica receives
+        only its OWN plaintext token; sidecars read the digest map to
+        *verify* inbound peer invocations without being able to
+        impersonate any peer (≙ one managed identity per container app
+        instead of a shared secret, SURVEY.md §5.10). Plaintext tokens
+        exist only in the orchestrator's memory and each owner's env."""
         import json as _json
         import pathlib
         import secrets as _secrets
 
+        from tasksrunner.security import hash_token
+
         self.config.app_tokens = {
             app.app_id: _secrets.token_hex(16) for app in self.config.apps
+        }
+        digests = {
+            app_id: hash_token(token)
+            for app_id, token in self.config.app_tokens.items()
         }
         registry = pathlib.Path(self.config.registry_file)
         if not registry.is_absolute():
@@ -295,13 +310,14 @@ class Orchestrator:
         tokens_path = registry.parent / "tokens.json"
         tokens_path.parent.mkdir(parents=True, exist_ok=True)
         # created 0600 from the first byte — chmod-after-write would
-        # leave a world-readable window for every app's token
+        # leave a readable window (and 0600 regardless: the digests
+        # leak which apps exist)
         fd = os.open(tokens_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
         with os.fdopen(fd, "w") as f:
-            f.write(_json.dumps(self.config.app_tokens, indent=2))
+            f.write(_json.dumps(digests, indent=2))
         tokens_path.chmod(0o600)  # pre-existing file: tighten it too
         self.config.tokens_file = str(tokens_path)
-        logger.info("issued per-app tokens for %d apps -> %s",
+        logger.info("issued per-app tokens for %d apps -> digest map %s",
                     len(self.config.app_tokens), tokens_path)
 
     async def _add_replica(self, app: AppSpec) -> None:

@@ -60,6 +60,15 @@ from tasksrunner.state.keyprefix import KeyPrefixer
 logger = logging.getLogger(__name__)
 
 
+def _delivery_logs() -> bool:
+    """Per-message delivery log lines honor the access-log knob
+    (TASKSRUNNER_ACCESS_LOG=0 — see hosting._access_log): both exist
+    to keep per-request log formatting off the tuned hot path."""
+    from tasksrunner.envflag import env_flag
+
+    return env_flag("TASKSRUNNER_ACCESS_LOG")
+
+
 class AppChannel(abc.ABC):
     """How the runtime reaches its application."""
 
@@ -515,6 +524,12 @@ class Runtime:
                     logger.exception("delivery to %s failed", route)
                     return False
                 metrics.inc("pubsub_delivery", route=route, status=str(status))
+                # delivery visibility in the multiplexed logs (the
+                # sidecar→app hop is an in-process call in host mode,
+                # so no access-log line marks it); honors the same
+                # knob that silences per-request access-log formatting
+                if _delivery_logs():
+                    logger.info('pubsub delivery "POST %s" %d', route, status)
                 return 200 <= status < 300
         return deliver
 
@@ -534,6 +549,9 @@ class Runtime:
                     return False
                 metrics.inc("binding_delivery", binding=binding.name,
                             status=str(status))
+                if _delivery_logs():
+                    logger.info('binding %s delivery "POST %s" %d',
+                                binding.name, binding.route, status)
                 return 200 <= status < 300
         return sink
 

@@ -161,9 +161,23 @@ def grants_from_env() -> AppGrants | None:
         "TASKSRUNNER_APP_ID", "?"))
 
 
+def hash_token(token: str) -> str:
+    """sha256 hex digest of a peer token — what sidecars store and
+    compare so plaintext peer tokens never leave their own replica."""
+    import hashlib
+
+    return hashlib.sha256(token.encode()).hexdigest()
+
+
 def load_token_map(path: str | pathlib.Path | None = None) -> dict[str, str]:
-    """app_id → token map (``per_app_tokens`` mode). Empty when the
-    file env/argument is unset or unreadable-as-JSON is an error."""
+    """app_id → token **digest** map (``per_app_tokens`` mode).
+
+    The orchestrator writes sha256 digests, not plaintext: every
+    replica can verify any inbound peer's token without being able to
+    impersonate that peer (a plaintext map would hand every app every
+    other app's identity — the opposite of per-app least privilege).
+    Empty when the file env/argument is unset; unreadable-as-JSON is
+    an error."""
     if path is None:
         path = os.environ.get(TOKENS_FILE_ENV)
     if not path:

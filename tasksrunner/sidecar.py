@@ -51,6 +51,7 @@ def _json_error(exc: Exception) -> web.Response:
 from tasksrunner.security import (  # noqa: E402 (re-export)
     TOKEN_ENV,
     TOKEN_HEADER,
+    hash_token,
     load_token_map,
 )
 
@@ -60,36 +61,41 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
     if api_token is None:
         api_token = os.environ.get(TOKEN_ENV) or None
     if peer_tokens is None:
-        # per-app-token mode: the orchestrator's token map lets this
-        # sidecar authenticate inbound peers without sharing one secret
+        # per-app-token mode: the orchestrator's map carries sha256
+        # DIGESTS, so this sidecar can authenticate inbound peers
+        # without holding (or being able to replay) their tokens
         peer_tokens = set(load_token_map().values())
 
     routes = web.RouteTableDef()
 
-    def _traced(handler):
-        async def wrapped(request: web.Request):
-            # app↔sidecar API token (≙ Dapr's dapr-api-token / the
-            # reference's identity posture, SURVEY.md §5.10): when a
-            # token is configured, every building-block call must carry
-            # it — healthz stays open for probes. A PEER app's token is
-            # honored only for inbound service invocation: another
-            # app's identity must not unlock this app's state, pub/sub,
-            # bindings, or secrets (≙ per-app least privilege).
-            if api_token is not None:
-                supplied = request.headers.get(TOKEN_HEADER)
-                if supplied != api_token and not (
-                    supplied in peer_tokens
-                    and request.path.startswith("/v1.0/invoke/")
-                ):
-                    return web.json_response(
-                        {"error": "missing or bad api token"}, status=401)
-            ctx = ensure_trace(request.headers.get(TRACEPARENT_HEADER))
-            with trace_scope(ctx):
-                try:
-                    return await handler(request)
-                except Exception as exc:  # noqa: BLE001 - mapped to status
-                    return _json_error(exc)
-        return wrapped
+    def _traced(handler=None, *, allow_peer: bool = False):
+        # app↔sidecar API token (≙ Dapr's dapr-api-token / the
+        # reference's identity posture, SURVEY.md §5.10): when a token
+        # is configured, every building-block call must carry it —
+        # healthz stays open for probes. A PEER app's token is honored
+        # only by handlers wrapped with allow_peer=True (service
+        # invocation): acceptance is a property of the handler actually
+        # executing, not of the request path, so routing and auth can
+        # never diverge. Another app's identity must not unlock this
+        # app's state, pub/sub, bindings, or secrets.
+        def deco(handler):
+            async def wrapped(request: web.Request):
+                if api_token is not None:
+                    supplied = request.headers.get(TOKEN_HEADER)
+                    peer_ok = (
+                        allow_peer and supplied is not None
+                        and hash_token(supplied) in peer_tokens)
+                    if supplied != api_token and not peer_ok:
+                        return web.json_response(
+                            {"error": "missing or bad api token"}, status=401)
+                ctx = ensure_trace(request.headers.get(TRACEPARENT_HEADER))
+                with trace_scope(ctx):
+                    try:
+                        return await handler(request)
+                    except Exception as exc:  # noqa: BLE001 - mapped to status
+                        return _json_error(exc)
+            return wrapped
+        return deco if handler is None else deco(handler)
 
     # -- state ----------------------------------------------------------
 
@@ -191,7 +197,7 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
     # -- service invocation ----------------------------------------------
 
     @routes.route("*", "/v1.0/invoke/{app_id}/method/{path:.*}")
-    @_traced
+    @_traced(allow_peer=True)
     async def invoke(request: web.Request):
         target = request.match_info["app_id"]
         path = request.match_info["path"]
@@ -242,7 +248,9 @@ class Sidecar:
         self._runner: web.AppRunner | None = None
 
     async def start(self) -> None:
-        self._runner = web.AppRunner(self._http)
+        from tasksrunner.hosting import _access_log
+
+        self._runner = web.AppRunner(self._http, access_log=_access_log())
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()

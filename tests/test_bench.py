@@ -2,39 +2,55 @@
 
 Runs a scaled-down version of bench.py's headline measurement — the
 faithful cross-process topology (separate api/processor OS processes,
-every [PB] hop of SURVEY.md §3.1 over real localhost HTTP) — and fails
-if throughput or tail latency regress past conservative floors.
+the [PB] process boundaries of SURVEY.md §3.1 over real localhost
+HTTP) — and fails if throughput or tail latency regress.
 
-The floors are ~5x below the measured numbers on this hardware
-(≈330 tasks/s, p99 ≈70 ms) so the test only trips on a real
-regression (a serialization bug, an accidental per-request reconnect,
-a broker poll pathology), not on host noise.
+Calibration (round 3, this hardware): ~1,180 tasks/s, p50 7.3 ms,
+p99 19 ms. Floors sit within ~2.5x of those so a real regression (a
+serialization bug, an accidental per-request reconnect, a reintroduced
+intra-process HTTP hop, a broker poll pathology) trips the suite while
+ordinary host noise does not. A deliberate 3x slowdown MUST fail here.
+
+On a machine slower than the calibration host (shared CI), skip these
+wall-clock tests with TASKSRUNNER_PERF_TESTS=0 rather than loosening
+the floors — loose floors guard nothing.
 """
 
-import sys
+import os
 import pathlib
+import sys
+
+import pytest
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 from bench import run_xproc  # noqa: E402
 
+from tasksrunner.envflag import env_flag  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    not env_flag("TASKSRUNNER_PERF_TESTS"),
+    reason="wall-clock perf gates disabled (TASKSRUNNER_PERF_TESTS=0)")
+
 
 async def test_xproc_write_path_throughput_and_latency():
     result = await run_xproc(
-        n_tasks=120, warmup=10, rounds=1, latency_probe=True)
-    assert result["throughput"] > 60, (
+        n_tasks=200, warmup=20, rounds=2, latency_probe=True)
+    # measured 1,181 tasks/s; floor at 450 = a 2.6x regression budget
+    assert result["throughput"] > 450, (
         f"cross-process write path regressed: {result['throughput']} tasks/s")
-    assert result["p99_ms"] < 500, (
+    # measured p99 19 ms at concurrency 8; floor at 60 ms
+    assert result["p99_ms"] < 60, (
         f"write-path p99 regressed: {result['p99_ms']} ms")
 
 
 async def test_xproc_competing_consumers_scale():
     # with 25 ms of work per message one replica caps at ~40/s; three
     # replicas must demonstrably beat one (competing-consumer contract,
-    # SURVEY.md §5.8) — floor at 1.5x to stay noise-proof
+    # SURVEY.md §5.8). Measured ~2.8x on this host; floor at 2.0x.
     one = await run_xproc(n_tasks=60, warmup=5, rounds=1, work_ms=25.0)
     three = await run_xproc(n_tasks=60, warmup=5, rounds=1,
                             n_processors=3, work_ms=25.0)
-    assert three["throughput"] > 1.5 * one["throughput"], (
+    assert three["throughput"] > 2.0 * one["throughput"], (
         f"scale-out broken: 1 replica {one['throughput']} tasks/s, "
         f"3 replicas {three['throughput']} tasks/s")

@@ -82,6 +82,11 @@ class Scratch:
             ["bash", "-c", script], cwd=self.dir, env=self.env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             start_new_session=True)
+        # remember the process GROUP at spawn time: cleanup must kill
+        # the whole tree even after the bash leader has already exited
+        # (a dead leader with live orphans was observed leaking servers
+        # on the fixed workshop ports, poisoning every later run)
+        p.pgid = os.getpgid(p.pid)
         self.procs.append(p)
         return p
 
@@ -100,25 +105,49 @@ class Scratch:
                 time.sleep(0.1)
         raise AssertionError(f"port {port} never opened")
 
+    @staticmethod
+    def _killpg(pgid: int, sig) -> None:
+        try:
+            os.killpg(pgid, sig)
+        except ProcessLookupError:
+            pass
+
     def stop_proc(self, p: subprocess.Popen, sig=signal.SIGTERM) -> None:
-        if p.poll() is None:
-            try:
-                os.killpg(os.getpgid(p.pid), sig)
-            except ProcessLookupError:
-                pass
+        # signal the GROUP unconditionally: children may outlive the
+        # bash leader, so p.poll() saying the leader exited proves
+        # nothing about the tree
+        self._killpg(p.pgid, sig)
         try:
             p.wait(timeout=10)
         except subprocess.TimeoutExpired:
-            os.killpg(os.getpgid(p.pid), signal.SIGKILL)
+            pass
+        self._killpg(p.pgid, signal.SIGKILL)
+        try:
             p.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
 
     def close(self) -> None:
         for p in self.procs:
             self.stop_proc(p, signal.SIGKILL)
 
 
+WORKSHOP_PORTS = (5103, 5189, 5217, 3500, 3501, 3502)
+
+
 @pytest.fixture
 def scratch(tmp_path):
+    # fail LOUDLY if a stale server holds the workshop's fixed ports —
+    # silently probing someone else's process produces nonsense
+    # assertions (a store-backed API answering the fake-mode test)
+    for port in WORKSHOP_PORTS:
+        try:
+            with socket.create_connection(("127.0.0.1", port), 0.2):
+                pytest.fail(
+                    f"port {port} already in use — a stale tasksrunner "
+                    f"process is running; kill it before this suite")
+        except OSError:
+            pass
     s = Scratch(tmp_path)
     yield s
     s.close()

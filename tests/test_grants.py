@@ -223,10 +223,14 @@ async def test_peer_token_unlocks_invoke_only(tmp_path, monkeypatch):
 
     from tasksrunner.hosting import AppHost
 
+    from tasksrunner.security import hash_token
+
     api_token, frontend_token = "tok-api-1", "tok-frontend-2"
+    # the distributed map carries sha256 digests, never plaintext —
+    # holding the map must not let an app impersonate its peers
     tokens_file = tmp_path / "tokens.json"
     tokens_file.write_text(json.dumps(
-        {API: api_token, FRONTEND: frontend_token}))
+        {API: hash_token(api_token), FRONTEND: hash_token(frontend_token)}))
     monkeypatch.setenv("TASKSRUNNER_TOKENS_FILE", str(tokens_file))
     monkeypatch.setenv("TASKSRUNNER_API_TOKEN", api_token)
 
@@ -322,7 +326,49 @@ def test_orchestrator_issues_per_app_tokens(tmp_path):
     orch._issue_app_tokens()
     assert set(config.app_tokens) == {"a", "b"}
     assert config.app_tokens["a"] != config.app_tokens["b"]
+    # the file on disk carries sha256 digests, never the plaintext
+    # tokens: any replica can VERIFY a peer, none can IMPERSONATE one
+    from tasksrunner.security import hash_token
     written = json.loads(pathlib.Path(config.tokens_file).read_text())
-    assert written == config.app_tokens
+    assert written == {
+        app_id: hash_token(tok) for app_id, tok in config.app_tokens.items()}
+    for plaintext in config.app_tokens.values():
+        assert plaintext not in pathlib.Path(config.tokens_file).read_text()
     mode = pathlib.Path(config.tokens_file).stat().st_mode & 0o777
     assert mode == 0o600
+
+
+@pytest.mark.asyncio
+async def test_stats_probe_is_token_gated(tmp_path, monkeypatch):
+    """GET /tasksrunner/stats on the app ingress port must require the
+    app's API token when one is configured — an ingress:external app
+    must not leak load numbers to unauthenticated callers. The
+    orchestrator's http-concurrency scaler authenticates like any
+    client (autoscale._read_inflight sends the token)."""
+    import aiohttp
+
+    from tasksrunner.hosting import AppHost
+    from tasksrunner.orchestrator.autoscale import _read_inflight
+
+    monkeypatch.setenv("TASKSRUNNER_API_TOKEN", "stats-tok")
+    app = App(API)
+    host = AppHost(app, specs=specs(tmp_path), register=False)
+    await host.start()
+    try:
+        url = f"http://127.0.0.1:{host.app_port}/tasksrunner/stats"
+        async with aiohttp.ClientSession() as session:
+            async with session.get(url) as resp:
+                assert resp.status == 401
+            async with session.get(
+                    url, headers={"tr-api-token": "stats-tok"}) as resp:
+                assert resp.status == 200
+                doc = await resp.json()
+                assert "inflight" in doc
+        replicas = [{"pid": 1, "app_port": host.app_port,
+                     "host": "127.0.0.1"}]
+        # the scaler's reader: 0 without the token (401 → counts 0),
+        # real number with it
+        assert _read_inflight(replicas) == 0
+        assert _read_inflight(replicas, api_token="stats-tok") == 0  # idle
+    finally:
+        await host.stop()

@@ -593,3 +593,87 @@ async def test_cpu_and_memory_rules_measure_real_processes(tmp_path):
     while time.monotonic() - t0 < 0.05:
         sum(i * i for i in range(1000))
     assert scaler._rule_desired(app.scale.rules[0]) >= 0
+
+
+@pytest.mark.asyncio
+async def test_memory_rule_is_stable_for_both_memory_shapes(tmp_path, monkeypatch):
+    """The composite memory formula must neither ratchet (fixed
+    per-replica baseline above the budget must not ask for ever more
+    replicas) nor flip-flop (load-proportional memory must not argue
+    for scale-in the moment scale-out has halved the mean)."""
+    from tasksrunner.orchestrator import autoscale
+    from tasksrunner.orchestrator.config import ScaleSpec, ScaleRule
+
+    rss_by_pid = {}
+    monkeypatch.setattr(autoscale, "_read_proc_rss_mb",
+                        lambda pid: rss_by_pid[pid])
+
+    def fleet(*pids):
+        return [{"pid": p, "app_port": None, "host": "127.0.0.1"}
+                for p in pids]
+
+    app = AppSpec(
+        app_id="w", module="x:y",
+        scale=ScaleSpec(min_replicas=1, max_replicas=50, rules=[
+            ScaleRule(type="memory", metadata={"megabytes": "512"}),
+        ]))
+    replicas = fleet(1)
+    scaler = AutoscaleController(app, [], lambda n: None,
+                                 base_dir=tmp_path,
+                                 replica_info=lambda: replicas)
+    rule = app.scale.rules[0]
+
+    # fixed baseline OVER budget (misconfigured threshold): one step
+    # out is allowed, then stable — never a ratchet toward max
+    rss_by_pid.update({1: 600.0, 2: 600.0, 3: 600.0})
+    assert scaler._rule_desired(rule) == 2
+    replicas = fleet(1, 2)
+    assert scaler._rule_desired(rule) == 2   # stable at 2
+    replicas = fleet(1, 2, 3)
+    assert scaler._rule_desired(rule) == 3   # never ABOVE current count
+
+    # load-proportional memory: 900 MB of working set on one replica
+    # scales out to two; the halved per-replica mean must NOT argue
+    # for scale-in while the total footprint still needs two replicas
+    rss_by_pid.update({1: 900.0})
+    replicas = fleet(1)
+    assert scaler._rule_desired(rule) == 2
+    rss_by_pid.update({1: 450.0, 2: 450.0})
+    replicas = fleet(1, 2)
+    assert scaler._rule_desired(rule) == 2   # stable, no flip-flop
+    # load actually drops -> scale-in follows
+    rss_by_pid.update({1: 100.0, 2: 100.0})
+    assert scaler._rule_desired(rule) == 1
+
+
+@pytest.mark.asyncio
+async def test_memory_rule_does_not_ratchet_with_replica_count(tmp_path):
+    """Memory scaling reads the per-replica AVERAGE, not the sum: a
+    fleet where every replica sits at the same baseline RSS must want
+    the same replica count whether one or three replicas are running —
+    otherwise each added replica feeds the signal and a threshold below
+    the baseline ratchets to max_replicas and never scales in."""
+    import os
+
+    from tasksrunner.orchestrator.config import ScaleSpec, ScaleRule
+
+    def fleet_of(n):
+        return [{"pid": os.getpid(), "app_port": None, "host": "127.0.0.1"}
+                for _ in range(n)]
+
+    app = AppSpec(
+        app_id="w", module="x:y",
+        scale=ScaleSpec(min_replicas=1, max_replicas=50, rules=[
+            ScaleRule(type="memory", metadata={"megabytes": "1"}),
+        ]))
+    replicas = fleet_of(1)
+    scaler = AutoscaleController(app, [], lambda n: None,
+                                 base_dir=tmp_path,
+                                 replica_info=lambda: replicas)
+    desired_one = scaler.desired_replicas()
+    assert desired_one >= 2  # this process holds far more than 1 MB
+
+    replicas = fleet_of(3)
+    assert scaler.desired_replicas() == desired_one, (
+        "same per-replica RSS must not ask for more replicas "
+        "just because more replicas exist")

@@ -205,8 +205,12 @@ async def test_frontend_serves_asset_tree():
     from samples.tasks_tracker.frontend_ui.app import make_app
 
     app = make_app()
-    resp = await app.handle("GET", "/static/site.css")
-    assert resp.status == 200
+    # the wwwroot tree (css/ + js/, ≙ the reference's wwwroot layout)
+    for path in ("/static/css/site.css", "/static/js/site.js",
+                 "/static/js/validation.js"):
+        resp = await app.handle("GET", path)
+        assert resp.status == 200, path
     resp = await app.handle("GET", "/")
     _, _, body = resp.encode()
-    assert b'href="/static/site.css"' in body
+    assert b'href="/static/css/site.css"' in body
+    assert b'src="/static/js/validation.js"' in body

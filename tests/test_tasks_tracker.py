@@ -375,3 +375,107 @@ async def test_frontend_per_field_validation(isolated_cwd):
         assert [t["taskName"] for t in tasks] == ["Valid"]
     finally:
         await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_shared_layout_and_asset_tree(isolated_cwd):
+    """VERDICT r2 item 5: the three pages render through ONE layout
+    (header/nav/footer + stylesheet + script includes, ≙
+    Pages/Shared/_Layout.cshtml:1-52) and every referenced asset
+    resolves from the wwwroot tree (≙ wwwroot/ css+js)."""
+    import re as _re
+
+    cluster, api, frontend, processor = build_cluster()
+    await cluster.start()
+    try:
+        signin = await frontend.handle("POST", "/", body=b"email=l%40x.com")
+        cookie = {"cookie": cookie_from(signin)}
+        pages = [
+            await frontend.handle("GET", "/", headers=cookie),
+            await frontend.handle("GET", "/tasks", headers=cookie),
+            await frontend.handle("GET", "/tasks/create", headers=cookie),
+        ]
+        asset_refs: set[str] = set()
+        for resp in pages:
+            assert resp.status == 200
+            doc = resp.body
+            # one shared chrome on every page
+            assert '<header class="site">' in doc
+            assert "<nav>" in doc
+            assert '<footer class="site">' in doc
+            asset_refs.update(_re.findall(r'(?:href|src)="(/static/[^"]+)"', doc))
+        assert '/static/css/site.css' in asset_refs
+        assert '/static/js/validation.js' in asset_refs
+        # every asset the layout references actually resolves
+        for ref in sorted(asset_refs):
+            resp = await frontend.handle("GET", ref)
+            assert resp.status == 200, f"{ref} did not resolve"
+    finally:
+        await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_client_validation_messages_mirror_server(isolated_cwd):
+    """The client-side bundle must produce EXACTLY the server's
+    per-field messages (≙ DataAnnotations text mirrored by the
+    unobtrusive bundle) — a drift means users see one message before
+    the round trip and a different one after."""
+    import pathlib as _pathlib
+
+    from samples.tasks_tracker.frontend_ui.app import _validate_task_form
+
+    # isolated_cwd changed cwd; resolve from the repo instead
+    js = _pathlib.Path(__file__).resolve().parent.parent / \
+        "samples/tasks_tracker/frontend_ui/wwwroot/js/validation.js"
+    source = js.read_text()
+    server_errors = _validate_task_form(
+        {"taskName": "", "taskDueDate": "not-a-date",
+         "taskAssignedTo": "not-an-email"})
+    # the three message TEMPLATES the server uses must appear verbatim
+    # in the client bundle (modulo the display-name interpolation)
+    assert '"The " + display + " field is required."' in source
+    assert '"The " + display + " field is not a valid e-mail address."' in source
+    assert '"The " + display + " field must be a valid date."' in source
+    assert server_errors["taskName"] == "The Task Name field is required."
+    assert server_errors["taskAssignedTo"] == \
+        "The Task Assigned To field is not a valid e-mail address."
+    assert server_errors["taskDueDate"] == \
+        "The Task Due Date field must be a valid date."
+
+
+@pytest.mark.asyncio
+async def test_edit_form_surfaces_malformed_stored_date(isolated_cwd):
+    """VERDICT r2 item 7: a malformed STORED due date must surface as
+    a visible field error on the edit form, not render as a silently
+    clipped plausible-looking date (the old value[:10] behavior)."""
+    cluster, api, frontend, processor = build_cluster()
+    await cluster.start()
+    try:
+        signin = await frontend.handle("POST", "/", body=b"email=d%40x.com")
+        cookie = {"cookie": cookie_from(signin)}
+        # plant a task with a corrupt stored date straight in the store
+        await cluster.client(API).save_state("statestore", "bad-date-task", {
+            "taskId": "bad-date-task", "taskName": "corrupt",
+            "taskCreatedBy": "d@x.com", "taskCreatedOn": "2026-07-01T00:00:00",
+            "taskDueDate": "07/29/2026 oops", "taskAssignedTo": "d@x.com",
+            "isCompleted": False, "isOverDue": False,
+        })
+        resp = await frontend.handle("GET", "/tasks/edit/bad-date-task",
+                                     headers=cookie)
+        assert resp.status == 400  # invalid state renders, flagged
+        assert "is not a valid date" in resp.body
+        assert "07/29/2026 oops" in resp.body  # named, not hidden
+        # and the good path still round-trips: a valid stored datetime
+        # renders as the input's YYYY-MM-DD
+        await cluster.client(API).save_state("statestore", "good-date-task", {
+            "taskId": "good-date-task", "taskName": "fine",
+            "taskCreatedBy": "d@x.com", "taskCreatedOn": "2026-07-01T00:00:00",
+            "taskDueDate": "2026-08-15T00:00:00", "taskAssignedTo": "d@x.com",
+            "isCompleted": False, "isOverDue": False,
+        })
+        resp = await frontend.handle("GET", "/tasks/edit/good-date-task",
+                                     headers=cookie)
+        assert resp.status == 200
+        assert 'value="2026-08-15"' in resp.body
+    finally:
+        await cluster.stop()
