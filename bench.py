@@ -5,18 +5,22 @@ benchmarks directory, no throughput/latency numbers; `"published": {}`),
 so there is no reference number to beat — ``vs_baseline`` is null.
 
 The HEADLINE metric measures the framework's canonical write path in
-the **faithful cross-process topology** — every hop the reference marks
-[PB] (SURVEY.md §3.1; docs/aca/03-aca-dapr-integration/index.md:107-127)
-is a real localhost HTTP hop between separate OS processes:
+the **faithful cross-process topology**: three OS processes, with
+every PROCESS-BOUNDARY hop a real localhost transport. Since round 3,
+app and sidecar inside one process dispatch directly (AppHost fuses
+them — profiling showed 4 of 5 aiohttp round trips per request never
+left a process; see BASELINE.md "where the time goes"); the [PB]
+boundaries of SURVEY.md §3.1 — peer-to-peer invocation and the broker
+— remain real:
 
-    driver (≙ browser)
-      → frontend sidecar            [PB: client → sidecar HTTP]
-      → api sidecar                 [PB: sidecar → peer sidecar HTTP]
-      → api app process             [PB: sidecar → app HTTP]
-      → api sidecar (state write)   [PB: app → own sidecar HTTP] → sqlite
-      → api sidecar (publish)       [PB] → durable sqlite broker
+    driver proc (≙ browser + frontend sidecar, fused)
+      → api sidecar                 [PB: peer-sidecar localhost HTTP]
+        → api app (direct dispatch, same process)
+          → state write → durable sqlite
+          → publish → durable sqlite broker file      [PB: shared file]
       ~ async ~
-      broker → processor sidecar → processor app process  [PB]
+      broker → processor proc (sidecar+app, fused)    [PB: competing
+                                                       consumer claim]
 
 Each unit of work exercises invocation, state, pub/sub, and competing-
 consumer delivery — the whole runtime in its production process model,

@@ -1,0 +1,46 @@
+"""The shared boolean-env-knob parser (tasksrunner/envflag.py): every
+toggle (TASKSRUNNER_ACCESS_LOG, TASKSRUNNER_FLASH,
+TASKSRUNNER_PERF_TESTS) must accept the same spellings."""
+
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tasksrunner.envflag import env_flag
+
+
+@pytest.mark.parametrize("raw", ["0", "false", "off", "no", "OFF", " False "])
+def test_disable_spellings(monkeypatch, raw):
+    monkeypatch.setenv("X_FLAG", raw)
+    assert env_flag("X_FLAG") is False
+
+
+@pytest.mark.parametrize("raw", ["1", "true", "on", "yes", "anything"])
+def test_enable_spellings(monkeypatch, raw):
+    monkeypatch.setenv("X_FLAG", raw)
+    assert env_flag("X_FLAG") is True
+
+
+def test_unset_uses_default(monkeypatch):
+    monkeypatch.delenv("X_FLAG", raising=False)
+    assert env_flag("X_FLAG") is True
+    assert env_flag("X_FLAG", default=False) is False
+
+
+def test_consumers_share_the_parser(monkeypatch):
+    """The knob consumers must all flip with one spelling — a
+    per-call-site tuple would drift."""
+    from tasksrunner.hosting import _access_log
+    from tasksrunner.ml.platform import pin_cpu_platform  # noqa: F401
+
+    monkeypatch.setenv("TASKSRUNNER_ACCESS_LOG", "off")
+    assert _access_log() is None
+    monkeypatch.setenv("TASKSRUNNER_ACCESS_LOG", "on")
+    assert _access_log() is not None
+
+    from tasksrunner.runtime import _delivery_logs
+    monkeypatch.setenv("TASKSRUNNER_ACCESS_LOG", "no")
+    assert _delivery_logs() is False
