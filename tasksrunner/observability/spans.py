@@ -262,20 +262,22 @@ def service_map(path: str) -> list[dict]:
     """
     conn = _connect_ro(path)
     try:
+        # one edge per (caller, kind, TARGET): span names embed the
+        # method path, so grouping by name would print the same App-Map
+        # edge once per distinct operation; extracting the target in
+        # SQL also keeps the grouping deterministic when attrs vary
+        # within one name
         rows = conn.execute(
-            "SELECT role, kind, name, attrs, COUNT(*) AS n, "
-            "AVG(duration) AS avg_duration "
+            "SELECT role, kind, "
+            "COALESCE(json_extract(attrs, '$.target'), name) AS target, "
+            "COUNT(*) AS n, AVG(duration) AS avg_duration "
             "FROM spans WHERE kind IN ('client', 'producer') "
-            "GROUP BY role, kind, name ORDER BY n DESC",
+            "GROUP BY role, kind, target ORDER BY n DESC",
         ).fetchall()
-        edges = []
-        for r in rows:
-            attrs = json.loads(r["attrs"]) if r["attrs"] else {}
-            target = attrs.get("target") or r["name"]
-            edges.append({
-                "from": r["role"], "to": target, "kind": r["kind"],
-                "calls": r["n"], "avg_ms": round(r["avg_duration"] * 1000, 2),
-            })
-        return edges
+        return [
+            {"from": r["role"], "to": r["target"], "kind": r["kind"],
+             "calls": r["n"], "avg_ms": round(r["avg_duration"] * 1000, 2)}
+            for r in rows
+        ]
     finally:
         conn.close()

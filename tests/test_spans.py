@@ -133,3 +133,35 @@ def test_span_retention_prunes_old_spans(tmp_path, monkeypatch):
     names = [r[0] for r in sqlite3.connect(db).execute(
         "SELECT name FROM spans").fetchall()]
     assert names == ["new"]
+
+
+def test_service_map_aggregates_per_edge_not_per_operation(tmp_path):
+    """Two different operations against the same target are ONE
+    App-Map edge: span names embed the method path, so grouping by
+    name alone would print `api --client--> api` once per distinct
+    route (observed with 3 duplicate rows in `tasksrunner traces map`)."""
+    from tasksrunner.observability.tracing import ensure_trace, trace_scope
+
+    trace_db = str(tmp_path / "spans.db")
+    rec = spans_mod.configure_spans("frontend", trace_db)
+    try:
+        import time as _time
+
+        with trace_scope(ensure_trace(None)):
+            for name in ("invoke api/api/tasks", "invoke api/api/overduetasks",
+                         "invoke api/api/tasks"):
+                # start must be recent: the flush-time retention sweep
+                # prunes old-epoch spans
+                rec.record(kind="client", name=name, status=200,
+                           start=_time.time(), duration=0.01,
+                           attrs={"target": "api"})
+        rec.flush()
+        edges = spans_mod.service_map(trace_db)
+        client_edges = [e for e in edges if e["kind"] == "client"]
+        assert len(client_edges) == 1
+        assert client_edges[0]["from"] == "frontend"
+        assert client_edges[0]["to"] == "api"
+        assert client_edges[0]["calls"] == 3
+    finally:
+        rec.close()
+        spans_mod._recorder = None
