@@ -1,0 +1,83 @@
+"""Module-5-era processor: the notifier BEFORE the bindings refactor.
+
+≙ the reference's per-module code snapshot
+`docs/aca/05-aca-dapr-pubsubapi/TasksNotifierController-SendGrid.cs:41-59`
+— the version that talks to the email provider DIRECTLY: a provider
+client object constructed in app code, credentials pulled from app
+config, provider types inside the business logic. Module 6 replaces
+all of it with ``invoke_binding("sendgrid", "create", ...)``
+(`samples/tasks_tracker/processor/app.py`); this file preserves the
+"before" state as a complete, runnable app so the evolution is
+diffable:
+
+    diff docs/modules/snippets/notifier_direct_email.py \\
+         samples/tasks_tracker/processor/app.py
+
+Unlike the reference's snapshots (which only compile as part of the
+docs build), this one stays IMPORTABLE and smoke-tested
+(tests/test_tasks_tracker.py) so the teaching artifact cannot rot.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import smtplib
+from email.mime.text import MIMEText
+
+from tasksrunner import App
+
+logger = logging.getLogger(__name__)
+
+APP_ID = "tasksmanager-backend-processor"
+CLOUD_PUBSUB = "dapr-pubsub-servicebus"
+LOCAL_PUBSUB = "taskspubsub"
+TOPIC = "tasksavedtopic"
+
+
+class DirectEmailClient:
+    """The provider SDK living inside the app — exactly what module 6
+    deletes. Provider address and credentials come from app config
+    (≙ the SendGrid API key in appsettings), not from a component."""
+
+    def __init__(self) -> None:
+        self.host = os.environ.get("SMTP_HOST", "127.0.0.1")
+        self.port = int(os.environ.get("SMTP_PORT", "25"))
+        self.api_key = os.environ.get("SENDGRID_API_KEY", "")
+
+    def send(self, *, to: str, subject: str, html: str) -> None:
+        msg = MIMEText(html, "html")
+        msg["From"] = "noreply@tasksrunner.local"
+        msg["To"] = to
+        msg["Subject"] = subject
+        with smtplib.SMTP(self.host, self.port, timeout=10) as smtp:
+            smtp.send_message(msg)
+
+
+def make_app(email_client: DirectEmailClient | None = None) -> App:
+    app = App(APP_ID)
+    client = email_client or DirectEmailClient()
+    app.state["notified"] = []
+
+    async def _task_saved(req):
+        task = req.data or {}
+        logger.info("Started processing message with task name '%s'",
+                    task.get("taskName"))
+        app.state["notified"].append(task)
+        assignee = task.get("taskAssignedTo", "")
+        if assignee:
+            # the provider call the module-6 refactor moves behind a
+            # component name: synchronous SDK, provider wire format,
+            # and failure modes all owned by the app
+            client.send(
+                to=assignee,
+                subject="Tasks assigned to you",
+                html=f"<p>Task <b>{task.get('taskName', '')}</b> "
+                     f"is assigned to you.</p>")
+        return 200
+
+    app.subscribe(CLOUD_PUBSUB, TOPIC,
+                  route="/api/tasksnotifier/tasksaved")(_task_saved)
+    app.subscribe(LOCAL_PUBSUB, TOPIC,
+                  route="/api/tasksnotifier/tasksaved")(_task_saved)
+    return app

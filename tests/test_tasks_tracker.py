@@ -479,3 +479,45 @@ async def test_edit_form_surfaces_malformed_stored_date(isolated_cwd):
         assert 'value="2026-08-15"' in resp.body
     finally:
         await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_module5_code_snapshot_stays_runnable(isolated_cwd):
+    """The docs' per-module code snapshot (the direct-SDK notifier the
+    module-6 refactor replaces, ≙ the reference's
+    TasksNotifierController-SendGrid.cs teaching copy) must stay
+    importable and functional — a snapshot that rots teaches a bug."""
+    import importlib.util
+    import pathlib as _pathlib
+
+    snippet = _pathlib.Path(__file__).resolve().parent.parent / \
+        "docs/modules/snippets/notifier_direct_email.py"
+    spec = importlib.util.spec_from_file_location("notifier_direct", snippet)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    sent = []
+
+    class FakeClient:
+        def send(self, *, to, subject, html):
+            sent.append((to, subject, html))
+
+    specs = load_components(COMPONENTS_DIR)
+    cluster = InProcCluster(specs)
+    api = make_api("store")
+    old_processor = mod.make_app(email_client=FakeClient())
+    cluster.add_app(api)
+    cluster.add_app(old_processor)
+    await cluster.start()
+    try:
+        await cluster.client(API).invoke_method(
+            API, "api/tasks", http_method="POST",
+            data={"taskName": "era-5 task", "taskCreatedBy": "s@x.com",
+                  "taskDueDate": "2026-12-01T00:00:00",
+                  "taskAssignedTo": "dev@x.com"})
+        await wait_until(lambda: len(sent) == 1)
+        to, subject, html = sent[0]
+        assert to == "dev@x.com"
+        assert "era-5 task" in html
+    finally:
+        await cluster.stop()
