@@ -12,7 +12,9 @@ Covered end-to-end: module 1 (host + both front doors + CRUD + the
 decoupled two-process layout), module 4 (store swap, durability across
 restart, queries, etag 409, transactions, raw probes), module 5
 (orchestrator, invoke → broker → processor delivery, metrics, raw
-publish).
+publish), module 6 (external-queue ingest chain: input binding →
+invoke → blob archive → email outbox, every hop in metrics), and
+module 7 (overdue task → manual cron fire → isOverDue flip).
 
 Mechanics: commands run with the scratch dir as cwd (so `.tasksrunner/`
 state lands there) with `samples/` and `run.yaml` reachable, exactly as
@@ -135,19 +137,31 @@ class Scratch:
 WORKSHOP_PORTS = (5103, 5189, 5217, 3500, 3501, 3502)
 
 
+def _port_open(port: int) -> bool:
+    try:
+        with socket.create_connection(("127.0.0.1", port), 0.2):
+            return True
+    except OSError:
+        return False
+
+
 @pytest.fixture
 def scratch(tmp_path):
     # fail LOUDLY if a stale server holds the workshop's fixed ports —
     # silently probing someone else's process produces nonsense
-    # assertions (a store-backed API answering the fake-mode test)
+    # assertions (a store-backed API answering the fake-mode test).
+    # Give the PREVIOUS test's just-killed tree a few seconds to vanish
+    # first: between back-to-back tests the kernel may still be tearing
+    # a listener down.
     for port in WORKSHOP_PORTS:
-        try:
-            with socket.create_connection(("127.0.0.1", port), 0.2):
+        deadline = time.monotonic() + 5.0
+        while _port_open(port):
+            if time.monotonic() > deadline:
                 pytest.fail(
-                    f"port {port} already in use — a stale tasksrunner "
-                    f"process is running; kill it before this suite")
-        except OSError:
-            pass
+                    f"port {port} still in use after 5s — a stale "
+                    f"tasksrunner process is running; kill it before "
+                    f"this suite")
+            time.sleep(0.2)
     s = Scratch(tmp_path)
     yield s
     s.close()
@@ -296,5 +310,94 @@ def test_module_05_pubsub(scratch):
             break
         assert time.monotonic() < deadline, "raw-published event never delivered"
         time.sleep(0.5)
+
+    scratch.stop_proc(orch)
+
+
+def _boot_topology(scratch):
+    """Module 5's one-command topology, reused by modules 6-7 ('leave
+    the orchestrator running — module 6 continues on this topology')."""
+    blocks = bash_blocks("05-pubsub.md")
+    orch = scratch.spawn(block_with(blocks, "tasksrunner run run.yaml"))
+    for port in (5103, 5189, 5217, 3500, 3502):
+        scratch.wait_port(port)
+    deadline = time.monotonic() + 30
+    while True:
+        ps = scratch.run(block_with(blocks, "tasksrunner ps"), check=False)
+        if ps.count("ok") >= 3:
+            return orch
+        assert time.monotonic() < deadline, f"apps never healthy:\n{ps}"
+        time.sleep(0.5)
+
+
+def _poll_logs(scratch, logs_cmd, needle, timeout=20):
+    deadline = time.monotonic() + timeout
+    while True:
+        logs = scratch.run(logs_cmd, check=False)
+        if needle in logs:
+            return logs
+        assert time.monotonic() < deadline, \
+            f"{needle!r} never appeared in:\n{logs}"
+        time.sleep(0.5)
+
+
+def test_module_06_bindings(scratch):
+    blocks = bash_blocks("06-bindings.md")
+    orch = _boot_topology(scratch)
+
+    # §3.1 drop a message in as an external system would
+    out = scratch.run(block_with(blocks, "SqliteQueue"))
+    assert "sent" in out
+
+    # §3.2 the chain executes under one trace, visible in the logs
+    logs_cmd = block_with(blocks, "tasksrunner logs tasksmanager-backend-processor")
+    _poll_logs(scratch, logs_cmd,
+               "Started processing message with task name 'Pay electricity bill'")
+    _poll_logs(scratch, logs_cmd, 'pubsub delivery "POST /api/tasksnotifier/tasksaved" 200')
+
+    # §3.3 the blob archive holds the payload under the stored id
+    blob = scratch.run(block_with(blocks, "externaltaskscontainer"))
+    assert '"taskName": "Pay electricity bill"' in blob
+
+    # §3.4 every hop counted in metrics
+    metrics = scratch.run(block_with(blocks, "tasksrunner metrics"))
+    for needle in ("binding_delivery{binding=externaltasksmanager,status=200}",
+                   "binding_invoke{binding=externaltasksblobstore,operation=create}",
+                   "binding_invoke{binding=sendgrid,operation=create}",
+                   "pubsub_delivery{route=/api/tasksnotifier/tasksaved,status=200}"):
+        assert needle in metrics, metrics
+
+    # §1.3 the outbox holds the notification email
+    outbox = scratch.run(block_with(blocks, ".tasksrunner/outbox"))
+    assert '"subject": "Tasks assigned to you"' in outbox
+    assert '"to": "ops@mail.com"' in outbox
+
+    scratch.stop_proc(orch)
+
+
+def test_module_07_cron(scratch):
+    blocks = bash_blocks("07-cron.md")
+    orch = _boot_topology(scratch)
+
+    # §3.1 create a task due yesterday (the doc computes Y itself)
+    created = scratch.run(block_with(blocks, "date -d yesterday"))
+    assert "taskId" in created
+
+    # §3.2 fire the job route exactly as the runtime would
+    fired = scratch.run(block_with(blocks, "method/ScheduledTasksManager"))
+    assert "HTTP 200" in fired
+
+    # §3.3 the flip is visible through the API...
+    deadline = time.monotonic() + 10
+    while True:
+        listed = scratch.run(block_with(blocks, "api/tasks?createdBy=me@mail.com"))
+        if '"isOverDue": true' in listed:
+            break
+        assert time.monotonic() < deadline, listed
+        time.sleep(0.5)
+    # ...and the job's own log lines confirm the 3-step flow
+    logs = scratch.run(block_with(blocks, "tasksrunner logs tasksmanager-backend-processor"))
+    assert "ScheduledTasksManager executed at" in logs
+    assert "Marking 1 tasks overdue" in logs
 
     scratch.stop_proc(orch)
