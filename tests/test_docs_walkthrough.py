@@ -13,8 +13,10 @@ decoupled two-process layout), module 4 (store swap, durability across
 restart, queries, etag 409, transactions, raw probes), module 5
 (orchestrator, invoke → broker → processor delivery, metrics, raw
 publish), module 6 (external-queue ingest chain: input binding →
-invoke → blob archive → email outbox, every hop in metrics), and
-module 7 (overdue task → manual cron fire → isOverDue flip).
+invoke → blob archive → email outbox, every hop in metrics), module 7
+(overdue task → manual cron fire → isOverDue flip), and module 14
+(revisions from env updates, rolling restart, and the staged DLQ
+incident: poison → dead-letter → diagnose → purge).
 
 Mechanics: commands run with the scratch dir as cwd (so `.tasksrunner/`
 state lands there) with `samples/` and `run.yaml` reachable, exactly as
@@ -399,5 +401,55 @@ def test_module_07_cron(scratch):
     logs = scratch.run(block_with(blocks, "tasksrunner logs tasksmanager-backend-processor"))
     assert "ScheduledTasksManager executed at" in logs
     assert "Marking 1 tasks overdue" in logs
+
+    scratch.stop_proc(orch)
+
+
+def test_module_14_operations(scratch):
+    """The operations drill: revisions from env updates, live scale
+    bounds, and the full staged DLQ incident (poison → dead-letter →
+    diagnose → purge) — each command straight from the doc."""
+    blocks = bash_blocks("14-operations.md")
+    orch = _boot_topology(scratch)
+
+    # ps (the doc's replica-status block)
+    ps = scratch.run(block_with(blocks, "tasksrunner ps"))
+    assert ps.count("ok") >= 3
+
+    # env change → revision 2; scale bounds; history lists both
+    rev_block = block_with(blocks, "--set-env LOG_LEVEL=debug")
+    out = scratch.run(rev_block, timeout=120)
+    assert "revision 2" in out
+    history = out  # the block ends with `revisions`
+    assert re.search(r"\b1\b.*initial deploy", history)
+    assert "env update" in history
+
+    # rolling restart, not a crash
+    out = scratch.run(block_with(blocks, "tasksrunner restart"))
+    assert "restarted tasksmanager-backend-api" in out
+
+    # stage the DLQ incident exactly as the doc does
+    poison = scratch.run(block_with(blocks, '"poison-1"'))
+    assert "messageId" in poison
+
+    dlq_list = block_with(blocks, "dlq list")
+    deadline = time.monotonic() + 30
+    while True:
+        parked = scratch.run(dlq_list)
+        m = re.search(r"^([0-9a-f]{32})\s+\d", parked, re.M)
+        if m:
+            msg_id = m.group(1)
+            break
+        assert time.monotonic() < deadline, parked
+        time.sleep(0.5)
+
+    shown = scratch.run(block_with(blocks, "dlq show"))
+    assert '"taskName": "malformed event' in shown
+
+    purge = block_with(blocks, "dlq purge").replace(
+        "84b02210b8599299f3c5c4d946a9aeef", msg_id)
+    out = scratch.run(purge)
+    assert "purged 1 message(s)" in out
+    assert "no dead letters" in scratch.run(dlq_list)
 
     scratch.stop_proc(orch)
