@@ -14,7 +14,9 @@ restart, queries, etag 409, transactions, raw probes), module 5
 (orchestrator, invoke → broker → processor delivery, metrics, raw
 publish), module 6 (external-queue ingest chain: input binding →
 invoke → blob archive → email outbox, every hop in metrics), module 7
-(overdue task → manual cron fire → isOverDue flip), and module 14
+(overdue task → manual cron fire → isOverDue flip), module 13 (the
+staged outage: concurrent burst trips the breaker, millisecond
+fast-fails while open, automatic recovery closing it), and module 14
 (revisions from env updates, rolling restart, and the staged DLQ
 incident: poison → dead-letter → diagnose → purge).
 
@@ -451,5 +453,70 @@ def test_module_14_operations(scratch):
     out = scratch.run(purge)
     assert "purged 1 message(s)" in out
     assert "no dead letters" in scratch.run(dlq_list)
+
+    scratch.stop_proc(orch)
+
+
+def test_module_13_resiliency_episode(scratch):
+    """The staged outage: kill the API mid-flight, watch retries give
+    way to the open circuit's fast-fails, then automatic recovery on
+    both sides — latencies and log lines as the doc promises."""
+    blocks = bash_blocks("13-resiliency.md")
+    orch = _boot_topology(scratch)
+
+    # the doc's curls assume a signed-in session (cookies.txt from the
+    # earlier modules); establish it the way the reader did
+    scratch.run("curl -s -c cookies.txt -X POST http://127.0.0.1:5189/ "
+                "-d 'email=resil@x.com' -o /dev/null")
+
+    # §1 note the API's pid, then a crash (not a clean stop). The doc's
+    # block contains both the ps and the kill with the <api-pid>
+    # placeholder the reader fills — fill it the same way first.
+    ps = scratch.run("python -m tasksrunner ps")
+    api_pid = re.search(r"tasksmanager-backend-api\s+(\d+)", ps).group(1)
+    kill_block = block_with(blocks, "kill -9").replace("<api-pid>", api_pid)
+    scratch.run(kill_block)
+
+    # §2 a concurrent burst trips the shared breaker: everyone 503s
+    # fast instead of burning a full retry budget
+    burst = block_with(blocks, "seq 1 8")
+    out = scratch.run(burst, check=False, timeout=60)
+    codes = re.findall(r"burst: (\d{3}) in ([0-9.]+)s", out)
+    assert len(codes) == 8, out
+    assert all(c == "503" for c, _ in codes), out
+    assert all(float(t) < 2.0 for _, t in codes), out
+
+    # while open, a sequential probe fast-fails in milliseconds
+    probe = block_with(blocks, '"open: %{http_code}')
+    saw_fast_fail = False
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not saw_fast_fail:
+        m = re.search(r"open: (\d{3}) in ([0-9.]+)s",
+                      scratch.run(probe, check=False, timeout=15))
+        if m and m.group(1) == "503" and float(m.group(2)) < 0.05:
+            saw_fast_fail = True
+    assert saw_fast_fail, "circuit never produced a millisecond fast-fail"
+    logs = scratch.run(
+        "python -m tasksrunner logs tasksmanager-frontend-webapp --tail 60",
+        check=False)
+    assert "circuit api-breaker[tasksmanager-backend-api] OPEN" in logs
+
+    # §3 recovery is automatic on both sides: the orchestrator restarts
+    # the replica, a probe closes the breaker, traffic flows again
+    recovered = block_with(blocks, '"recovered: %{http_code}')
+    deadline = time.monotonic() + 60
+    while True:
+        try:
+            out = scratch.run(recovered, check=False, timeout=15)
+        except subprocess.TimeoutExpired:
+            out = ""
+        if "recovered: 200" in out:
+            break
+        assert time.monotonic() < deadline, f"never recovered: {out}"
+        time.sleep(1)
+    logs = scratch.run(
+        "python -m tasksrunner logs tasksmanager-frontend-webapp --tail 60",
+        check=False)
+    assert "closed" in logs and "half-open" in logs
 
     scratch.stop_proc(orch)
