@@ -34,7 +34,6 @@ def test_consumers_share_the_parser(monkeypatch):
     """The knob consumers must all flip with one spelling — a
     per-call-site tuple would drift."""
     from tasksrunner.hosting import _access_log
-    from tasksrunner.ml.platform import pin_cpu_platform  # noqa: F401
 
     monkeypatch.setenv("TASKSRUNNER_ACCESS_LOG", "off")
     assert _access_log() is None
