@@ -252,6 +252,35 @@ def _cmd_traces(args) -> None:
         edges = service_map(db)
         if not edges:
             print("no client/producer spans recorded")
+            return
+        if getattr(args, "mermaid", False):
+            # paste-ready App-Map diagram (mkdocs-material renders
+            # mermaid fences — the docs' architecture diagrams use the
+            # same notation)
+            ids: dict[str, str] = {}
+
+            def node(name: str) -> str:
+                # sanitized ids can collide ("ps/saved" vs "ps-saved");
+                # keep them unique per distinct NAME so the diagram
+                # never silently merges two services
+                if name not in ids:
+                    base = "n" + "".join(
+                        c if c.isalnum() else "_" for c in name)
+                    ids[name] = (f"{base}_{len(ids)}"
+                                 if base in set(ids.values()) else base)
+                return ids[name]
+
+            def label(text) -> str:
+                # mermaid "..." labels: double quotes break the parser
+                return str(text).replace('"', "#quot;")
+
+            print("graph LR")
+            for e in edges:
+                style = "-.->" if e["kind"] == "producer" else "-->"
+                print(f'  {node(e["from"])}["{label(e["from"])}"] '
+                      f'{style}|"{e["calls"]} calls, avg {e["avg_ms"]} ms"| '
+                      f'{node(e["to"])}["{label(e["to"])}"]')
+            return
         for e in edges:
             print(f"{e['from']:<36} --{e['kind']}--> {e['to']:<42} "
                   f"{e['calls']:>5} calls  avg {e['avg_ms']} ms")
@@ -913,6 +942,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("trace_id", nargs="?", default=None)
     p.add_argument("--db", default=".tasksrunner/traces.db")
     p.add_argument("--limit", type=int, default=20)
+    p.add_argument("--mermaid", action="store_true",
+                   help="emit the service map as a mermaid graph "
+                        "(paste into any mkdocs/mermaid renderer)")
     p.set_defaults(fn=_cmd_traces)
 
     p = sub.add_parser(

@@ -165,3 +165,72 @@ def test_service_map_aggregates_per_edge_not_per_operation(tmp_path):
     finally:
         rec.close()
         spans_mod._recorder = None
+
+
+def test_service_map_mermaid_output(tmp_path, capsys):
+    """`traces map --mermaid` emits a valid mermaid graph: one edge per
+    aggregated (caller, target), dashed arrows for producer edges."""
+    import argparse
+
+    from tasksrunner.cli import _cmd_traces
+    from tasksrunner.observability.tracing import ensure_trace, trace_scope
+    import time as _time
+
+    trace_db = str(tmp_path / "spans.db")
+    rec = spans_mod.configure_spans("frontend", trace_db)
+    try:
+        with trace_scope(ensure_trace(None)):
+            rec.record(kind="client", name="invoke api/api/tasks", status=200,
+                       start=_time.time(), duration=0.01,
+                       attrs={"target": "api"})
+            rec.record(kind="producer", name="publish ps/saved", status=200,
+                       start=_time.time(), duration=0.001)
+        rec.flush()
+        args = argparse.Namespace(action="map", db=trace_db, trace_id=None,
+                                  limit=20, mermaid=True)
+        _cmd_traces(args)
+        out = capsys.readouterr().out
+        assert out.startswith("graph LR")
+        assert '-->|"1 calls' in out           # client edge, solid
+        assert '-.->|"1 calls' in out          # producer edge, dashed
+        assert 'nfrontend["frontend"]' in out
+        assert 'napi["api"]' in out
+    finally:
+        rec.close()
+        spans_mod._recorder = None
+
+
+def test_service_map_mermaid_escapes_and_disambiguates(tmp_path, capsys):
+    """Names differing only in punctuation must stay distinct nodes,
+    and quotes in names must not break the mermaid syntax."""
+    import argparse
+
+    from tasksrunner.cli import _cmd_traces
+    from tasksrunner.observability.tracing import ensure_trace, trace_scope
+    import time as _time
+
+    trace_db = str(tmp_path / "spans.db")
+    rec = spans_mod.configure_spans("caller", trace_db)
+    try:
+        with trace_scope(ensure_trace(None)):
+            for target in ("ps/saved", "ps-saved", 'q="x"'):
+                rec.record(kind="client", name=f"invoke {target}", status=200,
+                           start=_time.time(), duration=0.01,
+                           attrs={"target": target})
+        rec.flush()
+        _cmd_traces(argparse.Namespace(action="map", db=trace_db,
+                                       trace_id=None, limit=20, mermaid=True))
+        out = capsys.readouterr().out
+        # three distinct target nodes despite id sanitization collisions
+        import re as _re
+        target_ids = set()
+        for line in out.splitlines()[1:]:
+            m = _re.search(r"\| (\w+)\[", line)
+            assert m, line
+            target_ids.add(m.group(1))
+        assert len(target_ids) == 3, out
+        # raw double quotes never appear inside a label
+        assert '#quot;' in out and 'q="x"' not in out
+    finally:
+        rec.close()
+        spans_mod._recorder = None
