@@ -18,7 +18,10 @@ invoke → blob archive → email outbox, every hop in metrics), module 7
 staged outage: concurrent burst trips the breaker, millisecond
 fast-fails while open, automatic recovery closing it), and module 14
 (revisions from env updates, rolling restart, and the staged DLQ
-incident: poison → dead-letter → diagnose → purge).
+incident: poison → dead-letter → diagnose → purge), and module 15
+(the secure baseline: fail-closed apply, per-app identities refusing
+even the operator on the data plane, token-gated control plane, and
+the untouched app with its integration gated off).
 
 Mechanics: commands run with the scratch dir as cwd (so `.tasksrunner/`
 state lands there) with `samples/` and `run.yaml` reachable, exactly as
@@ -73,9 +76,11 @@ class Scratch:
         self.env.pop("TASKSRUNNER_API_TOKEN", None)
         self.procs: list[subprocess.Popen] = []
 
-    def run(self, script: str, timeout: float = 60, check: bool = True) -> str:
+    def run(self, script: str, timeout: float = 60, check: bool = True,
+            extra_env: dict | None = None) -> str:
         p = subprocess.run(
-            ["bash", "-c", script], cwd=self.dir, env=self.env,
+            ["bash", "-c", script], cwd=self.dir,
+            env={**self.env, **(extra_env or {})},
             capture_output=True, text=True, timeout=timeout)
         if check:
             assert p.returncode == 0, (
@@ -83,9 +88,11 @@ class Scratch:
                 f"--- stdout\n{p.stdout}\n--- stderr\n{p.stderr}")
         return p.stdout + p.stderr
 
-    def spawn(self, script: str) -> subprocess.Popen:
+    def spawn(self, script: str,
+              extra_env: dict | None = None) -> subprocess.Popen:
         p = subprocess.Popen(
-            ["bash", "-c", script], cwd=self.dir, env=self.env,
+            ["bash", "-c", script], cwd=self.dir,
+            env={**self.env, **(extra_env or {})},
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             start_new_session=True)
         # remember the process GROUP at spawn time: cleanup must kill
@@ -518,5 +525,85 @@ def test_module_13_resiliency_episode(scratch):
         "python -m tasksrunner logs tasksmanager-frontend-webapp --tail 60",
         check=False)
     assert "closed" in logs and "half-open" in logs
+
+    scratch.stop_proc(orch)
+
+
+def test_module_15_production_baseline(scratch):
+    """The secure-baseline drill: fail-closed apply without a token,
+    hardened deploy with one, data plane refusing even the operator,
+    control plane obeying the operator token — each fence pushed with
+    the doc's own commands."""
+    import shutil
+
+    # deploy writes its state beside the manifest; replace the samples
+    # SYMLINK with a real copy so the scratch run cannot touch the repo
+    (scratch.dir / "samples").unlink()
+    shutil.copytree(REPO / "samples", scratch.dir / "samples",
+                    ignore=shutil.ignore_patterns(".tasksrunner"))
+
+    blocks = bash_blocks("15-production-baseline.md")
+    token = {"TASKSRUNNER_API_TOKEN": "walkthrough-prod-tok"}
+
+    # the workshop reaches module 15 with module 11's dev environment
+    # applied — the prod what-if below diffs against that recorded state
+    scratch.run("python -m tasksrunner deploy apply "
+                "samples/tasks_tracker/environment.yaml")
+
+    # §2 fail closed: apply without a token is a hard error
+    out = scratch.run(block_with(blocks, "unset TASKSRUNNER_API_TOKEN"),
+                      check=False)
+    assert "requires an API token" in out
+
+    # §3 deploy with the token: the what-if diff IS the hardening list
+    diff = scratch.run(block_with(blocks, "deploy what-if"), extra_env=token)
+    assert "SENDGRID__INTEGRATIONENABLED" in diff
+    out = scratch.run("python -m tasksrunner deploy apply "
+                      "samples/tasks_tracker/environment.prod.yaml",
+                      extra_env=token)
+    assert "applied" in out
+    orch = scratch.spawn(
+        "python -m tasksrunner run "
+        "samples/tasks_tracker/.tasksrunner/tasks-tracker-prod-run.yaml",
+        extra_env=token)
+    for port in (5103, 5189, 5217):
+        scratch.wait_port(port)
+
+    reg = "samples/tasks_tracker/.tasksrunner/apps.json"
+    ps_cmd = f"python -m tasksrunner ps --registry-file {reg}"
+    deadline = time.monotonic() + 30
+    while True:
+        ps = scratch.run(ps_cmd, check=False, extra_env=token)
+        if ps.count("ok") >= 3:
+            break
+        assert time.monotonic() < deadline, ps
+        time.sleep(0.5)
+    # §4.1 health visible, inventory token-gated (per-app identities)
+    assert "auth" in ps
+
+    # §4.2 the data plane refuses even the operator's token
+    state_probe = block_with(blocks, "state get statestore")
+    out = scratch.run(state_probe, check=False, extra_env=token)
+    assert "401" in out
+
+    # §4.3 control plane obeys exactly the operator token
+    out = scratch.run(block_with(blocks, "tasksrunner restart"),
+                      extra_env=token)
+    assert "restarted tasksmanager-frontend-webapp" in out
+    out = scratch.run(block_with(blocks, "tasksrunner restart"), check=False)
+    assert "401" in out  # tokenless shell refused
+
+    # §4.5 the app itself is untouched: full CRUD through the frontend,
+    # and the prod env gates the email integration off (empty outbox)
+    scratch.run(
+        "curl -s -c c.txt -X POST http://127.0.0.1:5189/ -d email=p@x.com "
+        "-o /dev/null && "
+        "curl -s -b c.txt -X POST http://127.0.0.1:5189/tasks/create "
+        "-d 'taskName=prod-ok&taskAssignedTo=a@b.com&taskDueDate=2026-12-01' "
+        "-o /dev/null")
+    listed = scratch.run("curl -s -b c.txt http://127.0.0.1:5189/tasks")
+    assert "prod-ok" in listed
+    outbox = scratch.dir / ".tasksrunner" / "outbox"
+    assert not outbox.exists() or not any(outbox.iterdir())
 
     scratch.stop_proc(orch)
