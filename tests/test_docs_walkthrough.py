@@ -18,7 +18,10 @@ invoke → blob archive → email outbox, every hop in metrics), module 7
 staged outage: concurrent burst trips the breaker, millisecond
 fast-fails while open, automatic recovery closing it), and module 14
 (revisions from env updates, rolling restart, and the staged DLQ
-incident: poison → dead-letter → diagnose → purge), and module 15
+incident: poison → dead-letter → diagnose → purge), module 11 (the
+four deploy verbs: validate, first-run create, empty diff, the exact
+touched path after an edit, boot from generated artifacts), and
+module 15
 (the secure baseline: fail-closed apply, per-app identities refusing
 even the operator on the data plane, token-gated control plane, and
 the untouched app with its integration gated off).
@@ -606,4 +609,53 @@ def test_module_15_production_baseline(scratch):
     outbox = scratch.dir / ".tasksrunner" / "outbox"
     assert not outbox.exists() or not any(outbox.iterdir())
 
+    scratch.stop_proc(orch)
+
+
+def test_module_11_declarative_deploys(scratch):
+    """The four verbs with the doc's own outputs: validate, the
+    first-run create, apply's artifacts, the empty diff, the exact
+    touched path after an edit, and booting from generated artifacts."""
+    import shutil
+
+    (scratch.dir / "samples").unlink()
+    shutil.copytree(REPO / "samples", scratch.dir / "samples",
+                    ignore=shutil.ignore_patterns(".tasksrunner"))
+    blocks = bash_blocks("11-deploy.md")
+
+    out = scratch.run(block_with(blocks, "deploy validate"))
+    assert "manifest 'tasks-tracker-env' is valid (3 apps, 7 components)" in out
+
+    whatif = block_with(blocks, "deploy what-if")
+    assert "+ tasks-tracker-env" in scratch.run(whatif)   # first run: create
+
+    out = scratch.run(block_with(blocks, "deploy apply"))
+    assert "applied 1 change(s)" in out
+    assert "no changes" in scratch.run(whatif)            # recorded == manifest
+
+    # edit the manifest: what-if names exactly the touched path
+    env_yaml = scratch.dir / "samples/tasks_tracker/environment.yaml"
+    env_yaml.write_text(env_yaml.read_text().replace(
+        "app_port: 5103", "app_port: 5104"))
+    diff = scratch.run(whatif)
+    assert "~ apps.tasksmanager-backend-api.app_port: 5103 -> 5104" in diff
+    env_yaml.write_text(env_yaml.read_text().replace(
+        "app_port: 5104", "app_port: 5103"))
+    assert "no changes" in scratch.run(whatif)
+
+    # boot the environment from the generated artifacts (the doc's
+    # block includes module 10's SENDGRID_API_KEY export — the
+    # cloud-dialect components resolve secretRefs from the env)
+    orch = scratch.spawn(block_with(blocks, "tasks-tracker-env-run.yaml"))
+    for port in (5103, 5189, 5217):
+        scratch.wait_port(port)
+    reg = "samples/tasks_tracker/.tasksrunner/apps.json"
+    deadline = time.monotonic() + 30
+    while True:
+        ps = scratch.run(f"python -m tasksrunner ps --registry-file {reg}",
+                         check=False)
+        if ps.count("ok") >= 3:
+            break
+        assert time.monotonic() < deadline, ps
+        time.sleep(0.5)
     scratch.stop_proc(orch)
