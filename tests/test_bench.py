@@ -39,8 +39,8 @@ async def test_xproc_write_path_throughput_and_latency():
     # measured 1,181 tasks/s; floor at 450 = a 2.6x regression budget
     assert result["throughput"] > 450, (
         f"cross-process write path regressed: {result['throughput']} tasks/s")
-    # measured p99 19 ms at concurrency 8; floor at 60 ms
-    assert result["p99_ms"] < 60, (
+    # measured p99 15-22 ms at concurrency 8 across runs; floor at 45 ms
+    assert result["p99_ms"] < 45, (
         f"write-path p99 regressed: {result['p99_ms']} ms")
 
 

@@ -14,14 +14,13 @@ restart, queries, etag 409, transactions, raw probes), module 5
 (orchestrator, invoke → broker → processor delivery, metrics, raw
 publish), module 6 (external-queue ingest chain: input binding →
 invoke → blob archive → email outbox, every hop in metrics), module 7
-(overdue task → manual cron fire → isOverDue flip), module 13 (the
-staged outage: concurrent burst trips the breaker, millisecond
-fast-fails while open, automatic recovery closing it), and module 14
-(revisions from env updates, rolling restart, and the staged DLQ
-incident: poison → dead-letter → diagnose → purge), module 11 (the
+(overdue task → manual cron fire → isOverDue flip), module 11 (the
 four deploy verbs: validate, first-run create, empty diff, the exact
-touched path after an edit, boot from generated artifacts), and
-module 15
+touched path after an edit, boot from generated artifacts), module 13
+(the staged outage: concurrent burst trips the breaker, millisecond
+fast-fails while open, automatic recovery closing it), module 14
+(revisions from env updates, rolling restart, and the staged DLQ
+incident: poison → dead-letter → diagnose → purge), and module 15
 (the secure baseline: fail-closed apply, per-app identities refusing
 even the operator on the data plane, token-gated control plane, and
 the untouched app with its integration gated off).
