@@ -14,7 +14,9 @@ restart, queries, etag 409, transactions, raw probes), module 5
 (orchestrator, invoke → broker → processor delivery, metrics, raw
 publish), module 6 (external-queue ingest chain: input binding →
 invoke → blob archive → email outbox, every hop in metrics), module 7
-(overdue task → manual cron fire → isOverDue flip), module 11 (the
+(overdue task → manual cron fire → isOverDue flip), module 10 (the
+secret chain: granted reader resolves, ungranted reader refused with
+its missing grant named), module 11 (the
 four deploy verbs: validate, first-run create, empty diff, the exact
 touched path after an edit, boot from generated artifacts), module 13
 (the staged outage: concurrent burst trips the breaker, millisecond
@@ -23,7 +25,8 @@ fast-fails while open, automatic recovery closing it), module 14
 incident: poison → dead-letter → diagnose → purge), and module 15
 (the secure baseline: fail-closed apply, per-app identities refusing
 even the operator on the data plane, token-gated control plane, and
-the untouched app with its integration gated off).
+the untouched app with its integration gated off) — plus module 12's
+daemonless footprint measurement and its >=50% payload-saving claim.
 
 Mechanics: commands run with the scratch dir as cwd (so `.tasksrunner/`
 state lands there) with `samples/` and `run.yaml` reachable, exactly as
@@ -658,3 +661,46 @@ def test_module_11_declarative_deploys(scratch):
         assert time.monotonic() < deadline, ps
         time.sleep(0.5)
     scratch.stop_proc(orch)
+
+
+def test_module_10_secrets(scratch):
+    """The secret chain through the sidecar: the granted reader gets
+    the value, the ungranted reader gets the error naming its missing
+    grant — both straight from the doc's blocks."""
+    blocks = bash_blocks("10-secrets.md")
+
+    orch = scratch.spawn(block_with(blocks, "SENDGRID_API_KEY=sg-local-123"))
+    for port in (5103, 5189, 5217, 3502):
+        scratch.wait_port(port)
+    deadline = time.monotonic() + 30
+    while True:
+        ps = scratch.run("python -m tasksrunner ps", check=False)
+        if ps.count("ok") >= 3:
+            break
+        assert time.monotonic() < deadline, ps
+        time.sleep(0.5)
+
+    # §2 the granted reader resolves the env-backed secret
+    out = scratch.run(block_with(blocks, "tasksmanager-backend-processor"))
+    assert '"sendgrid-api-key": "sg-local-123"' in out
+    # raw curl against the processor's sidecar
+    raw = scratch.run(block_with(blocks, "v1.0/secrets/secretstoreakv"))
+    assert '"sendgrid-api-key": "sg-local-123"' in raw
+
+    # §3 the wrong reader is refused with the grant named
+    out = scratch.run(block_with(blocks, "tasksmanager-frontend-webapp"),
+                      check=False)
+    assert "has no 'read' grant on component 'secretstoreakv'" in out
+
+    scratch.stop_proc(orch)
+
+
+def test_module_12_footprint_measurement(scratch):
+    """The daemonless container measurement prints the breakdown and a
+    payload saving >= 50%, as the module's checkpoint promises."""
+    blocks = bash_blocks("12-optimize-containers.md")
+    out = scratch.run("cd " + str(REPO) + " && " +
+                      block_with(blocks, "measure_footprint"))
+    assert "installed-footprint" in out
+    m = re.search(r"payload saving, default -> optimized: ([0-9.]+)%", out)
+    assert m and float(m.group(1)) >= 50.0, out
