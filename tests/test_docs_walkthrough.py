@@ -105,6 +105,18 @@ class Scratch:
         # (a dead leader with live orphans was observed leaking servers
         # on the fixed workshop ports, poisoning every later run)
         p.pgid = os.getpgid(p.pid)
+        # drain stdout continuously: a chatty topology (orchestrator
+        # multiplexing every replica) would otherwise fill the 64 KB
+        # pipe and BLOCK on its next write, stalling the whole test
+        p.output = []
+
+        def _drain(proc=p):
+            for line in proc.stdout:
+                proc.output.append(line)
+
+        import threading
+        t = threading.Thread(target=_drain, daemon=True)
+        t.start()
         self.procs.append(p)
         return p
 
@@ -117,7 +129,7 @@ class Scratch:
                     return
             except OSError:
                 if proc_dead:
-                    out = self.procs[-1].stdout.read()
+                    out = "".join(self.procs[-1].output[-50:])
                     raise AssertionError(
                         f"server exited before opening :{port}\n{out}")
                 time.sleep(0.1)
