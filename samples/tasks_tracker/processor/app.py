@@ -23,6 +23,7 @@ controllers:
 
 from __future__ import annotations
 
+import asyncio
 import datetime as dt
 import logging
 import os
@@ -53,6 +54,15 @@ def make_app(*, sendgrid_enabled: bool | None = None) -> App:
 
     # -- TasksNotifierController -----------------------------------------
 
+    # ≙ the reference's load-test posture: with the integration off its
+    # controller sleeps 1 s per message ("Introduce artificial delay to
+    # slow down message processing", docs/aca/06-aca-dapr-bindingsapi/
+    # TasksNotifierController.cs:60-63) — that simulated work is what
+    # makes consumers the bottleneck so the module-9 flood has
+    # something to scale. Overridable for fast tests.
+    sim_work_s = float(os.environ.get(
+        "SENDGRID__SIMULATED_WORK_MS", "1000")) / 1000.0
+
     async def _task_saved(req):
         task = req.data or {}
         logger.info("Started processing message with task name '%s'",
@@ -68,6 +78,11 @@ def make_app(*, sendgrid_enabled: bool | None = None) -> App:
                     "subject": "Tasks assigned to you",
                 },
             )
+        elif sim_work_s > 0:
+            logger.info("Simulate slow processing for email with subject "
+                        "'Tasks assigned to you' to: '%s'",
+                        task.get("taskAssignedTo", ""))
+            await asyncio.sleep(sim_work_s)
         return 200
 
     # both [Topic] attributes stack on one action (cloud + local slots)

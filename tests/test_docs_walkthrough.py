@@ -344,9 +344,13 @@ def test_module_05_pubsub(scratch):
 
 def _boot_topology(scratch):
     """Module 5's one-command topology, reused by modules 6-7 ('leave
-    the orchestrator running — module 6 continues on this topology')."""
+    the orchestrator running — module 6 continues on this topology').
+    The simulated slow-processing delay (the reference's load-test
+    posture when the email integration is off) is shortened so floods
+    drain in test time while consumers stay the bottleneck."""
     blocks = bash_blocks("05-pubsub.md")
-    orch = scratch.spawn(block_with(blocks, "tasksrunner run run.yaml"))
+    orch = scratch.spawn(block_with(blocks, "tasksrunner run run.yaml"),
+                         extra_env={"SENDGRID__SIMULATED_WORK_MS": "100"})
     for port in (5103, 5189, 5217, 3500, 3502):
         scratch.wait_port(port)
     deadline = time.monotonic() + 30
@@ -716,3 +720,40 @@ def test_module_12_footprint_measurement(scratch):
     assert "installed-footprint" in out
     m = re.search(r"payload saving, default -> optimized: ([0-9.]+)%", out)
     assert m and float(m.group(1)) >= 50.0, out
+
+
+def test_module_09_autoscale_flood(scratch):
+    """The KEDA-style load test: gate the email integration off (the
+    reference's own load-test instruction), flood 200 events, watch the
+    scaler breathe 1→5→1 in the orchestrator's log, and finish with an
+    empty DLQ — all from the doc's blocks."""
+    blocks = bash_blocks("09-autoscale.md")
+    orch = _boot_topology(scratch)
+
+    out = scratch.run(block_with(blocks, "SENDGRID__INTEGRATIONENABLED=false"))
+    assert "revision 2" in out
+
+    out = scratch.run(block_with(blocks, "--count 200"))
+    assert "published 200/200" in out
+
+    def orch_log() -> str:
+        return "".join(orch.output)
+
+    # generous deadlines: on a loaded host the scaler's first sighting
+    # of the backlog can lag several poll intervals
+    deadline = time.monotonic() + 90
+    while not re.search(
+            r"scaling tasksmanager-backend-processor out: \d+ -> 5", orch_log()):
+        assert time.monotonic() < deadline, orch_log()[-2000:]
+        time.sleep(0.5)
+    deadline = time.monotonic() + 120
+    while not re.search(
+            r"scaling tasksmanager-backend-processor in: \d+ -> 1", orch_log()):
+        assert time.monotonic() < deadline, orch_log()[-2000:]
+        time.sleep(0.5)
+
+    # §3.4 exactly-once evidence: an empty DLQ after the episode
+    out = scratch.run(block_with(blocks, "dlq list"))
+    assert "no dead letters" in out
+
+    scratch.stop_proc(orch)
