@@ -60,8 +60,16 @@ def make_app(*, sendgrid_enabled: bool | None = None) -> App:
     # TasksNotifierController.cs:60-63) — that simulated work is what
     # makes consumers the bottleneck so the module-9 flood has
     # something to scale. Overridable for fast tests.
-    sim_work_s = float(os.environ.get(
-        "SENDGRID__SIMULATED_WORK_MS", "1000")) / 1000.0
+    try:
+        sim_work_s = float(os.environ.get(
+            "SENDGRID__SIMULATED_WORK_MS", "1000")) / 1000.0
+    except ValueError:
+        # a tuning knob must not crash-loop the replica: fall back to
+        # the reference's 1 s and say so
+        logger.warning("SENDGRID__SIMULATED_WORK_MS=%r is not a number; "
+                       "using 1000 ms",
+                       os.environ.get("SENDGRID__SIMULATED_WORK_MS"))
+        sim_work_s = 1.0
 
     async def _task_saved(req):
         task = req.data or {}
