@@ -121,6 +121,35 @@ def _task_form_page(title: str, action: str, submit: str,
     return page
 
 
+def _is_unreachable(exc: Exception) -> bool:
+    """True when a backend call failed to *connect* (pinned-URL fallback
+    refused, name resolution failed, circuit open) rather than the
+    backend answering with an error of its own."""
+    from tasksrunner.errors import (
+        CircuitOpenError,
+        InvocationError,
+        InvocationStatusError,
+    )
+
+    if isinstance(exc, (OSError, CircuitOpenError)):
+        # OSError covers aiohttp's ClientConnectorError; an open circuit
+        # means the call was never attempted — the backend is down from
+        # the reader's point of view
+        return True
+    try:
+        import aiohttp
+        # e.g. ServerDisconnectedError: ClientConnectionError but not OSError
+        if isinstance(exc, aiohttp.ClientConnectionError):
+            return True
+    except ImportError:  # pragma: no cover - aiohttp is in the image
+        pass
+    # every connect-level failure surfaces as InvocationError ("app
+    # unreachable at …", "sidecar unreachable at …", AppNotFound);
+    # InvocationStatusError is the one that means the backend answered
+    return (isinstance(exc, InvocationError)
+            and not isinstance(exc, InvocationStatusError))
+
+
 def _cookie_user(req) -> str | None:
     jar = SimpleCookie(req.headers.get("cookie", ""))
     morsel = jar.get(COOKIE_NAME)
@@ -227,7 +256,25 @@ def make_app() -> App:
         user = _cookie_user(req)
         if not user:
             return _redirect("/")
-        tasks = await _list_tasks(user)
+        try:
+            tasks = await _list_tasks(user)
+        except Exception as exc:
+            if not _is_unreachable(exc):
+                raise
+            # the module-2 lesson made visible: say plainly that the
+            # backend could not be reached (pinned-URL readers see this;
+            # invoke readers never should, since resolution is per-call).
+            # An open circuit keeps its 503 — module 13's fast-fail
+            # contract — while a dead backend is a 502 bad-gateway.
+            from tasksrunner.errors import CircuitOpenError
+
+            page = _page("Backend unreachable", f"""
+<p class="field-error">The backend API is unreachable.</p>
+<p>{html.escape(str(exc))}</p>
+<p>Check that <code>tasksmanager-backend-api</code> is running, then
+<a href="/tasks">reload</a>.</p>""")
+            page.status = 503 if isinstance(exc, CircuitOpenError) else 502
+            return page
         rows = "".join(_task_row(t) for t in tasks) or \
             '<tr><td colspan="6">No tasks yet.</td></tr>'
         return _page("Tasks", f"""

@@ -28,6 +28,7 @@ from tasksrunner.bindings.base import BindingResponse
 from tasksrunner.errors import (
     EtagMismatch,
     InvocationError,
+    InvocationStatusError,
     QueryError,
     SecretNotFound,
     TasksRunnerError,
@@ -58,7 +59,9 @@ class InvocationResponse:
     def raise_for_status(self) -> "InvocationResponse":
         if not self.ok:
             detail = self.body[:300].decode("utf-8", "replace")
-            raise InvocationError(f"invocation returned {self.status}: {detail}")
+            raise InvocationStatusError(
+                f"invocation returned {self.status}: {detail}",
+                status=self.status)
         return self
 
 

@@ -106,6 +106,17 @@ class InvocationError(TasksRunnerError):
     http_status = 500
 
 
+class InvocationStatusError(InvocationError):
+    """The invocation target ANSWERED, with a non-2xx status — raised by
+    ``raise_for_status``. Distinct from its parent so callers can tell
+    "the backend is down" from "the backend rejected the request"
+    without parsing the message."""
+
+    def __init__(self, message: str, *, status: int):
+        super().__init__(message)
+        self.status = status
+
+
 class CircuitOpenError(TasksRunnerError):
     """A resiliency circuit breaker is open — the call was rejected
     without being attempted (fail-fast). Maps to 503 so callers can
