@@ -561,7 +561,21 @@ def main() -> None:
     args = parser.parse_args()
 
     if args.worker:
-        asyncio.run(_worker_main(args.worker, args.tmp, args.idx))
+        profile_dir = os.environ.get("BENCH_PROFILE_DIR")
+        if profile_dir:
+            # per-worker cProfile dumps for write-path attribution
+            # (BASELINE.md "where the time goes")
+            import cProfile
+            prof = cProfile.Profile()
+            prof.enable()
+            try:
+                asyncio.run(_worker_main(args.worker, args.tmp, args.idx))
+            finally:
+                prof.disable()
+                prof.dump_stats(
+                    f"{profile_dir}/worker-{args.worker}-{args.idx}.prof")
+        else:
+            asyncio.run(_worker_main(args.worker, args.tmp, args.idx))
         return
 
     _log("bench 1/4: cross-process write path (faithful [PB] topology) ...")

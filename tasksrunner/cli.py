@@ -138,7 +138,8 @@ def _cmd_sidecar(args) -> None:
         await sidecar.start()
         resolver.register(AppAddress(app_id=args.app_id, host="127.0.0.1",
                                      sidecar_port=sidecar.port,
-                                     app_port=args.app_port))
+                                     app_port=args.app_port,
+                                     mesh_port=sidecar.mesh_port))
         print(f"ready app={args.app_id} sidecar_port={sidecar.port}", flush=True)
         try:
             await asyncio.Event().wait()

@@ -29,8 +29,14 @@ def load_component_file(path: str | pathlib.Path, *, name: str | None = None) ->
     except OSError as exc:
         raise ComponentError(f"cannot read component file {path}: {exc}") from exc
 
+    try:
+        docs = list(yaml.safe_load_all(text))
+    except yaml.YAMLError as exc:
+        raise ComponentError(
+            f"component file {path} is not valid YAML: {exc}") from exc
+
     specs: list[ComponentSpec] = []
-    for doc in yaml.safe_load_all(text):
+    for doc in docs:
         if doc is None:
             continue
         if is_resiliency_doc(doc):

@@ -180,6 +180,7 @@ class AppHost:
             self.resolver.register(AppAddress(
                 app_id=self.app.app_id, host=self.host,
                 sidecar_port=self.sidecar_port, app_port=self.app_port,
+                mesh_port=self.sidecar.mesh_port,
             ))
         # the app's client talks to its sidecar runtime directly — same
         # process, same Runtime object the HTTP surface serves, same

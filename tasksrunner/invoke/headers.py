@@ -1,0 +1,34 @@
+"""Header-filtering policy shared by every invoke transport.
+
+The sidecar HTTP route, the framed mesh lane, and the in-proc channel
+must treat headers identically — an app must not be able to observe
+which transport carried a call (runtime.py's behavioral-equivalence
+contract). One definition here, imported by all of them, so the sets
+cannot drift.
+"""
+
+from __future__ import annotations
+
+#: response headers that describe the hop, not the payload — never
+#: forwarded (≙ RFC 9110 §7.6.1 connection-oriented headers)
+HOP_BY_HOP = frozenset({
+    "content-length", "transfer-encoding", "connection",
+    "keep-alive", "server", "date",
+})
+
+
+def inward_headers(headers: dict[str, str]) -> dict[str, str]:
+    """The subset of caller headers forwarded to the target app:
+    content negotiation plus ``x-*`` application headers — cookies,
+    auth material, and transport noise stay behind."""
+    return {
+        k: v for k, v in ((k.lower(), v) for k, v in headers.items())
+        if k in ("content-type", "accept") or k.startswith("x-")
+    }
+
+
+def outward_headers(headers: dict[str, str]) -> dict[str, str]:
+    """App response headers minus hop-by-hop noise (redirect locations,
+    cookies, etags all travel — HTTP mode must not lose what the direct
+    transport delivers)."""
+    return {k: v for k, v in headers.items() if k.lower() not in HOP_BY_HOP}

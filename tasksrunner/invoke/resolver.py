@@ -29,6 +29,9 @@ class AppAddress:
     app_port: int | None = None
     pid: int | None = None
     registered_at: float = 0.0
+    #: framed peer-transport port (invoke/mesh.py — the sidecar↔sidecar
+    #: lane, ≙ Dapr's internal gRPC). None = peer only speaks HTTP.
+    mesh_port: int | None = None
 
     @property
     def base_url(self) -> str:

@@ -163,6 +163,9 @@ class Runtime:
         self._subscriptions = []
         self._input_bindings: list[InputBinding] = []
         self._session = None  # outbound aiohttp session for peer invokes
+        self._mesh_pool = None  # outbound framed-mesh connections (invoke/mesh.py)
+        from tasksrunner.envflag import env_flag
+        self._mesh_enabled = env_flag("TASKSRUNNER_MESH")
         self._started = False
 
     # -- helpers ---------------------------------------------------------
@@ -388,24 +391,43 @@ class Runtime:
                         f"cannot reach {target_app_id!r}: {exc}") from exc
             return _spanned(await _peer_attempt())
 
-        if self._session is None:
-            import aiohttp
-            self._session = aiohttp.ClientSession()
         token = os.environ.get(TOKEN_ENV)
         if token:
             # peer sidecars in a token-protected cluster share the token
             headers.setdefault(TOKEN_HEADER, token)
 
-        async def _attempt():
-            # re-resolve each attempt: the peer may have crashed,
-            # unregistered, and come back on a new port
-            addr = self.resolver.resolve(target_app_id)
+        async def _http_attempt(addr):
+            if self._session is None:
+                import aiohttp
+                self._session = aiohttp.ClientSession()
             url = f"{addr.base_url}/v1.0/invoke/{target_app_id}/method{path}"
             if query:
                 url += f"?{query}"
             async with self._session.request(http_method, url, headers=headers,
                                              data=body) as resp:
                 return resp.status, dict(resp.headers), await resp.read()
+
+        async def _attempt():
+            # re-resolve each attempt: the peer may have crashed,
+            # unregistered, and come back on a new port
+            addr = self.resolver.resolve(target_app_id)
+            # prefer the framed mesh lane when the peer advertises one
+            # (invoke/mesh.py, ≙ Dapr's internal sidecar↔sidecar gRPC);
+            # a refused dial falls back to HTTP within this attempt, an
+            # in-flight drop raises OSError into the normal retry path
+            if addr.mesh_port and self._mesh_enabled:
+                from tasksrunner.invoke.mesh import MeshConnectError
+                if self._mesh_pool is None:
+                    from tasksrunner.invoke.mesh import MeshPool
+                    self._mesh_pool = MeshPool()
+                try:
+                    return await self._mesh_pool.request(
+                        addr.host, addr.mesh_port, target_app_id,
+                        http_method, path, query=query, headers=headers,
+                        body=body)
+                except MeshConnectError:
+                    pass
+            return await _http_attempt(addr)
 
         if policy is not None:
             # declarative policy replaces the builtin transport retries
@@ -581,6 +603,9 @@ class Runtime:
         if self._session is not None:
             await self._session.close()
             self._session = None
+        if self._mesh_pool is not None:
+            await self._mesh_pool.close()
+            self._mesh_pool = None
         if self.app_channel is not None:
             await self.app_channel.close()
         await self.registry.close()

@@ -26,6 +26,7 @@ import os
 from aiohttp import web
 
 from tasksrunner.errors import TasksRunnerError, ValidationError
+from tasksrunner.invoke.headers import inward_headers, outward_headers
 from tasksrunner.observability.tracing import (
     TRACEPARENT_HEADER,
     ensure_trace,
@@ -202,22 +203,17 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
         target = request.match_info["app_id"]
         path = request.match_info["path"]
         body = await request.read()
-        fwd_headers = {
-            k.lower(): v for k, v in request.headers.items()
-            if k.lower() in ("content-type", "accept") or k.lower().startswith("x-")
-        }
+        # filtering policy shared with the mesh lane (invoke/headers.py)
+        # — the transports must stay indistinguishable to the app
+        fwd_headers = inward_headers(dict(request.headers))
         status, headers, resp_body = await runtime.invoke(
             target, path, http_method=request.method,
             query=request.query_string, headers=fwd_headers, body=body)
         # forward the app's response headers (redirect locations,
         # cookies, etags...) — HTTP mode must not lose what the direct
         # transport delivers; only hop-by-hop headers are dropped
-        hop_by_hop = {"content-length", "transfer-encoding", "connection",
-                      "keep-alive", "server", "date"}
-        out_headers = {
-            k: v for k, v in headers.items() if k.lower() not in hop_by_hop
-        }
-        return web.Response(status=status, body=resp_body, headers=out_headers)
+        return web.Response(status=status, body=resp_body,
+                            headers=outward_headers(headers))
 
     # -- meta ------------------------------------------------------------
 
@@ -238,17 +234,24 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
 
 
 class Sidecar:
-    """Runtime + HTTP server, with lifecycle management."""
+    """Runtime + HTTP server + peer mesh listener, with lifecycle
+    management. The HTTP surface is the app-facing API; the mesh port
+    (invoke/mesh.py) is the sidecar↔sidecar lane peers prefer — both
+    dispatch into the same Runtime under the same token policy."""
 
     def __init__(self, runtime: Runtime, *, host: str = "127.0.0.1", port: int = 3500):
         self.runtime = runtime
         self.host = host
         self.port = port
+        self.mesh_port: int | None = None
         self._http = build_sidecar_app(runtime)
         self._runner: web.AppRunner | None = None
+        self._mesh = None
 
     async def start(self) -> None:
+        from tasksrunner.envflag import env_flag
         from tasksrunner.hosting import _access_log
+        from tasksrunner.invoke.mesh import MeshServer
 
         self._runner = web.AppRunner(self._http, access_log=_access_log())
         await self._runner.setup()
@@ -256,12 +259,19 @@ class Sidecar:
         await site.start()
         if self.port == 0:  # pick the real ephemeral port
             self.port = self._runner.addresses[0][1]
+        if env_flag("TASKSRUNNER_MESH"):
+            self._mesh = MeshServer(self.runtime, host=self.host)
+            await self._mesh.start()
+            self.mesh_port = self._mesh.port
         await self.runtime.start()
-        logger.info("sidecar for %s listening on %s:%d",
-                    self.runtime.app_id, self.host, self.port)
+        logger.info("sidecar for %s listening on %s:%d (mesh :%s)",
+                    self.runtime.app_id, self.host, self.port, self.mesh_port)
 
     async def stop(self) -> None:
         await self.runtime.stop()
+        if self._mesh is not None:
+            await self._mesh.stop()
+            self._mesh = None
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None

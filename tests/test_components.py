@@ -109,6 +109,15 @@ def test_unknown_schema_rejected():
         parse_component({"foo": 1}, default_name="x")
 
 
+def test_malformed_yaml_is_component_error_naming_file(tmp_path):
+    """Broken YAML must surface as a ComponentError that names the
+    file, not a raw yaml.ParserError from the guts of pyyaml."""
+    bad = tmp_path / "broken.yaml"
+    bad.write_text("kind: Component\nmetadata: [unterminated")
+    with pytest.raises(ComponentError, match="broken.yaml"):
+        load_component_file(bad)
+
+
 def test_load_directory_scope_filter_and_duplicates(tmp_path):
     (tmp_path / "a.yaml").write_text(LOCAL_YAML)
     (tmp_path / "b.yaml").write_text(CLOUD_YAML)

@@ -1,0 +1,352 @@
+"""Framed sidecar↔sidecar mesh transport (tasksrunner/invoke/mesh.py).
+
+The behavioral contract under test: the mesh lane must be
+indistinguishable from the sidecar HTTP invoke route
+(``/v1.0/invoke/{app-id}/method/{path}``) in everything an app can
+observe — status/headers/body, token policy, trace adoption, error
+mapping — while being the transport peers *prefer* when the target
+advertises a mesh port (≙ Dapr's internal sidecar↔sidecar gRPC,
+reference docs/aca/03-aca-dapr-integration/index.md:30-38).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from tasksrunner import App, AppHost, load_components
+from tasksrunner.invoke.mesh import (
+    MAX_FRAME,
+    MeshConnectError,
+    MeshPool,
+    MeshServer,
+    _pack,
+)
+from tasksrunner.security import TOKEN_HEADER, hash_token
+
+
+# ---------------------------------------------------------------------------
+# a minimal Runtime stand-in: the mesh server only needs .invoke()
+# ---------------------------------------------------------------------------
+
+class FakeRuntime:
+    def __init__(self):
+        self.calls = []
+
+    async def invoke(self, target, path, *, http_method="POST", query="",
+                     headers=None, body=b""):
+        self.calls.append((target, path, http_method, query,
+                           dict(headers or {}), bytes(body)))
+        if path.endswith("boom"):
+            raise RuntimeError("handler exploded")
+        if path.endswith("slow"):
+            await asyncio.sleep(0.2)
+        payload = json.dumps({"path": path, "echo": body.decode() or None})
+        return 200, {"content-type": "application/json",
+                     "x-from-app": "yes",
+                     # hop-by-hop noise the mesh must strip, exactly
+                     # like the HTTP route does
+                     "Connection": "keep-alive",
+                     "Content-Length": "999"}, payload.encode()
+
+
+async def start_server(**kw):
+    srv = MeshServer(FakeRuntime(), **kw)
+    await srv.start()
+    return srv
+
+
+@pytest.mark.asyncio
+async def test_request_response_roundtrip():
+    srv = await start_server(api_token=None)
+    pool = MeshPool()
+    try:
+        status, headers, body = await pool.request(
+            "127.0.0.1", srv.port, "backend-api", "POST", "/api/tasks",
+            query="a=1", headers={"content-type": "application/json"},
+            body=b'{"x": 1}')
+        assert status == 200
+        doc = json.loads(body)
+        assert doc == {"path": "/api/tasks", "echo": '{"x": 1}'}
+        assert headers["x-from-app"] == "yes"
+        # hop-by-hop headers stripped, matching the HTTP route
+        assert "connection" not in {k.lower() for k in headers}
+        assert "content-length" not in {k.lower() for k in headers}
+        target, path, method, query, hdrs, sent = srv.runtime.calls[0]
+        assert (target, path, method, query) == (
+            "backend-api", "/api/tasks", "POST", "a=1")
+        assert sent == b'{"x": 1}'
+    finally:
+        await pool.close()
+        await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_multiplexing_one_connection_interleaves():
+    """A slow request must not stall a fast one sharing the connection,
+    and responses must correlate to their own requests."""
+    srv = await start_server(api_token=None)
+    pool = MeshPool()
+    try:
+        slow = asyncio.create_task(pool.request(
+            "127.0.0.1", srv.port, "t", "GET", "/slow"))
+        await asyncio.sleep(0.02)  # slow is in flight on the connection
+        t0 = asyncio.get_running_loop().time()
+        status, _, body = await pool.request(
+            "127.0.0.1", srv.port, "t", "GET", "/fast", body=b"hi")
+        fast_elapsed = asyncio.get_running_loop().time() - t0
+        assert status == 200 and json.loads(body)["echo"] == "hi"
+        assert fast_elapsed < 0.15  # did not queue behind the 200 ms call
+        status, _, body = await slow
+        assert status == 200 and json.loads(body)["path"] == "/slow"
+        # both rode one multiplexed connection
+        assert len(pool._conns) == 1
+    finally:
+        await pool.close()
+        await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_error_mapping_matches_http_route():
+    """Unhandled handler error → 500 {"error": ...}, like the sidecar."""
+    srv = await start_server(api_token=None)
+    pool = MeshPool()
+    try:
+        status, headers, body = await pool.request(
+            "127.0.0.1", srv.port, "t", "GET", "/boom")
+        assert status == 500
+        assert json.loads(body)["error"] == "handler exploded"
+    finally:
+        await pool.close()
+        await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_token_policy_own_peer_and_reject():
+    """Same gate as the HTTP invoke route (allow_peer=True): the app's
+    own token or a registered peer's token (digest match) is accepted;
+    anything else is 401 before dispatch."""
+    srv = await start_server(api_token="own-secret",
+                             peer_tokens={hash_token("peer-secret")})
+    pool = MeshPool()
+    try:
+        for token, want in [(None, 401), ("wrong", 401),
+                            ("own-secret", 200), ("peer-secret", 200)]:
+            headers = {} if token is None else {TOKEN_HEADER: token}
+            status, _, body = await pool.request(
+                "127.0.0.1", srv.port, "t", "GET", "/x", headers=headers)
+            assert status == want, (token, status, body)
+        # rejected requests never reached the runtime
+        assert len(srv.runtime.calls) == 2
+    finally:
+        await pool.close()
+        await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_pool_redials_after_peer_restart():
+    """Dead connections are dropped and re-dialed; an unreachable peer
+    raises MeshConnectError (the fall-back-to-HTTP signal)."""
+    srv = await start_server(api_token=None)
+    port = srv.port
+    pool = MeshPool()
+    try:
+        status, _, _ = await pool.request("127.0.0.1", port, "t", "GET", "/a")
+        assert status == 200
+        await srv.stop()
+        await asyncio.sleep(0.05)
+        # in-flight-less but dead: next request either sees the closed
+        # conn and re-dials (refused → MeshConnectError) or fails on
+        # the dropped stream (ConnectionError) — both are retriable
+        with pytest.raises((MeshConnectError, ConnectionError, OSError)):
+            await pool.request("127.0.0.1", port, "t", "GET", "/b")
+        # peer comes back on the same port
+        srv2 = MeshServer(FakeRuntime(), api_token=None, port=port)
+        await srv2.start()
+        try:
+            status, _, _ = await pool.request(
+                "127.0.0.1", port, "t", "GET", "/c")
+            assert status == 200
+        finally:
+            await srv2.stop()
+    finally:
+        await pool.close()
+
+
+@pytest.mark.asyncio
+async def test_oversized_request_gets_413_connection_survives():
+    """Parity with the HTTP route's client_max_size: an oversized
+    request body is answered 413 — and the multiplexed connection
+    keeps serving other requests (one bad request must not fail its
+    neighbours with a teardown)."""
+    srv = await start_server(api_token=None)
+    pool = MeshPool()
+    try:
+        import tasksrunner.invoke.mesh as mesh_mod
+        # shrink the limit for the test so we don't allocate 16 MiB
+        orig = mesh_mod.MAX_FRAME
+        mesh_mod.MAX_FRAME = 1024
+        try:
+            status, _, body = await pool.request(
+                "127.0.0.1", srv.port, "t", "POST", "/big",
+                body=b"x" * 2048)
+            assert status == 413
+            assert "limit" in json.loads(body)["error"]
+            # same connection still works
+            status, _, _ = await pool.request(
+                "127.0.0.1", srv.port, "t", "GET", "/after")
+            assert status == 200
+            assert len(pool._conns) == 1
+        finally:
+            mesh_mod.MAX_FRAME = orig
+    finally:
+        await pool.close()
+        await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_corrupt_frame_drops_connection():
+    srv = await start_server(api_token=None)
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
+        try:
+            import struct
+            # header length larger than the frame: structurally corrupt
+            writer.write(struct.pack(">I", 8) + struct.pack(">I", 100))
+            await writer.drain()
+            assert await reader.read(1) == b""
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+    finally:
+        await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_malformed_response_fails_pending_not_hangs():
+    """A server speaking garbage must fail in-flight requests promptly
+    — pending futures must never be stranded (the reader task dies,
+    _fail_all resolves them with ConnectionError)."""
+    async def bad_server(reader, writer):
+        await reader.read(64)  # swallow the request
+        writer.write(b"\x00\x00\x00\x08\x00\x00\x00\x04nope")  # header not JSON
+        await writer.drain()
+        await reader.read()  # hold until the client hangs up...
+        writer.close()       # ...then close, so wait_closed() returns
+
+    server = await asyncio.start_server(bad_server, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    pool = MeshPool()
+    try:
+        with pytest.raises((ConnectionError, OSError)):
+            await asyncio.wait_for(
+                pool.request("127.0.0.1", port, "t", "GET", "/x"), timeout=5)
+    finally:
+        await pool.close()
+        server.close()
+        await server.wait_closed()
+
+
+@pytest.mark.asyncio
+async def test_traceparent_adopted_by_server():
+    """A caller-supplied traceparent must reach the dispatched handler's
+    forwarded headers (trace continuity over the mesh = over HTTP)."""
+    srv = await start_server(api_token=None)
+    pool = MeshPool()
+    try:
+        tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+        status, _, _ = await pool.request(
+            "127.0.0.1", srv.port, "t", "GET", "/x",
+            headers={"traceparent": tp, "x-keep": "1", "cookie": "drop"})
+        assert status == 200
+        hdrs = srv.runtime.calls[0][4]
+        # x-* and content-type/accept travel inward; cookie is filtered
+        assert hdrs.get("x-keep") == "1"
+        assert "cookie" not in hdrs
+    finally:
+        await pool.close()
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: AppHost pairs pick the mesh lane automatically
+# ---------------------------------------------------------------------------
+
+COMPONENTS = """
+apiVersion: dapr.io/v1alpha1
+kind: Component
+metadata:
+  name: statestore
+spec:
+  type: state.in-memory
+  version: v1
+"""
+
+
+def _apps():
+    api = App("backend-api")
+
+    @api.post("/api/echo")
+    async def echo(req):
+        return {"got": req.json(), "app": "backend-api"}
+
+    front = App("frontend")
+
+    @front.get("/go")
+    async def go(req):
+        resp = await front.client.invoke_method(
+            "backend-api", "api/echo", http_method="POST",
+            data={"n": int(req.query.get("n", "0"))})
+        resp.raise_for_status()
+        return resp.json()
+
+    return api, front
+
+
+@pytest.mark.asyncio
+async def test_apphost_pair_prefers_mesh(tmp_path, monkeypatch):
+    monkeypatch.delenv("TASKSRUNNER_MESH", raising=False)
+    (tmp_path / "components.yaml").write_text(COMPONENTS)
+    specs = load_components(tmp_path)
+    registry = str(tmp_path / "apps.json")
+    api, front = _apps()
+    hosts = [AppHost(api, specs=specs, registry_file=registry),
+             AppHost(front, specs=specs, registry_file=registry)]
+    for h in hosts:
+        await h.start()
+    try:
+        assert hosts[0].sidecar.mesh_port  # advertised in the registry
+        resp = await hosts[1].client.invoke_method("frontend", "go", query="n=7",
+                                                   http_method="GET")
+        assert resp.json() == {"got": {"n": 7}, "app": "backend-api"}
+        # the frontend's runtime dialed the mesh lane, not HTTP
+        pool = hosts[1].sidecar.runtime._mesh_pool
+        assert pool is not None and len(pool._conns) == 1
+    finally:
+        for h in hosts:
+            await h.stop()
+
+
+@pytest.mark.asyncio
+async def test_apphost_pair_mesh_disabled_uses_http(tmp_path, monkeypatch):
+    monkeypatch.setenv("TASKSRUNNER_MESH", "0")
+    (tmp_path / "components.yaml").write_text(COMPONENTS)
+    specs = load_components(tmp_path)
+    registry = str(tmp_path / "apps.json")
+    api, front = _apps()
+    hosts = [AppHost(api, specs=specs, registry_file=registry),
+             AppHost(front, specs=specs, registry_file=registry)]
+    for h in hosts:
+        await h.start()
+    try:
+        assert hosts[0].sidecar.mesh_port is None
+        resp = await hosts[1].client.invoke_method("frontend", "go", query="n=3",
+                                                   http_method="GET")
+        assert resp.json() == {"got": {"n": 3}, "app": "backend-api"}
+        assert hosts[1].sidecar.runtime._mesh_pool is None
+    finally:
+        for h in hosts:
+            await h.stop()
