@@ -118,6 +118,12 @@ def _make_processor_app(tmp: str):
     conn = sqlite3.connect(f"{tmp}/delivered.db", timeout=30)
     conn.execute("PRAGMA journal_mode=WAL")
     conn.execute("PRAGMA busy_timeout=30000")
+    # measurement table, not the system under test: the default
+    # synchronous=FULL fsyncs every commit INSIDE the delivery handler
+    # (~0.65 ms on this host), capping the whole pipeline at ~1.5k
+    # deliveries/s of pure harness overhead. Durability of the counter
+    # is irrelevant — crash-loss tests use the framework's own stores.
+    conn.execute("PRAGMA synchronous=OFF")
 
     # simulated per-message work (≙ the reference processor's SendGrid
     # call) — this is what makes consumers the bottleneck so the
@@ -333,16 +339,23 @@ async def run_xproc(n_tasks: int = N_TASKS, *, warmup: int = WARMUP,
                 await drain(start_id + n)
                 return time.perf_counter() - t0
 
-            # best of `rounds`: the throughput ceiling is a property of
-            # the framework; transient host contention only lowers a round
-            best = 0.0
+            # all rounds are reported: the headline is the MEDIAN (so
+            # round-over-round comparisons aren't comparing luck on a
+            # shared host), with min/max/best carried alongside
+            # (BASELINE.md's variance-band table)
+            round_rates: list[float] = []
             next_id = warmup
             for _ in range(rounds):
                 await drain(next_id)
                 elapsed = await flood(next_id, n_tasks, concurrency)
                 next_id += n_tasks
-                best = max(best, n_tasks / elapsed)
-            out = {"throughput": round(best, 1)}
+                round_rates.append(n_tasks / elapsed)
+            out = {
+                "throughput": round(statistics.median(round_rates), 1),
+                "throughput_runs": [round(r, 1) for r in round_rates],
+                "throughput_min": round(min(round_rates), 1),
+                "throughput_max": round(max(round_rates), 1),
+            }
 
             if latency_probe:
                 n_probe = max(200, n_tasks // 3)
@@ -614,7 +627,9 @@ def main() -> None:
         "extras": {
             "topology": "3 OS processes (driver+frontend / api / "
                         "processor); process-boundary hops are real "
-                        "localhost HTTP (peer invoke, broker file); "
+                        "localhost transports (framed sidecar mesh "
+                        "for peer invoke — the default lane, ≙ Dapr's "
+                        "internal gRPC — and the shared broker file); "
                         "app<->own-sidecar hops are direct in-process "
                         "calls (AppHost fuses them, as deployed); "
                         "durable sqlite state + broker; access logs "
@@ -622,6 +637,13 @@ def main() -> None:
             "p50_ms": xproc["p50_ms"],
             "p99_ms": xproc["p99_ms"],
             "latency_concurrency": 8,
+            # noise-awareness: the headline value is the MEDIAN round;
+            # the spread shows what host noise did to this run
+            "throughput_rounds": xproc["throughput_runs"],
+            "throughput_spread": {
+                "min": xproc["throughput_min"],
+                "max": xproc["throughput_max"],
+            },
             "scaleout_20ms_work": {
                 "replicas1_tasks_per_sec": one["throughput"],
                 "replicas5_tasks_per_sec": five["throughput"],

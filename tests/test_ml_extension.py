@@ -103,6 +103,29 @@ def test_graft_entry_contract():
         g.dryrun_multichip(1024)
 
 
+def test_dryrun_multichip_catches_broken_collective(monkeypatch):
+    """The dryrun must be SELF-verifying: sabotage the sequence-parallel
+    collective (ring attention sees only its local K/V block — one ring
+    hop missing) and the dryrun has to fail, not print a plausible
+    loss."""
+    import __graft_entry__ as g
+    import tasksrunner.ml.ring as ring_mod
+
+    real = ring_mod.ring_attention
+
+    def broken_ring_attention(q, k, v, *, mesh):
+        # zero the second half of K/V: the blocks a working ring would
+        # deliver from the other sp shard arrive corrupted — the
+        # forward loss visibly shifts and the dryrun must notice
+        half = k.shape[1] // 2
+        return real(q, k.at[:, half:].set(0), v.at[:, half:].set(0),
+                    mesh=mesh)
+
+    monkeypatch.setattr(ring_mod, "ring_attention", broken_ring_attention)
+    with pytest.raises(AssertionError, match="diverge"):
+        g.dryrun_multichip(8)
+
+
 def test_ring_attention_matches_dense():
     """Ring attention over an sp axis must equal full attention (up to
     bf16 noise): the per-block flash accumulation and ppermute rotation
