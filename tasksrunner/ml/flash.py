@@ -74,13 +74,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale):
     m = jnp.max(s, axis=-1)                    # [S]
     p = jnp.exp(s - m[:, None])                # f32, unnormalised
     den = jnp.sum(p, axis=-1)                  # [S]
-    ctx = _dot(p, v) / den[:, None]            # [S, D]
-    o_ref[0, 0] = ctx
+    ctx = _dot(p, v) / den[:, None]            # [S, D] f32 in-register
+    o_ref[0, 0] = ctx.astype(o_ref.dtype)      # HBM bytes in IO dtype
     l_ref[0, 0, 0, :] = m + jnp.log(den)       # row logsumexp, for bwd
 
 
 def _flash_fwd(q, k, v, scale):
-    """q/k/v in internal [b, h, s, d] layout."""
+    """q/k/v in internal [b, h, s, d] layout; the context comes back in
+    the inputs' dtype (bf16 activations halve the HBM bytes — softmax
+    statistics and accumulation stay f32 inside the kernel)."""
     b, h, s, d = q.shape
     qkv_spec, lse_spec = _specs(b, s, h, d)
     out, lse = pl.pallas_call(
@@ -89,7 +91,7 @@ def _flash_fwd(q, k, v, scale):
         in_specs=[qkv_spec, qkv_spec, qkv_spec],
         out_specs=[qkv_spec, lse_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, 1, s), jnp.float32),
         ],
         interpret=_interpret(),
@@ -111,11 +113,12 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, l_ref,
     p = jnp.exp(s - lse[:, None])               # normalised probs, f32
     dv = _dot(p.T, do)                          # [S, D]
     dp = _dot(do, v, trans_b=True)              # [S, S]
-    delta = jnp.sum(do * o, axis=-1)            # [S]
+    delta = jnp.sum(do.astype(jnp.float32)      # f32 on the VPU even
+                    * o.astype(jnp.float32), axis=-1)  # with bf16 IO
     ds = p * (dp - delta[:, None]) * scale      # [S, S]
-    dq_ref[0, 0] = _dot(ds, k)
-    dk_ref[0, 0] = _dot(ds.T, q)
-    dv_ref[0, 0] = dv
+    dq_ref[0, 0] = _dot(ds, k).astype(dq_ref.dtype)
+    dk_ref[0, 0] = _dot(ds.T, q).astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
 def _flash_bwd_call(q, k, v, out, lse, dout, scale):
@@ -126,9 +129,9 @@ def _flash_bwd_call(q, k, v, out, lse, dout, scale):
         grid=(b, h),
         in_specs=[qkv_spec] * 5 + [lse_spec],
         out_specs=[qkv_spec] * 3,
-        out_shape=[jax.ShapeDtypeStruct((b, h, s, d), jnp.float32)] * 3,
+        out_shape=[jax.ShapeDtypeStruct((b, h, s, d), q.dtype)] * 3,
         interpret=_interpret(),
-    )(q, k, v, out, dout.astype(jnp.float32), lse)
+    )(q, k, v, out, dout.astype(q.dtype), lse)
 
 
 # -- public op ------------------------------------------------------------
@@ -139,9 +142,11 @@ def _to_internal(x):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def flash_attention(q, k, v, scale=None):
-    """Fused attention: q/k/v [batch, seq, heads, d_head] (f32) →
-    context [batch, seq, heads, d_head] (f32). Differentiable; the
-    VJP is the flash backward kernel."""
+    """Fused attention: q/k/v [batch, seq, heads, d_head] → context
+    [batch, seq, heads, d_head] **in the inputs' dtype** (bf16
+    activations halve HBM bytes; softmax statistics and MXU
+    accumulation stay f32 inside the kernel). Differentiable; the VJP
+    is the flash backward kernel, gradients in the inputs' dtype."""
     out, _ = _flash_fwd(_to_internal(q), _to_internal(k), _to_internal(v),
                         _resolve_scale(q, scale))
     return _to_internal(out)

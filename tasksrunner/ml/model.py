@@ -78,17 +78,36 @@ def init_params(cfg: ModelConfig, key: jax.Array) -> dict[str, Any]:
     }
 
 
+def act_dtype() -> jnp.dtype:
+    """Residual-stream / activation dtype, resolved at trace time.
+
+    bf16 by default: params stay f32 masters and every contraction
+    still accumulates f32 on the MXU, but activations written to HBM
+    (residual stream, FF intermediate, attention q/k/v/ctx and their
+    saved-for-backward residuals) are half the bytes — on a v5e the
+    step is HBM-bound in several phases, so this is the single largest
+    MFU lever (BASELINE.md roofline). ``TASKSRUNNER_ACT_F32=1``
+    restores full-f32 activations for A/B runs."""
+    from tasksrunner.envflag import env_flag
+    return (jnp.float32 if env_flag("TASKSRUNNER_ACT_F32", default=False)
+            else jnp.bfloat16)
+
+
 def _matmul(a: jax.Array, b: jax.Array) -> jax.Array:
-    """bf16 × bf16 → f32 accumulate: the MXU-native contraction."""
-    return jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
-                      preferred_element_type=jnp.float32)
+    """bf16 × bf16 → f32 accumulate on the MXU, result stored in the
+    activation dtype (the f32 accumulation happens in-register; only
+    the downcast result pays HBM bytes)."""
+    out = jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                     preferred_element_type=jnp.float32)
+    return out.astype(act_dtype())
 
 
 def _layernorm(x: jax.Array, scale: jax.Array) -> jax.Array:
-    x = x.astype(jnp.float32)
-    mu = jnp.mean(x, axis=-1, keepdims=True)
-    var = jnp.var(x, axis=-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * scale
+    x32 = x.astype(jnp.float32)  # moments in f32 on the VPU, always
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + 1e-6) * scale
+    return out.astype(act_dtype())
 
 
 def _use_ring(mesh: Mesh | None) -> bool:
@@ -139,14 +158,19 @@ def forward(params: dict, tokens: jax.Array, *, cfg: ModelConfig,
     ``mesh`` only changes which attention core runs (ring under an
     ``sp`` axis); everything else is plain GSPMD — the same code jits
     single-chip and multi-chip."""
-    x = params["embed"][tokens] + params["pos"][None, :, :]
+    x = (params["embed"][tokens] + params["pos"][None, :, :]).astype(act_dtype())
     for layer in params["layers"]:
         x = x + _attention(_layernorm(x, layer["ln1"]), layer, cfg, mesh)
         y = _layernorm(x, layer["ln2"])
         y = _matmul(jax.nn.gelu(_matmul(y, layer["w1"])), layer["w2"])
         x = x + y
-    pooled = jnp.mean(x, axis=1)
-    return _matmul(pooled, params["head"])
+    pooled = jnp.mean(x.astype(jnp.float32), axis=1)  # f32 reduction
+    # final logits stay full f32 (no act_dtype downcast): bf16 here
+    # saves no HBM — this IS the output — and would quantize the
+    # log_softmax inputs
+    return jnp.matmul(pooled.astype(jnp.bfloat16),
+                      params["head"].astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
 
 
 def loss_fn(params: dict, tokens: jax.Array, labels: jax.Array, *,
