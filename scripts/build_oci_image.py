@@ -1,0 +1,544 @@
+#!/usr/bin/env python3
+"""Build real, inspectable OCI images for the Tasks Tracker services —
+without a container daemon.
+
+≙ reference module 12 (docs/aca/12-optimize-containers/index.md:318-326:
+each service measured as a real image, default 226 MB → chiseled
+119 MB). Round 3 substituted an installed-footprint measurement because
+no builder (docker/podman/buildah/kaniko/…) exists in this environment;
+this script closes the gap from first principles: an OCI image is just
+content-addressed blobs — gzipped layer tars, a config JSON, a manifest
+JSON — plus a two-line ``oci-layout`` file and an ``index.json``. All of
+that is writable with the stdlib.
+
+For each service (backend-api, frontend-ui, processor) × variant
+(default, optimized) the script assembles the layers its Dockerfile
+describes, from the same live installation `measure_footprint.py`
+measures:
+
+* ``python-runtime`` — interpreter + stdlib (the slice of the base
+  image a Python service actually needs; byte-identical blob shared by
+  every image, exactly how registries deduplicate base layers);
+* ``site-packages`` (default) — dependency closure **plus the
+  pip/setuptools/wheel stack** that a full site-packages copy drags
+  along, sources as shipped; or ``install`` (optimized) — dependency
+  closure + framework only, byte-compiled (`compileall`), no tooling
+  (≙ the chiseled image's smaller package inventory);
+* ``app`` — the service's sample source under /app/samples;
+* ``users`` — /etc/passwd + /etc/group with the non-root ``app`` user
+  the Dockerfiles create (`USER app` works when the image runs).
+
+Layers are built reproducibly (sorted entries, zeroed mtimes/uids,
+gzip mtime 0, hash-based .pyc invalidation): the same tree always
+yields the same digests, so artifact diffs across rounds are
+meaningful. The on-disk result is a standard OCI image layout —
+``skopeo copy oci:build/oci/backend-api-optimized docker://…`` or
+``crane push`` consume it directly wherever those tools exist; here,
+``--verify`` re-walks every digest/size/diff_id instead.
+
+Base OS layers (Debian bookworm vs bookworm-slim) remain out of scope
+on both sides — they are upstream constants this repo doesn't control
+(BASELINE.md documents the exclusion).
+
+Run: python scripts/build_oci_image.py [--out build/oci] [--json]
+     [--verify] [--service NAME] [--variant default|optimized]
+"""
+
+from __future__ import annotations
+
+import argparse
+import compileall
+import gzip
+import hashlib
+import importlib.metadata
+import io
+import json
+import pathlib
+import py_compile
+import shutil
+import sys
+import sysconfig
+import tarfile
+import tempfile
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+def _footprint_module():
+    """The dependency-closure lists live in measure_footprint.py; import
+    them so the footprint table and the OCI artifact can never measure
+    different closures."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "measure_footprint", pathlib.Path(__file__).parent / "measure_footprint.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_fp = _footprint_module()
+RUNTIME_DEPS = _fp.RUNTIME_DEPS
+BUILD_TOOLING = _fp.BUILD_TOOLING
+
+SITE = "usr/local/lib/python3.12/site-packages"
+
+#: working-tree junk that must never ship in ANY variant (the
+#: optimized path's copytree ignores the same set) — asymmetric
+#: filtering would skew the measured saving
+JUNK_PARTS = frozenset({"__pycache__", ".tasksrunner"})
+JUNK_SUFFIXES = (".db", ".db-wal", ".db-shm")
+
+SERVICES = {
+    "backend-api": {
+        "module": "samples.tasks_tracker.backend_api:make_app",
+        "env": ["TASKS_MANAGER=store"],
+        "sidecar_port": "3500",
+    },
+    "frontend-ui": {
+        "module": "samples.tasks_tracker.frontend_ui:make_app",
+        "env": [],
+        "sidecar_port": "3501",
+    },
+    "processor": {
+        "module": "samples.tasks_tracker.processor:make_app",
+        "env": [],
+        "sidecar_port": "3502",
+    },
+}
+
+
+class LayoutError(Exception):
+    """An OCI layout failed verification."""
+
+
+def sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# deterministic tar layers
+# ---------------------------------------------------------------------------
+
+class _Symlink:
+    def __init__(self, target: str):
+        self.target = target
+
+
+class Layer:
+    """One OCI layer: a reproducible tar, gzipped; digests computed."""
+
+    def __init__(self, title: str):
+        self.title = title
+        #: container path → (filesystem source path | bytes, mode)
+        self._entries: dict[str, tuple[pathlib.Path | bytes, int]] = {}
+
+    def add_file(self, arcname: str, src: pathlib.Path | bytes,
+                 mode: int = 0o644) -> None:
+        self._entries[arcname.lstrip("/")] = (src, mode)
+
+    def add_symlink(self, arcname: str, target: str) -> None:
+        """Deterministic symlink entry (what real images use for the
+        `python` alias — a second full copy of the interpreter would
+        inflate the layer by ~7 MB that gzip cannot dedupe)."""
+        self._entries[arcname.lstrip("/")] = (_Symlink(target), 0o777)
+
+    def add_tree(self, arc_prefix: str, root: pathlib.Path, *,
+                 exclude_parts: frozenset[str] = frozenset({"__pycache__"}),
+                 exclude_suffixes: tuple[str, ...] = ()) -> None:
+        for p in sorted(root.rglob("*")):
+            if not p.is_file() or p.is_symlink():
+                continue
+            rel = p.relative_to(root)
+            if exclude_parts & set(rel.parts):
+                continue
+            if rel.name.endswith(exclude_suffixes):
+                continue
+            mode = 0o755 if (p.stat().st_mode & 0o100) else 0o644
+            self.add_file(f"{arc_prefix}/{rel}", p, mode)
+
+    def build(self) -> dict:
+        """→ {digest, diff_id, size, uncompressed_size, bytes}."""
+        raw = io.BytesIO()
+        with tarfile.open(fileobj=raw, mode="w",
+                          format=tarfile.PAX_FORMAT) as tar:
+            dirs_done: set[str] = set()
+            for arcname in sorted(self._entries):
+                # parent dir entries, once each, for clean extraction
+                parts = arcname.split("/")[:-1]
+                for i in range(1, len(parts) + 1):
+                    d = "/".join(parts[:i])
+                    if d and d not in dirs_done:
+                        dirs_done.add(d)
+                        info = tarfile.TarInfo(d)
+                        info.type = tarfile.DIRTYPE
+                        info.mode = 0o755
+                        info.mtime = 0
+                        tar.addfile(info)
+                src, mode = self._entries[arcname]
+                info = tarfile.TarInfo(arcname)
+                info.mode = mode
+                info.mtime = 0
+                if isinstance(src, _Symlink):
+                    info.type = tarfile.SYMTYPE
+                    info.linkname = src.target
+                    tar.addfile(info)
+                elif isinstance(src, bytes):
+                    info.size = len(src)
+                    tar.addfile(info, io.BytesIO(src))
+                else:
+                    info.size = src.stat().st_size
+                    with src.open("rb") as f:
+                        tar.addfile(info, f)
+        tar_bytes = raw.getvalue()
+        gz = io.BytesIO()
+        with gzip.GzipFile(fileobj=gz, mode="wb", mtime=0) as z:
+            z.write(tar_bytes)
+        gz_bytes = gz.getvalue()
+        return {
+            "title": self.title,
+            "digest": f"sha256:{sha256(gz_bytes)}",
+            "diff_id": f"sha256:{sha256(tar_bytes)}",
+            "size": len(gz_bytes),
+            "uncompressed_size": len(tar_bytes),
+            "bytes": gz_bytes,
+        }
+
+
+# ---------------------------------------------------------------------------
+# layer contents
+# ---------------------------------------------------------------------------
+
+def _dist_files(name: str):
+    """Yield (site-relative arcpath, absolute source path) for one
+    installed distribution, skipping entries outside site-packages
+    (console scripts land in usr/local/bin)."""
+    dist = importlib.metadata.distribution(name)
+    for f in dist.files or []:
+        p = pathlib.Path(dist.locate_file(f))
+        if not p.is_file():
+            continue
+        parts = f.parts
+        if ".." in parts:
+            # ../../../bin/foo style console script
+            if "bin" in parts:
+                yield f"usr/local/bin/{parts[-1]}", p
+            continue
+        # __pycache__ entries stay when RECORD lists them: the tooling
+        # stack ships precompiled (that's half its footprint, and half
+        # of what the optimized variant saves by dropping it)
+        yield f"{SITE}/{f}", p
+
+
+def _bytecompile_tree(src: pathlib.Path, scratch: pathlib.Path,
+                      container_dir: str) -> pathlib.Path:
+    """Copy ``src`` into scratch and compile with hash-based pyc
+    invalidation (no timestamps in pyc headers) and the CONTAINER
+    path embedded as co_filename (stripdir/prependdir) — without
+    that, every build would bake its own temp path into the pycs and
+    the layer digest would never reproduce."""
+    dst = scratch / src.name
+    shutil.copytree(src, dst, ignore=shutil.ignore_patterns(
+        "__pycache__", ".tasksrunner", "*.db", "*.db-wal", "*.db-shm"))
+    compileall.compile_dir(
+        str(dst), quiet=2,
+        stripdir=str(dst), prependdir=container_dir,
+        invalidation_mode=py_compile.PycInvalidationMode.CHECKED_HASH)
+    return dst
+
+
+def runtime_layer() -> Layer:
+    layer = Layer("python-runtime")
+    stdlib = pathlib.Path(sysconfig.get_paths()["stdlib"])
+    interp = pathlib.Path(sys.executable).resolve()
+    layer.add_file("usr/local/bin/python3.12", interp, 0o755)
+    layer.add_symlink("usr/local/bin/python", "python3.12")
+    layer.add_tree(
+        "usr/local/lib/python3.12", stdlib,
+        exclude_parts=frozenset({"__pycache__", "site-packages", "test",
+                                 "idlelib", "turtledemo"}))
+    return layer
+
+
+def payload_layer(variant: str, scratch: pathlib.Path) -> Layer:
+    """The Dockerfile's site-packages/install COPY."""
+    if variant == "default":
+        layer = Layer("site-packages")
+        for name in (*RUNTIME_DEPS, *BUILD_TOOLING):
+            for arc, p in _dist_files(name):
+                layer.add_file(arc, p, 0o755 if arc.startswith("usr/local/bin")
+                               else 0o644)
+        # the framework, as `pip install /src` lays it down (sources)
+        layer.add_tree(f"{SITE}/tasksrunner", REPO / "tasksrunner",
+                       exclude_parts=JUNK_PARTS,
+                       exclude_suffixes=JUNK_SUFFIXES)
+    else:
+        layer = Layer("install")
+        for name in RUNTIME_DEPS:
+            for arc, p in _dist_files(name):
+                layer.add_file(arc, p, 0o755 if arc.startswith("usr/local/bin")
+                               else 0o644)
+        compiled = _bytecompile_tree(REPO / "tasksrunner", scratch,
+                                     f"/{SITE}/tasksrunner")
+        layer.add_tree(f"{SITE}/tasksrunner", compiled,
+                       exclude_parts=frozenset())
+    return layer
+
+
+def app_layer(variant: str, scratch: pathlib.Path) -> Layer:
+    layer = Layer("app")
+    if variant == "default":
+        layer.add_tree("app/samples", REPO / "samples",
+                       exclude_parts=JUNK_PARTS,
+                       exclude_suffixes=JUNK_SUFFIXES)
+    else:
+        compiled = _bytecompile_tree(REPO / "samples", scratch,
+                                 "/app/samples")
+        layer.add_tree("app/samples", compiled, exclude_parts=frozenset())
+    return layer
+
+
+def users_layer() -> Layer:
+    """`RUN useradd --create-home app` without RUN: the two files the
+    command actually produces, so `USER app` resolves at runtime."""
+    layer = Layer("users")
+    layer.add_file("etc/passwd",
+                   b"root:x:0:0:root:/root:/bin/sh\n"
+                   b"app:x:1000:1000::/home/app:/bin/sh\n")
+    layer.add_file("etc/group", b"root:x:0:\napp:x:1000:\n")
+    layer.add_file("home/app/.keep", b"")
+    return layer
+
+
+# ---------------------------------------------------------------------------
+# image assembly
+# ---------------------------------------------------------------------------
+
+def build_image(service: str, variant: str, out_dir: pathlib.Path,
+                shared_layers: dict) -> dict:
+    svc = SERVICES[service]
+    with tempfile.TemporaryDirectory() as scratch_s:
+        scratch = pathlib.Path(scratch_s)
+        if "runtime" not in shared_layers:
+            shared_layers["runtime"] = runtime_layer().build()
+        if ("payload", variant) not in shared_layers:
+            shared_layers[("payload", variant)] = (
+                payload_layer(variant, scratch).build())
+        if ("app", variant) not in shared_layers:
+            shared_layers[("app", variant)] = app_layer(variant, scratch).build()
+        if "users" not in shared_layers:
+            shared_layers["users"] = users_layer().build()
+
+    layers = [shared_layers["runtime"], shared_layers[("payload", variant)],
+              shared_layers[("app", variant)], shared_layers["users"]]
+
+    config = {
+        "architecture": "amd64",
+        "os": "linux",
+        "config": {
+            "User": "app",
+            "Env": ["PATH=/usr/local/bin:/usr/bin:/bin",
+                    "PYTHONPATH=/app", *svc["env"]],
+            "Entrypoint": ["python", "-m", "tasksrunner", "host",
+                           svc["module"], "--app-port", "8080",
+                           "--sidecar-port", svc["sidecar_port"],
+                           "--host", "0.0.0.0"],
+            "WorkingDir": "/app",
+            "ExposedPorts": {"8080/tcp": {}},
+            "Labels": {
+                "org.opencontainers.image.title":
+                    f"tasksmanager-{service} ({variant})",
+                "org.opencontainers.image.source": "tasksrunner",
+            },
+        },
+        "rootfs": {"type": "layers",
+                   "diff_ids": [l["diff_id"] for l in layers]},
+        "history": [
+            {"created": "1970-01-01T00:00:00Z",
+             "created_by": f"tasksrunner build_oci_image ({l['title']})"}
+            for l in layers
+        ],
+    }
+    config_bytes = json.dumps(config, sort_keys=True,
+                              separators=(",", ":")).encode()
+    manifest = {
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.oci.image.manifest.v1+json",
+        "config": {
+            "mediaType": "application/vnd.oci.image.config.v1+json",
+            "digest": f"sha256:{sha256(config_bytes)}",
+            "size": len(config_bytes),
+        },
+        "layers": [
+            {"mediaType": "application/vnd.oci.image.layer.v1.tar+gzip",
+             "digest": l["digest"], "size": l["size"],
+             "annotations": {"org.opencontainers.image.title": l["title"]}}
+            for l in layers
+        ],
+    }
+    manifest_bytes = json.dumps(manifest, sort_keys=True,
+                                separators=(",", ":")).encode()
+    index = {
+        "schemaVersion": 2,
+        "mediaType": "application/vnd.oci.image.index.v1+json",
+        "manifests": [{
+            "mediaType": "application/vnd.oci.image.manifest.v1+json",
+            "digest": f"sha256:{sha256(manifest_bytes)}",
+            "size": len(manifest_bytes),
+            "annotations": {
+                "org.opencontainers.image.ref.name":
+                    f"tasksmanager-{service}:{variant}",
+            },
+        }],
+    }
+
+    image_dir = out_dir / f"{service}-{variant}"
+    blobs = image_dir / "blobs" / "sha256"
+    if image_dir.exists():
+        shutil.rmtree(image_dir)
+    blobs.mkdir(parents=True)
+    (image_dir / "oci-layout").write_text(
+        json.dumps({"imageLayoutVersion": "1.0.0"}) + "\n")
+    (image_dir / "index.json").write_text(
+        json.dumps(index, sort_keys=True, separators=(",", ":")) + "\n")
+    for l in layers:
+        blob = blobs / l["digest"].split(":", 1)[1]
+        if not blob.exists():
+            blob.write_bytes(l["bytes"])
+    (blobs / sha256(config_bytes)).write_bytes(config_bytes)
+    (blobs / sha256(manifest_bytes)).write_bytes(manifest_bytes)
+
+    payload_layers = layers[1:3]  # payload + app: what the variant controls
+    return {
+        "image": f"tasksmanager-{service}:{variant}",
+        "path": str(image_dir),
+        "layers": [{k: l[k] for k in
+                    ("title", "digest", "size", "uncompressed_size")}
+                   for l in layers],
+        "total_compressed": sum(l["size"] for l in layers),
+        "total_uncompressed": sum(l["uncompressed_size"] for l in layers),
+        "payload_compressed": sum(l["size"] for l in payload_layers),
+        "payload_uncompressed": sum(l["uncompressed_size"]
+                                    for l in payload_layers),
+    }
+
+
+# ---------------------------------------------------------------------------
+# verification (what skopeo/crane would check, minus the registry)
+# ---------------------------------------------------------------------------
+
+def verify_layout(image_dir: pathlib.Path) -> None:
+    """Walk index → manifest → config + layers, re-hashing every blob
+    and re-deriving every diff_id. Raises LayoutError on any mismatch
+    — explicit raises, not assert, so `python -O` cannot strip the
+    checks out of a verification tool. The replay test drives this."""
+
+    def check(cond: bool, msg: str) -> None:
+        if not cond:
+            raise LayoutError(f"{image_dir.name}: {msg}")
+
+    layout = json.loads((image_dir / "oci-layout").read_text())
+    check(layout.get("imageLayoutVersion") == "1.0.0",
+          f"bad oci-layout: {layout}")
+
+    def blob(digest: str) -> bytes:
+        algo, hexd = digest.split(":", 1)
+        check(algo == "sha256", f"unsupported digest algo in {digest}")
+        data = (image_dir / "blobs" / algo / hexd).read_bytes()
+        check(sha256(data) == hexd, f"blob {digest} corrupt")
+        return data
+
+    index = json.loads((image_dir / "index.json").read_text())
+    check(index.get("schemaVersion") == 2, "index schemaVersion != 2")
+    for mdesc in index["manifests"]:
+        manifest = json.loads(blob(mdesc["digest"]))
+        check(manifest.get("mediaType")
+              == "application/vnd.oci.image.manifest.v1+json",
+              f"bad manifest mediaType: {manifest.get('mediaType')}")
+        config_bytes = blob(manifest["config"]["digest"])
+        check(len(config_bytes) == manifest["config"]["size"],
+              "config size mismatch")
+        config = json.loads(config_bytes)
+        diff_ids = config["rootfs"]["diff_ids"]
+        check(len(diff_ids) == len(manifest["layers"]),
+              "diff_ids/layers count mismatch")
+        for ldesc, diff_id in zip(manifest["layers"], diff_ids):
+            gz_bytes = blob(ldesc["digest"])
+            check(len(gz_bytes) == ldesc["size"],
+                  f"layer size mismatch: {ldesc}")
+            tar_bytes = gzip.decompress(gz_bytes)
+            check(f"sha256:{sha256(tar_bytes)}" == diff_id,
+                  f"diff_id mismatch for {ldesc}")
+            # and the tar must actually parse
+            with tarfile.open(fileobj=io.BytesIO(tar_bytes)) as tar:
+                check(bool(tar.getmembers()), "empty layer tar")
+        check(config["config"]["Entrypoint"][0] == "python",
+              "unexpected entrypoint")
+
+
+# ---------------------------------------------------------------------------
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=str(REPO / "build" / "oci"))
+    parser.add_argument("--json", action="store_true")
+    parser.add_argument("--verify", action="store_true",
+                        help="verify existing layouts instead of building")
+    parser.add_argument("--service", choices=sorted(SERVICES))
+    parser.add_argument("--variant", choices=["default", "optimized"])
+    args = parser.parse_args()
+    out_dir = pathlib.Path(args.out)
+
+    services = [args.service] if args.service else sorted(SERVICES)
+    variants = [args.variant] if args.variant else ["default", "optimized"]
+
+    if args.verify:
+        for service in services:
+            for variant in variants:
+                verify_layout(out_dir / f"{service}-{variant}")
+                print(f"ok {service}-{variant}")
+        return
+
+    shared: dict = {}
+    results = [build_image(s, v, out_dir, shared)
+               for s in services for v in variants]
+    for image_dir in [out_dir / f"{s}-{v}" for s in services for v in variants]:
+        verify_layout(image_dir)
+
+    mb = 1024.0 * 1024.0
+    # fleet-wide saving: summed payload bytes across every built
+    # service, per variant (a first-service-only figure would misstate
+    # the fleet when app layers diverge)
+    payload_by_variant: dict[str, int] = {}
+    for r in results:
+        variant = r["image"].rsplit(":", 1)[1]
+        payload_by_variant[variant] = (payload_by_variant.get(variant, 0)
+                                       + r["payload_uncompressed"])
+    summary = {
+        "images": results,
+        "payload_saving_pct": None,
+    }
+    if {"default", "optimized"} <= payload_by_variant.keys():
+        d = payload_by_variant["default"]
+        o = payload_by_variant["optimized"]
+        summary["payload_saving_pct"] = round(100.0 * (1 - o / d), 1)
+
+    if args.json:
+        for r in results:  # bytes are not JSON; sizes are
+            for l in r["layers"]:
+                l.pop("bytes", None)
+        print(json.dumps(summary, indent=2))
+        return
+
+    for r in results:
+        print(f"\n{r['image']}  ({r['path']})")
+        for l in r["layers"]:
+            print(f"  {l['title']:<16} {l['size']/mb:8.2f} MB gz "
+                  f"({l['uncompressed_size']/mb:8.2f} MB)  {l['digest'][:25]}…")
+        print(f"  {'TOTAL':<16} {r['total_compressed']/mb:8.2f} MB gz "
+              f"({r['total_uncompressed']/mb:8.2f} MB)")
+    if summary["payload_saving_pct"] is not None:
+        print(f"\npayload saving (variant-controlled layers), "
+              f"default → optimized: {summary['payload_saving_pct']}%")
+
+
+if __name__ == "__main__":
+    main()
