@@ -33,8 +33,14 @@ fast-fails while open, automatic recovery closing it), module 14
 incident: poison → dead-letter → diagnose → purge), and module 15
 (the secure baseline: fail-closed apply, per-app identities refusing
 even the operator on the data plane, token-gated control plane, and
-the untouched app with its integration gated off) — plus module 12's
-daemonless footprint measurement and its >=50% payload-saving claim.
+the untouched app with its integration gated off) — plus module 11b
+(the GitHub Actions pipeline rehearsed job by job from the page text,
+including the smoke write through the public frontend and the 401
+data-plane fence the page's warning box promises), module 11c (the
+broken-manifest rehearsal that proves the ADO stage gate), and module
+12's daemonless footprint measurement, its >=50% payload-saving
+claim, and the real OCI image artifacts (build, digest-walk
+verification, layer dedup, reproducibility, corrupted-blob failure).
 
 Mechanics: commands run with the scratch dir as cwd (so `.tasksrunner/`
 state lands there) with `samples/` and `run.yaml` reachable, exactly as
@@ -164,6 +170,14 @@ class Scratch:
             p.wait(timeout=5)
         except subprocess.TimeoutExpired:
             pass
+
+    def materialize_samples(self) -> None:
+        """Swap the samples symlink for a real copy (tests that APPLY
+        deployments need a writable .tasksrunner dir under samples/)."""
+        import shutil
+        (self.dir / "samples").unlink()
+        shutil.copytree(REPO / "samples", self.dir / "samples",
+                        ignore=shutil.ignore_patterns(".tasksrunner"))
 
     def close(self) -> None:
         for p in self.procs:
@@ -644,11 +658,7 @@ def test_module_11_declarative_deploys(scratch):
     """The four verbs with the doc's own outputs: validate, the
     first-run create, apply's artifacts, the empty diff, the exact
     touched path after an edit, and booting from generated artifacts."""
-    import shutil
-
-    (scratch.dir / "samples").unlink()
-    shutil.copytree(REPO / "samples", scratch.dir / "samples",
-                    ignore=shutil.ignore_patterns(".tasksrunner"))
+    scratch.materialize_samples()
     blocks = bash_blocks("11-deploy.md")
 
     out = scratch.run(block_with(blocks, "deploy validate"))
@@ -687,6 +697,94 @@ def test_module_11_declarative_deploys(scratch):
         assert time.monotonic() < deadline, ps
         time.sleep(0.5)
     scratch.stop_proc(orch)
+
+
+def test_module_11b_github_pipeline_rehearsal(scratch):
+    """Module 11b replayed: the pipeline's own commands — validate,
+    what-if, apply, the smoke step's two probes, teardown — run from
+    the page text in job order, printing the outputs the page quotes.
+    This is the CI pipeline executed locally, which is the page's
+    whole thesis."""
+    scratch.materialize_samples()
+    blocks = bash_blocks("11-deploy-ci-github.md")
+
+    # job 1: lint-validate
+    out = scratch.run(block_with(blocks, "deploy validate"))
+    assert "manifest 'tasks-tracker-env' is valid (3 apps, 7 components)" in out
+    # job 2: what-if — first run shows the full create
+    out = scratch.run(block_with(blocks, "deploy what-if"))
+    assert "+ tasks-tracker-env" in out
+    # job 3: apply
+    out = scratch.run(block_with(blocks, "deploy apply"))
+    assert "applied 1 change(s)" in out
+
+    # the smoke step: boot from the generated run config, then drive
+    # one real write through the frontend — the public door — exactly
+    # as the page's block does (the page backgrounds with `&` +
+    # kill %1; the test manages the process itself)
+    smoke = block_with(blocks, "ci-smoke")
+    lines = smoke.strip().splitlines()
+    # the boot prefix = everything up to the backgrounded run command;
+    # fail loudly if the page's block shape changes
+    boot_end = next(i for i, l in enumerate(lines) if l.rstrip().endswith("&"))
+    boot_lines = lines[:boot_end + 1]
+    assert boot_lines[0].startswith("export SENDGRID_API_KEY"), boot_lines
+    assert any("tasksrunner run" in l for l in boot_lines), boot_lines
+    assert len(boot_lines) == 3, boot_lines
+    boot = "\n".join(boot_lines).replace("timeout 30 ", "")
+    orch = scratch.spawn(boot.rstrip("& \n"))
+    for port in (5189, 3500):
+        scratch.wait_port(port)
+    jar = scratch.dir / "jar"
+    out = scratch.run(
+        f"curl -sf -c {jar} -b {jar} http://127.0.0.1:5189/ -o /dev/null "
+        f"&& echo frontend-ok")
+    assert "frontend-ok" in out
+    # the page's warning box, enforced: the token-fenced data plane
+    # refuses the runner's direct sidecar curl
+    out = scratch.run(
+        "curl -s -o /dev/null -w '%{http_code}' -X POST "
+        "http://127.0.0.1:3500/v1.0/invoke/tasksmanager-backend-api"
+        "/method/api/tasks -H 'content-type: application/json' "
+        "-d '{\"taskName\":\"x\"}'")
+    assert "401" in out
+    scratch.run(f"curl -sf -c {jar} -b {jar} -X POST "
+                f"http://127.0.0.1:5189/ -d 'email=ci@x.com' -o /dev/null")
+    scratch.run(
+        f"curl -sf -c {jar} -b {jar} -X POST "
+        f"http://127.0.0.1:5189/tasks/create "
+        f"-d 'taskName=ci-smoke&taskAssignedTo=ci@x.com"
+        f"&taskDueDate=2026-12-31' -o /dev/null")
+    out = scratch.run(
+        f"curl -sf -c {jar} -b {jar} http://127.0.0.1:5189/tasks "
+        f"| grep ci-smoke")
+    assert "ci-smoke" in out
+    scratch.stop_proc(orch)
+
+    # teardown path: down removes state; what-if shows the create again
+    out = scratch.run(block_with(blocks, "deploy down"))
+    assert "environment 'tasks-tracker-env' state removed" in out
+    out = scratch.run(block_with(blocks, "deploy what-if"))
+    assert "+ tasks-tracker-env" in out
+
+
+def test_module_11c_azdo_stage_gating(scratch):
+    """Module 11c §3 replayed: the broken-manifest rehearsal — the
+    duplicated app_id fails `validate` non-zero with the duplicate
+    named, the gate that stops both CI systems' later stages."""
+    blocks = bash_blocks("11-deploy-ci-azdo.md")
+    block = block_with(blocks, "broken-env.yaml")
+    # the page writes to /tmp; keep the rehearsal inside the scratch dir
+    block = block.replace("/tmp/broken-env.yaml",
+                          str(scratch.dir / "broken-env.yaml"))
+    out = scratch.run(block, check=False)
+    assert "tasksmanager-backend-api" in out
+    assert "duplicate" in out.lower()
+    # and the verb really exited non-zero (the stage gate)
+    rc = scratch.run(
+        f"python -m tasksrunner deploy validate "
+        f"{scratch.dir / 'broken-env.yaml'} >/dev/null 2>&1; echo rc=$?")
+    assert "rc=0" not in rc
 
 
 def test_module_10_secrets(scratch):
