@@ -3,22 +3,37 @@
 Runs a scaled-down version of bench.py's headline measurement — the
 faithful cross-process topology (separate api/processor OS processes,
 the [PB] process boundaries of SURVEY.md §3.1 over real localhost
-HTTP) — and fails if throughput or tail latency regress.
+transports) — and fails if throughput or tail latency regress.
 
-Calibration (round 3, this hardware): ~1,180 tasks/s, p50 7.3 ms,
-p99 19 ms. Floors sit within ~2.5x of those so a real regression (a
-serialization bug, an accidental per-request reconnect, a reintroduced
-intra-process HTTP hop, a broker poll pathology) trips the suite while
-ordinary host noise does not. A deliberate 3x slowdown MUST fail here.
+The floors are CALIBRATION-RELATIVE (round 4): a fixed-work probe
+(json + hashing + sqlite commits — the write path's instruction mix)
+measures how fast THIS host executes the framework's kind of work, and
+the floors scale by the ratio to the dev-host baseline. A slower CI
+runner gets a proportionally lower floor instead of a skipped gate —
+fixed floors had to be disabled on shared runners, which meant a 2x
+regression merged green everywhere (round-3 verdict). Hosts measuring
+under half the baseline are outside the calibration's linear range:
+the gate SKIPS there with the measured ratio in the message (visible
+in the test summary, unlike a permanently-exported env var), and
+TASKSRUNNER_PERF_TESTS=0 stays available as the manual override.
+Faster hosts cap at 1.5x, and the p99 ceiling never tightens below
+its baseline (tail latency is fixed-cost dominated).
 
-On a machine slower than the calibration host (shared CI), skip these
-wall-clock tests with TASKSRUNNER_PERF_TESTS=0 rather than loosening
-the floors — loose floors guard nothing.
+A deliberate slowdown MUST trip the gate: the last test injects one
+(per-message work in the consumer, capping the pipeline well under the
+floor) and asserts the same gate logic fails it.
+
+Dev-host baselines (1-core, round 4): calibration ~110k ops/s; gate
+topology ~1,600-2,400 tasks/s (200-task rounds), p99 12-22 ms.
 """
 
-import os
+import functools
+import hashlib
+import json
 import pathlib
+import sqlite3
 import sys
+import time
 
 import pytest
 
@@ -32,25 +47,114 @@ pytestmark = pytest.mark.skipif(
     not env_flag("TASKSRUNNER_PERF_TESTS"),
     reason="wall-clock perf gates disabled (TASKSRUNNER_PERF_TESTS=0)")
 
+#: calibration ops/s on the host the floors were tuned on
+CAL_BASELINE = 110_000.0
+#: throughput floor AT the calibration baseline — ~2.2x under the
+#: measured 1,600-2,400 tasks/s band for this scaled-down run
+BASE_THROUGHPUT_FLOOR = 900.0
+#: p99 ceiling at the baseline (measured 12-22 ms at concurrency 8)
+BASE_P99_CEILING_MS = 40.0
+
+
+def calibrate(n: int = 3000, rounds: int = 3) -> float:
+    """ops/s of a fixed probe with the write path's instruction mix:
+    JSON encode/decode, hashing, sqlite inserts with batched commits.
+    Best-of-rounds — transient host contention only lowers a round."""
+    doc = {"taskName": "calibration task", "taskCreatedBy": "cal@x.com",
+           "taskDueDate": "2026-08-01T00:00:00", "isCompleted": False}
+    best = 0.0
+    for _ in range(rounds):
+        conn = sqlite3.connect(":memory:")
+        conn.execute("CREATE TABLE t (k TEXT PRIMARY KEY, v TEXT)")
+        t0 = time.perf_counter()
+        for i in range(n):
+            s = json.dumps({**doc, "taskId": f"t{i}"})
+            json.loads(s)
+            h = hashlib.sha256(s.encode()).hexdigest()
+            conn.execute("INSERT OR REPLACE INTO t VALUES (?, ?)",
+                         (h[:16], s))
+            if i % 64 == 0:
+                conn.commit()
+        conn.commit()
+        conn.close()
+        best = max(best, n / (time.perf_counter() - t0))
+    return best
+
+
+@functools.cache
+def host_ratio() -> float:
+    """This host's speed relative to the calibration baseline (cached:
+    every gate in the session must judge against the SAME ratio).
+
+    Hosts measuring below half the baseline are outside the
+    calibration's linear range — the gate SKIPS there, visibly, rather
+    than failing every run on a floor that was never calibrated for
+    them (the round-3 fixed floors died exactly that death). Faster
+    hosts are capped at 1.5x so a probe overestimate cannot raise the
+    floor past the measured band."""
+    ratio = calibrate() / CAL_BASELINE
+    if ratio < 0.5:
+        pytest.skip(
+            f"host measures {ratio:.2f}x the calibration baseline — "
+            f"outside the perf gate's linear range (<0.5x); floors "
+            f"would be uncalibrated noise here")
+    return min(1.5, ratio)
+
+
+def check_gate(result: dict, ratio: float) -> list[str]:
+    """The gate logic, shared by the real gate and the
+    simulated-regression test: [] = pass, else failure messages."""
+    failures = []
+    floor = BASE_THROUGHPUT_FLOOR * ratio
+    if result["throughput"] <= floor:
+        failures.append(
+            f"cross-process write path regressed: {result['throughput']} "
+            f"tasks/s <= floor {floor:.0f} (host ratio {ratio:.2f})")
+    if "p99_ms" in result:
+        # slower hosts get a raised ceiling; faster hosts KEEP the
+        # baseline ceiling (tail latency is dominated by fixed costs —
+        # localhost RTT, event-loop scheduling — that do not shrink
+        # with per-core speed, so tightening would false-positive)
+        ceiling = BASE_P99_CEILING_MS / min(ratio, 1.0)
+        if result["p99_ms"] >= ceiling:
+            failures.append(
+                f"write-path p99 regressed: {result['p99_ms']} ms >= "
+                f"ceiling {ceiling:.0f} ms (host ratio {ratio:.2f})")
+    return failures
+
 
 async def test_xproc_write_path_throughput_and_latency():
+    ratio = host_ratio()
     result = await run_xproc(
         n_tasks=200, warmup=20, rounds=2, latency_probe=True)
-    # measured 1,181 tasks/s; floor at 450 = a 2.6x regression budget
-    assert result["throughput"] > 450, (
-        f"cross-process write path regressed: {result['throughput']} tasks/s")
-    # measured p99 15-22 ms at concurrency 8 across runs; floor at 45 ms
-    assert result["p99_ms"] < 45, (
-        f"write-path p99 regressed: {result['p99_ms']} ms")
+    # the latency gate must never silently vanish: the probe's key is
+    # part of run_xproc's contract for this call
+    assert "p99_ms" in result, f"latency probe missing from {result}"
+    failures = check_gate(result, ratio)
+    assert not failures, failures
 
 
 async def test_xproc_competing_consumers_scale():
     # with 25 ms of work per message one replica caps at ~40/s; three
     # replicas must demonstrably beat one (competing-consumer contract,
-    # SURVEY.md §5.8). Measured ~2.8x on this host; floor at 2.0x.
+    # SURVEY.md §5.8). A ratio of throughputs — host-speed independent.
     one = await run_xproc(n_tasks=60, warmup=5, rounds=1, work_ms=25.0)
     three = await run_xproc(n_tasks=60, warmup=5, rounds=1,
                             n_processors=3, work_ms=25.0)
     assert three["throughput"] > 2.0 * one["throughput"], (
         f"scale-out broken: 1 replica {one['throughput']} tasks/s, "
         f"3 replicas {three['throughput']} tasks/s")
+
+
+async def test_gate_catches_simulated_regression():
+    """The gate's reason to exist, proven every run: inject a real
+    slowdown (3 ms of per-message consumer work drags pipeline
+    completion under ~350 tasks/s — like a reintroduced blocking call
+    in the delivery handler) and the SAME gate logic must fail it."""
+    ratio = host_ratio()
+    slowed = await run_xproc(n_tasks=120, warmup=10, rounds=1, work_ms=3.0)
+    failures = check_gate(slowed, ratio)
+    assert failures, (
+        f"gate failed to catch a simulated regression: "
+        f"{slowed['throughput']} tasks/s passed floor "
+        f"{BASE_THROUGHPUT_FLOOR * ratio:.0f}")
