@@ -13,10 +13,19 @@ set -u
 VARS_FILE="${TASKSRUNNER_VARS_FILE:-.tasksrunner/variables.env}"
 ACTION="${1:-restore}"
 
+# restore only works when SOURCED: a child process can export into
+# itself, never into the shell that launched it — executed directly,
+# "restore" would print success and change nothing
+if [[ "$ACTION" == "restore" && "${BASH_SOURCE[0]:-}" == "$0" ]]; then
+  echo "warning: run as 'source $0 restore' — executed directly, the" >&2
+  echo "restored variables die with this subshell" >&2
+  exit 1
+fi
+
 case "$ACTION" in
   save)
     mkdir -p "$(dirname "$VARS_FILE")"
-    env | grep -E '^(TASKSRUNNER_|TR_|TASKS_MANAGER=|SENDGRID_)' | sort > "$VARS_FILE"
+    env | grep -E '^(TASKSRUNNER_|TR_|TASKS_MANAGER=|SENDGRID_)' | LC_ALL=C sort > "$VARS_FILE"
     echo "saved $(wc -l < "$VARS_FILE") variable(s) to $VARS_FILE"
     ;;
   restore)

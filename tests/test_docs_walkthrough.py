@@ -41,6 +41,11 @@ broken-manifest rehearsal that proves the ADO stage gate), and module
 12's daemonless footprint measurement, its >=50% payload-saving
 claim, and the real OCI image artifacts (build, digest-walk
 verification, layer dedup, reproducibility, corrupted-blob failure).
+The appendices replay too: session variables (save / fresh-shell
+restore / update-in-place / direct-execution warning / the restored
+environment booting the full sample) and the debugging appendix's
+one-terminal forensic loop (ps, logs, the traces pivot, the
+deliberate restart and the re-resolve recovery that follows).
 
 Mechanics: commands run with the scratch dir as cwd (so `.tasksrunner/`
 state lands there) with `samples/` and `run.yaml` reachable, exactly as
@@ -1169,4 +1174,117 @@ def test_module_09_autoscale_flood(scratch):
     out = scratch.run(block_with(blocks, "dlq list"))
     assert "no dead letters" in out
 
+    scratch.stop_proc(orch)
+
+
+def test_appendix_variables(scratch):
+    """The session-variables appendix replayed as the two sittings it
+    describes: save at the end of one shell, restore in a fresh one,
+    update in place, and the direct-execution warning."""
+    (scratch.dir / "scripts").symlink_to(REPO / "scripts")
+    blocks = bash_blocks("31-appendix-variables.md")
+
+    # sitting 1 ends: export + save (one shell, the page's block)
+    out = scratch.run(block_with(blocks, "set_variables.sh save"))
+    assert "saved 3 variable(s)" in out
+    out = scratch.run(block_with(blocks, "set_variables.sh show"))
+    assert out.splitlines()[:3] == [
+        "SENDGRID_API_KEY=sg-123",
+        "TASKSRUNNER_API_TOKEN=tok-1",
+        "TASKS_MANAGER=store",
+    ]
+
+    # sitting 2: a FRESH shell restores and the state is back
+    out = scratch.run(block_with(blocks, "manager=$TASKS_MANAGER"))
+    assert "restored 3 variable(s)" in out
+    assert "manager=store key=sg-123" in out
+
+    # §4 update-in-place: changed value, still THREE lines (the doc's
+    # checkpoint 3 — an update must not shrink the snapshot)
+    out = scratch.run(block_with(blocks, "TASKS_MANAGER=fake"))
+    assert "TASKS_MANAGER=fake" in out
+    show = scratch.run("source scripts/set_variables.sh show")
+    assert show.count("TASKS_MANAGER=fake") == 1
+    assert len([l for l in show.splitlines() if "=" in l]) == 3, show
+    # put the store value back for the boot below
+    scratch.run("source scripts/set_variables.sh restore && "
+                "export TASKS_MANAGER=store && "
+                "source scripts/set_variables.sh save")
+
+    # checkpoint 4: executed directly, restore warns and fails
+    out = scratch.run("bash scripts/set_variables.sh restore; echo rc=$?")
+    assert "die" in out or "source" in out
+    assert "rc=1" in out
+
+    # §2's proof: the restored environment boots the full sample
+    # (sendgrid secretRef resolves from the restored shell)
+    orch = scratch.spawn(
+        "source scripts/set_variables.sh restore && "
+        "python -m tasksrunner run run.yaml")
+    for port in (5103, 5189, 5217):
+        scratch.wait_port(port)
+    deadline = time.monotonic() + 30
+    while True:
+        ps = scratch.run("python -m tasksrunner ps", check=False)
+        if ps.count("ok") >= 3:
+            break
+        assert time.monotonic() < deadline, ps
+        time.sleep(0.5)
+    scratch.stop_proc(orch)
+
+
+def test_appendix_debugging_forensic_loop(scratch):
+    """The debugging appendix's one-terminal altitude, replayed: boot
+    the topology, run the forensic commands the page lists (ps, logs
+    --tail, traces list/show/map), then the deliberate-kill move and
+    the recovery the page promises."""
+    blocks = bash_blocks("30-appendix-debugging.md")
+    orch = scratch.spawn(block_with(blocks, "tasksrunner run run.yaml"),
+                         extra_env={"SENDGRID_API_KEY": "sg-dbg"})
+    for port in (5103, 5189, 5217):
+        scratch.wait_port(port)
+    deadline = time.monotonic() + 30
+    while True:
+        ps = scratch.run(block_with(blocks, "tasksrunner ps"), check=False)
+        if ps.count("ok") >= 3:
+            break
+        assert time.monotonic() < deadline, ps
+        time.sleep(0.5)
+
+    # make one transaction to have something to inspect
+    scratch.run("curl -sf -X POST http://127.0.0.1:3500/v1.0/invoke/"
+                "tasksmanager-backend-api/method/api/tasks "
+                "-H 'content-type: application/json' "
+                "-d '{\"taskName\":\"dbg\",\"taskCreatedBy\":\"d@x.com\"}'")
+
+    out = scratch.run(block_with(blocks, "logs tasksmanager-backend-api"))
+    assert "role=tasksmanager-backend-api" in out
+    # the three pivot commands share one block; run line by line,
+    # filling the <trace-id> placeholder the way the reader would
+    pivot = [l.split("#")[0].strip()
+             for l in block_with(blocks, "traces list").splitlines()
+             if l.strip()]
+    assert len(pivot) == 3, pivot
+    out = scratch.run(pivot[0])                       # traces list
+    trace_id = out.split()[0]
+    out = scratch.run(pivot[1].replace("<trace-id>", trace_id))
+    assert "invoke" in out or "POST" in out
+    out = scratch.run(pivot[2])                       # traces map --mermaid
+    assert "graph" in out or "-->" in out  # mermaid output
+
+    # the deliberate kill: staged restart, then recovery
+    out = scratch.run(block_with(blocks, "tasksrunner restart"))
+    deadline = time.monotonic() + 30
+    while True:
+        ps = scratch.run("python -m tasksrunner ps", check=False)
+        if ps.count("ok") >= 3:
+            break
+        assert time.monotonic() < deadline, ps
+        time.sleep(0.5)
+    # the re-resolve argument: the same invoke works after the restart
+    out = scratch.run("curl -sf -X POST http://127.0.0.1:3500/v1.0/invoke/"
+                      "tasksmanager-backend-api/method/api/tasks "
+                      "-H 'content-type: application/json' "
+                      "-d '{\"taskName\":\"dbg2\",\"taskCreatedBy\":\"d@x.com\"}'")
+    assert "taskId" in out
     scratch.stop_proc(orch)
