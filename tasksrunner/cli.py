@@ -29,6 +29,7 @@ import argparse
 import asyncio
 import importlib
 import logging
+import os
 import sys
 
 from tasksrunner.app import App
@@ -144,7 +145,8 @@ def _cmd_sidecar(args) -> None:
         try:
             await asyncio.Event().wait()
         finally:
-            resolver.unregister(args.app_id)
+            resolver.unregister(args.app_id, pid=os.getpid(),
+                                sidecar_port=sidecar.port)
             await sidecar.stop()
 
     _run_until_interrupt(main())
@@ -321,20 +323,28 @@ def _cmd_ps(args) -> None:
 
         net_errors = (OSError, asyncio.TimeoutError, aiohttp.ClientError)
 
-        async def probe(s, app_id):
-            from tasksrunner.errors import AppNotFound
-
-            try:
-                addr = resolver.resolve(app_id)
-            except AppNotFound:
+        async def probe_app(s, app_id):
+            """One row per registered replica (≙ `az containerapp
+            replica list`); scale-out replicas show as app-id·N."""
+            replicas = resolver.resolve_all(app_id)
+            if not replicas:
                 # unregistered between listing and probing — report it,
                 # don't abort the other rows
-                return {"app_id": app_id, "pid": None, "app_port": None,
-                        "sidecar_port": None, "host": None,
-                        "up_seconds": None, "health": "gone",
-                        "components": None, "subscriptions": None}
+                return [{"app_id": app_id, "pid": None, "app_port": None,
+                         "sidecar_port": None, "host": None,
+                         "up_seconds": None, "health": "gone",
+                         "components": None, "subscriptions": None}]
+            return await asyncio.gather(
+                *(probe(s, app_id, addr, idx, len(replicas))
+                  for idx, addr in enumerate(replicas)))
+
+        async def probe(s, app_id, addr, idx, n_replicas):
+            # app_id stays the clean machine-readable key (--json
+            # consumers filter on it); the replica ordinal is its own
+            # field and only the human-readable table fuses them
             row = {
                 "app_id": app_id,
+                "replica": idx if n_replicas > 1 else None,
                 "pid": addr.pid,
                 "app_port": addr.app_port,
                 "sidecar_port": addr.sidecar_port,
@@ -377,7 +387,9 @@ def _cmd_ps(args) -> None:
             return row
 
         async with aiohttp.ClientSession(timeout=timeout) as session:
-            return await asyncio.gather(*(probe(session, a) for a in app_ids))
+            groups = await asyncio.gather(
+                *(probe_app(session, a) for a in app_ids))
+            return [row for group in groups for row in group]
 
     rows = asyncio.run(probe_all())
     any_down = any(r["health"] in ("down", "app-down", "gone") for r in rows)
@@ -394,11 +406,15 @@ def _cmd_ps(args) -> None:
         h, m = divmod(m, 60)
         return f"{h}h{m:02d}m" if h else f"{m}m{s:02d}s"
 
-    width = max(6, max(len(r["app_id"]) for r in rows))
+    def tag(r):
+        return (r["app_id"] if r.get("replica") is None
+                else f"{r['app_id']}·{r['replica']}")
+
+    width = max(6, max(len(tag(r)) for r in rows))
     print(f"{'APP-ID':<{width}}  {'PID':>7}  {'APP':>5}  {'SIDECAR':>7}  "
           f"{'HEALTH':<9}  {'COMPS':>5}  {'SUBS':>4}  UP")
     for r in rows:
-        print(f"{r['app_id']:<{width}}  {r['pid'] or '-':>7}  "
+        print(f"{tag(r):<{width}}  {r['pid'] or '-':>7}  "
               f"{r['app_port'] or '-':>5}  {r['sidecar_port'] or '-':>7}  "
               f"{r['health']:<9}  "
               f"{'-' if r['components'] is None else r['components']:>5}  "
@@ -865,19 +881,32 @@ def _cmd_stop(args) -> None:
     from tasksrunner.invoke.resolver import NameResolver
 
     resolver = NameResolver(registry_file=args.registry_file)
-    try:
-        addr = resolver.resolve(args.app_id)
-    except AppNotFound:
+    replicas = resolver.resolve_all(args.app_id)
+    if not replicas:
         known = ", ".join(resolver.known_apps()) or "(none registered)"
         raise SystemExit(
             f"app {args.app_id!r} is not registered; running apps: {known}")
-    if not addr.pid:
-        raise SystemExit(f"registry has no pid for {args.app_id!r}")
-    try:
-        os.kill(addr.pid, signal.SIGTERM)
-    except ProcessLookupError:
-        raise SystemExit(f"{args.app_id}: pid {addr.pid} is already gone")
-    print(f"sent SIGTERM to {args.app_id} (pid {addr.pid})")
+    # every replica of the app, as `dapr stop` stops the whole app —
+    # each outcome reported on its own line, never summarized away
+    signalled = 0
+    failures = []
+    for addr in replicas:
+        if not addr.pid:
+            failures.append(f"registry has no pid for {args.app_id!r}")
+            continue
+        try:
+            os.kill(addr.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            failures.append(
+                f"{args.app_id}: pid {addr.pid} is already gone "
+                f"(stale registration)")
+        else:
+            signalled += 1
+            print(f"sent SIGTERM to {args.app_id} (pid {addr.pid})")
+    for msg in failures:
+        print(f"warning: {msg}", file=sys.stderr)
+    if not signalled:
+        raise SystemExit("; ".join(failures))
 
 
 def _run_until_interrupt(coro) -> None:

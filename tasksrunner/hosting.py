@@ -17,6 +17,7 @@ Two shapes, behaviorally identical (SURVEY.md §7.4 hard part #1):
 from __future__ import annotations
 
 import logging
+import os
 
 from aiohttp import web
 
@@ -173,9 +174,9 @@ class AppHost:
         await self.sidecar.start()
         self.sidecar_port = self.sidecar.port
 
-        # 3. register for peer discovery (scale-out replicas skip this:
-        # they compete on the broker, they don't serve invokes), then
-        # hand the app its client
+        # 3. register for peer discovery — appended to the app's
+        # replica list, so every serving replica is in the invoke
+        # rotation — then hand the app its client
         if self.register:
             self.resolver.register(AppAddress(
                 app_id=self.app.app_id, host=self.host,
@@ -195,7 +196,10 @@ class AppHost:
     async def stop(self) -> None:
         await self.app.shutdown()
         if self.register:
-            self.resolver.unregister(self.app.app_id)
+            # scoped to THIS replica's entry: a stopping replica must
+            # not deregister its siblings
+            self.resolver.unregister(self.app.app_id, pid=os.getpid(),
+                                     sidecar_port=self.sidecar_port)
         if self.client is not None:
             await self.client.close()
         if self.sidecar is not None:

@@ -38,14 +38,36 @@ class AppAddress:
         return f"http://{self.host}:{self.sidecar_port}"
 
 
+def _same_replica(a: dict, b: dict) -> bool:
+    """Entry identity for replace-on-reregister: one replica = one
+    (pid, sidecar_port) pair. pid alone is not enough — several
+    runtimes can share a process (tests, in-proc layouts)."""
+    return a.get("pid") == b.get("pid") and \
+        a.get("sidecar_port") == b.get("sidecar_port")
+
+
 class NameResolver:
-    """app-id → AppAddress, backed by a static table and/or a registry file."""
+    """app-id → replicas of AppAddress, backed by a static table and/or
+    a registry file.
+
+    Multi-replica since round 4: every serving replica of an app
+    registers its own address, and ``resolve`` hands them out
+    round-robin — the local analog of ACA's HTTP ingress
+    load-balancing across an app's replicas (the reference's scale
+    rules add replicas precisely so traffic spreads over them,
+    docs/aca/09-aca-autoscale-keda/index.md). A dead replica's entry
+    fails its connect; the caller's retry re-resolves and the rotation
+    serves the next replica, so one stale entry degrades a request to
+    a retry, never to an outage.
+    """
 
     def __init__(self, *, registry_file: str | pathlib.Path | None = None,
                  static: dict[str, AppAddress] | None = None):
         self.registry_file = pathlib.Path(registry_file) if registry_file else None
-        self._static = dict(static or {})
-        self._cache: dict[str, AppAddress] = {}
+        self._static: dict[str, list[AppAddress]] = {
+            app_id: [addr] for app_id, addr in (static or {}).items()}
+        self._cache: dict[str, list[AppAddress]] = {}
+        self._rr: dict[str, int] = {}
         self._mtime = 0.0
 
     # -- registration ----------------------------------------------------
@@ -55,15 +77,50 @@ class NameResolver:
         if addr.pid is None:
             addr.pid = os.getpid()
         if self.registry_file is None:
-            self._static[addr.app_id] = addr
+            replicas = self._static.setdefault(addr.app_id, [])
+            doc = asdict(addr)
+            replicas[:] = [a for a in replicas
+                           if not _same_replica(asdict(a), doc)] + [addr]
             return
-        self._mutate(lambda entries: entries.__setitem__(addr.app_id, asdict(addr)))
 
-    def unregister(self, app_id: str) -> None:
+        def mutate(entries: dict) -> None:
+            replicas = entries.get(addr.app_id) or []
+            doc = asdict(addr)
+            entries[addr.app_id] = [
+                e for e in replicas if not _same_replica(e, doc)] + [doc]
+
+        self._mutate(mutate)
+
+    def unregister(self, app_id: str, *, pid: int | None = None,
+                   sidecar_port: int | None = None) -> None:
+        """Remove one replica's entry (by pid, optionally narrowed by
+        sidecar_port), or every entry for the app when pid is None —
+        a replica shutting down must not deregister its siblings."""
+        def keep(e: dict) -> bool:
+            if pid is None:
+                return False
+            if e.get("pid") != pid:
+                return True
+            return (sidecar_port is not None
+                    and e.get("sidecar_port") != sidecar_port)
+
         if self.registry_file is None:
-            self._static.pop(app_id, None)
+            replicas = [a for a in self._static.get(app_id, ())
+                        if keep(asdict(a))]
+            if replicas:
+                self._static[app_id] = replicas
+            else:
+                self._static.pop(app_id, None)
             return
-        self._mutate(lambda entries: entries.pop(app_id, None))
+
+        def mutate(entries: dict) -> None:
+            replicas = [e for e in (entries.get(app_id) or []) if keep(e)]
+            if replicas:
+                entries[app_id] = replicas
+            else:
+                entries.pop(app_id, None)
+
+        self._mutate(mutate)
 
     def _mutate(self, fn) -> None:
         """Atomic read-modify-write with a lock file (cross-process)."""
@@ -100,13 +157,17 @@ class NameResolver:
             except FileNotFoundError:
                 pass
 
-    def _read_file(self) -> dict[str, dict]:
+    def _read_file(self) -> dict[str, list[dict]]:
         if self.registry_file is None or not self.registry_file.is_file():
             return {}
         try:
-            return json.loads(self.registry_file.read_text() or "{}")
+            raw = json.loads(self.registry_file.read_text() or "{}")
         except ValueError:
             return {}
+        # legacy single-entry format (pre multi-replica): one dict per
+        # app_id — normalize so every consumer sees a replica list
+        return {app_id: entry if isinstance(entry, list) else [entry]
+                for app_id, entry in raw.items()}
 
     # -- resolution ------------------------------------------------------
 
@@ -121,25 +182,34 @@ class NameResolver:
             return
         self._mtime = mtime
         self._cache = {
-            app_id: AppAddress(**entry) for app_id, entry in self._read_file().items()
+            app_id: [AppAddress(**e) for e in entries]
+            for app_id, entries in self._read_file().items()
         }
 
-    def resolve(self, app_id: str) -> AppAddress:
+    def resolve_all(self, app_id: str) -> list[AppAddress]:
+        """Every registered replica of the app (empty ≠ error here —
+        ``resolve`` owns the not-found contract)."""
         if app_id in self._static:
-            return self._static[app_id]
+            return list(self._static[app_id])
         self._refresh()
-        if app_id in self._cache:
-            return self._cache[app_id]
-        # force one re-read in case the peer registered this instant
-        self._mtime = 0.0
-        self._refresh()
-        try:
-            return self._cache[app_id]
-        except KeyError:
+        if app_id not in self._cache:
+            # force one re-read in case the peer registered this instant
+            self._mtime = 0.0
+            self._refresh()
+        return list(self._cache.get(app_id, ()))
+
+    def resolve(self, app_id: str) -> AppAddress:
+        replicas = self.resolve_all(app_id)
+        if not replicas:
             known = sorted({*self._static, *self._cache})
             raise AppNotFound(
                 f"no app registered with id {app_id!r} (known: {known})"
             ) from None
+        # round-robin across replicas (≙ ACA ingress load balancing);
+        # a failed attempt's re-resolve naturally rotates onward
+        i = self._rr.get(app_id, 0)
+        self._rr[app_id] = (i + 1) % (1 << 30)
+        return replicas[i % len(replicas)]
 
     def known_apps(self) -> list[str]:
         self._refresh()

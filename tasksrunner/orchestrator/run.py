@@ -8,10 +8,11 @@ process.
 
 Each replica is a subprocess running ``python -m tasksrunner host
 <module>`` (app server + sidecar in one process, HTTP between them).
-Replica 0 owns the configured ports and the name-registry entry;
-scale-out replicas get ephemeral ports and skip registration — they
-participate through competing consumption on the shared broker, which
-is exactly how extra ACA replicas of the processor participate.
+Replica 0 owns the configured ports; scale-out replicas take
+ephemeral ports. Every replica registers under the app-id (the
+registry holds a replica list, and peers' invokes round-robin across
+it — ACA's ingress load-balancing) and competes on the shared broker,
+which is exactly how extra ACA replicas participate on both planes.
 """
 
 from __future__ import annotations
@@ -80,7 +81,11 @@ class Replica:
             cmd += ["--app-port", str(self.app.app_port),
                     "--sidecar-port", str(self.app.sidecar_port)]
         else:
-            cmd += ["--app-port", "0", "--sidecar-port", "0", "--no-register"]
+            # scale-out replicas take ephemeral ports and REGISTER them
+            # (round 4): every serving replica joins the app's entry in
+            # the registry, and peers' invokes round-robin across them —
+            # ACA's ingress load-balancing, not just competing consumers
+            cmd += ["--app-port", "0", "--sidecar-port", "0"]
         return cmd
 
     async def start(self) -> None:
@@ -214,7 +219,23 @@ class Replica:
         """Restart on crash with bounded backoff (ACA restart analog)."""
         while not self.stopping:
             assert self.proc is not None
+            dead_pid = self.proc.pid
             code = await self.proc.wait()
+            # evict the dead incarnation's registry entry NOW: a
+            # SIGKILLed replica never unregistered itself, and leaving
+            # it in rotation turns every Nth invoke into a
+            # connect-refused retry until the restart lands
+            try:
+                from tasksrunner.invoke.resolver import NameResolver
+                # off-loop: the registry mutation busy-waits on a lock
+                # file (worst case seconds if the dead replica held it)
+                # and must not freeze the supervisor's event loop
+                await asyncio.to_thread(
+                    NameResolver(registry_file=self.config.registry_file
+                                 ).unregister,
+                    self.app.app_id, pid=dead_pid)
+            except OSError:  # pragma: no cover - registry dir gone at teardown
+                pass
             if self.stopping:
                 return
             if self.manual_restart:

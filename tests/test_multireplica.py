@@ -1,0 +1,163 @@
+"""Multi-replica ingress: every serving replica registers, invokes
+round-robin across them, and one replica's death degrades a request to
+a retry — never to an outage.
+
+≙ ACA's HTTP ingress load-balancing across an app's replicas: the
+reference's scale rules add replicas precisely so traffic spreads over
+them (docs/aca/09-aca-autoscale-keda/index.md), not only so competing
+consumers drain faster. Round 4 brings the same to the local runtime:
+the registry holds a replica LIST per app-id, `resolve` rotates, and
+the invoke path's re-resolve-per-attempt turns a stale entry into the
+next replica instead of an error.
+"""
+
+import asyncio
+import collections
+
+import pytest
+
+from tasksrunner import App, AppHost, load_components
+from tasksrunner.errors import AppNotFound
+from tasksrunner.invoke.resolver import AppAddress, NameResolver
+
+
+# ---------------------------------------------------------------------------
+# resolver unit behavior
+# ---------------------------------------------------------------------------
+
+def _addr(app_id, port, pid):
+    return AppAddress(app_id=app_id, host="127.0.0.1", sidecar_port=port,
+                      app_port=port + 1, pid=pid)
+
+
+def test_resolver_round_robin_and_scoped_unregister(tmp_path):
+    reg = tmp_path / "apps.json"
+    w = NameResolver(registry_file=reg)
+    w.register(_addr("api", 1000, pid=11))
+    w.register(_addr("api", 2000, pid=22))
+
+    r = NameResolver(registry_file=reg)
+    assert len(r.resolve_all("api")) == 2
+    ports = [r.resolve("api").sidecar_port for _ in range(4)]
+    assert sorted(set(ports)) == [1000, 2000]          # both serve
+    assert ports[0] != ports[1]                        # and they rotate
+
+    # re-register (same pid+port) replaces, never duplicates
+    w.register(_addr("api", 2000, pid=22))
+    assert len(NameResolver(registry_file=reg).resolve_all("api")) == 2
+
+    # a stopping replica removes ONLY its own entry
+    w.unregister("api", pid=22, sidecar_port=2000)
+    survivors = NameResolver(registry_file=reg).resolve_all("api")
+    assert [a.sidecar_port for a in survivors] == [1000]
+
+    # unscoped unregister clears the app
+    w.unregister("api")
+    with pytest.raises(AppNotFound):
+        NameResolver(registry_file=reg).resolve("api")
+
+
+def test_resolver_reads_legacy_single_entry_format(tmp_path):
+    """Registry files written before multi-replica hold one dict per
+    app-id; they must keep resolving (mixed-version topologies during
+    an upgrade)."""
+    import dataclasses, json
+    reg = tmp_path / "apps.json"
+    reg.write_text(json.dumps(
+        {"api": dataclasses.asdict(_addr("api", 1000, pid=11))}))
+    r = NameResolver(registry_file=reg)
+    assert r.resolve("api").sidecar_port == 1000
+    # and a new-style register upgrades the entry to a list in place
+    r.register(_addr("api", 2000, pid=22))
+    assert len(NameResolver(registry_file=reg).resolve_all("api")) == 2
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: two replicas behind one app-id
+# ---------------------------------------------------------------------------
+
+COMPONENTS = """
+apiVersion: dapr.io/v1alpha1
+kind: Component
+metadata:
+  name: statestore
+spec:
+  type: state.in-memory
+  version: v1
+"""
+
+
+def _backend(counter: collections.Counter, tag: str) -> App:
+    app = App("backend-api")
+
+    @app.post("/api/work")
+    async def work(req):
+        counter[tag] += 1
+        return {"served_by": tag}
+
+    return app
+
+
+async def _start_pair(tmp_path, counter):
+    (tmp_path / "components.yaml").write_text(COMPONENTS)
+    specs = load_components(tmp_path)
+    registry = str(tmp_path / "apps.json")
+    hosts = [AppHost(_backend(counter, "r0"), specs=specs,
+                     registry_file=registry),
+             AppHost(_backend(counter, "r1"), specs=specs,
+                     registry_file=registry)]
+    for h in hosts:
+        await h.start()
+
+    front = App("frontend")
+    fhost = AppHost(front, specs=specs, registry_file=registry)
+    await fhost.start()
+    return hosts, fhost
+
+
+@pytest.mark.asyncio
+async def test_invokes_spread_across_replicas(tmp_path):
+    counter: collections.Counter = collections.Counter()
+    hosts, fhost = await _start_pair(tmp_path, counter)
+    try:
+        for _ in range(10):
+            resp = await fhost.app.client.invoke_method(
+                "backend-api", "api/work", http_method="POST", data={})
+            assert resp.status == 200
+        # ingress semantics: BOTH replicas served (round-robin ⇒ 5/5)
+        assert counter["r0"] == 5 and counter["r1"] == 5, counter
+    finally:
+        for h in [*hosts, fhost]:
+            await h.stop()
+
+
+@pytest.mark.asyncio
+async def test_replica_loss_degrades_to_retry_not_outage(tmp_path):
+    counter: collections.Counter = collections.Counter()
+    hosts, fhost = await _start_pair(tmp_path, counter)
+    stopped = False
+    try:
+        # kill replica 0 WITHOUT unregistering it (the crash case: a
+        # SIGKILLed process leaves its stale entry in the registry)
+        hosts[0].resolver.register(  # keep a copy of the real entry
+            AppAddress(app_id="backend-api", host="127.0.0.1",
+                       sidecar_port=hosts[0].sidecar_port,
+                       app_port=hosts[0].app_port,
+                       mesh_port=hosts[0].sidecar.mesh_port))
+        real_unregister = hosts[0].resolver.unregister
+        hosts[0].resolver.unregister = lambda *a, **k: None  # simulate SIGKILL
+        await hosts[0].stop()
+        stopped = True
+        hosts[0].resolver.unregister = real_unregister
+
+        # every request must still succeed: the stale entry costs a
+        # retry that re-resolves onto the live replica
+        for _ in range(6):
+            resp = await fhost.app.client.invoke_method(
+                "backend-api", "api/work", http_method="POST", data={})
+            assert resp.status == 200
+            assert resp.json()["served_by"] == "r1"
+        assert counter["r1"] >= 6
+    finally:
+        for h in ([hosts[1], fhost] if stopped else [*hosts, fhost]):
+            await h.stop()

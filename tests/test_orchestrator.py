@@ -167,7 +167,8 @@ async def test_orchestrator_multiprocess_tasks_tracker(tmp_path):
 
         import json
         entries = json.loads(registry.read_text())
-        frontend_port = entries["tasksmanager-frontend-webapp"]["app_port"]
+        # registry entries are replica LISTS since multi-replica ingress
+        frontend_port = entries["tasksmanager-frontend-webapp"][0]["app_port"]
 
         jar = aiohttp.CookieJar(unsafe=True)
         async with aiohttp.ClientSession(cookie_jar=jar) as browser:
