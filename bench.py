@@ -345,6 +345,14 @@ async def run_xproc(n_tasks: int = N_TASKS, *, warmup: int = WARMUP,
             # (BASELINE.md's variance-band table)
             round_rates: list[float] = []
             next_id = warmup
+            # one full-size throwaway round first: the per-request
+            # warmup above exercises the path, but the first *flood*
+            # still pays cold costs (allocator growth, broker file
+            # pages, branch-warm paths) — measured consistently ~20%
+            # below steady state, which would skew the median low
+            await drain(next_id)
+            elapsed = await flood(next_id, n_tasks, concurrency)
+            next_id += n_tasks
             for _ in range(rounds):
                 await drain(next_id)
                 elapsed = await flood(next_id, n_tasks, concurrency)
