@@ -6,13 +6,16 @@ contract's compute path.
 
 Design (per /opt/skills/guides/pallas_guide.md):
 
-* One grid program per (batch, head): at the scorer's shapes
-  (seq ≤ 1k, d_head 64) a head's whole attention fits VMEM
-  comfortably (q/k/v/o ≈ 0.5 MB + one [S,S] f32 score tile ≈ 1 MB of
-  the ~16 MB/core budget), so the kernel is a single fused
-  QKᵀ → softmax → PV with no K-streaming loop — the flash recipe's
-  streaming only pays once S² no longer fits, and the blockwise ring
-  layer (ring.py) already bounds S per device before that point.
+* One grid program per (batch, head-block): at the scorer's shapes
+  (seq ≤ 1k, d_head 64) a head's whole attention fits VMEM easily
+  (q/k/v/o ≈ 0.5 MB + one [S,S] f32 score tile ≈ 1 MB at s=512), so
+  each head is a single fused QKᵀ → softmax → PV with no K-streaming
+  loop — and because one-head programs are overhead-dominated (the
+  round-4 attribution), `_head_block` folds as many heads per program
+  as the ~16 MB/core VMEM budget allows (4 at the bench shapes; the
+  budget math lives in its docstring). The flash recipe's K-streaming
+  only pays once S² no longer fits, and the blockwise ring layer
+  (ring.py) already bounds S per device before that point.
 * Internally arrays are laid out [batch, heads, seq, d_head] so each
   block's minor-most two dims are the full (seq, d_head) tile —
   Pallas TPU requires the last two block dims be tile-aligned or
@@ -26,7 +29,8 @@ Design (per /opt/skills/guides/pallas_guide.md):
   whose backward pass is a second Pallas kernel implementing the
   standard flash backward (recompute P from the saved row-logsumexp,
   then dV = PᵀdO, dS = P∘(dO Vᵀ − Δ), dQ = dS·K, dK = dSᵀ·Q) — same
-  VMEM-residency argument, one kernel launch per (batch, head).
+  VMEM-residency argument, one grid program per (batch, head-block)
+  with a tighter budget (more streams and live score tiles).
 * Off-TPU the kernels run in interpreter mode, so the correctness
   suite (tests/test_ml_extension.py) exercises the exact kernel code
   on CPU against the einsum reference.
@@ -54,16 +58,29 @@ def _dot(a, b, *, trans_b: bool = False):
         preferred_element_type=jnp.float32)
 
 
-def _head_block(h: int) -> int:
+def _head_block(h: int, s: int, d: int, *, n_qkv: int = 4,
+                n_tiles: int = 2) -> int:
     """Heads folded into one grid program. One-head programs are tiny
     (67 MFLOP at the bench shapes) and the per-program pipeline
     overhead dominated the kernel — measured on the v5e, 4 heads per
     program runs the forward 1.7× faster than 1 (2.17 → 1.27 ms at
     b=32 h=16 s=512 d=64), while 8 regresses (VMEM pressure defeats
     the in/out copy pipelining). The loop is a static unroll; results
-    are bit-identical across block sizes."""
+    are bit-identical across block sizes.
+
+    The block size is VMEM-budgeted, not fixed: per program, Pallas
+    double-buffers ``n_qkv``-ish [h_blk, s, d] bf16 streams and the
+    unrolled body keeps ~``n_tiles`` [s, s] f32 score tiles live per
+    head iteration — at larger seq the tiles quadruple, so a blind
+    h_blk=4 would blow the ~16 MB/core budget exactly the way the
+    measured 8-head variant did at s=512."""
+    budget = 12 * 1024 * 1024  # leave headroom under ~16 MB/core
     for blk in (4, 2):
-        if h % blk == 0:
+        if h % blk:
+            continue
+        streams = 2 * n_qkv * blk * s * d * 2          # double-buffered bf16
+        tiles = n_tiles * s * s * 4                    # f32, per iteration
+        if streams + tiles <= budget:
             return blk
     return 1
 
@@ -100,7 +117,7 @@ def _flash_fwd(q, k, v, scale):
     the inputs' dtype (bf16 activations halve the HBM bytes — softmax
     statistics and accumulation stay f32 inside the kernel)."""
     b, h, s, d = q.shape
-    h_blk = _head_block(h)
+    h_blk = _head_block(h, s, d, n_qkv=5, n_tiles=2)
     qkv_spec, lse_spec = _specs(b, s, h, d, h_blk)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, h_blk=h_blk),
@@ -141,7 +158,9 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, l_ref,
 
 def _flash_bwd_call(q, k, v, out, lse, dout, scale):
     b, h, s, d = q.shape
-    h_blk = _head_block(h)
+    # bwd streams more (q/k/v/o/do in, dq/dk/dv out) and keeps more
+    # score-sized temporaries live (s, p, dp, ds)
+    h_blk = _head_block(h, s, d, n_qkv=8, n_tiles=3)
     qkv_spec, lse_spec = _specs(b, s, h, d, h_blk)
     return pl.pallas_call(
         functools.partial(_bwd_kernel, scale=scale, h_blk=h_blk),
@@ -194,24 +213,28 @@ flash_attention.defvjp(_fwd_rule, _bwd_rule)
 # -- ring block update ----------------------------------------------------
 
 def _ring_block_kernel(q_ref, k_ref, v_ref, m_ref, num_ref, den_ref,
-                       m_out, num_out, den_out, *, scale):
+                       m_out, num_out, den_out, *, scale, h_blk):
     """One visiting K/V block folded into the running flash state —
     the ring step's inner update (ring.py `_block_update`) as one
     fused kernel: logits, running max, correction, and both
-    accumulators without leaving VMEM."""
-    q = q_ref[0, 0]                             # [Sq, D]
-    k = k_ref[0, 0]                             # [Sk, D]
-    v = v_ref[0, 0]
-    m = m_ref[0, 0, 0, :]                       # [Sq]
-    num = num_ref[0, 0]                         # [Sq, D]
-    den = den_ref[0, 0, 0, :]
-    s = _dot(q, k, trans_b=True) * scale        # [Sq, Sk]
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-    corr = jnp.exp(m - m_new)
-    p = jnp.exp(s - m_new[:, None])
-    m_out[0, 0, 0, :] = m_new
-    num_out[0, 0] = num * corr[:, None] + _dot(p, v)
-    den_out[0, 0, 0, :] = den * corr + jnp.sum(p, axis=-1)
+    accumulators without leaving VMEM. Head-blocked like the main
+    kernels: the per-device ring blocks are the SMALLEST programs in
+    the module (Sq = seq/sp), so per-program overhead bites hardest
+    here."""
+    for i in range(h_blk):                      # static unroll
+        q = q_ref[0, i]                         # [Sq, D]
+        k = k_ref[0, i]                         # [Sk, D]
+        v = v_ref[0, i]
+        m = m_ref[0, i, 0, :]                   # [Sq]
+        num = num_ref[0, i]                     # [Sq, D]
+        den = den_ref[0, i, 0, :]
+        s = _dot(q, k, trans_b=True) * scale    # [Sq, Sk]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        m_out[0, i, 0, :] = m_new
+        num_out[0, i] = num * corr[:, None] + _dot(p, v)
+        den_out[0, i, 0, :] = den * corr + jnp.sum(p, axis=-1)
 
 
 def ring_block_update(q, k_blk, v_blk, m, num, den, *, scale):
@@ -223,12 +246,15 @@ def ring_block_update(q, k_blk, v_blk, m, num, den, *, scale):
     """
     b, sq, h, d = q.shape
     sk = k_blk.shape[1]
-    qkv_spec, vec_spec = _specs(b, sq, h, d)
-    kv_spec = pl.BlockSpec((1, 1, sk, d), lambda i, j: (i, j, 0, 0),
+    # budget with the larger of the two seq dims: the score tile is
+    # [Sq, Sk] and the streams carry both block sizes
+    h_blk = _head_block(h, max(sq, sk), d, n_qkv=7, n_tiles=2)
+    qkv_spec, vec_spec = _specs(b, sq, h, d, h_blk)
+    kv_spec = pl.BlockSpec((1, h_blk, sk, d), lambda i, j: (i, j, 0, 0),
                            memory_space=pltpu.VMEM)
     m_new, num_new, den_new = pl.pallas_call(
-        functools.partial(_ring_block_kernel, scale=scale),
-        grid=(b, h),
+        functools.partial(_ring_block_kernel, scale=scale, h_blk=h_blk),
+        grid=(b, h // h_blk),
         in_specs=[qkv_spec, kv_spec, kv_spec, vec_spec, qkv_spec, vec_spec],
         out_specs=[vec_spec, qkv_spec, vec_spec],
         out_shape=[
