@@ -97,6 +97,9 @@ class EnvironmentManifest:
     #: one generated token per app at run time (≙ one managed identity
     #: per container app); travels into the emitted run config
     per_app_tokens: bool = False
+    #: mutual TLS on the sidecar mesh (≙ "Dapr sidecars communicate
+    #: over mutual TLS"): environment CA + per-app workload certs
+    mesh_tls: bool = False
     source_path: pathlib.Path | None = None
 
     @property
@@ -148,6 +151,7 @@ def load_manifest(path: str | pathlib.Path) -> EnvironmentManifest:
         registry_file=str(env.get("registry_file", ".tasksrunner/apps.json")),
         require_api_token=bool(env.get("require_api_token", False)),
         per_app_tokens=bool(env.get("per_app_tokens", False)),
+        mesh_tls=bool(env.get("mesh_tls", False)),
         source_path=path.resolve(),
     )
 

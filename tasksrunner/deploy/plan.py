@@ -190,6 +190,8 @@ def apply_manifest(manifest: EnvironmentManifest) -> dict:
         run_config["require_api_token"] = True
     if manifest.per_app_tokens:
         run_config["per_app_tokens"] = True
+    if manifest.mesh_tls:
+        run_config["mesh_tls"] = True
     run_path = out_dir / f"{manifest.name}-run.yaml"
     run_path.write_text(yaml.safe_dump(run_config, sort_keys=False))
 

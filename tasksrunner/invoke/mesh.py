@@ -150,8 +150,13 @@ class MeshServer:
         self._conn_writers: set[asyncio.StreamWriter] = set()
 
     async def start(self) -> None:
+        from tasksrunner.invoke.pki import server_ssl_context
+
+        # mTLS when the environment provisioned certs (invoke/pki.py,
+        # ≙ Dapr sentry's workload certificates); plaintext otherwise
         self._server = await asyncio.start_server(
-            self._on_connection, self.host, self.port)
+            self._on_connection, self.host, self.port,
+            ssl=server_ssl_context())
         self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
@@ -248,9 +253,12 @@ class MeshServer:
 # ---------------------------------------------------------------------------
 
 class _MeshConnection:
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, server_hostname: str | None = None):
         self.host = host
         self.port = port
+        #: under mTLS, the app-id this connection expects the peer to
+        #: prove (SAN check) — None on the plaintext mesh
+        self.server_hostname = server_hostname
         self.closed = False
         self._ids = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
@@ -259,13 +267,21 @@ class _MeshConnection:
         self._reader_task: asyncio.Task | None = None
 
     async def connect(self) -> None:
+        from tasksrunner.invoke.pki import client_ssl_context
+
+        ctx = client_ssl_context()
         try:
             reader, self._writer = await asyncio.wait_for(
-                asyncio.open_connection(self.host, self.port),
+                asyncio.open_connection(
+                    self.host, self.port, ssl=ctx,
+                    server_hostname=(self.server_hostname
+                                     if ctx is not None else None)),
                 CONNECT_TIMEOUT)
-        except (OSError, asyncio.TimeoutError) as exc:
+        except (OSError, asyncio.TimeoutError) as exc:  # SSLError ⊂ OSError
             # a blackholed host times out here instead of holding the
-            # caller for the kernel SYN-retry window
+            # caller for the kernel SYN-retry window; a failed TLS
+            # handshake (wrong CA, wrong identity) is equally a
+            # this-peer-is-not-usable signal
             self.closed = True
             raise MeshConnectError(
                 f"mesh peer {self.host}:{self.port} unreachable: {exc}") from exc
@@ -351,8 +367,8 @@ class MeshPool:
     connections are dropped and re-dialed on the next request."""
 
     def __init__(self):
-        self._conns: dict[tuple[str, int], _MeshConnection] = {}
-        self._dial_locks: dict[tuple[str, int], asyncio.Lock] = {}
+        self._conns: dict[tuple, _MeshConnection] = {}
+        self._dial_locks: dict[tuple, asyncio.Lock] = {}
         self._closed = False
 
     def _prune(self) -> None:
@@ -370,7 +386,15 @@ class MeshPool:
                       body: bytes = b"") -> tuple[int, dict[str, str], bytes]:
         if self._closed:
             raise ConnectionError("mesh pool closed")
-        key = (host, port)
+        from tasksrunner.invoke.pki import mesh_tls_enabled
+
+        # under mTLS a connection IS an identity: key it by the pinned
+        # app-id too, so a pooled connection verified as app A is never
+        # reused for a request targeting app B (that reuse would skip
+        # the SAN check entirely). Plaintext mode keeps one connection
+        # per address — identity there is the token layer's job.
+        pin = target if mesh_tls_enabled() else None
+        key = (host, port, pin)
         conn = self._conns.get(key)
         if conn is None or conn.closed:
             # serialize dialing PER PEER so concurrent first requests
@@ -382,7 +406,9 @@ class MeshPool:
                 conn = self._conns.get(key)
                 if conn is None or conn.closed:
                     self._prune()  # dialing is rare: sweep stale keys now
-                    conn = _MeshConnection(host, port)
+                    # the handshake must prove the app-id this request
+                    # targets (one sidecar = one app)
+                    conn = _MeshConnection(host, port, server_hostname=pin)
                     await conn.connect()
                     if self._closed:  # pool closed mid-dial
                         await conn.close()

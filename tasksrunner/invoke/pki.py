@@ -1,0 +1,187 @@
+"""Mesh mTLS: a private CA per environment, one certificate per app.
+
+The reference's architecture note is explicit that while apps talk
+HTTP to their own sidecar, the sidecars talk to EACH OTHER over
+**mutual TLS** (docs/aca/03-aca-dapr-integration/index.md:30-38 —
+Dapr's sentry issues workload certs from a trust-domain CA). This
+module is that machinery for the framework's mesh lane
+(invoke/mesh.py): the orchestrator plays sentry — it generates an
+environment CA at start and issues each app a certificate whose SAN
+is its app-id — and the mesh endpoints authenticate BOTH ways:
+
+* the dialing sidecar verifies the listener's cert chains to the
+  environment CA **and** names the app-id it meant to reach (a
+  hijacked registry entry pointing at a rogue port fails the
+  handshake — the rogue can't present the right identity);
+* the listening sidecar requires a client cert from the same CA
+  (non-members can't even speak; app-level authorization on top of
+  that stays with the per-app token digests, as on the HTTP surface).
+
+Enabled when the three env vars point at PEM files (the orchestrator
+sets them per replica when the manifest asks for ``mesh_tls``):
+
+    TASKSRUNNER_MESH_CA    — the environment CA certificate
+    TASKSRUNNER_MESH_CERT  — this app's certificate
+    TASKSRUNNER_MESH_KEY   — this app's private key (mode 0600)
+
+Unset → the mesh stays plaintext-on-localhost (the dev default, where
+every process shares a kernel anyway); the HTTP surface is never TLS
+— it is localhost-only app-facing API, exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+import pathlib
+import ssl
+
+CA_ENV = "TASKSRUNNER_MESH_CA"
+CERT_ENV = "TASKSRUNNER_MESH_CERT"
+KEY_ENV = "TASKSRUNNER_MESH_KEY"
+
+
+def mesh_tls_enabled() -> bool:
+    return all(os.environ.get(v) for v in (CA_ENV, CERT_ENV, KEY_ENV))
+
+
+# ---------------------------------------------------------------------------
+# issuance (orchestrator side, ≙ Dapr sentry)
+# ---------------------------------------------------------------------------
+
+def _keypair():
+    from cryptography.hazmat.primitives.asymmetric import ec
+    return ec.generate_private_key(ec.SECP256R1())
+
+
+def _key_pem(key) -> bytes:
+    from cryptography.hazmat.primitives import serialization
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+
+
+def generate_ca(common_name: str = "tasksrunner-mesh-ca",
+                *, days: int = 365) -> tuple[bytes, bytes]:
+    """→ (ca_cert_pem, ca_key_pem)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.x509.oid import NameOID
+
+    key = _keypair()
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0),
+                       critical=True)
+        .add_extension(x509.KeyUsage(
+            digital_signature=True, key_cert_sign=True, crl_sign=True,
+            content_commitment=False, key_encipherment=False,
+            data_encipherment=False, key_agreement=False,
+            encipher_only=False, decipher_only=False), critical=True)
+        .sign(key, hashes.SHA256())
+    )
+    from cryptography.hazmat.primitives import serialization
+    return cert.public_bytes(serialization.Encoding.PEM), _key_pem(key)
+
+
+def issue_cert(ca_cert_pem: bytes, ca_key_pem: bytes, app_id: str,
+               *, days: int = 365) -> tuple[bytes, bytes]:
+    """→ (cert_pem, key_pem) for one app: SAN carries the app-id (the
+    identity the dialer pins) plus the loopback names the mesh
+    actually connects to."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+    ca_cert = x509.load_pem_x509_certificate(ca_cert_pem)
+    ca_key = serialization.load_pem_private_key(ca_key_pem, password=None)
+    key = _keypair()
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, app_id)]))
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(x509.SubjectAlternativeName([
+            x509.DNSName(app_id),
+            x509.DNSName("localhost"),
+            x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+        ]), critical=False)
+        .add_extension(x509.ExtendedKeyUsage([
+            ExtendedKeyUsageOID.SERVER_AUTH,
+            ExtendedKeyUsageOID.CLIENT_AUTH,
+        ]), critical=False)
+        .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                       critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+    return cert.public_bytes(serialization.Encoding.PEM), _key_pem(key)
+
+
+def write_pki(directory: str | pathlib.Path,
+              app_ids: list[str]) -> dict[str, dict[str, str]]:
+    """Generate a CA + per-app certs under ``directory``; private keys
+    land mode 0600. → {app_id: {ca, cert, key}} env-ready path maps."""
+    directory = pathlib.Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    ca_cert, ca_key = generate_ca()
+    ca_path = directory / "ca.pem"
+    ca_path.write_bytes(ca_cert)
+    # the CA key never leaves this function's files; replicas get only
+    # the CA *cert* (to verify) and their own leaf pair
+    ca_key_path = directory / "ca-key.pem"
+    ca_key_path.touch(mode=0o600)
+    ca_key_path.write_bytes(ca_key)
+    out: dict[str, dict[str, str]] = {}
+    for app_id in app_ids:
+        cert, key = issue_cert(ca_cert, ca_key, app_id)
+        cert_path = directory / f"{app_id}.pem"
+        key_path = directory / f"{app_id}-key.pem"
+        cert_path.write_bytes(cert)
+        key_path.touch(mode=0o600)
+        key_path.write_bytes(key)
+        out[app_id] = {"ca": str(ca_path), "cert": str(cert_path),
+                       "key": str(key_path)}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# runtime side (both ends of the mesh)
+# ---------------------------------------------------------------------------
+
+def server_ssl_context() -> ssl.SSLContext | None:
+    """mTLS listener context from the env, or None (plaintext mesh)."""
+    if not mesh_tls_enabled():
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(os.environ[CERT_ENV], os.environ[KEY_ENV])
+    ctx.load_verify_locations(os.environ[CA_ENV])
+    ctx.verify_mode = ssl.CERT_REQUIRED  # the "m" in mTLS
+    return ctx
+
+
+def client_ssl_context() -> ssl.SSLContext | None:
+    """Dialer context: presents this app's cert, verifies the peer
+    against the environment CA; the caller passes the target app-id as
+    ``server_hostname`` so the SAN check pins the peer's identity."""
+    if not mesh_tls_enabled():
+        return None
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_cert_chain(os.environ[CERT_ENV], os.environ[KEY_ENV])
+    ctx.load_verify_locations(os.environ[CA_ENV])
+    ctx.check_hostname = True
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx

@@ -113,11 +113,18 @@ class RunConfig:
     #: only ITS app's token; sidecars accept peer tokens solely for
     #: inbound service invocation
     per_app_tokens: bool = False
+    #: mesh lane mTLS (≙ Dapr sentry workload certs): the orchestrator
+    #: generates an environment CA + per-app certificates at start and
+    #: each replica's sidecar requires/presents them on peer dials
+    mesh_tls: bool = False
     #: filled by the orchestrator at start when per_app_tokens is on
     #: (app_id → generated token); not read from YAML
     app_tokens: dict[str, str] = field(default_factory=dict)
     #: path of the emitted token map file (set with app_tokens)
     tokens_file: str | None = None
+    #: filled by the orchestrator at start when mesh_tls is on
+    #: (app_id → {ca, cert, key} PEM paths); not read from YAML
+    mesh_certs: dict[str, dict[str, str]] = field(default_factory=dict)
 
 
 def parse_health(health_raw: object) -> HealthSpec:
@@ -209,4 +216,5 @@ def load_run_config(path: str | pathlib.Path) -> RunConfig:
         admin_port=int(doc.get("admin_port", 0)),
         require_api_token=bool(doc.get("require_api_token", False)),
         per_app_tokens=bool(doc.get("per_app_tokens", False)),
+        mesh_tls=bool(doc.get("mesh_tls", False)),
     )

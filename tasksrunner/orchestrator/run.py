@@ -117,6 +117,14 @@ class Replica:
             from tasksrunner.security import TOKENS_FILE_ENV
             env[_TOKEN_ENV] = self.config.app_tokens[self.app.app_id]
             env[TOKENS_FILE_ENV] = self.config.tokens_file or ""
+        if self.config.mesh_certs:
+            # mesh mTLS (≙ Dapr sentry workload certs): each replica
+            # gets the environment CA + ITS app's cert/key paths
+            from tasksrunner.invoke.pki import CA_ENV, CERT_ENV, KEY_ENV
+            paths = self.config.mesh_certs[self.app.app_id]
+            env[CA_ENV] = paths["ca"]
+            env[CERT_ENV] = paths["cert"]
+            env[KEY_ENV] = paths["key"]
         # the orchestrator's import context must reach the replicas
         # (run configs may live outside the package root)
         env["PYTHONPATH"] = os.pathsep.join(
@@ -281,6 +289,8 @@ class Orchestrator:
     async def start(self) -> None:
         if self.config.per_app_tokens and not self.config.app_tokens:
             self._issue_app_tokens()
+        if self.config.mesh_tls and not self.config.mesh_certs:
+            self._issue_mesh_certs()
         for app in self.config.apps:
             self.replicas[app.app_id] = []
             self._record_revision(app.app_id, "initial deploy")
@@ -303,6 +313,25 @@ class Orchestrator:
         from tasksrunner.orchestrator.admin import AdminServer
         self._admin = AdminServer(self, port=self.config.admin_port)
         await self._admin.start()
+
+    def _issue_mesh_certs(self) -> None:
+        """Generate the environment CA + one workload certificate per
+        app (playing Dapr's sentry) under <registry dir>/pki; replicas
+        receive the CA cert (to verify peers) and only their OWN leaf
+        pair. Fresh PKI per orchestrator start — short-lived certs,
+        nothing to rotate."""
+        import pathlib as _pathlib
+
+        from tasksrunner.invoke.pki import write_pki
+
+        registry = _pathlib.Path(self.config.registry_file)
+        if not registry.is_absolute():
+            registry = self.config.base_dir / registry
+        pki_dir = registry.parent / "pki"
+        self.config.mesh_certs = write_pki(
+            pki_dir, [app.app_id for app in self.config.apps])
+        logger.info("mesh mTLS on: environment CA + %d workload cert(s) "
+                    "under %s", len(self.config.mesh_certs), pki_dir)
 
     def _issue_app_tokens(self) -> None:
         """Generate one token per app and write the app_id→sha256-digest

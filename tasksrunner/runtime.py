@@ -417,6 +417,7 @@ class Runtime:
             # in-flight drop raises OSError into the normal retry path
             if addr.mesh_port and self._mesh_enabled:
                 from tasksrunner.invoke.mesh import MeshConnectError
+                from tasksrunner.invoke.pki import mesh_tls_enabled
                 if self._mesh_pool is None:
                     from tasksrunner.invoke.mesh import MeshPool
                     self._mesh_pool = MeshPool()
@@ -426,7 +427,18 @@ class Runtime:
                         http_method, path, query=query, headers=headers,
                         body=body)
                 except MeshConnectError:
-                    pass
+                    if mesh_tls_enabled():
+                        # NO downgrade under mTLS: a failed handshake
+                        # (wrong CA, wrong identity, anonymous peer) is
+                        # a REFUSAL — falling back to plaintext HTTP
+                        # would hand the request, token header and all,
+                        # to the very endpoint that just failed to
+                        # prove itself. Surface as a retriable
+                        # transport error instead (the retry re-resolves
+                        # and may reach an honest replica).
+                        raise
+                    # plaintext mesh: the peer may simply predate the
+                    # mesh or have it disabled — HTTP is equivalent
             return await _http_attempt(addr)
 
         if policy is not None:
