@@ -579,7 +579,15 @@ def main() -> None:
     parser.add_argument("--worker", choices=["api", "processor"])
     parser.add_argument("--tmp")
     parser.add_argument("--idx", type=int, default=0)
+    parser.add_argument("--tpu-bench", action="store_true",
+                        help="run ONLY the TPU step bench, print its JSON "
+                             "(invoked as a subprocess so a dead chip "
+                             "tunnel can be timed out, not hung on)")
     args = parser.parse_args()
+
+    if args.tpu_bench:
+        print(json.dumps(run_tpu_step_bench()))
+        return
 
     if args.worker:
         profile_dir = os.environ.get("BENCH_PROFILE_DIR")
@@ -622,7 +630,23 @@ def main() -> None:
     _log(f"  -> {inproc} tasks/s")
 
     _log("bench 4/4: ML-extension train step on the attached chip ...")
-    tpu = run_tpu_step_bench()
+    # subprocess + hard timeout: a dead/hung chip tunnel must cost this
+    # bench one skipped section, never a hang (jax init itself blocks
+    # when the tunnel is down, so in-process guarding can't help)
+    tpu = None
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "bench.py"), "--tpu-bench"],
+            capture_output=True, text=True, timeout=600)
+        if proc.returncode == 0 and proc.stdout.strip():
+            tpu = json.loads(proc.stdout.strip().splitlines()[-1])
+        else:
+            _log(f"  tpu bench failed rc={proc.returncode}: "
+                 f"{proc.stderr.strip()[-300:]}")
+    except subprocess.TimeoutExpired:
+        _log("  tpu bench timed out (chip tunnel unresponsive); skipping")
+    except ValueError as exc:
+        _log(f"  tpu bench output unparsable: {exc}")
     if tpu:
         _log(f"  -> {tpu['step_ms']} ms/step, {tpu['tflops_per_sec']} TFLOP/s, "
              f"MFU {tpu['mfu']} on {tpu['device']}")
