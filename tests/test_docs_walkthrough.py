@@ -643,6 +643,13 @@ def test_module_15_production_baseline(scratch):
     out = scratch.run(block_with(blocks, "tasksrunner restart"), check=False)
     assert "401" in out  # tokenless shell refused
 
+    # §4.3b the orchestrator played sentry: CA + one workload cert per
+    # app, and the cert's SAN is the app-id (the pinned identity)
+    out = scratch.run(block_with(blocks, "pki/"))
+    assert "ca.pem" in out
+    assert "subject=CN = tasksmanager-backend-api" in out
+    assert "DNS:tasksmanager-backend-api" in out
+
     # §4.5 the app itself is untouched: full CRUD through the frontend,
     # and the prod env gates the email integration off (empty outbox)
     scratch.run(
