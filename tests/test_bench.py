@@ -158,3 +158,17 @@ async def test_gate_catches_simulated_regression():
         f"gate failed to catch a simulated regression: "
         f"{slowed['throughput']} tasks/s passed floor "
         f"{BASE_THROUGHPUT_FLOOR * ratio:.0f}")
+
+
+def test_gate_skips_visibly_below_linear_range(monkeypatch):
+    """A host slower than the calibration's linear range must SKIP
+    with the measured ratio in the message — neither fail on
+    uncalibrated floors (the round-3 death) nor silently pass."""
+    import test_bench as tb
+    monkeypatch.setattr(tb, "calibrate", lambda *a, **k: CAL_BASELINE * 0.3)
+    tb.host_ratio.cache_clear()
+    try:
+        with pytest.raises(pytest.skip.Exception, match="0.30x"):
+            tb.host_ratio()
+    finally:
+        tb.host_ratio.cache_clear()
