@@ -422,10 +422,12 @@ class Runtime:
                     from tasksrunner.invoke.mesh import MeshPool
                     self._mesh_pool = MeshPool()
                 try:
-                    return await self._mesh_pool.request(
+                    result = await self._mesh_pool.request(
                         addr.host, addr.mesh_port, target_app_id,
                         http_method, path, query=query, headers=headers,
                         body=body)
+                    metrics.inc("invoke_transport", lane="mesh")
+                    return result
                 except MeshConnectError:
                     if mesh_tls_enabled():
                         # NO downgrade under mTLS: a failed handshake
@@ -439,7 +441,9 @@ class Runtime:
                         raise
                     # plaintext mesh: the peer may simply predate the
                     # mesh or have it disabled — HTTP is equivalent
-            return await _http_attempt(addr)
+            result = await _http_attempt(addr)
+            metrics.inc("invoke_transport", lane="http")
+            return result
 
         if policy is not None:
             # declarative policy replaces the builtin transport retries
