@@ -251,6 +251,30 @@ def _cmd_traces(args) -> None:
             print(f"{offset:8.1f}ms {s['duration']*1000:7.1f}ms  "
                   f"{indent}[{s['role']}] {s['kind']:<8} {s['name']} "
                   f"({s['status']})")
+    elif args.action == "query":
+        # the local Log-Analytics pane (≙ the reference's Kusto queries
+        # over App Insights tables, docs module 8): read-only SQL
+        # straight over the span store. Opened with mode=ro so no
+        # query — however creative — can mutate telemetry.
+        if not args.trace_id:
+            raise SystemExit(
+                "query needs SQL, e.g. tasksrunner traces query "
+                "\"SELECT role, COUNT(*) FROM spans GROUP BY role\"")
+        import sqlite3 as _sqlite3
+        conn = _sqlite3.connect(f"file:{db}?mode=ro", uri=True)
+        try:
+            cur = conn.execute(args.trace_id)
+            cols = [d[0] for d in cur.description or []]
+            rows = cur.fetchall()
+        except _sqlite3.Error as exc:
+            raise SystemExit(f"query failed: {exc}")
+        finally:
+            conn.close()
+        if cols:
+            print("\t".join(cols))
+        for row in rows:
+            print("\t".join(
+                f"{v:.3f}" if isinstance(v, float) else str(v) for v in row))
     elif args.action == "map":
         edges = service_map(db)
         if not edges:
@@ -968,8 +992,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser(
         "traces",
         help="inspect recorded traces (transaction search + service map)")
-    p.add_argument("action", choices=["list", "show", "map"])
-    p.add_argument("trace_id", nargs="?", default=None)
+    p.add_argument("action", choices=["list", "show", "map", "query"])
+    p.add_argument("trace_id", nargs="?", default=None,
+                   help="trace id for `show`; the SQL text for `query`")
     p.add_argument("--db", default=".tasksrunner/traces.db")
     p.add_argument("--limit", type=int, default=20)
     p.add_argument("--mermaid", action="store_true",

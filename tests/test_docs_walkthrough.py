@@ -1144,6 +1144,22 @@ def test_module_08_observability(scratch):
     meta = scratch.run(block_with(blocks, "v1.0/metadata"))
     assert '"id"' in meta and '"components"' in meta
 
+    # §3b the local Log-Analytics pane: every example query from the
+    # page runs over the live span store
+    out = scratch.run(block_with(blocks, "GROUP BY role"))
+    assert out.splitlines()[0] == "role\tn\tavg_ms"
+    assert "tasksmanager-backend-api" in out
+    out = scratch.run(block_with(blocks, "wall_ms DESC"))
+    assert out.splitlines()[0] == "trace_id\twall_ms\tspans"
+    assert re.search(r"^[0-9a-f]{32}\t", out.splitlines()[1]), out
+    out = scratch.run(block_with(blocks, "kind='consumer'"))
+    assert "/api/tasksnotifier/tasksaved" in out
+    # and a query drilling into the poison route's errors shows them
+    # read-only: a mutating query must fail without touching telemetry
+    out = scratch.run("python -m tasksrunner traces query "
+                      "'DELETE FROM spans'", check=False)
+    assert "query failed" in out and "readonly" in out.lower()
+
     scratch.stop_proc(orch)
 
 
