@@ -261,7 +261,9 @@ def _cmd_traces(args) -> None:
                 "query needs SQL, e.g. tasksrunner traces query "
                 "\"SELECT role, COUNT(*) FROM spans GROUP BY role\"")
         import sqlite3 as _sqlite3
-        conn = _sqlite3.connect(f"file:{db}?mode=ro", uri=True)
+
+        from tasksrunner.observability.spans import _connect_ro
+        conn = _connect_ro(db)
         try:
             cur = conn.execute(args.trace_id)
             cols = [d[0] for d in cur.description or []]

@@ -210,7 +210,11 @@ def record_span(*, kind: str, name: str, status: int | None,
 # -- query side ----------------------------------------------------------
 
 def _connect_ro(path: str) -> sqlite3.Connection:
-    conn = sqlite3.connect(path, check_same_thread=False)
+    """Genuinely read-only (mode=ro): every reader of the span store —
+    list/show/map/query — gets the cannot-mutate-telemetry guarantee,
+    not just the one that documents it."""
+    conn = sqlite3.connect(f"file:{path}?mode=ro", uri=True,
+                           check_same_thread=False)
     conn.row_factory = sqlite3.Row
     return conn
 
