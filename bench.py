@@ -686,6 +686,11 @@ def main() -> None:
             },
             "inproc_tasks_per_sec": inproc,
             "ml_extension_tpu": tpu,
+            **({} if tpu else {"ml_extension_note":
+                "chip bench skipped (no TPU reachable within the "
+                "timeout); last measured figures are tabulated in "
+                "BASELINE.md (round 4: step 84.3 ms, MFU 0.645 on "
+                "TPU v5 lite)"}),
         },
     }))
 
