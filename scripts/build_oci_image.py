@@ -493,7 +493,12 @@ def main() -> None:
     if args.verify:
         for service in services:
             for variant in variants:
-                verify_layout(out_dir / f"{service}-{variant}")
+                layout = out_dir / f"{service}-{variant}"
+                if not (layout / "oci-layout").is_file():
+                    raise SystemExit(
+                        f"no OCI layout at {layout} — build first "
+                        f"(run without --verify)")
+                verify_layout(layout)
                 print(f"ok {service}-{variant}")
         return
 
