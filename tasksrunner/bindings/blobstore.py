@@ -11,6 +11,7 @@ binding exposes.
 from __future__ import annotations
 
 import json
+import os
 import pathlib
 from typing import Any
 
@@ -30,9 +31,17 @@ class LocalBlobStoreBinding(OutputBinding):
     def operations(self) -> list[str]:
         return ["create", "get", "delete", "list"]
 
-    def _path(self, blob_name: str) -> pathlib.Path:
-        p = (self.root / blob_name).resolve()
-        if not p.is_relative_to(self.root.resolve()):
+    def _path(self, blob_name: str) -> str:
+        # containment check via os.path (realpath), NOT pathlib: blob
+        # names are unique per task, and on CPython 3.12 (immortal
+        # interned strings) pathlib's component interning (sys.intern
+        # in _parse_path) retains every name for the life of the
+        # process (see email.py — same leak, measured under soak;
+        # other CPython versions free mortal interned strings, but the
+        # hot path avoiding the parser is cheap on all of them)
+        root = os.path.realpath(str(self.root))
+        p = os.path.realpath(os.path.join(root, blob_name))
+        if not (p == root or p.startswith(root + os.sep)):
             raise BindingError(f"blob name {blob_name!r} escapes the container")
         return p
 
@@ -40,11 +49,16 @@ class LocalBlobStoreBinding(OutputBinding):
                      metadata: dict[str, str] | None = None) -> BindingResponse:
         metadata = metadata or {}
         if operation == "list":
-            names = sorted(
-                str(p.relative_to(self.root))
-                for p in self.root.rglob("*") if p.is_file()
-            )
-            return BindingResponse(data=names)
+            # os.walk, same reason as _path: rglob + relative_to would
+            # route every unique blob name through pathlib's parser
+            root = str(self.root)
+            names = []
+            for dirpath, _dirs, files in os.walk(root):
+                rel = os.path.relpath(dirpath, root)
+                for fname in files:
+                    names.append(fname if rel == "."
+                                 else os.path.join(rel, fname))
+            return BindingResponse(data=sorted(names))
 
         blob_name = metadata.get("blobName")
         if not blob_name:
@@ -56,23 +70,28 @@ class LocalBlobStoreBinding(OutputBinding):
         path = self._path(blob_name)
 
         if operation == "create":
-            path.parent.mkdir(parents=True, exist_ok=True)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            # utf-8 explicitly (write_text used the locale encoding;
+            # a deliberate, portable choice beats a host-dependent one)
             if isinstance(data, (bytes, bytearray)):
-                path.write_bytes(data)
+                payload = bytes(data)
             elif isinstance(data, str):
-                path.write_text(data)
+                payload = data.encode("utf-8")
             else:
-                path.write_text(json.dumps(data, indent=2))
+                payload = json.dumps(data, indent=2).encode("utf-8")
+            with open(path, "wb") as f:
+                f.write(payload)
             return BindingResponse(metadata={"blobName": blob_name})
         if operation == "get":
-            if not path.is_file():
+            if not os.path.isfile(path):
                 raise BindingError(f"blob {blob_name!r} does not exist")
-            return BindingResponse(data=path.read_bytes(),
-                                   metadata={"blobName": blob_name})
+            with open(path, "rb") as f:
+                return BindingResponse(data=f.read(),
+                                       metadata={"blobName": blob_name})
         if operation == "delete":
-            existed = path.is_file()
+            existed = os.path.isfile(path)
             if existed:
-                path.unlink()
+                os.unlink(path)
             return BindingResponse(metadata={"deleted": "true" if existed else "false"})
         raise BindingError(f"blob binding has no operation {operation!r}")
 
