@@ -1,0 +1,107 @@
+"""Opt-in endurance soak (TASKSRUNNER_SOAK=1): sustained load through
+the full in-process pipeline with a memory-flatness assertion.
+
+The round-4 soak (BASELINE.md "Round 4 endurance") caught what the
+functional suite structurally cannot: per-message memory retention —
+CPython 3.12's pathlib interning every unique outbox/blob filename
+forever. This test is that soak, distilled: drive thousands of
+messages through subscribe → handler → output binding and assert the
+process does NOT retain memory per message. Off by default (it runs
+minutes-scale work under load-sensitive assertions); enable with
+TASKSRUNNER_SOAK=1 for release checks and leak hunts.
+"""
+
+import asyncio
+import gc
+import tracemalloc
+
+import pytest
+
+from tasksrunner import App, InProcCluster
+from tasksrunner.component.spec import parse_component
+from tasksrunner.envflag import env_flag
+
+pytestmark = pytest.mark.skipif(
+    not env_flag("TASKSRUNNER_SOAK", default=False),
+    reason="endurance soak is opt-in (TASKSRUNNER_SOAK=1)")
+
+#: net retained bytes allowed across the measured 5k messages —
+#: the pre-fix leak measured ~1.9 MB here; post-fix ~47 KiB of
+#: transient buffers. 400 KiB keeps headroom without letting a
+#: per-message leak (>80 B/msg) back in.
+RETAINED_BUDGET = 400 * 1024
+
+
+@pytest.mark.asyncio
+async def test_no_per_message_memory_retention(tmp_path):
+    specs = [
+        parse_component({
+            "componentType": "pubsub.sqlite",
+            "metadata": [
+                {"name": "brokerPath", "value": str(tmp_path / "broker.db")},
+                {"name": "pollIntervalSeconds", "value": "0.002"},
+            ]}, default_name="pubsub"),
+        parse_component({
+            "componentType": "bindings.twilio.sendgrid",
+            "metadata": [{"name": "outboxPath",
+                          "value": str(tmp_path / "outbox")}],
+        }, default_name="sendgrid"),
+        parse_component({
+            "componentType": "bindings.azure.blobstorage",
+            "metadata": [{"name": "rootPath",
+                          "value": str(tmp_path / "blobs")}],
+        }, default_name="blobstore"),
+    ]
+
+    received = 0
+    target = 0
+    done = asyncio.Event()
+    app = App("proc")
+
+    @app.subscribe(pubsub="pubsub", topic="t", route="/on")
+    async def on(req):
+        nonlocal received
+        # the production processor's per-message work: one outbox mail
+        # + one blob archive, both with UNIQUE names (the leak shape)
+        task_id = req.data["taskId"]
+        await app.client.invoke_binding(
+            "sendgrid", "create", {"body": "x" * 200},
+            {"emailTo": "a@b.com"})
+        await app.client.invoke_binding(
+            "blobstore", "create", req.data, {"blobName": f"{task_id}.json"})
+        received += 1
+        if received >= target:
+            done.set()
+        return 200
+
+    pub = App("pub")
+    cluster = InProcCluster(specs)
+    cluster.add_app(app)
+    cluster.add_app(pub)
+    await cluster.start()
+    try:
+        client = cluster.client("pub")
+
+        async def drive(n: int, start: int) -> None:
+            nonlocal target
+            done.clear()
+            target = received + n
+            for i in range(start, start + n):
+                await client.publish_event("pubsub", "t", {"taskId": f"s{i}"})
+            await asyncio.wait_for(done.wait(), timeout=240)
+
+        await drive(1000, 0)          # warmup: caches, pools, lazy init
+        gc.collect()
+        tracemalloc.start(10)
+        base = tracemalloc.take_snapshot()
+        await drive(5000, 1000)       # the measured window
+        gc.collect()
+        snap = tracemalloc.take_snapshot()
+        retained = sum(s.size_diff for s in snap.compare_to(base, "lineno"))
+        assert retained < RETAINED_BUDGET, (
+            f"retained {retained/1024:.0f} KiB across 5k messages "
+            f"(budget {RETAINED_BUDGET/1024:.0f} KiB) — top sites:\n" +
+            "\n".join(str(s) for s in snap.compare_to(base, "lineno")[:5]))
+    finally:
+        tracemalloc.stop()
+        await cluster.stop()
