@@ -551,6 +551,26 @@ async def test_http_concurrency_rule_scales_out_and_back(tmp_path, monkeypatch):
                         "never scaled out to max under sustained "
                         f"concurrency (at {orch.replica_count('slowapp')})")
                     await asyncio.sleep(0.1)
+
+                # round-4 ingress: the ADDED replicas joined the
+                # registry (they serve invokes, ≙ ACA ingress
+                # balancing) and resolve() rotates across the fleet
+                from tasksrunner.invoke.resolver import NameResolver
+                resolver = NameResolver(registry_file=config.registry_file)
+                deadline = asyncio.get_running_loop().time() + 15
+                while len(resolver.resolve_all("slowapp")) < 3:
+                    assert asyncio.get_running_loop().time() < deadline, (
+                        f"scale-out replicas never registered: "
+                        f"{resolver.resolve_all('slowapp')}")
+                    await asyncio.sleep(0.2)
+                    resolver = NameResolver(
+                        registry_file=config.registry_file)
+                fleet = {a.sidecar_port
+                         for a in resolver.resolve_all("slowapp")}
+                assert len(fleet) == 3
+                rotated = {resolver.resolve("slowapp").sidecar_port
+                           for _ in range(6)}
+                assert rotated == fleet  # every replica is in rotation
             finally:
                 stop_flood.set()
                 for t in flood:
