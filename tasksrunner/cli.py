@@ -20,7 +20,14 @@ Commands mirror the reference's local workflow surface:
   against a running app's sidecar (≙ ``dapr invoke`` / ``dapr
   publish`` / the workshop's curl checkpoints,
   docs/aca/04-aca-dapr-stateapi/index.md:41-75)
-* ``tasksrunner stop``    — SIGTERM a registered host (≙ ``dapr stop``)
+* ``tasksrunner stop``    — SIGTERM every replica of a registered app
+  (≙ ``dapr stop``)
+* ``tasksrunner traces``  — transaction search / span tree / service
+  map over the span store, plus ``traces query`` for read-only SQL
+  (≙ App Insights transaction search + Log Analytics, docs module 8)
+* ``tasksrunner logs / metrics / restart / scale / update / revisions
+  / dlq`` — the ``az containerapp`` operations surface against the
+  orchestrator's admin API (docs module 14)
 """
 
 from __future__ import annotations
