@@ -369,14 +369,21 @@ class MeshPool:
     def __init__(self):
         self._conns: dict[tuple, _MeshConnection] = {}
         self._dial_locks: dict[tuple, asyncio.Lock] = {}
+        # refcount of callers currently inside (or queued on) a key's
+        # dial section — _prune must never sweep those keys, or two
+        # callers end up holding *different* lock objects for the same
+        # key and dial concurrently (the loser's socket/reader leak)
+        self._dialing: dict[tuple, int] = {}
         self._closed = False
 
     def _prune(self) -> None:
         """Drop dead connections under stale keys (peers restart onto
         fresh ephemeral ports, so old keys are never re-requested —
-        without this sweep their sockets/locks accumulate forever)."""
+        without this sweep their sockets/locks accumulate forever).
+        Keys with a dial in progress are skipped: their lock object is
+        live in another task's hands."""
         for key, conn in list(self._conns.items()):
-            if conn.closed:
+            if conn.closed and key not in self._dialing:
                 del self._conns[key]
                 self._dial_locks.pop(key, None)
 
@@ -402,18 +409,35 @@ class MeshPool:
             # — while a slow/unreachable peer's dial never queues dials
             # to healthy peers behind it
             lock = self._dial_locks.setdefault(key, asyncio.Lock())
-            async with lock:
-                conn = self._conns.get(key)
-                if conn is None or conn.closed:
-                    self._prune()  # dialing is rare: sweep stale keys now
-                    # the handshake must prove the app-id this request
-                    # targets (one sidecar = one app)
-                    conn = _MeshConnection(host, port, server_hostname=pin)
-                    await conn.connect()
-                    if self._closed:  # pool closed mid-dial
-                        await conn.close()
-                        raise ConnectionError("mesh pool closed")
-                    self._conns[key] = conn
+            self._dialing[key] = self._dialing.get(key, 0) + 1
+            try:
+                async with lock:
+                    conn = self._conns.get(key)
+                    if conn is None or conn.closed:
+                        self._prune()  # dialing is rare: sweep stale keys
+                        # the handshake must prove the app-id this request
+                        # targets (one sidecar = one app)
+                        conn = _MeshConnection(host, port,
+                                               server_hostname=pin)
+                        await conn.connect()
+                        if self._closed:  # pool closed mid-dial
+                            await conn.close()
+                            raise ConnectionError("mesh pool closed")
+                        self._conns[key] = conn
+            finally:
+                left = self._dialing[key] - 1
+                if left:
+                    self._dialing[key] = left
+                else:
+                    del self._dialing[key]
+                    live = self._conns.get(key)
+                    if live is None or live.closed:
+                        # every dialer for this key failed and none are
+                        # queued: reclaim the lock now. _prune can't —
+                        # it walks _conns, and a never-connected key
+                        # has no entry there (a dead-peer address would
+                        # otherwise leak one Lock forever).
+                        self._dial_locks.pop(key, None)
         return await conn.request(target, method, path, query=query,
                                   headers=headers, body=body)
 

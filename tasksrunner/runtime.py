@@ -408,6 +408,8 @@ class Runtime:
                 return resp.status, dict(resp.headers), await resp.read()
 
         async def _attempt():
+            from tasksrunner.invoke.mesh import MeshConnectError
+            from tasksrunner.invoke.pki import mesh_tls_enabled
             # re-resolve each attempt: the peer may have crashed,
             # unregistered, and come back on a new port
             addr = self.resolver.resolve(target_app_id)
@@ -415,9 +417,28 @@ class Runtime:
             # (invoke/mesh.py, ≙ Dapr's internal sidecar↔sidecar gRPC);
             # a refused dial falls back to HTTP within this attempt, an
             # in-flight drop raises OSError into the normal retry path
+            if mesh_tls_enabled() and not self._mesh_enabled:
+                # local misconfiguration, not a peer problem: certs are
+                # provisioned but THIS node has the mesh lane switched
+                # off. Retrying/re-resolving cannot help — fail fast
+                # with an error that points at the right machine.
+                raise InvocationError(
+                    "mesh_tls: certs are provisioned but the mesh lane "
+                    "is disabled on this node (TASKSRUNNER_MESH=0); "
+                    "plaintext invokes are refused under mTLS")
+            if mesh_tls_enabled() and not addr.mesh_port:
+                # a peer with no mesh lane (legacy registration, a
+                # TASKSRUNNER_MESH=0 peer, or a tampered registry entry
+                # that dropped mesh_port) would route over plaintext
+                # HTTP with the token header and no peer identity check
+                # — the exact hole the mTLS fence exists to close.
+                # Refuse it the same way a failed handshake is refused:
+                # retriable, so a re-resolve can land on an honest
+                # replica that does advertise the authenticated lane.
+                raise MeshConnectError(
+                    f"mesh_tls: peer {target_app_id!r} offers no mesh "
+                    "lane; refusing plaintext fallback")
             if addr.mesh_port and self._mesh_enabled:
-                from tasksrunner.invoke.mesh import MeshConnectError
-                from tasksrunner.invoke.pki import mesh_tls_enabled
                 if self._mesh_pool is None:
                     from tasksrunner.invoke.mesh import MeshPool
                     self._mesh_pool = MeshPool()

@@ -350,3 +350,47 @@ async def test_apphost_pair_mesh_disabled_uses_http(tmp_path, monkeypatch):
     finally:
         for h in hosts:
             await h.stop()
+
+
+@pytest.mark.asyncio
+async def test_prune_skips_in_progress_dials():
+    """_prune, run while some task is inside a key's dial section, must
+    not sweep that key: popping its lock would let a new caller mint a
+    SECOND lock object for the same peer and dial concurrently — the
+    losing connection's socket and reader task then leak until the
+    peer closes them."""
+    pool = MeshPool()
+    key = ("127.0.0.1", 1234, None)
+
+    class _Dead:
+        closed = True
+
+    pool._conns[key] = _Dead()
+    lock = pool._dial_locks.setdefault(key, asyncio.Lock())
+    await lock.acquire()  # a dialer currently holds this key's lock
+    pool._dialing[key] = 1
+    try:
+        pool._prune()
+        # untouched: the dialer's lock object is still THE lock, and
+        # the dead conn is left for the dialer itself to replace
+        assert pool._dial_locks[key] is lock
+        assert key in pool._conns
+    finally:
+        lock.release()
+    # with the dial section exited, the stale key is sweepable again
+    del pool._dialing[key]
+    pool._prune()
+    assert key not in pool._conns and key not in pool._dial_locks
+
+
+@pytest.mark.asyncio
+async def test_failed_dial_reclaims_lock():
+    """A key whose dial never succeeds has no _conns entry, so _prune
+    can never sweep its lock — the last failing dialer must reclaim it
+    itself, or every dead-peer address leaks one Lock forever."""
+    pool = MeshPool()
+    with pytest.raises((MeshConnectError, ConnectionError, OSError)):
+        await pool.request("127.0.0.1", 1, "x", "GET", "/")
+    assert pool._dial_locks == {}
+    assert pool._dialing == {}
+    await pool.close()

@@ -215,6 +215,51 @@ async def test_no_plaintext_downgrade_on_handshake_failure(tmp_path,
 
 
 @pytest.mark.asyncio
+async def test_no_plaintext_fallback_for_meshless_peer(tmp_path,
+                                                       monkeypatch):
+    """The quieter downgrade: a registry entry with NO mesh_port at all
+    (legacy registration, a TASKSRUNNER_MESH=0 peer, or a tampered
+    entry that simply dropped the field). Nothing fails loudly — the
+    old behavior was to route straight over plaintext HTTP, token
+    header and all, with no peer identity check. Under mesh_tls that
+    path must be refused exactly like a failed handshake."""
+    from tests.test_mesh import COMPONENTS, _apps
+    from tasksrunner import AppHost, load_components
+    from tasksrunner.errors import TasksRunnerError
+    from tasksrunner.invoke.resolver import AppAddress
+
+    paths = write_pki(tmp_path / "pki", ["backend-api", "frontend"])
+    monkeypatch.setenv(CA_ENV, paths["backend-api"]["ca"])
+    monkeypatch.setenv(CERT_ENV, paths["backend-api"]["cert"])
+    monkeypatch.setenv(KEY_ENV, paths["backend-api"]["key"])
+    monkeypatch.delenv("TASKSRUNNER_MESH", raising=False)
+
+    (tmp_path / "components.yaml").write_text(COMPONENTS)
+    specs = load_components(tmp_path)
+    registry = str(tmp_path / "apps.json")
+    api, front = _apps()
+    hosts = [AppHost(api, specs=specs, registry_file=registry),
+             AppHost(front, specs=specs, registry_file=registry)]
+    for h in hosts:
+        await h.start()
+    try:
+        # strip the mesh lane from backend-api's entry; its HTTP port
+        # still leads to the real, working sidecar — so the ONLY way
+        # this invoke can "succeed" is by the forbidden plaintext hop
+        real = hosts[0].resolver.resolve("backend-api")
+        hosts[0].resolver.register(AppAddress(
+            app_id="backend-api", host=real.host,
+            sidecar_port=real.sidecar_port, app_port=real.app_port,
+            pid=real.pid, mesh_port=None))
+        with pytest.raises(TasksRunnerError):
+            await hosts[1].app.client.invoke_method(
+                "backend-api", "api/echo", http_method="POST", data={})
+    finally:
+        for h in hosts:
+            await h.stop()
+
+
+@pytest.mark.asyncio
 async def test_apphost_pair_over_mtls(tmp_path, monkeypatch):
     """Two AppHosts with provisioned certs: invokes ride the TLS mesh
     end-to-end, and the app observes nothing different."""
@@ -243,6 +288,40 @@ async def test_apphost_pair_over_mtls(tmp_path, monkeypatch):
         assert resp.json() == {"got": {"n": 9}, "app": "backend-api"}
         pool = hosts[1].sidecar.runtime._mesh_pool
         assert pool is not None and len(pool._conns) == 1
+    finally:
+        for h in hosts:
+            await h.stop()
+
+
+@pytest.mark.asyncio
+async def test_local_mesh_disabled_under_mtls_fails_fast(tmp_path,
+                                                         monkeypatch):
+    """Certs provisioned but TASKSRUNNER_MESH=0 on THIS node: a local
+    misconfiguration, not a peer problem. The invoke must refuse
+    plaintext (same fence) but fail FAST with an error naming the
+    local node — burning retries on re-resolve could never help."""
+    from tests.test_mesh import COMPONENTS, _apps
+    from tasksrunner import AppHost, load_components
+    from tasksrunner.errors import InvocationError
+
+    paths = write_pki(tmp_path / "pki", ["backend-api", "frontend"])
+    monkeypatch.setenv(CA_ENV, paths["backend-api"]["ca"])
+    monkeypatch.setenv(CERT_ENV, paths["backend-api"]["cert"])
+    monkeypatch.setenv(KEY_ENV, paths["backend-api"]["key"])
+    monkeypatch.setenv("TASKSRUNNER_MESH", "0")
+
+    (tmp_path / "components.yaml").write_text(COMPONENTS)
+    specs = load_components(tmp_path)
+    registry = str(tmp_path / "apps.json")
+    api, front = _apps()
+    hosts = [AppHost(api, specs=specs, registry_file=registry),
+             AppHost(front, specs=specs, registry_file=registry)]
+    for h in hosts:
+        await h.start()
+    try:
+        with pytest.raises(InvocationError, match="disabled on this node"):
+            await hosts[1].app.client.invoke_method(
+                "backend-api", "api/echo", http_method="POST", data={})
     finally:
         for h in hosts:
             await h.stop()
