@@ -181,7 +181,8 @@ async def _worker_main(role: str, tmp: str, idx: int) -> None:
 # ---------------------------------------------------------------------------
 
 class _Workers:
-    def __init__(self, tmp: str, n_processors: int, *, work_ms: float = 0.0):
+    def __init__(self, tmp: str, n_processors: int, *, work_ms: float = 0.0,
+                 pki: dict | None = None):
         self.tmp = tmp
         self.procs: list[subprocess.Popen] = []
         self.expected = ["api-0"] + [f"processor-{i}" for i in range(n_processors)]
@@ -197,12 +198,22 @@ class _Workers:
         self._logs = []
         for name in self.expected:
             role, idx = name.rsplit("-", 1)
+            wenv = dict(env)
+            if pki:
+                # each worker process runs under its OWN workload
+                # identity, as deployed — the mTLS variant must pay
+                # real per-app certificate verification, not a shared
+                # self-identity shortcut
+                from tasksrunner.invoke.pki import CA_ENV, CERT_ENV, KEY_ENV
+                p = pki["bench-api" if role == "api" else "bench-processor"]
+                wenv.update({CA_ENV: p["ca"], CERT_ENV: p["cert"],
+                             KEY_ENV: p["key"]})
             log = open(f"{tmp}/worker-{name}.log", "w")
             self._logs.append(log)
             self.procs.append(subprocess.Popen(
                 [sys.executable, str(REPO / "bench.py"),
                  "--worker", role, "--tmp", tmp, "--idx", idx],
-                cwd=str(REPO), env=env, stderr=log))
+                cwd=str(REPO), env=wenv, stderr=log))
 
     def wait_ready(self, timeout: float = 60.0) -> None:
         deadline = time.time() + timeout
@@ -249,7 +260,8 @@ def _delivered_count(tmp: str) -> int:
 async def run_xproc(n_tasks: int = N_TASKS, *, warmup: int = WARMUP,
                     n_processors: int = 1, rounds: int = 3,
                     concurrency: int = CONCURRENCY, work_ms: float = 0.0,
-                    latency_probe: bool = False) -> dict:
+                    latency_probe: bool = False,
+                    mesh_tls: bool = False) -> dict:
     """The faithful topology: separate api/processor OS processes, all
     hops over localhost HTTP, durable sqlite state + broker.
 
@@ -258,6 +270,11 @@ async def run_xproc(n_tasks: int = N_TASKS, *, warmup: int = WARMUP,
     {"p50_ms", "p99_ms"} when ``latency_probe`` — per-request write-path
     round trips measured in a separate low-concurrency (8) pass so the
     numbers reflect service time, not load-generator queueing.
+
+    With ``mesh_tls`` an environment CA and per-app workload certs are
+    provisioned and every peer-sidecar hop rides the authenticated TLS
+    mesh lane (invoke/pki.py) — the production posture module 15
+    recommends, measured instead of assumed.
     """
     from tasksrunner import App
     from tasksrunner.hosting import AppHost
@@ -271,8 +288,27 @@ async def run_xproc(n_tasks: int = N_TASKS, *, warmup: int = WARMUP,
     finally:
         setup.close()
 
-    workers = _Workers(tmp, n_processors, work_ms=work_ms)
+    pki_paths = None
+    pki_prev: dict[str, str | None] = {}
+    if mesh_tls:
+        from tasksrunner.invoke.pki import (CA_ENV, CERT_ENV, KEY_ENV,
+                                            write_pki)
+        pki_paths = write_pki(pathlib.Path(tmp) / "pki",
+                              ["bench-frontend", "bench-api",
+                               "bench-processor"])
+        # the driver process plays the frontend: it dials under the
+        # frontend's identity for the whole measurement (restored in
+        # the outer finally — pytest reuses this interpreter)
+        front = pki_paths["bench-frontend"]
+        for var, val in ((CA_ENV, front["ca"]), (CERT_ENV, front["cert"]),
+                         (KEY_ENV, front["key"])):
+            pki_prev[var] = os.environ.get(var)
+            os.environ[var] = val
+
+    workers = None
     try:
+        workers = _Workers(tmp, n_processors, work_ms=work_ms,
+                           pki=pki_paths)
         workers.wait_ready()
 
         # the driver plays the frontend: its own app + sidecar so the
@@ -379,7 +415,13 @@ async def run_xproc(n_tasks: int = N_TASKS, *, warmup: int = WARMUP,
         finally:
             await fhost.stop()
     finally:
-        workers.stop()
+        if workers is not None:
+            workers.stop()
+        for var, val in pki_prev.items():
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
         conn = _count_conns.pop(tmp, None)
         if conn is not None:
             conn.close()
@@ -573,6 +615,99 @@ def run_tpu_step_bench() -> dict | None:
     }
 
 
+_TPU_CACHE = REPO / ".tpu_bench_cache.json"
+
+
+def run_tpu_section() -> dict | None:
+    """The on-chip measurement, made outage-proof.
+
+    This host's chip tunnel is known to go unresponsive for hours at a
+    time (jax init then HANGS rather than erroring), and a null ML
+    figure in the round artifact costs more than the outage itself —
+    so this section (a) probes the tunnel with a short-timeout
+    subprocess, (b) retries the probe with bounded backoff, and (c) on
+    final failure falls back to the last measured-on-chip result from
+    the timestamped cache file ``.tpu_bench_cache.json``, marked
+    ``stale: true``. A fresh measurement overwrites the cache.
+    """
+    reason = "no probe attempted"
+    for attempt in range(3):
+        if attempt:
+            backoff = 20 * attempt
+            _log(f"  tunnel probe retry in {backoff}s ...")
+            time.sleep(backoff)
+        # cheap liveness probe first: a dead tunnel hangs jax init, so
+        # only a subprocess timeout can bound it
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=45, cwd=str(REPO))
+        except subprocess.TimeoutExpired:
+            reason = "chip tunnel unresponsive (jax init hung)"
+            _log(f"  {reason}")
+            continue
+        if probe.returncode != 0:
+            reason = (f"jax init failed: {probe.stderr.strip()[-200:]}")
+            _log(f"  {reason}")
+            continue
+        out_lines = probe.stdout.strip().splitlines() if probe.stdout else []
+        platform = out_lines[-1] if out_lines else ""
+        if platform != "tpu" and os.environ.get(
+                "TASKSRUNNER_BENCH_TPU_FORCE") != "1":
+            # not an outage — there is genuinely no chip here (e.g. a
+            # CPU-only CI host). Still surface the cached on-chip
+            # figure so the artifact carries the real number.
+            reason = f"no TPU visible (default device is {platform!r})"
+            _log(f"  {reason}")
+            break
+        try:
+            proc = subprocess.run(
+                [sys.executable, str(REPO / "bench.py"), "--tpu-bench"],
+                capture_output=True, text=True, timeout=600)
+        except subprocess.TimeoutExpired:
+            reason = "tpu bench timed out mid-run (tunnel died after probe)"
+            _log(f"  {reason}")
+            continue
+        if proc.returncode == 0 and proc.stdout.strip():
+            try:
+                tpu = json.loads(proc.stdout.strip().splitlines()[-1])
+            except ValueError as exc:
+                reason = f"tpu bench output unparsable: {exc}"
+                _log(f"  {reason}")
+                continue
+            if tpu:
+                import datetime
+                measured_at = datetime.datetime.now(
+                    datetime.timezone.utc).isoformat(timespec="seconds")
+                _TPU_CACHE.write_text(json.dumps(
+                    {"measured_at": measured_at,
+                     "provenance": "measured on-chip by bench.py "
+                                   "--tpu-bench on this host",
+                     "result": tpu}, indent=1) + "\n")
+                return {**tpu, "stale": False, "measured_at": measured_at}
+            reason = "run_tpu_step_bench returned null on a live device"
+            _log(f"  {reason}")
+            break
+        reason = (f"tpu bench failed rc={proc.returncode}: "
+                  f"{proc.stderr.strip()[-300:]}")
+        _log(f"  {reason}")
+
+    # final failure: embed the last on-chip measurement, honestly marked
+    if _TPU_CACHE.exists():
+        try:
+            cached = json.loads(_TPU_CACHE.read_text())
+            _log(f"  using cached on-chip result from "
+                 f"{cached.get('measured_at')} (stale)")
+            return {**cached["result"], "stale": True,
+                    "measured_at": cached.get("measured_at"),
+                    "provenance": cached.get("provenance"),
+                    "stale_reason": reason}
+        except (ValueError, KeyError) as exc:
+            _log(f"  tpu cache unreadable: {exc}")
+    return None
+
+
 # ---------------------------------------------------------------------------
 # entry point
 # ---------------------------------------------------------------------------
@@ -610,16 +745,50 @@ def main() -> None:
             asyncio.run(_worker_main(args.worker, args.tmp, args.idx))
         return
 
-    _log("bench 1/4: cross-process write path (faithful [PB] topology) ...")
-    xproc = asyncio.run(run_xproc(latency_probe=True))
+    # the chip section runs FIRST: it is the scarcest measurement (the
+    # tunnel has documented multi-hour outages) and must not queue
+    # behind minutes of CPU benches that could overlap an outage window
+    _log("bench 1/5: ML-extension train step on the attached chip ...")
+    # belt over braces: the section is internally fault-tolerant, but
+    # it also runs FIRST now — nothing it could raise may be allowed
+    # to cost the CPU sections their numbers
+    try:
+        tpu = run_tpu_section()
+    except Exception as exc:  # noqa: BLE001 - artifact must survive
+        _log(f"  tpu section raised unexpectedly: {exc!r}")
+        tpu = None
+    if tpu and not tpu.get("stale"):
+        _log(f"  -> {tpu['step_ms']} ms/step, {tpu['tflops_per_sec']} TFLOP/s, "
+             f"MFU {tpu['mfu']} on {tpu['device']}")
+    elif tpu:
+        _log(f"  -> STALE (cache of {tpu.get('measured_at')}): "
+             f"{tpu['step_ms']} ms/step, MFU {tpu['mfu']} on {tpu['device']}")
+
+    _log("bench 2/5: cross-process write path (faithful [PB] topology) ...")
+    xproc = asyncio.run(run_xproc(latency_probe=True, rounds=5))
     _log(f"  -> {xproc['throughput']} tasks/s, "
          f"p50 {xproc['p50_ms']} ms, p99 {xproc['p99_ms']} ms (conc=8)")
+
+    # same topology under the recommended production posture: per-app
+    # workload certs, every peer hop on the authenticated mesh lane —
+    # module 15 quotes this delta instead of recommending an unmeasured
+    # configuration
+    _log("bench 3/5: cross-process write path under mesh mTLS ...")
+    # same rounds as the plaintext headline — an asymmetric pair would
+    # bake an ordering/averaging confound into the published delta
+    mtls = asyncio.run(run_xproc(latency_probe=True, rounds=5,
+                                 mesh_tls=True))
+    mtls_overhead = round(
+        (xproc["throughput"] - mtls["throughput"])
+        / xproc["throughput"] * 100.0, 1)
+    _log(f"  -> {mtls['throughput']} tasks/s, p50 {mtls['p50_ms']} ms, "
+         f"p99 {mtls['p99_ms']} ms ({mtls_overhead:+.1f}% vs plaintext)")
 
     # scale-out: with 20 ms of simulated work per message (≙ the
     # reference processor's SendGrid call) consumers are the
     # bottleneck; 5 competing replicas vs 1 shows the KEDA-style
     # scale-out actually scaling (SURVEY.md §5.8)
-    _log("bench 2/4: competing-consumer scale-out (20 ms work/message) ...")
+    _log("bench 4/5: competing-consumer scale-out (20 ms work/message) ...")
     one = asyncio.run(run_xproc(n_tasks=300, n_processors=1, rounds=2,
                                 work_ms=20.0))
     five = asyncio.run(run_xproc(n_tasks=300, n_processors=5, rounds=2,
@@ -628,31 +797,9 @@ def main() -> None:
     _log(f"  -> 1 replica: {one['throughput']} tasks/s; "
          f"5 replicas: {five['throughput']} tasks/s ({speedup}x)")
 
-    _log("bench 3/4: in-process cluster (round-1 continuity) ...")
+    _log("bench 5/5: in-process cluster (round-1 continuity) ...")
     inproc = asyncio.run(run_inproc())
     _log(f"  -> {inproc} tasks/s")
-
-    _log("bench 4/4: ML-extension train step on the attached chip ...")
-    # subprocess + hard timeout: a dead/hung chip tunnel must cost this
-    # bench one skipped section, never a hang (jax init itself blocks
-    # when the tunnel is down, so in-process guarding can't help)
-    tpu = None
-    try:
-        proc = subprocess.run(
-            [sys.executable, str(REPO / "bench.py"), "--tpu-bench"],
-            capture_output=True, text=True, timeout=600)
-        if proc.returncode == 0 and proc.stdout.strip():
-            tpu = json.loads(proc.stdout.strip().splitlines()[-1])
-        else:
-            _log(f"  tpu bench failed rc={proc.returncode}: "
-                 f"{proc.stderr.strip()[-300:]}")
-    except subprocess.TimeoutExpired:
-        _log("  tpu bench timed out (chip tunnel unresponsive); skipping")
-    except ValueError as exc:
-        _log(f"  tpu bench output unparsable: {exc}")
-    if tpu:
-        _log(f"  -> {tpu['step_ms']} ms/step, {tpu['tflops_per_sec']} TFLOP/s, "
-             f"MFU {tpu['mfu']} on {tpu['device']}")
 
     print(json.dumps({
         "metric": "e2e_xproc_write_throughput",
@@ -679,18 +826,37 @@ def main() -> None:
                 "min": xproc["throughput_min"],
                 "max": xproc["throughput_max"],
             },
+            "xproc_mtls": {
+                "tasks_per_sec": mtls["throughput"],
+                "p50_ms": mtls["p50_ms"],
+                "p99_ms": mtls["p99_ms"],
+                "throughput_rounds": mtls["throughput_runs"],
+                "overhead_vs_plaintext_pct": mtls_overhead,
+                "note": "same topology with per-app workload certs; "
+                        "every peer-sidecar hop on the authenticated "
+                        "TLS mesh lane (module 15's recommended "
+                        "production posture). Runs back-to-back after "
+                        "the plaintext section on a 1-core host with "
+                        "±20% noise: a negative 'overhead' means the "
+                        "later, warmer run measured faster, not that "
+                        "TLS speeds anything up",
+            },
             "scaleout_20ms_work": {
                 "replicas1_tasks_per_sec": one["throughput"],
                 "replicas5_tasks_per_sec": five["throughput"],
                 "speedup": speedup,
+                "host_note": "this host has ONE CPU core and the "
+                             "20 ms/message work is simulated sleep: "
+                             "the figure proves competing-consumer "
+                             "claim/lease correctness under scale-out, "
+                             "not parallel CPU speedup",
             },
             "inproc_tasks_per_sec": inproc,
             "ml_extension_tpu": tpu,
             **({} if tpu else {"ml_extension_note":
                 "chip bench skipped (no TPU reachable within the "
-                "timeout); last measured figures are tabulated in "
-                "BASELINE.md (round 4: step 84.3 ms, MFU 0.645 on "
-                "TPU v5 lite)"}),
+                "retry budget and no cached on-chip measurement); "
+                "last measured figures are tabulated in BASELINE.md"}),
         },
     }))
 

@@ -1,0 +1,141 @@
+"""bench.py harness logic that must not depend on host speed or a
+live chip: the outage-proof TPU section (probe → bounded retry →
+timestamped stale-cache fallback) and the mTLS topology variant.
+
+These are correctness tests for the measurement harness itself — the
+wall-clock perf gates live in test_bench.py behind
+TASKSRUNNER_PERF_TESTS. The round-4 verdict's top item was a round
+whose on-chip number never reached the driver artifact because the
+bench gave up after one attempt with no carry-forward; this file pins
+the fallback chain so that failure mode cannot return.
+"""
+
+import asyncio
+import json
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1]))
+import bench
+
+
+class _FakeCompleted:
+    def __init__(self, rc=0, stdout="", stderr=""):
+        self.returncode = rc
+        self.stdout = stdout
+        self.stderr = stderr
+
+
+def _no_sleep(monkeypatch):
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+
+
+def test_tpu_section_stale_cache_on_dead_tunnel(tmp_path, monkeypatch):
+    """All probes hang → the section embeds the cached on-chip result
+    marked stale, with its timestamp and the failure reason."""
+    _no_sleep(monkeypatch)
+    cache = tmp_path / "cache.json"
+    cache.write_text(json.dumps({
+        "measured_at": "2026-07-30T10:30:00+00:00",
+        "provenance": "test",
+        "result": {"step_ms": 84.3, "mfu": 0.645, "device": "TPU v5 lite"},
+    }))
+    monkeypatch.setattr(bench, "_TPU_CACHE", cache)
+
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 0))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    out = bench.run_tpu_section()
+    assert len(calls) == 3  # bounded retry, not single-shot, not forever
+    assert out["stale"] is True
+    assert out["mfu"] == 0.645
+    assert out["measured_at"] == "2026-07-30T10:30:00+00:00"
+    assert "unresponsive" in out["stale_reason"]
+
+
+def test_tpu_section_no_cache_returns_none(tmp_path, monkeypatch):
+    _no_sleep(monkeypatch)
+    monkeypatch.setattr(bench, "_TPU_CACHE", tmp_path / "absent.json")
+
+    def fake_run(cmd, **kw):
+        raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 0))
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench.run_tpu_section() is None
+
+
+def test_tpu_section_fresh_measurement_overwrites_cache(tmp_path,
+                                                        monkeypatch):
+    """A live chip → fresh result is returned non-stale AND written to
+    the cache file for the next outage round."""
+    _no_sleep(monkeypatch)
+    cache = tmp_path / "cache.json"
+    monkeypatch.setattr(bench, "_TPU_CACHE", cache)
+    fresh = {"step_ms": 70.0, "mfu": 0.7, "device": "TPU v5 lite",
+             "tflops_per_sec": 150.0}
+
+    def fake_run(cmd, **kw):
+        if "-c" in cmd:  # the liveness probe
+            return _FakeCompleted(stdout="tpu\n")
+        return _FakeCompleted(stdout=json.dumps(fresh) + "\n")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    out = bench.run_tpu_section()
+    assert out["stale"] is False
+    assert out["mfu"] == 0.7
+    saved = json.loads(cache.read_text())
+    assert saved["result"] == fresh
+    assert saved["measured_at"] == out["measured_at"]
+
+
+def test_tpu_section_recovers_after_one_failed_probe(monkeypatch,
+                                                     tmp_path):
+    """A single tunnel blip must cost one backoff, not the round's
+    number: probe 1 hangs, probe 2 succeeds, the bench runs."""
+    _no_sleep(monkeypatch)
+    monkeypatch.setattr(bench, "_TPU_CACHE", tmp_path / "cache.json")
+    fresh = {"step_ms": 70.0, "mfu": 0.7, "device": "TPU v5 lite"}
+    state = {"probes": 0}
+
+    def fake_run(cmd, **kw):
+        if "-c" in cmd:
+            state["probes"] += 1
+            if state["probes"] == 1:
+                raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 0))
+            return _FakeCompleted(stdout="tpu\n")
+        return _FakeCompleted(stdout=json.dumps(fresh) + "\n")
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    out = bench.run_tpu_section()
+    assert out["stale"] is False and state["probes"] == 2
+
+
+def test_repo_cache_file_is_valid():
+    """The committed cache must stay loadable — it is the artifact's
+    fallback leg."""
+    assert bench._TPU_CACHE.exists()
+    cached = json.loads(bench._TPU_CACHE.read_text())
+    assert cached["measured_at"]
+    assert cached["result"]["mfu"] > 0
+    assert cached["result"]["step_ms"] > 0
+
+
+def test_xproc_mesh_tls_variant_runs_and_restores_env():
+    """The mTLS bench topology: per-app certs provisioned, the run
+    completes through the authenticated lane, and the driver's cert
+    env vars do not leak into the calling process."""
+    import os
+    from tasksrunner.invoke.pki import CA_ENV, CERT_ENV, KEY_ENV
+
+    before = {k: os.environ.get(k) for k in (CA_ENV, CERT_ENV, KEY_ENV)}
+    out = asyncio.run(bench.run_xproc(
+        n_tasks=40, warmup=5, rounds=1, concurrency=16, mesh_tls=True))
+    assert out["throughput"] > 0
+    after = {k: os.environ.get(k) for k in (CA_ENV, CERT_ENV, KEY_ENV)}
+    assert before == after

@@ -1311,3 +1311,34 @@ def test_appendix_debugging_forensic_loop(scratch):
                       "-d '{\"taskName\":\"dbg2\",\"taskCreatedBy\":\"d@x.com\"}'")
     assert "taskId" in out
     scratch.stop_proc(orch)
+
+
+def test_docs_mermaid_blocks_are_wellformed():
+    """Every mermaid fence in the docs opens with a known diagram type
+    and closes — the strict mkdocs build renders them client-side, so
+    a truncated block would fail silently at read time, not build
+    time. (The three load-bearing diagrams: scenario architecture,
+    module-5 pub/sub topology, module-15 production topology.)"""
+    import pathlib
+    docs = pathlib.Path(__file__).resolve().parents[1] / "docs"
+    known = ("flowchart", "sequenceDiagram", "graph", "stateDiagram")
+    found = []
+    for md in sorted(docs.rglob("*.md")):
+        lines = md.read_text().splitlines()
+        open_at = None
+        for i, line in enumerate(lines):
+            if line.strip() == "```mermaid":
+                assert open_at is None, f"{md}:{i+1}: nested mermaid fence"
+                open_at = i
+                first = next((l.strip() for l in lines[i + 1:]
+                              if l.strip()), "")
+                assert first.startswith(known), \
+                    f"{md}:{i+2}: unknown mermaid type {first[:30]!r}"
+            elif line.strip().startswith("```") and open_at is not None:
+                found.append(md.name)
+                open_at = None
+        assert open_at is None, f"{md}: unclosed mermaid fence"
+    # the three diagrams the round-4 verdict called load-bearing
+    assert "00-intro-2-scenario-architecture.md" in found
+    assert "05-pubsub.md" in found
+    assert "15-production-baseline.md" in found

@@ -325,3 +325,54 @@ async def test_local_mesh_disabled_under_mtls_fails_fast(tmp_path,
     finally:
         for h in hosts:
             await h.stop()
+
+
+@pytest.mark.asyncio
+async def test_tls_handshake_failure_fails_over_without_downgrade(
+        tmp_path, monkeypatch):
+    """Fault injection under mTLS: replica 0's mesh entry is re-pointed
+    at an endpoint that cannot complete the TLS handshake, while its
+    REAL plaintext HTTP sidecar stays alive and would happily serve.
+    Every invoke must fail over to the healthy replica over TLS — and
+    none may ever reach replica 0 over plaintext HTTP (the served_by
+    counter is the downgrade detector)."""
+    import collections
+
+    from tests.test_multireplica import _start_pair, _tamper_replica0
+
+    paths = write_pki(tmp_path / "pki", ["backend-api", "frontend"])
+    monkeypatch.setenv(CA_ENV, paths["backend-api"]["ca"])
+    monkeypatch.setenv(CERT_ENV, paths["backend-api"]["cert"])
+    monkeypatch.setenv(KEY_ENV, paths["backend-api"]["key"])
+    monkeypatch.delenv("TASKSRUNNER_MESH", raising=False)
+
+    counter: collections.Counter = collections.Counter()
+    hosts, fhost = await _start_pair(tmp_path, counter)
+
+    async def no_tls_here(reader, writer):  # a plain socket: any TLS
+        try:                                # ClientHello dies here
+            await reader.read(-1)
+        except (ConnectionError, OSError):
+            pass
+        writer.close()
+
+    tarpit = await asyncio.start_server(no_tls_here, "127.0.0.1", 0)
+    try:
+        await _tamper_replica0(
+            hosts, mesh_port=tarpit.sockets[0].getsockname()[1])
+        before_r0 = counter["r0"]
+        for _ in range(6):
+            resp = await fhost.app.client.invoke_method(
+                "backend-api", "api/work", http_method="POST", data={})
+            assert resp.status == 200
+            assert resp.json()["served_by"] == "r1"
+        # the downgrade detector: replica 0's live HTTP sidecar never
+        # saw a request after the poisoning
+        assert counter["r0"] == before_r0
+    finally:
+        # hosts first: their mesh-pool close EOFs the tar-pit readers,
+        # which py3.12's wait_closed() awaits
+        for h in [*hosts, fhost]:
+            await h.stop()
+        tarpit.close()  # no wait_closed(): py3.12 can await handler
+        # coroutines forever here; the loop is torn down right after

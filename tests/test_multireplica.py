@@ -161,3 +161,101 @@ async def test_replica_loss_degrades_to_retry_not_outage(tmp_path):
     finally:
         for h in ([hosts[1], fhost] if stopped else [*hosts, fhost]):
             await h.stop()
+
+
+# ---------------------------------------------------------------------------
+# fault injection on the mesh lane: established connections that die
+# ---------------------------------------------------------------------------
+
+async def _tamper_replica0(hosts, *, mesh_port):
+    """Re-point replica 0's registry entry at a different mesh port,
+    keeping its real HTTP sidecar port (so only the mesh lane is
+    poisoned — exactly the shape of a half-dead peer)."""
+    victim = next(a for a in hosts[0].resolver.resolve_all("backend-api")
+                  if a.sidecar_port == hosts[0].sidecar_port)
+    hosts[0].resolver.register(AppAddress(
+        app_id="backend-api", host=victim.host,
+        sidecar_port=victim.sidecar_port, app_port=victim.app_port,
+        pid=victim.pid, mesh_port=mesh_port))
+
+
+@pytest.mark.asyncio
+async def test_established_mesh_conn_dropped_midflight_fails_over(tmp_path):
+    """The connection DIALS fine, then the peer dies after reading the
+    request frame (crash mid-handling, RST, a dying VM). That is an
+    in-flight drop — not a refused dial — so it must burn one retry,
+    re-resolve, and land on the healthy replica. Requests keep
+    succeeding throughout."""
+    counter: collections.Counter = collections.Counter()
+    hosts, fhost = await _start_pair(tmp_path, counter)
+
+    async def drop_after_first_frame(reader, writer):
+        try:
+            await reader.readexactly(4)   # accept the dial, take bytes,
+        except asyncio.IncompleteReadError:
+            pass
+        writer.transport.abort()          # then die abruptly mid-flight
+
+    tarpit = await asyncio.start_server(
+        drop_after_first_frame, "127.0.0.1", 0)
+    try:
+        await _tamper_replica0(
+            hosts, mesh_port=tarpit.sockets[0].getsockname()[1])
+        for _ in range(6):
+            resp = await fhost.app.client.invoke_method(
+                "backend-api", "api/work", http_method="POST", data={})
+            assert resp.status == 200
+            assert resp.json()["served_by"] == "r1"
+    finally:
+        # hosts first: closing their mesh pools EOFs the tar-pit's
+        # reader coroutines, which wait_closed() awaits on py3.12
+        for h in [*hosts, fhost]:
+            await h.stop()
+        tarpit.close()  # no wait_closed(): py3.12 can await handler
+        # coroutines forever here; the loop is torn down right after
+
+
+@pytest.mark.asyncio
+async def test_blackholed_mesh_conn_times_out_and_fails_over(
+        tmp_path, monkeypatch):
+    """The nastier variant: the peer accepts the connection and the
+    frame, then answers NOTHING (network partition after SYN/ACK, a
+    wedged process). The per-request ceiling must convert the silence
+    into a retriable timeout and the retry must land on the healthy
+    replica — bounded, not an unbounded hang."""
+    from tasksrunner.invoke import mesh as mesh_mod
+
+    monkeypatch.setattr(mesh_mod, "REQUEST_TIMEOUT", 0.5)
+    counter: collections.Counter = collections.Counter()
+    hosts, fhost = await _start_pair(tmp_path, counter)
+
+    async def blackhole(reader, writer):
+        try:
+            await reader.read(-1)         # consume forever, reply never
+        except (ConnectionError, OSError):
+            pass
+
+    tarpit = await asyncio.start_server(blackhole, "127.0.0.1", 0)
+    try:
+        await _tamper_replica0(
+            hosts, mesh_port=tarpit.sockets[0].getsockname()[1])
+        import time as _time
+        t0 = _time.perf_counter()
+        for _ in range(4):
+            resp = await fhost.app.client.invoke_method(
+                "backend-api", "api/work", http_method="POST", data={})
+            assert resp.status == 200
+            assert resp.json()["served_by"] == "r1"
+        # 4 requests, worst case ~2 blackhole timeouts each at 0.5 s.
+        # The ceiling is deliberately HUGE relative to that (~25x):
+        # it only distinguishes "bounded" from "stuck on the 300 s
+        # default REQUEST_TIMEOUT", so shared-runner noise can never
+        # trip it (the perf-gate lesson from tests.yml applies here)
+        assert _time.perf_counter() - t0 < 60
+    finally:
+        # hosts first (see above): their pool close EOFs the blackhole
+        # readers so wait_closed() can finish
+        for h in [*hosts, fhost]:
+            await h.stop()
+        tarpit.close()  # no wait_closed(): py3.12 can await handler
+        # coroutines forever here; the loop is torn down right after
