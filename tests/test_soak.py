@@ -1,14 +1,20 @@
-"""Opt-in endurance soak (TASKSRUNNER_SOAK=1): sustained load through
-the full in-process pipeline with a memory-flatness assertion.
+"""Endurance soak: sustained load through the full in-process pipeline
+with a memory-flatness assertion.
 
 The round-4 soak (BASELINE.md "Round 4 endurance") caught what the
 functional suite structurally cannot: per-message memory retention —
 CPython 3.12's pathlib interning every unique outbox/blob filename
-forever. This test is that soak, distilled: drive thousands of
+forever. This file is that soak, distilled: drive thousands of
 messages through subscribe → handler → output binding and assert the
-process does NOT retain memory per message. Off by default (it runs
-minutes-scale work under load-sensitive assertions); enable with
-TASKSRUNNER_SOAK=1 for release checks and leak hunts.
+process does NOT retain memory per message.
+
+Two tiers (round-5 verdict item 3 — the leak detector must not depend
+on someone remembering to run it):
+
+* ``test_no_per_message_memory_retention_bounded`` — ALWAYS ON in the
+  default suite; a ~1-minute bounded window sized for the 1-core host.
+* ``test_no_per_message_memory_retention`` — the full opt-in soak
+  (TASKSRUNNER_SOAK=1) for release checks and leak hunts.
 """
 
 import asyncio
@@ -21,19 +27,17 @@ from tasksrunner import App, InProcCluster
 from tasksrunner.component.spec import parse_component
 from tasksrunner.envflag import env_flag
 
-pytestmark = pytest.mark.skipif(
-    not env_flag("TASKSRUNNER_SOAK", default=False),
-    reason="endurance soak is opt-in (TASKSRUNNER_SOAK=1)")
-
-#: net retained bytes allowed across the measured 5k messages —
-#: the pre-fix leak measured ~1.9 MB here; post-fix ~47 KiB of
-#: transient buffers. 400 KiB keeps headroom without letting a
-#: per-message leak (>80 B/msg) back in.
-RETAINED_BUDGET = 400 * 1024
+#: net retained bytes allowed per measured message. The pre-fix leak
+#: measured ~380 B/msg (pathlib interning); post-fix retention is
+#: ~10 B/msg of transient buffers amortized. 80 B/msg keeps headroom
+#: for allocator noise without letting a real per-message leak back in.
+RETAINED_BUDGET_PER_MSG = 80
 
 
-@pytest.mark.asyncio
-async def test_no_per_message_memory_retention(tmp_path):
+async def _retention_probe(tmp_path, *, warmup: int, measured: int) -> int:
+    """Run the processor-shaped pipeline (subscribe → unique-name
+    outbox mail + unique-name blob archive per message) and return net
+    retained bytes across the measured window."""
     specs = [
         parse_component({
             "componentType": "pubsub.sqlite",
@@ -90,18 +94,40 @@ async def test_no_per_message_memory_retention(tmp_path):
                 await client.publish_event("pubsub", "t", {"taskId": f"s{i}"})
             await asyncio.wait_for(done.wait(), timeout=240)
 
-        await drive(1000, 0)          # warmup: caches, pools, lazy init
+        await drive(warmup, 0)        # warmup: caches, pools, lazy init
         gc.collect()
         tracemalloc.start(10)
-        base = tracemalloc.take_snapshot()
-        await drive(5000, 1000)       # the measured window
-        gc.collect()
-        snap = tracemalloc.take_snapshot()
-        retained = sum(s.size_diff for s in snap.compare_to(base, "lineno"))
-        assert retained < RETAINED_BUDGET, (
-            f"retained {retained/1024:.0f} KiB across 5k messages "
-            f"(budget {RETAINED_BUDGET/1024:.0f} KiB) — top sites:\n" +
-            "\n".join(str(s) for s in snap.compare_to(base, "lineno")[:5]))
+        try:
+            base = tracemalloc.take_snapshot()
+            await drive(measured, warmup)   # the measured window
+            gc.collect()
+            snap = tracemalloc.take_snapshot()
+            diff = snap.compare_to(base, "lineno")
+            retained = sum(s.size_diff for s in diff)
+            budget = RETAINED_BUDGET_PER_MSG * measured
+            assert retained < budget, (
+                f"retained {retained/1024:.0f} KiB across {measured} "
+                f"messages (budget {budget/1024:.0f} KiB) — top sites:\n"
+                + "\n".join(str(s) for s in diff[:5]))
+            return retained
+        finally:
+            tracemalloc.stop()
     finally:
-        tracemalloc.stop()
         await cluster.stop()
+
+
+@pytest.mark.asyncio
+async def test_no_per_message_memory_retention_bounded(tmp_path):
+    """Default-suite leak detector: small enough for every run on the
+    1-core host, large enough that the round-4 leak class (~380 B per
+    message of immortal interned strings) overshoots the budget ~5x."""
+    await _retention_probe(tmp_path, warmup=400, measured=1600)
+
+
+@pytest.mark.asyncio
+@pytest.mark.skipif(
+    not env_flag("TASKSRUNNER_SOAK", default=False),
+    reason="full endurance soak is opt-in (TASKSRUNNER_SOAK=1)")
+async def test_no_per_message_memory_retention(tmp_path):
+    """The full-size opt-in soak (release checks, leak hunts)."""
+    await _retention_probe(tmp_path, warmup=1000, measured=5000)
