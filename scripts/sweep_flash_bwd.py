@@ -1,0 +1,131 @@
+"""On-chip sweep of the flash kernels' head-block sizes and backward
+variant — the round-4 follow-up the chip tunnel interrupted
+(BASELINE.md: "bwd keeps the same heuristic pending a finer sweep").
+
+Every variant is numerically interchangeable (pinned by
+tests/test_ml_extension.py::test_flash_backward_variants_match_einsum),
+so this sweep is purely a clock question. Each variant runs in its OWN
+python process (host quirk: chip experiments must not share a process;
+first compile ~15-50 s) with the variant expressed as env overrides:
+
+* TASKSRUNNER_FLASH_HBLK_FWD / _BWD — heads folded per grid program;
+* TASKSRUNNER_FLASH_BWD_DELTA=precompute — Δ=Σ(dO∘O) outside the
+  kernel, dropping the ``o`` stream (flash-v2 arrangement).
+
+Usage (tunnel up):   python scripts/sweep_flash_bwd.py
+Results: ranked table on stdout + build/sweep_flash_bwd.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+OUT = REPO / "build" / "sweep_flash_bwd.json"
+
+#: (label, env overrides). The baseline row is the round-4 shipped
+#: configuration: heuristic blocks (4 at bench shapes), Δ in-kernel.
+VARIANTS: list[tuple[str, dict[str, str]]] = [
+    ("baseline(heuristic)", {}),
+    ("bwd_hblk=2", {"TASKSRUNNER_FLASH_HBLK_BWD": "2"}),
+    ("bwd_hblk=8", {"TASKSRUNNER_FLASH_HBLK_BWD": "8"}),
+    ("delta_pre", {"TASKSRUNNER_FLASH_BWD_DELTA": "precompute"}),
+    ("delta_pre+bwd8", {"TASKSRUNNER_FLASH_BWD_DELTA": "precompute",
+                        "TASKSRUNNER_FLASH_HBLK_BWD": "8"}),
+    ("delta_pre+bwd2", {"TASKSRUNNER_FLASH_BWD_DELTA": "precompute",
+                        "TASKSRUNNER_FLASH_HBLK_BWD": "2"}),
+    ("fwd_hblk=8", {"TASKSRUNNER_FLASH_HBLK_FWD": "8"}),
+    ("fwd8+delta_pre+bwd8", {"TASKSRUNNER_FLASH_HBLK_FWD": "8",
+                             "TASKSRUNNER_FLASH_BWD_DELTA": "precompute",
+                             "TASKSRUNNER_FLASH_HBLK_BWD": "8"}),
+]
+
+
+def child() -> None:
+    """One timing run under the current env. Bench-sized config, sync
+    via value fetch (block_until_ready returns early on the tunneled
+    backend — see bench.py measure())."""
+    import jax
+
+    from tasksrunner.ml.model import ModelConfig, init_params, make_train_step
+
+    cfg = ModelConfig(vocab=32768, seq_len=512, d_model=1024,
+                      n_heads=16, d_ff=4096, n_layers=8)
+    batch = 32
+    key = jax.random.key(0)
+    import jax.numpy as jnp
+    tokens = jax.random.randint(key, (batch, cfg.seq_len), 0, cfg.vocab,
+                                dtype=jnp.int32)
+    labels = jax.random.randint(key, (batch,), 0, cfg.n_classes,
+                                dtype=jnp.int32)
+    params = init_params(cfg, key)
+    step = make_train_step(cfg)
+    t0 = time.perf_counter()
+    params, loss = step(params, tokens, labels)
+    float(loss)
+    compile_s = time.perf_counter() - t0
+    n = 20
+    t0 = time.perf_counter()
+    for _ in range(n):
+        params, loss = step(params, tokens, labels)
+    float(loss)
+    print(json.dumps({"step_ms": (time.perf_counter() - t0) / n * 1000.0,
+                      "compile_s": compile_s}))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--child", action="store_true")
+    parser.add_argument("--timeout", type=int, default=600)
+    args = parser.parse_args()
+    if args.child:
+        child()
+        return
+
+    results = []
+    for label, env in VARIANTS:
+        print(f"[{label}] ...", flush=True)
+        try:
+            proc = subprocess.run(
+                [sys.executable, str(pathlib.Path(__file__)), "--child"],
+                capture_output=True, text=True, timeout=args.timeout,
+                env={**os.environ, **env}, cwd=str(REPO))
+        except subprocess.TimeoutExpired:
+            print(f"[{label}] TIMED OUT (tunnel?)", flush=True)
+            results.append({"variant": label, "env": env, "error": "timeout"})
+            continue
+        if proc.returncode != 0:
+            tail = proc.stderr.strip()[-300:]
+            print(f"[{label}] FAILED: {tail}", flush=True)
+            results.append({"variant": label, "env": env, "error": tail})
+            continue
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        row = {"variant": label, "env": env, **row}
+        print(f"[{label}] step {row['step_ms']:.2f} ms "
+              f"(compile {row['compile_s']:.1f} s)", flush=True)
+        results.append(row)
+
+    ok = [r for r in results if "step_ms" in r]
+    ok.sort(key=lambda r: r["step_ms"])
+    OUT.parent.mkdir(parents=True, exist_ok=True)
+    OUT.write_text(json.dumps({"results": results, "ranked": ok}, indent=1))
+    if ok:
+        print("\nranked:")
+        for r in ok:
+            print(f"  {r['step_ms']:8.2f} ms  {r['variant']}")
+        best = ok[0]
+        print(f"\nbest: {best['variant']} — export "
+              + " ".join(f"{k}={v}" for k, v in best["env"].items())
+              or "(baseline: no overrides)")
+    print(f"\nwrote {OUT}")
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(REPO))
+    main()

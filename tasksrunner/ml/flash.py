@@ -58,6 +58,21 @@ def _dot(a, b, *, trans_b: bool = False):
         preferred_element_type=jnp.float32)
 
 
+def _env_hblk(var: str, h: int) -> int | None:
+    """Trace-time head-block override for on-chip sweeps
+    (scripts/sweep_flash_bwd.py): TASKSRUNNER_FLASH_HBLK_FWD /
+    _BWD / _RING = an integer dividing n_heads. Unset → the
+    VMEM-budget heuristic below decides."""
+    import os
+    raw = os.environ.get(var)
+    if not raw:
+        return None
+    blk = int(raw)
+    if blk < 1 or h % blk:
+        raise ValueError(f"{var}={raw} must divide n_heads={h}")
+    return blk
+
+
 def _head_block(h: int, s: int, d: int, *, n_qkv: int = 4,
                 n_tiles: int = 2) -> int:
     """Heads folded into one grid program. One-head programs are tiny
@@ -117,7 +132,8 @@ def _flash_fwd(q, k, v, scale):
     the inputs' dtype (bf16 activations halve the HBM bytes — softmax
     statistics and accumulation stay f32 inside the kernel)."""
     b, h, s, d = q.shape
-    h_blk = _head_block(h, s, d, n_qkv=5, n_tiles=2)
+    h_blk = (_env_hblk("TASKSRUNNER_FLASH_HBLK_FWD", h)
+             or _head_block(h, s, d, n_qkv=5, n_tiles=2))
     qkv_spec, lse_spec = _specs(b, s, h, d, h_blk)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, h_blk=h_blk),
@@ -156,11 +172,62 @@ def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, l_ref,
         dv_ref[0, i] = dv.astype(dv_ref.dtype)
 
 
+def _bwd_kernel_delta(q_ref, k_ref, v_ref, do_ref, l_ref, delta_ref,
+                      dq_ref, dk_ref, dv_ref, *, scale, h_blk):
+    """Backward variant with Δ = Σ(dO ∘ O) PREcomputed outside the
+    kernel (the standard flash-v2 arrangement): the ``o`` stream
+    disappears from the program (one fewer [h_blk, S, D] double-
+    buffered input), trading a cheap XLA-fused elementwise pass for
+    VMEM headroom. Numerically identical to _bwd_kernel; which one
+    wins on the clock is a sweep question (scripts/sweep_flash_bwd.py)."""
+    for i in range(h_blk):                      # static unroll
+        q = q_ref[0, i]
+        k = k_ref[0, i]
+        v = v_ref[0, i]
+        do = do_ref[0, i]
+        lse = l_ref[0, i, 0, :]                 # [S]
+        delta = delta_ref[0, i, 0, :]           # [S], f32
+        s = _dot(q, k, trans_b=True) * scale    # [S, S]
+        p = jnp.exp(s - lse[:, None])           # normalised probs, f32
+        dv = _dot(p.T, do)                      # [S, D]
+        dp = _dot(do, v, trans_b=True)          # [S, S]
+        ds = p * (dp - delta[:, None]) * scale  # [S, S]
+        dq_ref[0, i] = _dot(ds, k).astype(dq_ref.dtype)
+        dk_ref[0, i] = _dot(ds.T, q).astype(dk_ref.dtype)
+        dv_ref[0, i] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_delta_precompute() -> bool:
+    """TASKSRUNNER_FLASH_BWD_DELTA=precompute switches the backward to
+    _bwd_kernel_delta (trace-time; default keeps Δ in-kernel — the
+    round-4 measured configuration)."""
+    import os
+    return os.environ.get("TASKSRUNNER_FLASH_BWD_DELTA") == "precompute"
+
+
 def _flash_bwd_call(q, k, v, out, lse, dout, scale):
     b, h, s, d = q.shape
+    dout = dout.astype(q.dtype)
+    if _bwd_delta_precompute():
+        # Δ in one XLA-fused elementwise+reduce pass; the kernel then
+        # streams 4 big inputs instead of 5
+        delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)[:, :, None, :]            # [b, h, 1, s]
+        h_blk = (_env_hblk("TASKSRUNNER_FLASH_HBLK_BWD", h)
+                 or _head_block(h, s, d, n_qkv=7, n_tiles=3))
+        qkv_spec, lse_spec = _specs(b, s, h, d, h_blk)
+        return pl.pallas_call(
+            functools.partial(_bwd_kernel_delta, scale=scale, h_blk=h_blk),
+            grid=(b, h // h_blk),
+            in_specs=[qkv_spec] * 4 + [lse_spec, lse_spec],
+            out_specs=[qkv_spec] * 3,
+            out_shape=[jax.ShapeDtypeStruct((b, h, s, d), q.dtype)] * 3,
+            interpret=_interpret(),
+        )(q, k, v, dout, lse, delta)
     # bwd streams more (q/k/v/o/do in, dq/dk/dv out) and keeps more
     # score-sized temporaries live (s, p, dp, ds)
-    h_blk = _head_block(h, s, d, n_qkv=8, n_tiles=3)
+    h_blk = (_env_hblk("TASKSRUNNER_FLASH_HBLK_BWD", h)
+             or _head_block(h, s, d, n_qkv=8, n_tiles=3))
     qkv_spec, lse_spec = _specs(b, s, h, d, h_blk)
     return pl.pallas_call(
         functools.partial(_bwd_kernel, scale=scale, h_blk=h_blk),
@@ -169,7 +236,7 @@ def _flash_bwd_call(q, k, v, out, lse, dout, scale):
         out_specs=[qkv_spec] * 3,
         out_shape=[jax.ShapeDtypeStruct((b, h, s, d), q.dtype)] * 3,
         interpret=_interpret(),
-    )(q, k, v, out, dout.astype(q.dtype), lse)
+    )(q, k, v, out, dout, lse)
 
 
 # -- public op ------------------------------------------------------------
@@ -248,7 +315,8 @@ def ring_block_update(q, k_blk, v_blk, m, num, den, *, scale):
     sk = k_blk.shape[1]
     # budget with the larger of the two seq dims: the score tile is
     # [Sq, Sk] and the streams carry both block sizes
-    h_blk = _head_block(h, max(sq, sk), d, n_qkv=7, n_tiles=2)
+    h_blk = (_env_hblk("TASKSRUNNER_FLASH_HBLK_RING", h)
+             or _head_block(h, max(sq, sk), d, n_qkv=7, n_tiles=2))
     qkv_spec, vec_spec = _specs(b, sq, h, d, h_blk)
     kv_spec = pl.BlockSpec((1, h_blk, sk, d), lambda i, j: (i, j, 0, 0),
                            memory_space=pltpu.VMEM)

@@ -342,3 +342,49 @@ def test_dryrun_factored_rejects_bad_factorization():
         g._dryrun_factored(8, dp=2, sp=1, tp=2)   # 4 != 8
     with pytest.raises(ValueError, match="divide"):
         g._dryrun_factored(8, dp=1, sp=1, tp=8)   # 8 ∤ n_heads=4
+
+
+@pytest.mark.parametrize("env", [
+    {"TASKSRUNNER_FLASH_BWD_DELTA": "precompute"},
+    {"TASKSRUNNER_FLASH_HBLK_BWD": "1"},
+    {"TASKSRUNNER_FLASH_HBLK_BWD": "2",
+     "TASKSRUNNER_FLASH_HBLK_FWD": "2"},
+    {"TASKSRUNNER_FLASH_BWD_DELTA": "precompute",
+     "TASKSRUNNER_FLASH_HBLK_BWD": "4",
+     "TASKSRUNNER_FLASH_HBLK_FWD": "4"},
+])
+def test_flash_backward_variants_match_einsum(monkeypatch, env):
+    """Every sweepable kernel configuration (scripts/sweep_flash_bwd.py
+    explores these on-chip) must be numerically interchangeable: the
+    sweep may only ever trade SPEED. Exercised in interpret mode so
+    the exact kernel bodies run on CPU."""
+    from tasksrunner.ml.flash import flash_attention
+
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    key = jax.random.key(11)
+    b, s, h, d = 2, 64, 4, 32
+    q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.float32)
+               for kk in jax.random.split(key, 3))
+
+    def loss_of(attn):
+        return lambda *qkv: jnp.sum(jnp.sin(attn(*qkv)))
+
+    out = flash_attention(q, k, v)
+    ref = _einsum_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-3, rtol=5e-3)
+    g_flash = jax.grad(loss_of(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_of(_einsum_attention), argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-2, rtol=1e-2)
+
+
+def test_flash_hblk_override_rejects_nondivisor(monkeypatch):
+    from tasksrunner.ml.flash import flash_attention
+
+    monkeypatch.setenv("TASKSRUNNER_FLASH_HBLK_FWD", "3")
+    q = jnp.zeros((1, 8, 4, 8), jnp.float32)
+    with pytest.raises(ValueError, match="divide n_heads"):
+        flash_attention(q, q, q)
