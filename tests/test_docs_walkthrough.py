@@ -1342,3 +1342,28 @@ def test_docs_mermaid_blocks_are_wellformed():
     assert "00-intro-2-scenario-architecture.md" in found
     assert "05-pubsub.md" in found
     assert "15-production-baseline.md" in found
+
+
+def test_appendix_snippets_commands_are_real():
+    """The command-snippets appendix (module 35) is a copy-paste
+    surface: every `python -m tasksrunner <sub>` it shows must be a
+    registered CLI subcommand, and the OCI builder flags must match
+    the script's argparse choices — the page may never rot ahead of
+    the tools it quotes."""
+    import pathlib
+    import re
+
+    from tasksrunner.cli import build_parser
+
+    page = (pathlib.Path(__file__).resolve().parents[1]
+            / "docs/modules/35-appendix-snippets.md").read_text()
+    subs = set(re.findall(r"python -m tasksrunner (\w+)", page))
+    assert {"host", "serve", "sidecar", "run", "state"} <= subs
+    parser = build_parser()
+    known = set()
+    for action in parser._subparsers._group_actions:
+        known |= set(action.choices)
+    unknown = subs - known
+    assert not unknown, f"snippets page quotes unknown subcommands: {unknown}"
+    # the OCI builder flags quoted on the page
+    assert "--service backend-api --variant optimized" in page
