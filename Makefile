@@ -1,12 +1,18 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test bench run validate docs-serve docs-build clean
+.PHONY: test soak bench sweep-flash run validate docs-serve docs-build clean
 
 test:
 	python -m pytest tests/ -q
 
+soak:
+	TASKSRUNNER_SOAK=1 python -m pytest tests/test_soak.py -q
+
 bench:
 	python bench.py
+
+sweep-flash:
+	python scripts/sweep_flash_bwd.py
 
 run:
 	python -m tasksrunner run run.yaml
