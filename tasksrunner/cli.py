@@ -388,6 +388,13 @@ def _cmd_ps(args) -> None:
                 "components": None,
                 "subscriptions": None,
             }
+            # a dead LOCAL pid is stale registry debris (SIGKILL leaves
+            # entries behind) — report it as such instead of probing
+            # ports a NEW incarnation may have reclaimed, which would
+            # show the ghost as healthy
+            if NameResolver.local_pid_dead(addr.host, addr.pid):
+                row["health"] = "stale"
+                return row
             try:
                 async with s.get(f"{addr.base_url}/v1.0/healthz") as r:
                     row["health"] = "ok" if r.status < 500 else "unhealthy"

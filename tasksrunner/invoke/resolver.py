@@ -122,6 +122,69 @@ class NameResolver:
 
         self._mutate(mutate)
 
+    @staticmethod
+    def local_pid_dead(host: str | None, pid: int | None) -> bool:
+        """True iff the entry was registered on THIS host (loopback)
+        with a pid that no longer exists — the signature of SIGKILL
+        debris. The ONE liveness predicate: `ps` and the prune sweep
+        must never drift apart on what counts as stale. For a remote
+        host a missing local pid proves nothing → False."""
+        if host not in ("127.0.0.1", "localhost"):
+            return False
+        if not pid or pid == os.getpid():
+            return False
+        try:
+            os.kill(pid, 0)
+            return False
+        except ProcessLookupError:
+            return True
+        except PermissionError:  # exists, owned by someone else
+            return False
+
+    def prune_dead_local(self) -> list[tuple[str, int]]:
+        """Remove replicas registered on THIS host whose pid no longer
+        exists — the entries a SIGKILLed topology leaves behind
+        (graceful shutdown unregisters; a kill -9 cannot). Stale
+        entries only cost invokes a retry, but they poison `ps` (a new
+        incarnation on the same ports answers the dead entry's health
+        probe) and make every first invoke to the app gamble on the
+        rotation. Returns the (app_id, pid) pairs pruned."""
+        dead: list[tuple[str, int]] = []
+
+        def is_dead(e: dict) -> bool:
+            return self.local_pid_dead(e.get("host"), e.get("pid"))
+
+        if self.registry_file is None:
+            for app_id, replicas in list(self._static.items()):
+                kept = []
+                for a in replicas:
+                    if is_dead(asdict(a)):
+                        dead.append((app_id, a.pid))
+                    else:
+                        kept.append(a)
+                if kept:
+                    self._static[app_id] = kept
+                else:
+                    self._static.pop(app_id, None)
+            return dead
+
+        def mutate(entries: dict) -> None:
+            for app_id, replicas in list(entries.items()):
+                kept = []
+                for e in replicas:
+                    if is_dead(e):
+                        dead.append((app_id, e.get("pid")))
+                    else:
+                        kept.append(e)
+                if kept:
+                    entries[app_id] = kept
+                else:
+                    entries.pop(app_id, None)
+
+        self._mutate(mutate)
+        self._mtime = 0.0  # force re-read on the next resolve
+        return dead
+
     def _mutate(self, fn) -> None:
         """Atomic read-modify-write with a lock file (cross-process)."""
         assert self.registry_file is not None

@@ -75,10 +75,7 @@ class AdminServer:
         actual_port = self._site._server.sockets[0].getsockname()[1]
         self.port = actual_port
 
-        registry = pathlib.Path(self.orch.config.registry_file)
-        if not registry.is_absolute():
-            registry = self.orch.config.base_dir / registry
-        self._info_file = info_path(registry)
+        self._info_file = info_path(self.orch.config.registry_path)
         self._info_file.parent.mkdir(parents=True, exist_ok=True)
         self._info_file.write_text(json.dumps({
             "admin_url": f"http://{self.host}:{actual_port}",

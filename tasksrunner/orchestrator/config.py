@@ -99,6 +99,16 @@ class RunConfig:
     resources_path: str | None = None
     registry_file: str = ".tasksrunner/apps.json"
     base_dir: pathlib.Path = field(default_factory=pathlib.Path.cwd)
+
+    @property
+    def registry_path(self) -> pathlib.Path:
+        """``registry_file`` resolved against ``base_dir`` — the ONE
+        way to locate the registry. Every consumer must use this: a
+        raw ``Path(registry_file)`` resolves against the launching
+        shell's cwd instead, silently targeting a different file when
+        the config was emitted by ``deploy apply`` elsewhere."""
+        p = pathlib.Path(self.registry_file)
+        return p if p.is_absolute() else self.base_dir / p
     #: localhost control-plane port (0 = ephemeral). The admin API is
     #: the `az containerapp update / revision restart / logs show`
     #: surface of the orchestrator; its address is advertised in

@@ -239,7 +239,7 @@ class Replica:
                 # file (worst case seconds if the dead replica held it)
                 # and must not freeze the supervisor's event loop
                 await asyncio.to_thread(
-                    NameResolver(registry_file=self.config.registry_file
+                    NameResolver(registry_file=self.config.registry_path
                                  ).unregister,
                     self.app.app_id, pid=dead_pid)
             except OSError:  # pragma: no cover - registry dir gone at teardown
@@ -287,6 +287,23 @@ class Orchestrator:
         return entry
 
     async def start(self) -> None:
+        # sweep entries a previous SIGKILLed topology left behind —
+        # without this, the new replicas share ports with ghost entries
+        # that `ps` then reports healthy (the live process answers the
+        # dead entry's probe) and invokes gamble on the rotation
+        try:
+            from tasksrunner.invoke.resolver import NameResolver
+            registry = self.config.registry_path
+            if registry.is_file():
+                pruned = await asyncio.get_running_loop().run_in_executor(
+                    None, NameResolver(registry_file=registry).prune_dead_local)
+                if pruned:
+                    logger.info("pruned %d stale registry entr%s from a "
+                                "previous run: %s", len(pruned),
+                                "y" if len(pruned) == 1 else "ies",
+                                ", ".join(f"{a} (pid {p})" for a, p in pruned))
+        except OSError:  # pragma: no cover - registry unreadable
+            pass
         if self.config.per_app_tokens and not self.config.app_tokens:
             self._issue_app_tokens()
         if self.config.mesh_tls and not self.config.mesh_certs:
@@ -320,14 +337,9 @@ class Orchestrator:
         receive the CA cert (to verify peers) and only their OWN leaf
         pair. Fresh PKI per orchestrator start — short-lived certs,
         nothing to rotate."""
-        import pathlib as _pathlib
-
         from tasksrunner.invoke.pki import write_pki
 
-        registry = _pathlib.Path(self.config.registry_file)
-        if not registry.is_absolute():
-            registry = self.config.base_dir / registry
-        pki_dir = registry.parent / "pki"
+        pki_dir = self.config.registry_path.parent / "pki"
         self.config.mesh_certs = write_pki(
             pki_dir, [app.app_id for app in self.config.apps])
         logger.info("mesh mTLS on: environment CA + %d workload cert(s) "
@@ -354,10 +366,7 @@ class Orchestrator:
             app_id: hash_token(token)
             for app_id, token in self.config.app_tokens.items()
         }
-        registry = pathlib.Path(self.config.registry_file)
-        if not registry.is_absolute():
-            registry = self.config.base_dir / registry
-        tokens_path = registry.parent / "tokens.json"
+        tokens_path = self.config.registry_path.parent / "tokens.json"
         tokens_path.parent.mkdir(parents=True, exist_ok=True)
         # created 0600 from the first byte — chmod-after-write would
         # leave a readable window (and 0600 regardless: the digests

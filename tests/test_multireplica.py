@@ -259,3 +259,32 @@ async def test_blackholed_mesh_conn_times_out_and_fails_over(
             await h.stop()
         tarpit.close()  # no wait_closed(): py3.12 can await handler
         # coroutines forever here; the loop is torn down right after
+
+
+def test_prune_dead_local_removes_sigkill_debris(tmp_path):
+    """A SIGKILLed topology cannot unregister; its registry entries
+    linger and — because a new incarnation reuses the same ports —
+    answer health probes through the NEW process, so `ps` shows ghost
+    replicas as ok. prune_dead_local() sweeps loopback entries whose
+    pid is gone; live pids and remote hosts are untouched."""
+    import os
+
+    reg = tmp_path / "apps.json"
+    w = NameResolver(registry_file=reg)
+    # a pid that certainly exists (ours) and one that certainly doesn't
+    w.register(AppAddress(app_id="api", host="127.0.0.1",
+                          sidecar_port=1000, app_port=1001,
+                          pid=os.getpid()))
+    dead_pid = 2 ** 22 + 7919     # beyond default pid_max
+    w.register(AppAddress(app_id="api", host="127.0.0.1",
+                          sidecar_port=2000, app_port=2001, pid=dead_pid))
+    # remote-host entry with the same dead pid: a missing LOCAL pid
+    # proves nothing about another machine — must survive
+    w.register(AppAddress(app_id="remote", host="10.0.0.9",
+                          sidecar_port=3000, app_port=3001, pid=dead_pid))
+
+    pruned = NameResolver(registry_file=reg).prune_dead_local()
+    assert pruned == [("api", dead_pid)]
+    fresh = NameResolver(registry_file=reg)
+    assert [a.pid for a in fresh.resolve_all("api")] == [os.getpid()]
+    assert len(fresh.resolve_all("remote")) == 1
