@@ -44,10 +44,24 @@ from tasksrunner.app import App
 
 def _load_factory(spec: str):
     """Import "pkg.module:factory" and return the factory/App."""
+    from tasksrunner.errors import TasksRunnerError
+
     module_name, _, attr = spec.partition(":")
-    module = importlib.import_module(module_name)
-    target = getattr(module, attr or "make_app")
-    return target
+    try:
+        module = importlib.import_module(module_name)
+    except ImportError as exc:
+        # a typo'd --module arg is an operator error, not a crash:
+        # one clean line instead of the runpy import traceback
+        raise TasksRunnerError(
+            f"cannot import app module {module_name!r} (from {spec!r}): "
+            f"{exc}. The form is pkg.module:factory, resolved on "
+            f"PYTHONPATH from the current directory") from exc
+    try:
+        return getattr(module, attr or "make_app")
+    except AttributeError as exc:
+        raise TasksRunnerError(
+            f"module {module_name!r} has no attribute "
+            f"{attr or 'make_app'!r} (from {spec!r})") from exc
 
 
 def _make_app(spec: str) -> App:

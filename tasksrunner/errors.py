@@ -129,3 +129,12 @@ class AppNotFound(InvocationError):
     """Name resolution failed for a target app-id."""
 
     http_status = 404
+
+
+class PortInUseError(TasksRunnerError):
+    """A server socket could not bind because the port is taken.
+
+    Raised instead of the raw OSError so operators get one clean line
+    naming the port and the usual causes (another replica, a leftover
+    process) rather than a runpy traceback — the failure every
+    workshop attendee hits at least once."""

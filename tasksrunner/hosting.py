@@ -97,6 +97,27 @@ def build_app_server(app: App) -> web.Application:
     return server
 
 
+async def _bind_or_explain(site, what: str, host: str, port: int) -> None:
+    """TCPSite.start with the one failure every attendee hits mapped to
+    a clean error: EADDRINUSE -> PortInUseError naming the port (the
+    raw OSError surfaces as a runpy traceback and, under the
+    orchestrator, an anonymous crash-loop)."""
+    import errno
+
+    from tasksrunner.errors import PortInUseError
+
+    try:
+        await site.start()
+    except OSError as exc:
+        if exc.errno == errno.EADDRINUSE:
+            raise PortInUseError(
+                f"{what} port {port} on {host} is already in use - "
+                f"another replica or a leftover process holds it "
+                f"(find it: ss -tlnp | grep {port}); stop it or change "
+                f"the configured port") from exc
+        raise
+
+
 class AppHost:
     """App server + sidecar for one service, in one process."""
 
@@ -149,7 +170,7 @@ class AppHost:
             build_app_server(self.app), access_log=_access_log())
         await self._app_runner.setup()
         site = web.TCPSite(self._app_runner, self.bind, self.app_port)
-        await site.start()
+        await _bind_or_explain(site, "app", self.bind, self.app_port)
         if self.app_port == 0:
             self.app_port = self._app_runner.addresses[0][1]
 

@@ -256,7 +256,8 @@ class Sidecar:
         self._runner = web.AppRunner(self._http, access_log=_access_log())
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
-        await site.start()
+        from tasksrunner.hosting import _bind_or_explain
+        await _bind_or_explain(site, "sidecar", self.host, self.port)
         if self.port == 0:  # pick the real ephemeral port
             self.port = self._runner.addresses[0][1]
         if env_flag("TASKSRUNNER_MESH"):

@@ -161,3 +161,22 @@ async def test_cli_stop_terminates_host(tmp_path):
         if host.returncode is None:
             host.kill()
             await host.wait()
+
+
+def test_bad_module_spec_is_a_clean_error():
+    """A typo'd --module must produce ERROR: lines, not an import
+    traceback — same operator-error contract as bad manifests."""
+    import subprocess
+    import sys
+
+    for spec, needle in [
+        ("nosuch.module:make_app", "cannot import app module"),
+        ("samples.tasks_tracker.backend_api:no_such", "no attribute"),
+    ]:
+        p = subprocess.run(
+            [sys.executable, "-m", "tasksrunner", "host", spec],
+            capture_output=True, text=True, timeout=30,
+            cwd=str(pathlib.Path(__file__).resolve().parents[1]))
+        assert p.returncode == 1
+        err = p.stderr.strip().splitlines()[-1]
+        assert err.startswith("ERROR:") and needle in err, p.stderr[-400:]

@@ -214,3 +214,38 @@ async def test_frontend_serves_asset_tree():
     _, _, body = resp.encode()
     assert b'href="/static/css/site.css"' in body
     assert b'src="/static/js/validation.js"' in body
+
+
+@pytest.mark.asyncio
+async def test_port_in_use_raises_clean_error(tmp_path):
+    """EADDRINUSE — the failure every attendee hits once — must
+    surface as PortInUseError naming the port (the CLI maps it to one
+    clean ERROR line), for both the app server and the sidecar bind."""
+    import socket
+
+    from tasksrunner import AppHost
+    from tasksrunner.errors import PortInUseError
+
+    squat = socket.socket()
+    squat.bind(("127.0.0.1", 0))
+    squat.listen()
+    port = squat.getsockname()[1]
+    try:
+        app = App("clash")
+        host = AppHost(app, specs=[], app_port=port,
+                       registry_file=str(tmp_path / "apps.json"))
+        with pytest.raises(PortInUseError, match=f"app port {port}"):
+            await host.start()
+
+        app2 = App("clash2")
+        host2 = AppHost(app2, specs=[], sidecar_port=port,
+                        registry_file=str(tmp_path / "apps.json"))
+        try:
+            with pytest.raises(PortInUseError, match=f"sidecar port {port}"):
+                await host2.start()
+        finally:
+            # the app server bound before the sidecar failed — release it
+            if host2._app_runner is not None:
+                await host2._app_runner.cleanup()
+    finally:
+        squat.close()
