@@ -1,15 +1,21 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test soak bench sweep-flash run validate docs-serve docs-build clean
+.PHONY: test soak bench bench-state sweep-flash run validate docs-serve docs-build clean
 
 test:
 	python -m pytest tests/ -q
 
 soak:
 	TASKSRUNNER_SOAK=1 python -m pytest tests/test_soak.py -q
+	python -m pytest tests/ -q -m slow
 
 bench:
 	python bench.py
+
+# state-store section only: group-commit write queue vs the
+# one-commit-per-call path, plus the read cache — seconds, not minutes
+bench-state:
+	python bench.py --state-bench
 
 sweep-flash:
 	python scripts/sweep_flash_bwd.py

@@ -29,6 +29,10 @@ not a micro-op and not the flattering in-proc mode.
 Also reported (in the final line's ``extras``):
 
 * p50/p99 request latency under load in the same topology;
+* ``state_ops_per_sec`` — the durable sqlite state engine measured
+  alone: write-heavy concurrent upserts through the group-commit queue
+  vs the seed one-commit-per-call path in the same run, plus read-heavy
+  point gets with and without the write-through LRU read cache;
 * a 5-replica competing-consumer throughput figure (KEDA-style
   scale-out semantics, SURVEY.md §5.8);
 * the in-process cluster number (continuity with round 1);
@@ -500,6 +504,155 @@ async def run_inproc(n_tasks: int = N_TASKS, *, warmup: int = WARMUP,
 
 
 # ---------------------------------------------------------------------------
+# state-store micro-bench: the durable engine measured alone
+# ---------------------------------------------------------------------------
+
+class _SeedSqliteStore:
+    """The PRE-change state write path, frozen as the bench comparator:
+    one inline BEGIN IMMEDIATE…COMMIT per save, executed directly on
+    the event loop (the seed tasksrunner/state/sqlite.py). The ≥2x
+    acceptance gate for the group-commit store measures against THIS,
+    same run, same host."""
+
+    def __init__(self, path: str):
+        from tasksrunner.state.sqlite import _SCHEMA
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA synchronous=NORMAL")
+        self._conn.executescript(_SCHEMA)
+        self._conn.commit()
+        self._returning = sqlite3.sqlite_version_info >= (3, 35, 0)
+
+    async def set(self, key, value, *, etag=None):
+        cur = self._conn.cursor()
+        try:
+            cur.execute("BEGIN IMMEDIATE")
+            if self._returning:
+                (n,) = cur.execute(
+                    "UPDATE etag_seq SET n = n + 1 WHERE id = 1 RETURNING n"
+                ).fetchone()
+            else:
+                cur.execute("UPDATE etag_seq SET n = n + 1 WHERE id = 1")
+                (n,) = cur.execute(
+                    "SELECT n FROM etag_seq WHERE id = 1").fetchone()
+            doc = json.dumps(value, separators=(",", ":"), allow_nan=False)
+            cur.execute(
+                "INSERT INTO state(key, value, etag) VALUES(?, ?, ?) "
+                "ON CONFLICT(key) DO UPDATE SET value=excluded.value, "
+                "etag=excluded.etag",
+                (key, doc, str(n)))
+            self._conn.commit()
+            return str(n)
+        except BaseException:
+            self._conn.rollback()
+            raise
+
+    async def get(self, key):
+        row = self._conn.execute(
+            "SELECT value, etag FROM state WHERE key = ?", (key,)).fetchone()
+        return None if row is None else json.loads(row[0])
+
+    def close(self):
+        self._conn.close()
+
+
+async def _state_op_rate(store, mode: str, n_ops: int, concurrency: int,
+                         keys: list) -> float:
+    # fixed worker loops, not a semaphore over n_ops gathered tasks:
+    # each worker issues its next op as soon as its last resolves —
+    # the request-handler pattern — and the harness itself stays thin
+    # enough that the measurement is the store, not task scheduling
+    per_worker = n_ops // concurrency
+
+    async def worker(w: int) -> None:
+        base = w * per_worker
+        for i in range(base, base + per_worker):
+            if mode == "write":
+                await store.set(keys[i % len(keys)],
+                                {"taskId": f"t{i}", "n": i,
+                                 "taskCreatedBy": "bench@x.com"})
+            else:
+                await store.get(keys[i % len(keys)])
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(worker(w) for w in range(concurrency)))
+    return (per_worker * concurrency) / (time.perf_counter() - t0)
+
+
+async def run_state_bench(n_ops: int = 4000, *, concurrency: int = 64,
+                          rounds: int = 3, n_keys: int = 512) -> dict:
+    """``state_ops_per_sec``: the durable sqlite state engine alone, no
+    HTTP hops — the component the e2e write path bottlenecks on.
+
+    write-heavy: ``concurrency`` coroutines upserting over ``n_keys``
+    keys (the bench hot path's save_state pattern), measured twice in
+    the same run — the seed one-commit-per-call path, then the shipping
+    group-commit store. read-heavy: point gets over the same keys (the
+    frontend's read-per-render pattern), on the off-loop SQL path and
+    with the write-through LRU read cache enabled. Medians of
+    ``rounds`` after a warmup round, like every other section.
+    """
+    from tasksrunner.state.sqlite import SqliteStateStore
+
+    tmp = tempfile.mkdtemp(prefix="tasksrunner-bench-state-")
+    keys = [f"k{i}" for i in range(n_keys)]
+
+    async def measure(store, mode: str) -> float:
+        rates = []
+        await _state_op_rate(store, mode, max(200, n_ops // 4),
+                             concurrency, keys)  # warmup round, discarded
+        for _ in range(rounds):
+            rates.append(await _state_op_rate(store, mode, n_ops,
+                                              concurrency, keys))
+        return statistics.median(rates)
+
+    seed = _SeedSqliteStore(f"{tmp}/seed.db")
+    try:
+        seed_write = await measure(seed, "write")
+    finally:
+        seed.close()
+
+    store = SqliteStateStore("bench-state", f"{tmp}/state.db")
+    try:
+        gc_write = await measure(store, "write")
+        plain_read = await measure(store, "read")
+    finally:
+        store.close()
+
+    cached = SqliteStateStore("bench-state-cache", f"{tmp}/state.db",
+                              cache_size=n_keys)
+    try:
+        # write-through: the cache fills from writes, as in the serving
+        # pattern (the API writes what the frontend then re-reads)
+        for i, k in enumerate(keys):
+            await cached.set(k, {"taskId": f"t{i}", "n": i,
+                                 "taskCreatedBy": "bench@x.com"})
+        cached_read = await measure(cached, "read")
+    finally:
+        cached.close()
+
+    return {
+        "write_heavy": {
+            "ops_per_sec": round(gc_write, 1),
+            "pre_change_ops_per_sec": round(seed_write, 1),
+            "speedup": round(gc_write / seed_write, 2),
+            "concurrency": concurrency,
+        },
+        "read_heavy": {
+            "ops_per_sec": round(plain_read, 1),
+            "cached_ops_per_sec": round(cached_read, 1),
+            "cache_speedup": round(cached_read / plain_read, 2),
+            "concurrency": concurrency,
+        },
+        "note": "durable sqlite state engine measured alone (no HTTP "
+                "hops): write-heavy = concurrent upserts through the "
+                "group-commit queue vs the seed one-commit-per-call "
+                "path in the same run; read-heavy = off-loop point "
+                "gets vs the write-through LRU cache (readCacheSize)",
+    }
+
+
+# ---------------------------------------------------------------------------
 # optional: ML-extension step time on the real chip (EXTENSION ONLY)
 # ---------------------------------------------------------------------------
 
@@ -721,10 +874,24 @@ def main() -> None:
                         help="run ONLY the TPU step bench, print its JSON "
                              "(invoked as a subprocess so a dead chip "
                              "tunnel can be timed out, not hung on)")
+    parser.add_argument("--state-bench", action="store_true",
+                        help="run ONLY the state-store ops/s section "
+                             "(`make bench-state`) and print its JSON")
     args = parser.parse_args()
 
     if args.tpu_bench:
         print(json.dumps(run_tpu_step_bench()))
+        return
+
+    if args.state_bench:
+        _log("state-store ops/s (group-commit write queue) ...")
+        state_ops = asyncio.run(run_state_bench())
+        w, r = state_ops["write_heavy"], state_ops["read_heavy"]
+        _log(f"  -> write-heavy {w['ops_per_sec']} ops/s "
+             f"({w['speedup']}x vs pre-change {w['pre_change_ops_per_sec']}), "
+             f"read-heavy {r['ops_per_sec']} ops/s "
+             f"(cached {r['cached_ops_per_sec']}, {r['cache_speedup']}x)")
+        print(json.dumps({"state_ops_per_sec": state_ops}))
         return
 
     if args.worker:
@@ -748,7 +915,7 @@ def main() -> None:
     # the chip section runs FIRST: it is the scarcest measurement (the
     # tunnel has documented multi-hour outages) and must not queue
     # behind minutes of CPU benches that could overlap an outage window
-    _log("bench 1/5: ML-extension train step on the attached chip ...")
+    _log("bench 1/6: ML-extension train step on the attached chip ...")
     # belt over braces: the section is internally fault-tolerant, but
     # it also runs FIRST now — nothing it could raise may be allowed
     # to cost the CPU sections their numbers
@@ -764,7 +931,17 @@ def main() -> None:
         _log(f"  -> STALE (cache of {tpu.get('measured_at')}): "
              f"{tpu['step_ms']} ms/step, MFU {tpu['mfu']} on {tpu['device']}")
 
-    _log("bench 2/5: cross-process write path (faithful [PB] topology) ...")
+    # the component the e2e write path bottlenecks on, measured alone —
+    # and the seed write path measured in the SAME run, so the group-
+    # commit speedup is a same-host apples-to-apples figure
+    _log("bench 2/6: state-store ops/s (group-commit write queue) ...")
+    state_ops = asyncio.run(run_state_bench())
+    _log(f"  -> write-heavy {state_ops['write_heavy']['ops_per_sec']} ops/s "
+         f"({state_ops['write_heavy']['speedup']}x vs pre-change), "
+         f"read-heavy {state_ops['read_heavy']['ops_per_sec']} ops/s "
+         f"(cached {state_ops['read_heavy']['cached_ops_per_sec']})")
+
+    _log("bench 3/6: cross-process write path (faithful [PB] topology) ...")
     xproc = asyncio.run(run_xproc(latency_probe=True, rounds=5))
     _log(f"  -> {xproc['throughput']} tasks/s, "
          f"p50 {xproc['p50_ms']} ms, p99 {xproc['p99_ms']} ms (conc=8)")
@@ -773,7 +950,7 @@ def main() -> None:
     # workload certs, every peer hop on the authenticated mesh lane —
     # module 15 quotes this delta instead of recommending an unmeasured
     # configuration
-    _log("bench 3/5: cross-process write path under mesh mTLS ...")
+    _log("bench 4/6: cross-process write path under mesh mTLS ...")
     # same rounds as the plaintext headline — an asymmetric pair would
     # bake an ordering/averaging confound into the published delta
     mtls = asyncio.run(run_xproc(latency_probe=True, rounds=5,
@@ -788,7 +965,7 @@ def main() -> None:
     # reference processor's SendGrid call) consumers are the
     # bottleneck; 5 competing replicas vs 1 shows the KEDA-style
     # scale-out actually scaling (SURVEY.md §5.8)
-    _log("bench 4/5: competing-consumer scale-out (20 ms work/message) ...")
+    _log("bench 5/6: competing-consumer scale-out (20 ms work/message) ...")
     one = asyncio.run(run_xproc(n_tasks=300, n_processors=1, rounds=2,
                                 work_ms=20.0))
     five = asyncio.run(run_xproc(n_tasks=300, n_processors=5, rounds=2,
@@ -797,7 +974,7 @@ def main() -> None:
     _log(f"  -> 1 replica: {one['throughput']} tasks/s; "
          f"5 replicas: {five['throughput']} tasks/s ({speedup}x)")
 
-    _log("bench 5/5: in-process cluster (round-1 continuity) ...")
+    _log("bench 6/6: in-process cluster (round-1 continuity) ...")
     inproc = asyncio.run(run_inproc())
     _log(f"  -> {inproc} tasks/s")
 
@@ -852,6 +1029,7 @@ def main() -> None:
                              "not parallel CPU speedup",
             },
             "inproc_tasks_per_sec": inproc,
+            "state_ops_per_sec": state_ops,
             "ml_extension_tpu": tpu,
             **({} if tpu else {"ml_extension_note":
                 "chip bench skipped (no TPU reachable within the "

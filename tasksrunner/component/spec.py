@@ -47,6 +47,36 @@ def scalar_str(value: Any) -> str:
     return str(value)
 
 
+def metadata_bool(metadata: Mapping[str, Any], key: str, default: bool) -> bool:
+    """Coerce a string-typed metadata value to bool, failing loudly.
+
+    Component metadata is YAML-sourced strings (``scalar_str``); every
+    driver re-parsing booleans ad hoc invites the ``== "True"`` class of
+    silent misread this module's docstring warns about — parse here.
+    """
+    raw = metadata.get(key)
+    if raw is None or raw == "":
+        return default
+    val = str(raw).strip().lower()
+    if val in ("1", "true", "yes", "on"):
+        return True
+    if val in ("0", "false", "no", "off"):
+        return False
+    raise ComponentError(f"metadata {key!r} must be a boolean, not {raw!r}")
+
+
+def metadata_int(metadata: Mapping[str, Any], key: str, default: int) -> int:
+    """Coerce a string-typed metadata value to int, failing loudly."""
+    raw = metadata.get(key)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(str(raw).strip())
+    except ValueError:
+        raise ComponentError(
+            f"metadata {key!r} must be an integer, not {raw!r}") from None
+
+
 @dataclass(frozen=True)
 class SecretRef:
     """A deferred secret lookup: resolve ``key`` in secret store ``store``.

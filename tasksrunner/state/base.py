@@ -68,7 +68,13 @@ class StateStore(abc.ABC):
     @abc.abstractmethod
     async def set(self, key: str, value: Any, *, etag: str | None = None) -> str:
         """Upsert; returns the new etag. Raises EtagMismatch if ``etag``
-        is given and doesn't match the stored one."""
+        is given and doesn't match the stored one.
+
+        Drivers MAY coalesce concurrent writes into one backend
+        transaction (the sqlite engine's group-commit queue does), but
+        per-call semantics must be preserved exactly: each caller gets
+        its own etag or EtagMismatch, a call resolves only after its
+        write is durable, and writes apply in submission order."""
 
     @abc.abstractmethod
     async def delete(self, key: str, *, etag: str | None = None) -> bool:
@@ -96,4 +102,12 @@ class StateStore(abc.ABC):
         raise NotImplementedError
 
     def close(self) -> None:  # pragma: no cover - default no-op
+        """Release backend resources. Must be callable without a
+        running event loop (CLI probes close stores synchronously)."""
         pass
+
+    async def aclose(self) -> None:
+        """Async close; the component registry prefers this when
+        present. Default delegates to the sync ``close()`` — drivers
+        with real async teardown (network stores) override it."""
+        self.close()

@@ -29,6 +29,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "asyncio: run this coroutine test on a fresh event loop"
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: soak-style tests excluded from the tier-1 fast run "
+        "(-m 'not slow'); run them explicitly or via `make soak`",
+    )
 
 
 @pytest.hookimpl(tryfirst=True)

@@ -8,6 +8,8 @@ identically — the sqlite engine compiles the dialect to SQL and the
 memory engine interprets it, so divergence here is a real bug.
 """
 
+import asyncio
+
 import pytest
 
 from tasksrunner.errors import EtagMismatch, QueryError
@@ -310,6 +312,210 @@ async def test_negative_page_token_rejected(store):
     await seed(store)
     with pytest.raises(QueryError):
         await store.query({"page": {"limit": 2, "token": "-1"}})
+
+
+# -- group-commit queue: concurrent-writer etag contention -----------------
+# These run against EVERY engine: coalescing concurrent writes into one
+# transaction (sqlite) must be observationally identical to the memory
+# engine's lock-per-call — same winners, same per-key EtagMismatch.
+
+
+@pytest.mark.asyncio
+async def test_concurrent_stale_etag_contention(store):
+    """N coroutines race a CAS on one key: exactly one wins, every
+    other gets its own EtagMismatch, and the winner's etag is live."""
+    etag = await store.set("k", 0)
+    results = await asyncio.gather(
+        *(store.set("k", i, etag=etag) for i in range(16)),
+        return_exceptions=True)
+    winners = [r for r in results if isinstance(r, str)]
+    losers = [r for r in results if isinstance(r, EtagMismatch)]
+    assert len(winners) == 1
+    assert len(losers) == 15
+    assert (await store.get("k")).etag == winners[0]
+
+
+@pytest.mark.asyncio
+async def test_mixed_outcomes_within_one_coalesced_flush(store):
+    """A concurrent burst mixing successes, stale etags, deletes, and a
+    miss: each caller gets its own outcome, untouched keys stay put."""
+    etags = {k: await store.set(k, 0) for k in ("a", "b", "c", "d")}
+    results = await asyncio.gather(
+        store.set("a", 1, etag=etags["a"]),       # ok
+        store.set("b", 1, etag="bogus"),          # per-key mismatch
+        store.delete("c", etag=etags["c"]),       # ok
+        store.delete("d", etag="bogus"),          # per-key mismatch
+        store.set("e", 1),                        # ok, no etag
+        store.delete("missing"),                  # False, not an error
+        return_exceptions=True)
+    assert isinstance(results[0], str)
+    assert isinstance(results[1], EtagMismatch)
+    assert results[2] is True
+    assert isinstance(results[3], EtagMismatch)
+    assert isinstance(results[4], str)
+    assert results[5] is False
+    assert (await store.get("a")).value == 1
+    assert (await store.get("b")).value == 0      # refused write left b alone
+    assert await store.get("c") is None
+    assert (await store.get("d")).value == 0
+    assert (await store.get("e")).value == 1
+
+
+@pytest.mark.asyncio
+async def test_transact_atomicity_survives_coalescing(store):
+    """A failing transact inside a concurrent burst applies NOTHING,
+    while its batch-mates commit normally."""
+    await store.set("a", 1)
+    results = await asyncio.gather(
+        store.transact([TransactionOp("upsert", "x", 1),
+                        TransactionOp("upsert", "y", 2)]),
+        store.transact([TransactionOp("upsert", "z", 3),
+                        TransactionOp("delete", "a", etag="bogus")]),
+        store.set("w", 9),
+        return_exceptions=True)
+    assert results[0] is None
+    assert isinstance(results[1], EtagMismatch)
+    assert isinstance(results[2], str)
+    assert (await store.get("x")).value == 1
+    assert (await store.get("y")).value == 2
+    assert await store.get("z") is None            # atomic: nothing leaked
+    assert (await store.get("a")).value == 1
+    assert (await store.get("w")).value == 9
+
+
+@pytest.mark.asyncio
+async def test_queued_writes_apply_in_submission_order(store):
+    """Coalesced ops see the effects of ops queued before them, exactly
+    as if each had committed alone (last submission wins)."""
+    await asyncio.gather(*(store.set("k", i) for i in range(8)))
+    assert (await store.get("k")).value == 7
+
+
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_group_commit_cas_soak(tmp_path):
+    """Soak: 16 workers CAS-increment 8 shared counters through the
+    group-commit queue; a single lost update fails the count."""
+    s = SqliteStateStore("s", tmp_path / "soak.db")
+    try:
+        for k in range(8):
+            await s.set(f"ctr{k}", 0)
+
+        async def worker(wid: int) -> None:
+            key = f"ctr{wid % 8}"
+            for _ in range(25):
+                while True:
+                    item = await s.get(key)
+                    try:
+                        await s.set(key, item.value + 1, etag=item.etag)
+                        break
+                    except EtagMismatch:
+                        continue
+
+        await asyncio.gather(*(worker(w) for w in range(16)))
+        for k in range(8):
+            assert (await s.get(f"ctr{k}")).value == 50
+    finally:
+        s.close()
+
+
+# -- read cache -------------------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_read_cache_semantics(tmp_path):
+    s = SqliteStateStore("s", tmp_path / "cache.db", cache_size=4)
+    try:
+        etag = await s.set("k", {"nested": {"n": 1}})
+        item = await s.get("k")                  # hit (write-through)
+        item.value["nested"]["n"] = 99           # isolation holds on hits
+        assert (await s.get("k")).value["nested"]["n"] == 1
+        # a refused write must not touch the cache
+        with pytest.raises(EtagMismatch):
+            await s.set("k", {"nested": {"n": 2}}, etag="bogus")
+        assert (await s.get("k")).value["nested"]["n"] == 1
+        # a successful CAS updates value AND etag in the cache
+        etag2 = await s.set("k", {"nested": {"n": 2}}, etag=etag)
+        got = await s.get("k")
+        assert got.value["nested"]["n"] == 2 and got.etag == etag2
+        # delete invalidates
+        await s.delete("k")
+        assert await s.get("k") is None
+        # transact updates and invalidates its keys
+        await s.set("t1", 1)
+        await s.set("t2", 2)
+        await s.transact([TransactionOp("upsert", "t1", 10),
+                          TransactionOp("delete", "t2")])
+        assert (await s.get("t1")).value == 10
+        assert await s.get("t2") is None
+    finally:
+        s.close()
+
+
+@pytest.mark.asyncio
+async def test_read_cache_lru_bound_and_coherence(tmp_path):
+    s = SqliteStateStore("s", tmp_path / "lru.db", cache_size=4)
+    try:
+        for i in range(32):
+            await s.set(f"k{i}", i)
+        assert len(s._cache) <= 4                # bound enforced
+        # evicted keys still read correctly (SQL path)
+        assert (await s.get("k0")).value == 0
+    finally:
+        s.close()
+    # what the cache served matches what a fresh store reads from disk
+    s2 = SqliteStateStore("s2", tmp_path / "lru.db")
+    try:
+        assert (await s2.get("k31")).value == 31
+    finally:
+        s2.close()
+
+
+def test_sqlite_driver_metadata_knobs(tmp_path):
+    from tasksrunner.component.registry import ComponentRegistry
+    from tasksrunner.component.spec import parse_component
+
+    spec = parse_component({
+        "componentType": "state.sqlite",
+        "metadata": [
+            {"name": "databasePath", "value": str(tmp_path / "s.db")},
+            {"name": "readCacheSize", "value": "128"},
+            {"name": "groupCommit", "value": "false"},
+        ],
+    }, default_name="st")
+    store = ComponentRegistry([spec]).get("st")
+    assert store.cache_size == 128
+    assert store.group_commit is False
+    store.close()
+
+
+def test_sqlite_driver_metadata_knobs_rejected(tmp_path):
+    from tasksrunner.component.registry import ComponentRegistry
+    from tasksrunner.component.spec import parse_component
+    from tasksrunner.errors import ComponentError
+
+    spec = parse_component({
+        "componentType": "state.sqlite",
+        "metadata": [{"name": "readCacheSize", "value": "lots"}],
+    }, default_name="st")
+    with pytest.raises(ComponentError, match="readCacheSize"):
+        ComponentRegistry([spec]).get("st")
+
+
+@pytest.mark.asyncio
+async def test_group_commit_off_still_honors_contract(tmp_path):
+    """The groupCommit=false comparison knob: per-op transactions, same
+    observable semantics."""
+    s = SqliteStateStore("s", tmp_path / "nogc.db", group_commit=False)
+    try:
+        etag = await s.set("k", 0)
+        results = await asyncio.gather(
+            *(s.set("k", i, etag=etag) for i in range(8)),
+            return_exceptions=True)
+        assert sum(isinstance(r, str) for r in results) == 1
+        assert sum(isinstance(r, EtagMismatch) for r in results) == 7
+    finally:
+        s.close()
 
 
 def test_state_drivers_registered_by_plain_import():
