@@ -1,6 +1,6 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test soak bench bench-state sweep-flash run validate docs-serve docs-build clean
+.PHONY: test soak bench bench-state chaos sweep-flash run validate docs-serve docs-build clean
 
 test:
 	python -m pytest tests/ -q
@@ -16,6 +16,14 @@ bench:
 # one-commit-per-call path, plus the read cache — seconds, not minutes
 bench-state:
 	python bench.py --state-bench
+
+# chaos verification: the deterministic fault-injection harness, the
+# faulty-broker convergence soak, and the proof that the disabled gate
+# costs <1% on the write-heavy state path
+chaos:
+	python -m pytest tests/test_chaos.py -q
+	python -m pytest "tests/test_soak.py::test_tasks_pipeline_converges_despite_faulty_broker" -q
+	python bench.py --chaos-bench
 
 sweep-flash:
 	python scripts/sweep_flash_bwd.py

@@ -37,6 +37,13 @@ from tasksrunner.resiliency import (
     load_resiliency,
     parse_resiliency,
 )
+from tasksrunner.chaos import (
+    ChaosPolicies,
+    ChaosSpec,
+    chaos_enabled,
+    load_chaos,
+    parse_chaos,
+)
 
 __all__ = [
     "ComponentSpec",
@@ -61,5 +68,10 @@ __all__ = [
     "ResiliencySpec",
     "load_resiliency",
     "parse_resiliency",
+    "ChaosPolicies",
+    "ChaosSpec",
+    "chaos_enabled",
+    "load_chaos",
+    "parse_chaos",
     "__version__",
 ]

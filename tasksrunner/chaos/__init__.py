@@ -1,0 +1,47 @@
+"""Declarative, deterministic fault injection (``kind: Chaos``).
+
+Off by default: the subsystem only activates when the host runs with
+``TASKSRUNNER_CHAOS=1`` *and* a Chaos document targets the app — the
+production hot path never sees a wrapper object. See
+``docs/modules/16-chaos.md``.
+"""
+
+from tasksrunner.chaos.engine import ChaosPolicies, ChaosPolicy, chaos_enabled
+from tasksrunner.chaos.spec import (
+    BlackholeFault,
+    ChaosRule,
+    ChaosSpec,
+    CrashEveryNFault,
+    ErrorFault,
+    LatencyFault,
+    is_chaos_doc,
+    load_chaos,
+    parse_chaos,
+)
+from tasksrunner.chaos.wrappers import (
+    ChaosInputBinding,
+    ChaosOutputBinding,
+    ChaosPubSubBroker,
+    ChaosStateStore,
+    wrap_component,
+)
+
+__all__ = [
+    "BlackholeFault",
+    "ChaosInputBinding",
+    "ChaosOutputBinding",
+    "ChaosPolicies",
+    "ChaosPolicy",
+    "ChaosPubSubBroker",
+    "ChaosRule",
+    "ChaosSpec",
+    "ChaosStateStore",
+    "CrashEveryNFault",
+    "ErrorFault",
+    "LatencyFault",
+    "chaos_enabled",
+    "is_chaos_doc",
+    "load_chaos",
+    "parse_chaos",
+    "wrap_component",
+]

@@ -1,0 +1,215 @@
+"""The fault-injection engine: deterministic, per-target injectors.
+
+``ChaosPolicies`` is the runtime-facing view, mirroring
+``ResiliencyPolicies``: merged in-scope specs resolved per target, with
+one persistent ``_Injector`` per (rule, target) pair. Each injector
+owns a PRNG seeded from ``(spec seed, rule name, target key)`` — string
+seeding hashes deterministically (not via PYTHONHASHSEED), so a seeded
+chaos run is bit-for-bit reproducible across processes and across
+invocations: the Nth call to a given target sees the same verdict every
+run.
+
+Every injected fault increments ``chaos_injected_total{target,fault}``
+in the process-global :data:`~tasksrunner.observability.metrics.metrics`
+registry, which the sidecar's ``/v1.0/metadata`` already exports —
+``tasksrunner chaos status`` reads it from there.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+
+from tasksrunner.chaos.spec import (
+    BlackholeFault,
+    ChaosRule,
+    ChaosSpec,
+    CrashEveryNFault,
+    ErrorFault,
+    LatencyFault,
+    resolve_error_class,
+)
+from tasksrunner.errors import ChaosInjectedError
+from tasksrunner.observability.metrics import metrics
+
+logger = logging.getLogger(__name__)
+
+
+def chaos_enabled() -> bool:
+    """The master gate: chaos wiring exists only under
+    ``TASKSRUNNER_CHAOS=1`` (off by default — the opposite default from
+    every other env flag, because fault injection in production is an
+    explicit decision)."""
+    from tasksrunner.envflag import env_flag
+
+    return env_flag("TASKSRUNNER_CHAOS", default=False)
+
+
+class _Injector:
+    """One (rule, target) pair: seeded PRNG + deterministic call count."""
+
+    def __init__(self, rule: ChaosRule, target: str, seed: int,
+                 disabled: set[str]):
+        self.rule = rule
+        self.target = target
+        # string seeding is stable across processes (sha512-based, not
+        # object hash) — the reproducibility contract rests on this
+        self.rng = random.Random(f"{seed}:{rule.name}:{target}")
+        self.calls = 0
+        self._disabled = disabled  # shared with the owning ChaosPolicies
+
+    def _record(self) -> None:
+        metrics.inc("chaos_injected_total",
+                    target=self.target, fault=self.rule.name)
+
+    async def inject(self) -> int | None:
+        """Apply this rule once. Returns an HTTP status to synthesize
+        (status-mode error faults) or None; raises for raising faults.
+
+        The call counter and PRNG advance even while the rule is
+        disabled-then-reenabled only for calls actually seen — verdicts
+        are a pure function of (seed, rule, target, call index).
+        """
+        if self.rule.name in self._disabled:
+            return None
+        self.calls += 1
+        fault = self.rule.fault
+        if isinstance(fault, LatencyFault):
+            delay = fault.duration
+            if fault.jitter:
+                delay += self.rng.uniform(0.0, fault.jitter)
+            self._record()
+            await asyncio.sleep(delay)
+            return None
+        if isinstance(fault, ErrorFault):
+            if fault.probability < 1.0 and self.rng.random() >= fault.probability:
+                return None
+            self._record()
+            if fault.status is not None:
+                return fault.status
+            cls = resolve_error_class(fault.error)
+            raise cls(f"chaos: injected {fault.error} by rule "
+                      f"{self.rule.name!r} on {self.target!r}")
+        if isinstance(fault, BlackholeFault):
+            self._record()
+            await asyncio.sleep(fault.deadline)
+            raise TimeoutError(
+                f"chaos: blackhole rule {self.rule.name!r} held "
+                f"{self.target!r} for {fault.deadline}s")
+        if isinstance(fault, CrashEveryNFault):
+            if self.calls % fault.n == 0:
+                self._record()
+                cls = resolve_error_class(fault.error)
+                raise cls(f"chaos: injected {fault.error} by rule "
+                          f"{self.rule.name!r} on {self.target!r} "
+                          f"(call #{self.calls})")
+            return None
+        raise ChaosInjectedError(  # pragma: no cover - parser rejects
+            f"unknown fault kind on rule {self.rule.name!r}")
+
+
+class ChaosPolicy:
+    """The resolved injector chain for one target."""
+
+    def __init__(self, injectors: list[_Injector]):
+        self.injectors = injectors
+
+    async def before_call(self) -> int | None:
+        """Run every injector; the first synthesized HTTP status wins
+        (raising faults propagate immediately)."""
+        status = None
+        for inj in self.injectors:
+            s = await inj.inject()
+            if s is not None and status is None:
+                status = s
+        return status
+
+    def raise_for_status(self, status: int) -> None:
+        """Component seams have no HTTP reply to synthesize — a
+        status-mode fault surfaces as ChaosInjectedError carrying it."""
+        raise ChaosInjectedError(
+            f"chaos: injected HTTP {status} on a component call",
+            status=status)
+
+
+class ChaosPolicies:
+    """Merged in-scope Chaos specs with persistent per-target injectors
+    (mirrors ``ResiliencyPolicies``' resolution and caching shape)."""
+
+    def __init__(self, specs: list[ChaosSpec], *, app_id: str | None = None):
+        self.specs = [s for s in specs if s.in_scope(app_id)]
+        self._injectors: dict[tuple[str, str], _Injector] = {}
+        self._cache: dict[tuple[str, str, str], ChaosPolicy | None] = {}
+        #: rule names currently switched off (runtime toggle: tests
+        #: flip faults mid-scenario; the admin surface lists them)
+        self.disabled: set[str] = set()
+
+    # -- runtime toggles -------------------------------------------------
+
+    def disable(self, rule_name: str) -> None:
+        self.disabled.add(rule_name)
+
+    def enable(self, rule_name: str) -> None:
+        self.disabled.discard(rule_name)
+
+    # -- resolution ------------------------------------------------------
+
+    def for_app(self, app_id: str) -> ChaosPolicy | None:
+        """Faults applied to service invocation toward ``app_id``."""
+        return self._resolve("apps", app_id, "outbound")
+
+    def for_component(self, name: str, direction: str = "outbound") -> ChaosPolicy | None:
+        """Faults applied to component operations on ``name``."""
+        return self._resolve("components", name, direction)
+
+    def _resolve(self, kind: str, name: str, direction: str) -> ChaosPolicy | None:
+        cache_key = (kind, name, direction)
+        if cache_key in self._cache:
+            return self._cache[cache_key]
+        injectors: list[_Injector] = []
+        for spec in self.specs:
+            if kind == "apps":
+                refs = spec.app_targets.get(name)
+            else:
+                refs = (spec.component_targets.get(name) or {}).get(direction)
+            if not refs:
+                continue
+            target_key = f"{kind}/{name}/{direction}"
+            for ref in refs:
+                ikey = (ref, target_key)
+                inj = self._injectors.get(ikey)
+                if inj is None:
+                    inj = self._injectors[ikey] = _Injector(
+                        spec.rules[ref], target_key, spec.seed, self.disabled)
+                injectors.append(inj)
+            break  # first in-scope spec naming the target wins
+        policy = ChaosPolicy(injectors) if injectors else None
+        self._cache[cache_key] = policy
+        return policy
+
+    # -- introspection ---------------------------------------------------
+
+    def describe(self) -> list[dict]:
+        """Flat rule/target listing for the admin surface."""
+        out = []
+        for spec in self.specs:
+            for rule in spec.rules.values():
+                bound = [
+                    f"apps/{app}" for app, refs in spec.app_targets.items()
+                    if rule.name in refs
+                ] + [
+                    f"components/{comp}/{direction}"
+                    for comp, dirs in spec.component_targets.items()
+                    for direction, refs in dirs.items()
+                    if rule.name in refs
+                ]
+                out.append({
+                    "spec": spec.name,
+                    "rule": rule.name,
+                    "fault": type(rule.fault).__name__,
+                    "params": rule.fault.__dict__,
+                    "targets": bound,
+                    "disabled": rule.name in self.disabled,
+                })
+        return out

@@ -1,0 +1,299 @@
+"""Parse ``kind: Chaos`` YAML documents.
+
+A Chaos document declares named **fault rules** and binds them to the
+same target taxonomy the Resiliency loader uses (apps; components with
+outbound/inbound directions — ``resiliency/spec.py``). The documents
+live in the resources directory beside components and Resiliency docs;
+the component loader skips them and ``load_chaos`` collects them. They
+are inert unless the host runs with ``TASKSRUNNER_CHAOS=1``.
+
+.. code-block:: yaml
+
+    apiVersion: tasksrunner/v1alpha1
+    kind: Chaos
+    metadata:
+      name: tasks-chaos
+    scopes: [tasksmanager-backend-api]       # optional
+    spec:
+      seed: 42                               # PRNG seed (default 0)
+      faults:
+        slowStore:
+          latency: {duration: 20ms, jitter: 10ms}
+        flakyStore:
+          error: {probability: 0.1, raise: OSError}
+        deadPeer:
+          blackhole: {deadline: 2s}
+        poison:
+          crashEveryN: {n: 5, raise: PubSubError}
+      targets:
+        apps:
+          tasksmanager-backend-api: [deadPeer]
+        components:
+          statestore:
+            outbound: [slowStore, flakyStore]
+          taskspubsub:
+            inbound: [poison]
+
+Each named fault carries exactly one fault kind:
+
+* ``latency`` — fixed delay plus uniform jitter before the call;
+* ``error`` — with ``probability``, raise a named error class
+  (a ``tasksrunner.errors`` class, or one of the transport shapes
+  ``OSError``/``TimeoutError``/``ConnectionError`` that the resiliency
+  retry loop treats as retriable), or synthesize an HTTP ``status``;
+* ``blackhole`` — hang for ``deadline`` seconds, then time out;
+* ``crashEveryN`` — deterministically fail every Nth call.
+
+Dangling rule references and unknown error names fail at load time,
+matching the Resiliency loader's posture: a typo'd chaos file must fail
+the host's startup, not silently inject nothing.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+import yaml
+
+from tasksrunner import errors as errors_mod
+from tasksrunner.errors import ComponentError
+from tasksrunner.resiliency.policy import parse_duration
+
+_YAML_SUFFIXES = {".yaml", ".yml"}
+
+#: error names an ``error``/``crashEveryN`` fault may raise: every
+#: TasksRunnerError subclass, plus the transport shapes the builtin and
+#: declarative retry loops treat as retriable.
+_TRANSPORT_ERRORS = {
+    "OSError": OSError,
+    "TimeoutError": TimeoutError,
+    "ConnectionError": ConnectionError,
+}
+
+
+def resolve_error_class(name: str, *, where: str = "chaos") -> type[BaseException]:
+    """Map a fault's ``raise:`` name to an exception class, or fail."""
+    if name in _TRANSPORT_ERRORS:
+        return _TRANSPORT_ERRORS[name]
+    cls = getattr(errors_mod, name, None)
+    if isinstance(cls, type) and issubclass(cls, errors_mod.TasksRunnerError):
+        return cls
+    known = sorted(
+        [n for n in dir(errors_mod)
+         if isinstance(getattr(errors_mod, n), type)
+         and issubclass(getattr(errors_mod, n), errors_mod.TasksRunnerError)]
+        + list(_TRANSPORT_ERRORS))
+    raise ComponentError(
+        f"{where}: unknown fault error class {name!r} "
+        f"(known: {', '.join(known)})")
+
+
+@dataclass(frozen=True)
+class LatencyFault:
+    duration: float
+    jitter: float = 0.0
+
+
+@dataclass(frozen=True)
+class ErrorFault:
+    probability: float = 1.0
+    #: name of the exception class to raise (validated at parse time)
+    error: str | None = None
+    #: alternatively, synthesize this HTTP status (invoke targets reply
+    #: with it; component calls raise ChaosInjectedError carrying it)
+    status: int | None = None
+
+
+@dataclass(frozen=True)
+class BlackholeFault:
+    #: how long the call hangs before failing with TimeoutError
+    deadline: float = 60.0
+
+
+@dataclass(frozen=True)
+class CrashEveryNFault:
+    n: int
+    error: str = "OSError"
+
+
+Fault = LatencyFault | ErrorFault | BlackholeFault | CrashEveryNFault
+
+
+@dataclass(frozen=True)
+class ChaosRule:
+    """One named fault rule (``spec.faults.<name>``)."""
+
+    name: str
+    fault: Fault
+
+
+@dataclass
+class ChaosSpec:
+    """One parsed Chaos document."""
+
+    name: str
+    seed: int = 0
+    scopes: list[str] = field(default_factory=list)
+    rules: dict[str, ChaosRule] = field(default_factory=dict)
+    #: app-id → rule names applied to outbound invokes toward that app
+    app_targets: dict[str, tuple[str, ...]] = field(default_factory=dict)
+    #: component → direction → rule names
+    component_targets: dict[str, dict[str, tuple[str, ...]]] = field(
+        default_factory=dict)
+
+    def in_scope(self, app_id: str | None) -> bool:
+        if not self.scopes or app_id is None:
+            return True
+        return app_id in self.scopes
+
+
+def is_chaos_doc(doc: Any) -> bool:
+    return isinstance(doc, Mapping) and doc.get("kind") == "Chaos"
+
+
+def _parse_fault(name: str, raw: Mapping[str, Any], *, where: str) -> Fault:
+    if not isinstance(raw, Mapping) or len(raw) != 1:
+        raise ComponentError(
+            f"{where}: fault {name!r} must be a mapping with exactly one "
+            "fault kind (latency / error / blackhole / crashEveryN)")
+    kind, body = next(iter(raw.items()))
+    if not isinstance(body, Mapping):
+        raise ComponentError(f"{where}: fault {name!r}.{kind} must be a mapping")
+    if kind == "latency":
+        jitter = parse_duration(body.get("jitter", 0))
+        duration = parse_duration(body.get("duration", 0))
+        if duration < 0 or jitter < 0:
+            raise ComponentError(f"{where}: fault {name!r}: negative latency")
+        return LatencyFault(duration=duration, jitter=jitter)
+    if kind == "error":
+        prob = float(body.get("probability", 1.0))
+        if not 0.0 <= prob <= 1.0:
+            raise ComponentError(
+                f"{where}: fault {name!r}: probability must be in [0, 1]")
+        error = body.get("raise")
+        status = body.get("status")
+        if (error is None) == (status is None):
+            raise ComponentError(
+                f"{where}: fault {name!r}: give exactly one of "
+                "'raise: <ErrorClass>' or 'status: <int>'")
+        if error is not None:
+            resolve_error_class(str(error), where=f"{where}: fault {name!r}")
+            return ErrorFault(probability=prob, error=str(error))
+        status = int(status)
+        if not 100 <= status <= 599:
+            raise ComponentError(
+                f"{where}: fault {name!r}: status {status} is not an "
+                "HTTP status")
+        return ErrorFault(probability=prob, status=status)
+    if kind == "blackhole":
+        return BlackholeFault(deadline=parse_duration(body.get("deadline", "60s")))
+    if kind == "crashEveryN":
+        n = int(body.get("n", 0))
+        if n < 1:
+            raise ComponentError(
+                f"{where}: fault {name!r}: crashEveryN needs n >= 1")
+        error = str(body.get("raise", "OSError"))
+        resolve_error_class(error, where=f"{where}: fault {name!r}")
+        return CrashEveryNFault(n=n, error=error)
+    raise ComponentError(
+        f"{where}: fault {name!r}: unknown fault kind {kind!r} "
+        "(expected latency / error / blackhole / crashEveryN)")
+
+
+def _parse_rule_refs(raw: Any, *, where: str, target: str) -> tuple[str, ...]:
+    """A target binds one rule name or a list of them."""
+    if isinstance(raw, str):
+        return (raw,)
+    if isinstance(raw, list) and all(isinstance(r, str) for r in raw):
+        return tuple(raw)
+    raise ComponentError(
+        f"{where}: target {target!r} must name a fault rule or a list "
+        "of fault rules")
+
+
+def parse_chaos(doc: Mapping[str, Any], *, source: str | None = None) -> ChaosSpec:
+    where = source or "chaos"
+    if not is_chaos_doc(doc):
+        raise ComponentError(f"{where}: not a Chaos document")
+    meta = doc.get("metadata") or {}
+    name = str(meta.get("name") or "chaos")
+    spec = doc.get("spec") or {}
+
+    try:
+        seed = int(spec.get("seed", 0))
+    except (TypeError, ValueError):
+        raise ComponentError(f"{where}: seed must be an integer") from None
+
+    rules: dict[str, ChaosRule] = {}
+    for rname, raw in (spec.get("faults") or {}).items():
+        rules[str(rname)] = ChaosRule(
+            name=str(rname), fault=_parse_fault(str(rname), raw, where=where))
+
+    targets = spec.get("targets") or {}
+    app_targets = {
+        str(app): _parse_rule_refs(raw, where=where, target=str(app))
+        for app, raw in (targets.get("apps") or {}).items()
+    }
+    component_targets: dict[str, dict[str, tuple[str, ...]]] = {}
+    for comp, raw in (targets.get("components") or {}).items():
+        if not isinstance(raw, Mapping):
+            raise ComponentError(
+                f"{where}: component target {comp!r} must be a mapping")
+        directions: dict[str, tuple[str, ...]] = {}
+        for direction in ("outbound", "inbound"):
+            if direction in raw:
+                directions[direction] = _parse_rule_refs(
+                    raw[direction], where=where, target=str(comp))
+        if not directions:
+            raise ComponentError(
+                f"{where}: component target {comp!r} needs an 'outbound' "
+                "or 'inbound' direction")
+        component_targets[str(comp)] = directions
+
+    scopes = doc.get("scopes") or []
+    if not isinstance(scopes, list) or not all(isinstance(s, str) for s in scopes):
+        raise ComponentError(f"{where}: scopes must be a list of app-ids")
+
+    # dangling rule references fail at load time, like the Resiliency
+    # loader: a typo must fail startup, not silently inject nothing
+    all_refs = list(app_targets.items()) + [
+        (comp, ref)
+        for comp, dirs in component_targets.items()
+        for ref in dirs.values()
+    ]
+    for target, refs in all_refs:
+        for ref in refs:
+            if ref not in rules:
+                raise ComponentError(
+                    f"{where}: target {target!r} references unknown fault "
+                    f"rule {ref!r}")
+
+    return ChaosSpec(
+        name=name,
+        seed=seed,
+        scopes=list(scopes),
+        rules=rules,
+        app_targets=app_targets,
+        component_targets=component_targets,
+    )
+
+
+def load_chaos(resources_path: str | pathlib.Path) -> list[ChaosSpec]:
+    """Collect every ``kind: Chaos`` document under ``resources_path``."""
+    root = pathlib.Path(resources_path)
+    if not root.is_dir():
+        return []
+    specs: list[ChaosSpec] = []
+    for path in sorted(root.iterdir()):
+        if path.suffix.lower() not in _YAML_SUFFIXES or not path.is_file():
+            continue
+        try:
+            docs = list(yaml.safe_load_all(path.read_text()))
+        except (OSError, yaml.YAMLError) as exc:
+            raise ComponentError(f"cannot read {path}: {exc}") from exc
+        for doc in docs:
+            if is_chaos_doc(doc):
+                specs.append(parse_chaos(doc, source=str(path)))
+    return specs

@@ -1,0 +1,226 @@
+"""Chaos wrappers: interpose fault rules on every building-block seam.
+
+Each wrapper subclasses the building-block ABC it shadows (the runtime
+isinstance-checks ``InputBinding``/``OutputBinding`` and treats the
+others by block), applies the resolved :class:`ChaosPolicy` before
+delegating, and forwards everything else to the wrapped instance via
+``__getattr__`` so driver extras — the sqlite broker's
+``requeue_dead_letters``/``dead_letters``, the state store's cache
+stats — keep working through the wrapper.
+
+Direction semantics mirror the Resiliency target taxonomy:
+
+* state stores and output bindings are pure *outbound* seams;
+* pub/sub applies **outbound** rules to ``publish`` and **inbound**
+  rules to each delivery (the handler wrapper raises, which the broker
+  counts as a nack → redelivery → DLQ, so injected inbound faults
+  exercise the real at-least-once machinery);
+* input bindings apply **inbound** rules to each event delivery.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tasksrunner.bindings.base import (
+    BindingEvent,
+    BindingResponse,
+    EventSink,
+    InputBinding,
+    OutputBinding,
+)
+from tasksrunner.chaos.engine import ChaosPolicies, ChaosPolicy
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.pubsub.base import Handler, Message, PubSubBroker, Subscription
+from tasksrunner.state.base import (
+    QueryResponse,
+    StateItem,
+    StateStore,
+    TransactionOp,
+)
+
+
+async def _before(policy: ChaosPolicy | None) -> None:
+    """Run the injector chain for a component seam. Synthesized HTTP
+    statuses have no reply to ride on here, so they become
+    ChaosInjectedError carrying the status."""
+    if policy is None:
+        return
+    status = await policy.before_call()
+    if status is not None:
+        policy.raise_for_status(status)
+
+
+class ChaosStateStore(StateStore):
+    """State store with outbound fault rules applied per operation."""
+
+    def __init__(self, inner: StateStore, policy: ChaosPolicy):
+        super().__init__(inner.name)
+        self.inner = inner
+        self.policy = policy
+        self.supports_query = inner.supports_query
+
+    async def get(self, key: str) -> StateItem | None:
+        await _before(self.policy)
+        return await self.inner.get(key)
+
+    async def set(self, key: str, value: Any, *, etag: str | None = None) -> str:
+        await _before(self.policy)
+        return await self.inner.set(key, value, etag=etag)
+
+    async def delete(self, key: str, *, etag: str | None = None) -> bool:
+        await _before(self.policy)
+        return await self.inner.delete(key, etag=etag)
+
+    async def query(self, query: dict, *, key_prefix: str = "") -> QueryResponse:
+        await _before(self.policy)
+        return await self.inner.query(query, key_prefix=key_prefix)
+
+    async def bulk_get(self, keys: list[str]) -> list[StateItem | None]:
+        await _before(self.policy)
+        return await self.inner.bulk_get(keys)
+
+    async def transact(self, ops: list[TransactionOp]) -> None:
+        await _before(self.policy)
+        await self.inner.transact(ops)
+
+    async def keys(self, *, prefix: str = "") -> list[str]:
+        await _before(self.policy)
+        return await self.inner.keys(prefix=prefix)
+
+    def close(self) -> None:
+        self.inner.close()
+
+    async def aclose(self) -> None:
+        await self.inner.aclose()
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self.inner, item)
+
+
+class ChaosPubSubBroker(PubSubBroker):
+    """Broker with outbound rules on publish, inbound rules on delivery."""
+
+    def __init__(self, inner: PubSubBroker,
+                 outbound: ChaosPolicy | None, inbound: ChaosPolicy | None):
+        super().__init__(inner.name)
+        self.inner = inner
+        self.outbound = outbound
+        self.inbound = inbound
+
+    async def publish(self, topic: str, data: Any, *,
+                      metadata: dict[str, str] | None = None) -> str:
+        await _before(self.outbound)
+        return await self.inner.publish(topic, data, metadata=metadata)
+
+    async def subscribe(self, topic: str, group: str, handler: Handler) -> Subscription:
+        if self.inbound is None:
+            return await self.inner.subscribe(topic, group, handler)
+        inbound = self.inbound
+
+        async def chaotic_handler(message: Message) -> bool:
+            # a raised fault is a nack: the broker's redelivery /
+            # dead-letter machinery sees exactly what a crashing
+            # consumer would produce
+            await _before(inbound)
+            return await handler(message)
+
+        return await self.inner.subscribe(topic, group, chaotic_handler)
+
+    async def ensure_group(self, topic: str, group: str) -> None:
+        await self.inner.ensure_group(topic, group)
+
+    async def aclose(self) -> None:
+        await self.inner.aclose()
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self.inner, item)
+
+
+class ChaosInputBinding(InputBinding):
+    """Input binding with inbound rules applied to each delivery."""
+
+    def __init__(self, inner: InputBinding, policy: ChaosPolicy):
+        super().__init__(inner.name)
+        self.inner = inner
+        self.policy = policy
+        self.route = inner.route
+
+    @property
+    def running(self) -> bool:
+        return self.inner.running
+
+    @running.setter
+    def running(self, value: bool) -> None:
+        # InputBinding.__init__ assigns running=False before self.inner
+        # exists; the real flag lives on the wrapped instance
+        if "inner" in self.__dict__:
+            self.inner.running = value
+
+    async def start(self, sink: EventSink) -> None:
+        policy = self.policy
+
+        async def chaotic_sink(event: BindingEvent) -> bool:
+            await _before(policy)
+            return await sink(event)
+
+        await self.inner.start(chaotic_sink)
+
+    async def stop(self) -> None:
+        await self.inner.stop()
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self.inner, item)
+
+
+class ChaosOutputBinding(OutputBinding):
+    """Output binding with outbound rules applied per invoke."""
+
+    def __init__(self, inner: OutputBinding, policy: ChaosPolicy):
+        super().__init__(inner.name)
+        self.inner = inner
+        self.policy = policy
+
+    @property
+    def operations(self) -> list[str]:
+        return self.inner.operations
+
+    async def invoke(self, operation: str, data: Any,
+                     metadata: dict[str, str] | None = None) -> BindingResponse:
+        await _before(self.policy)
+        return await self.inner.invoke(operation, data, metadata)
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self.inner, item)
+
+
+def wrap_component(instance: Any, spec: ComponentSpec,
+                   chaos: ChaosPolicies | None) -> Any:
+    """Wrap a freshly-built component in its chaos interposer, if any
+    rule targets it. With no matching rules (or no chaos at all) the
+    instance is returned untouched — the disabled path allocates
+    nothing."""
+    if chaos is None:
+        return instance
+    block = spec.block
+    if block == "state":
+        policy = chaos.for_component(spec.name, "outbound")
+        if policy is not None and isinstance(instance, StateStore):
+            return ChaosStateStore(instance, policy)
+        return instance
+    if block == "pubsub":
+        outbound = chaos.for_component(spec.name, "outbound")
+        inbound = chaos.for_component(spec.name, "inbound")
+        if (outbound or inbound) and isinstance(instance, PubSubBroker):
+            return ChaosPubSubBroker(instance, outbound, inbound)
+        return instance
+    if block == "bindings":
+        if isinstance(instance, InputBinding):
+            policy = chaos.for_component(spec.name, "inbound")
+            if policy is not None:
+                return ChaosInputBinding(instance, policy)
+        elif isinstance(instance, OutputBinding):
+            policy = chaos.for_component(spec.name, "outbound")
+            if policy is not None:
+                return ChaosOutputBinding(instance, policy)
+    return instance

@@ -714,6 +714,81 @@ def _cmd_metrics(args) -> None:
         print(f"{key:<{width}}  {shown}")
 
 
+def _cmd_chaos(args) -> None:
+    """Admin surface for the fault-injection subsystem: show the gate,
+    validate the Chaos documents in a resources dir (the same load-time
+    validation a starting host runs), list every rule/target binding,
+    and — when a running app is named — its live injection counters."""
+    import json as json_mod
+
+    from tasksrunner.chaos import ChaosPolicies, chaos_enabled, load_chaos
+
+    specs = load_chaos(args.resources)  # raises on malformed docs
+    policies = ChaosPolicies(specs, app_id=args.app_id)
+    rules = policies.describe()
+    enabled = chaos_enabled()
+
+    live: dict[str, float] = {}
+    if args.app_id:
+        addr = None
+        try:
+            addr, headers = _resolve_sidecar(args)
+        except SystemExit:
+            pass  # not running — static view only
+        if addr is not None:
+            import urllib.error
+            import urllib.request
+
+            req = urllib.request.Request(f"{addr.base_url}/v1.0/metadata",
+                                         headers=headers)
+            try:
+                with urllib.request.urlopen(req, timeout=10) as resp:
+                    meta = json_mod.loads(resp.read())
+                live = {
+                    k: v for k, v in (meta.get("metrics") or {}).items()
+                    if k.startswith(("chaos_injected_total",
+                                     "resiliency_breaker_state",
+                                     "resiliency_retry"))
+                }
+            except (urllib.error.URLError, OSError, ValueError):
+                pass
+
+    if args.json:
+        print(json_mod.dumps(
+            {"enabled": enabled, "documents": len(specs),
+             "rules": rules, "metrics": live}, indent=2))
+        if not enabled and specs:
+            raise SystemExit(3)
+        return
+
+    print(f"chaos gate: {'ON (TASKSRUNNER_CHAOS=1)' if enabled else 'off'}")
+    if not specs:
+        print(f"no Chaos documents under {args.resources}")
+        return
+    print(f"{len(specs)} Chaos document(s), all valid")
+    width = max(len(r["rule"]) for r in rules)
+    for r in rules:
+        params = ", ".join(f"{k}={v}" for k, v in r["params"].items()
+                           if v not in (None, 0.0))
+        state = " [disabled]" if r["disabled"] else ""
+        print(f"  {r['rule']:<{width}}  {r['fault']}({params}){state}")
+        for t in r["targets"]:
+            print(f"  {'':<{width}}    -> {t}")
+    if live:
+        print("live counters:")
+        lw = max(len(k) for k in live)
+        for key in sorted(live):
+            value = live[key]
+            shown = int(value) if float(value).is_integer() else round(value, 3)
+            print(f"  {key:<{lw}}  {shown}")
+    if not enabled:
+        # documents present but inert: the state an operator most
+        # often means to ask about — make it unmissable and scriptable
+        print("NOTE: documents are inert until the host runs with "
+              "TASKSRUNNER_CHAOS=1")
+        raise SystemExit(3)
+
+
 def _admin_request(registry_file: str, method: str, path: str,
                    body: dict | None = None) -> dict:
     """Talk to the orchestrator's control plane (the `az containerapp`
@@ -1123,6 +1198,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="directory relative brokerPath resolves against "
                         "(the run-config's directory)")
     p.set_defaults(fn=_cmd_dlq)
+
+    p = sub.add_parser("chaos",
+                       help="fault-injection status: gate, validated "
+                            "rules/targets, live injection counters")
+    p.add_argument("action", choices=["status"])
+    p.add_argument("--resources", default="components",
+                   help="resources directory holding the Chaos YAML")
+    p.add_argument("--app-id", default=None,
+                   help="scope the view to one app and fetch its live "
+                        "counters when it is running")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--registry-file", **registry_arg)
+    p.set_defaults(fn=_cmd_chaos)
 
     p = sub.add_parser("restart",
                        help="rolling-restart an app via the orchestrator "

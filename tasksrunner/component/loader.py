@@ -16,6 +16,7 @@ import yaml
 
 from tasksrunner.component.spec import ComponentSpec, parse_component
 from tasksrunner.errors import ComponentError
+from tasksrunner.chaos.spec import is_chaos_doc
 from tasksrunner.resiliency.spec import is_resiliency_doc
 
 _YAML_SUFFIXES = {".yaml", ".yml"}
@@ -39,9 +40,10 @@ def load_component_file(path: str | pathlib.Path, *, name: str | None = None) ->
     for doc in docs:
         if doc is None:
             continue
-        if is_resiliency_doc(doc):
-            # Resiliency documents share the resources directory
-            # (tasksrunner/resiliency/spec.py loads them)
+        if is_resiliency_doc(doc) or is_chaos_doc(doc):
+            # Resiliency and Chaos documents share the resources
+            # directory (tasksrunner/resiliency/spec.py and
+            # tasksrunner/chaos/spec.py load them)
             continue
         specs.append(parse_component(doc, default_name=name or path.stem, source=str(path)))
     return specs

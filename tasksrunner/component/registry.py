@@ -77,9 +77,14 @@ class ComponentRegistry:
         *,
         app_id: str | None = None,
         secret_resolver: SecretResolver | None = None,
+        chaos: Any = None,
     ):
         self.app_id = app_id
         self.resolver = secret_resolver or SecretResolver()
+        #: ChaosPolicies when fault injection is active (TASKSRUNNER_CHAOS=1
+        #: and a Chaos doc in scope); None means _build returns bare
+        #: driver instances — the production path allocates no wrappers.
+        self.chaos = chaos
         self._specs: dict[str, ComponentSpec] = {}
         self._instances: dict[str, Any] = {}
 
@@ -102,7 +107,12 @@ class ComponentRegistry:
     def _build(self, spec: ComponentSpec) -> Any:
         factory = resolve_driver(spec.type)
         metadata = self.resolver.resolve_metadata(spec)
-        return factory(spec, metadata)
+        instance = factory(spec, metadata)
+        if self.chaos is not None:
+            from tasksrunner.chaos.wrappers import wrap_component
+
+            instance = wrap_component(instance, spec, self.chaos)
+        return instance
 
     # -- lookup ----------------------------------------------------------
 

@@ -117,6 +117,22 @@ class InvocationStatusError(InvocationError):
         self.status = status
 
 
+class ChaosInjectedError(TasksRunnerError):
+    """A fault injected by the chaos subsystem (``TASKSRUNNER_CHAOS=1``).
+
+    Raised only when an operator has declared a ``kind: Chaos`` document
+    and enabled the gate — never on a production path. Status-mode
+    faults carry the synthesized HTTP status so the sidecar API maps
+    the injection to exactly the declared code.
+    """
+
+    def __init__(self, message: str, *, status: int | None = None):
+        super().__init__(message)
+        if status is not None:
+            self.http_status = status
+        self.status = status
+
+
 class CircuitOpenError(TasksRunnerError):
     """A resiliency circuit breaker is open — the call was rejected
     without being attempted (fail-fast). Maps to 503 so callers can

@@ -22,6 +22,8 @@ import os
 from aiohttp import web
 
 from tasksrunner.app import App
+from tasksrunner.chaos.engine import ChaosPolicies, chaos_enabled
+from tasksrunner.chaos.spec import ChaosSpec, load_chaos
 from tasksrunner.client import AppClient
 from tasksrunner.component.loader import load_components
 from tasksrunner.component.registry import ComponentRegistry
@@ -153,6 +155,12 @@ class AppHost:
         #: resources dir), exactly as Dapr loads them
         self.resiliency_specs: list[ResiliencySpec] = (
             load_resiliency(components_path) if components_path else [])
+        #: Chaos documents share the resources dir too, but stay inert
+        #: unless the operator runs with TASKSRUNNER_CHAOS=1 — the gate
+        #: is checked here once, so a disabled host never even loads them
+        self.chaos_specs: list[ChaosSpec] = (
+            load_chaos(components_path)
+            if components_path and chaos_enabled() else [])
         self.resolver = resolver or NameResolver(registry_file=registry_file)
         #: per-app component authorization; None = unrestricted, or set
         #: TASKSRUNNER_GRANTS (the orchestrator does, per app spec)
@@ -182,7 +190,10 @@ class AppHost:
         # HTTPAppChannel; both must stay behaviorally identical
         # (SURVEY.md §7.4 hard part #1 — App.handle adopts trace
         # context and feeds the same request counters either way).
-        registry = ComponentRegistry(self.specs, app_id=self.app.app_id)
+        chaos = (ChaosPolicies(self.chaos_specs, app_id=self.app.app_id)
+                 if self.chaos_specs else None)
+        registry = ComponentRegistry(self.specs, app_id=self.app.app_id,
+                                     chaos=chaos)
         runtime = Runtime(
             self.app.app_id, registry, resolver=self.resolver,
             app_channel=InProcAppChannel(self.app),
@@ -190,6 +201,7 @@ class AppHost:
                 self.resiliency_specs, app_id=self.app.app_id)
             if self.resiliency_specs else None,
             grants=self.grants,
+            chaos=chaos,
         )
         self.sidecar = Sidecar(runtime, host=self.host, port=self.sidecar_port)
         await self.sidecar.start()
@@ -239,9 +251,16 @@ class InProcCluster:
 
     def __init__(self, specs: list[ComponentSpec] | None = None, *,
                  resiliency_specs: list[ResiliencySpec] | None = None,
+                 chaos_specs: list[ChaosSpec] | None = None,
                  grants: dict[str, AppGrants | dict] | None = None):
         self.specs = specs or []
         self.resiliency_specs = resiliency_specs or []
+        #: one ChaosPolicies for the whole cluster (component instances
+        #: are shared across apps, so their wrappers must be too);
+        #: still behind the TASKSRUNNER_CHAOS gate
+        self.chaos = (
+            ChaosPolicies(chaos_specs)
+            if chaos_specs and chaos_enabled() else None)
         #: optional per-app grants (app_id → AppGrants or raw mapping);
         #: apps absent from the dict run unrestricted
         self.grants = {
@@ -260,7 +279,7 @@ class InProcCluster:
         self.apps[app.app_id] = app
 
     def _make_registry(self, app_id: str) -> ComponentRegistry:
-        reg = ComponentRegistry(self.specs, app_id=app_id)
+        reg = ComponentRegistry(self.specs, app_id=app_id, chaos=self.chaos)
         # share instances across apps: first builder wins, others reuse
         original_get = reg.get
 
@@ -286,7 +305,8 @@ class InProcCluster:
                 app_id, self._make_registry(app_id), app_channel=channel,
                 resiliency=ResiliencyPolicies(self.resiliency_specs, app_id=app_id)
                 if self.resiliency_specs else None,
-                grants=self.grants.get(app_id))
+                grants=self.grants.get(app_id),
+                chaos=self.chaos)
             self.runtimes[app_id] = runtime
             app.client = AppClient.direct(runtime)
         # wire peers after all channels exist

@@ -16,12 +16,14 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import re
 import time
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Iterator
 
 from tasksrunner.errors import CircuitOpenError, ComponentError
+from tasksrunner.observability.metrics import metrics
 
 logger = logging.getLogger(__name__)
 
@@ -56,14 +58,31 @@ class RetrySpec:
     max_interval: float = 60.0
     #: additional attempts after the first; -1 = unlimited
     max_retries: int = -1
+    #: jitter blend in [0, 1]: 0 = the deterministic schedule below
+    #: (default, preserves exact historical delays), 1 = fully
+    #: decorrelated jitter (AWS style: sleep = min(cap,
+    #: uniform(base, prev*3))) so many replicas retrying the same dead
+    #: dependency don't synchronize into a thundering herd. Values in
+    #: between linearly blend the two.
+    jitter: float = 0.0
 
-    def delays(self) -> Iterator[float]:
+    def delays(self, rng: random.Random | None = None) -> Iterator[float]:
+        if self.jitter and rng is None:
+            rng = random.Random()
         n = 0
+        prev = self.duration
         while self.max_retries < 0 or n < self.max_retries:
             if self.policy == "exponential":
-                yield min(self.duration * (2 ** n), self.max_interval)
+                base = min(self.duration * (2 ** n), self.max_interval)
             else:
-                yield self.duration
+                base = self.duration
+            if self.jitter:
+                decorrelated = min(self.max_interval,
+                                   rng.uniform(self.duration, prev * 3))
+                prev = decorrelated
+                yield (1.0 - self.jitter) * base + self.jitter * decorrelated
+            else:
+                yield base
             n += 1
 
 
@@ -102,6 +121,9 @@ class CircuitBreaker:
 
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
 
+    #: gauge encoding for resiliency_breaker_state{policy,target}
+    _STATE_VALUES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
     def __init__(self, spec: CircuitBreakerSpec, *, target: str = ""):
         self.spec = spec
         self.target = target
@@ -109,6 +131,14 @@ class CircuitBreaker:
         self.consecutive_failures = 0
         self._opened_at = 0.0
         self._half_open_inflight = 0
+        self._publish_state()
+
+    def _publish_state(self) -> None:
+        # 0=closed, 1=half-open, 2=open — admin surfaces read this to
+        # show WHY traffic toward a target is being shed
+        metrics.set_gauge("resiliency_breaker_state",
+                          self._STATE_VALUES[self.state],
+                          policy=self.spec.name, target=self.target)
 
     def before_call(self) -> None:
         """Gate a call; raises ``CircuitOpenError`` when rejected."""
@@ -116,6 +146,7 @@ class CircuitBreaker:
             if time.monotonic() - self._opened_at >= self.spec.timeout:
                 self.state = self.HALF_OPEN
                 self._half_open_inflight = 0
+                self._publish_state()
                 logger.info("circuit %s[%s] half-open (probing)",
                             self.spec.name, self.target)
             else:
@@ -134,6 +165,7 @@ class CircuitBreaker:
         self.state = self.CLOSED
         self.consecutive_failures = 0
         self._half_open_inflight = 0
+        self._publish_state()
 
     def release_probe(self) -> None:
         """A half-open probe ended without a verdict (e.g. the caller
@@ -151,6 +183,7 @@ class CircuitBreaker:
         if should_trip and self.state != self.OPEN:
             self.state = self.OPEN
             self._opened_at = time.monotonic()
+            self._publish_state()
             logger.warning("circuit %s[%s] OPEN after %d consecutive failures",
                            self.spec.name, self.target, self.consecutive_failures)
 
@@ -163,6 +196,11 @@ class TargetPolicy:
     timeout: float | None = None
     retry: RetrySpec | None = None
     breaker: CircuitBreaker | None = None
+    #: "perAttempt" (historical default: each attempt gets the full
+    #: timeout, so a 3-retry policy with a 5s timeout can hold a caller
+    #: for 20s+) or "total": the timeout is an overall budget across
+    #: attempts AND backoff sleeps.
+    timeout_policy: str = "perAttempt"
 
     async def execute(
         self,
@@ -178,11 +216,31 @@ class TargetPolicy:
         never retried here — fail fast is the point of the breaker.
         """
         delays = self.retry.delays() if self.retry else iter(())
+        deadline = (
+            time.monotonic() + self.timeout
+            if self.timeout is not None and self.timeout_policy == "total"
+            else None)
+
+        def _budget_error(cause: BaseException | None = None) -> TimeoutError:
+            err = TimeoutError(
+                f"call to {self.target!r} exceeded {self.timeout}s "
+                "total budget")
+            if cause is not None:
+                err.__cause__ = cause
+            return err
+
         while True:
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise _budget_error()
             if self.breaker is not None:
                 self.breaker.before_call()
             try:
-                if self.timeout is not None:
+                if remaining is not None:
+                    result = await asyncio.wait_for(fn(), remaining)
+                elif self.timeout is not None:
                     result = await asyncio.wait_for(fn(), self.timeout)
                 else:
                     result = await fn()
@@ -191,11 +249,21 @@ class TargetPolicy:
                     self.breaker.record_failure()
                 delay = next(delays, None)
                 if delay is None:
+                    metrics.inc("resiliency_retry_exhausted_total",
+                                target=self.target)
                     if isinstance(exc, asyncio.TimeoutError):
                         raise TimeoutError(
                             f"call to {self.target!r} exceeded "
                             f"{self.timeout}s timeout") from exc
                     raise
+                if deadline is not None and \
+                        time.monotonic() + delay >= deadline:
+                    # sleeping through the backoff would blow the
+                    # budget — surface exhaustion NOW, not after it
+                    metrics.inc("resiliency_retry_exhausted_total",
+                                target=self.target)
+                    raise _budget_error(exc)
+                metrics.inc("resiliency_retry_total", target=self.target)
                 logger.warning("retrying %s in %.3fs after %r",
                                self.target, delay, exc)
                 await asyncio.sleep(delay)
@@ -221,6 +289,8 @@ class _TargetRef:
     timeout: str | None = None
     retry: str | None = None
     circuit_breaker: str | None = None
+    #: "perAttempt" | "total" (see TargetPolicy.timeout_policy)
+    timeout_policy: str = "perAttempt"
 
 
 @dataclass
@@ -289,7 +359,8 @@ class ResiliencyPolicies:
                 breaker = self._breakers.setdefault(
                     bk, CircuitBreaker(cb_spec, target=name))
             policy = TargetPolicy(
-                target=name, timeout=timeout, retry=retry, breaker=breaker)
+                target=name, timeout=timeout, retry=retry, breaker=breaker,
+                timeout_policy=ref.timeout_policy)
             break  # first in-scope spec naming the target wins
         self._cache[cache_key] = policy
         return policy

@@ -70,10 +70,16 @@ def is_resiliency_doc(doc: Any) -> bool:
 def _parse_target_ref(raw: Mapping[str, Any], *, where: str) -> _TargetRef:
     if not isinstance(raw, Mapping):
         raise ComponentError(f"{where}: target must be a mapping")
+    timeout_policy = str(raw.get("timeoutPolicy", "perAttempt"))
+    if timeout_policy not in ("perAttempt", "total"):
+        raise ComponentError(
+            f"{where}: timeoutPolicy must be 'perAttempt' or 'total', "
+            f"not {timeout_policy!r}")
     return _TargetRef(
         timeout=raw.get("timeout"),
         retry=raw.get("retry"),
         circuit_breaker=raw.get("circuitBreaker"),
+        timeout_policy=timeout_policy,
     )
 
 
@@ -95,11 +101,16 @@ def parse_resiliency(doc: Mapping[str, Any], *, source: str | None = None) -> Re
     for rname, raw in (policies.get("retries") or {}).items():
         if not isinstance(raw, Mapping):
             raise ComponentError(f"{where}: retry {rname!r} must be a mapping")
+        jitter = float(raw.get("jitter", 0.0))
+        if not 0.0 <= jitter <= 1.0:
+            raise ComponentError(
+                f"{where}: retry {rname!r}: jitter must be in [0, 1]")
         retries[str(rname)] = RetrySpec(
             policy=str(raw.get("policy", "constant")),
             duration=parse_duration(raw.get("duration", "5s")),
             max_interval=parse_duration(raw.get("maxInterval", "60s")),
             max_retries=int(raw.get("maxRetries", -1)),
+            jitter=jitter,
         )
 
     breakers: dict[str, CircuitBreakerSpec] = {}

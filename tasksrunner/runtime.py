@@ -134,6 +134,7 @@ class Runtime:
         invoke_retry_delay: float = 0.2,
         resiliency: ResiliencyPolicies | None = None,
         grants: "AppGrants | None" = None,
+        chaos: Any = None,
     ):
         self.app_id = app_id
         self.registry = registry
@@ -154,6 +155,11 @@ class Runtime:
         #: unrestricted. Enforced HERE, transport-neutrally, so the
         #: HTTP sidecar and the in-proc client behave identically.
         self.grants = grants
+        #: ChaosPolicies when fault injection is active; the invoke
+        #: client consults app-targeted rules per attempt so resiliency
+        #: policies (timeout/retry/breaker) see injected faults exactly
+        #: like real peer failures. None on the production path.
+        self.chaos = chaos
         self.app_channel = app_channel
         #: in-process peer channels (app-id → AppChannel); consulted
         #: before name resolution so a single-process cluster can route
@@ -361,9 +367,29 @@ class Runtime:
                         span_id=child.span_id, parent_id=base_ctx.span_id)
             return result
 
+        # chaos rules targeting this app run per ATTEMPT (inside the
+        # resiliency policy), so injected faults hit the same retry/
+        # breaker/timeout machinery a real flaky peer would exercise.
+        # Status-mode faults synthesize a reply; raising faults look
+        # like transport errors.
+        cpolicy = (self.chaos.for_app(target_app_id)
+                   if self.chaos is not None else None)
+
+        async def _chaos_gate() -> tuple[int, dict[str, str], bytes] | None:
+            if cpolicy is None:
+                return None
+            status = await cpolicy.before_call()
+            if status is None:
+                return None
+            return (status, {"x-tasksrunner-chaos": "injected"},
+                    json.dumps({"message": "chaos: injected status"}).encode())
+
         if self.app_id is not None and target_app_id == self.app_id:
             if self.app_channel is None:
                 raise InvocationError(f"no app channel for local app {self.app_id!r}")
+            injected = await _chaos_gate()
+            if injected is not None:
+                return _spanned(injected)
             return _spanned(await self.app_channel.request(
                 http_method, path, query=query, headers=headers, body=body))
 
@@ -374,6 +400,9 @@ class Runtime:
             channel = self.peers[target_app_id]
 
             async def _peer_attempt():
+                injected = await _chaos_gate()
+                if injected is not None:
+                    return injected
                 return await channel.request(
                     http_method, path, query=query, headers=headers, body=body)
 
@@ -408,6 +437,9 @@ class Runtime:
                 return resp.status, dict(resp.headers), await resp.read()
 
         async def _attempt():
+            injected = await _chaos_gate()
+            if injected is not None:
+                return injected
             from tasksrunner.invoke.mesh import MeshConnectError
             from tasksrunner.invoke.pki import mesh_tls_enabled
             # re-resolve each attempt: the peer may have crashed,
@@ -545,7 +577,7 @@ class Runtime:
             # (processor-backend-service.bicep:190-198): an app must not
             # start silently deaf to a subscription it declared
             self._authorize(pubsub_name, "subscribe", topic=topic)
-            handler = self._make_subscription_handler(route)
+            handler = self._make_subscription_handler(pubsub_name, route)
             self._subscriptions.append(
                 await broker.subscribe(topic, self.app_id or "default", handler))
             logger.info("subscribed %s to %s/%s -> %s",
@@ -566,7 +598,18 @@ class Runtime:
                 logger.info("input binding %s -> %s", name, instance.route)
         self._started = True
 
-    def _make_subscription_handler(self, route: str):
+    def _inbound_policy(self, component_name: str):
+        """The component's inbound resiliency policy (if any) — applied
+        on the sidecar→app delivery hop, ≙ Dapr's inbound target
+        direction: a transiently-failing handler is retried locally
+        before the delivery counts as a nack."""
+        if self.resiliency is None:
+            return None
+        return self.resiliency.for_component(component_name, "inbound")
+
+    def _make_subscription_handler(self, pubsub_name: str, route: str):
+        policy = self._inbound_policy(pubsub_name)
+
         async def deliver(msg: Message) -> bool:
             ctx = ensure_trace(msg.metadata.get(TRACEPARENT_HEADER))
             with trace_scope(ctx):
@@ -576,9 +619,17 @@ class Runtime:
                         "content-type", cloudevents.CONTENT_TYPE),
                     TRACEPARENT_HEADER: ctx.header,
                 }
-                try:
-                    status, _, _ = await self.app_channel.request(
+
+                async def _deliver_once():
+                    return await self.app_channel.request(
                         "POST", route, headers=headers, body=body)
+
+                try:
+                    if policy is not None:
+                        status, _, _ = await policy.execute(
+                            _deliver_once, retriable=(OSError,))
+                    else:
+                        status, _, _ = await _deliver_once()
                 except Exception:
                     logger.exception("delivery to %s failed", route)
                     return False
@@ -593,6 +644,8 @@ class Runtime:
         return deliver
 
     def _make_binding_sink(self, binding: InputBinding):
+        policy = self._inbound_policy(binding.name)
+
         async def sink(event: BindingEvent) -> bool:
             ctx = ensure_trace(None)
             with trace_scope(ctx):
@@ -600,9 +653,17 @@ class Runtime:
                 headers = {"content-type": "application/json",
                            TRACEPARENT_HEADER: ctx.header}
                 headers.update(event.metadata)
-                try:
-                    status, _, _ = await self.app_channel.request(
+
+                async def _deliver_once():
+                    return await self.app_channel.request(
                         "POST", binding.route, headers=headers, body=body)
+
+                try:
+                    if policy is not None:
+                        status, _, _ = await policy.execute(
+                            _deliver_once, retriable=(OSError,))
+                    else:
+                        status, _, _ = await _deliver_once()
                 except Exception:
                     logger.exception("binding delivery to %s failed", binding.route)
                     return False

@@ -1,0 +1,835 @@
+"""Chaos verification harness (`tasksrunner/chaos`).
+
+This file is the second half of the chaos tentpole: the spec layer is
+tested the way the Resiliency spec is (round-trip + load-time
+validation), and the engine is tested for the property the whole
+subsystem exists to provide — a *deterministic* adversary that lets us
+assert the resiliency guarantees we advertise actually hold:
+
+* seeded injection is bit-for-bit reproducible across two invocations;
+* retries recover from sub-threshold error rates with **no lost
+  writes**;
+* sustained failure walks the breaker open → half-open → closed on the
+  documented schedule (and the `resiliency_breaker_state` gauge tracks
+  it);
+* poisoned deliveries exhaust redelivery, land in the DLQ, and
+  ``requeue_dead_letters`` drains them once the fault clears;
+* with the gate off (the default) components are NOT wrapped — the
+  production path allocates nothing.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from tasksrunner.chaos import (
+    ChaosPolicies,
+    chaos_enabled,
+    load_chaos,
+    parse_chaos,
+)
+from tasksrunner.chaos.wrappers import (
+    ChaosOutputBinding,
+    ChaosPubSubBroker,
+    ChaosStateStore,
+    wrap_component,
+)
+from tasksrunner.component.loader import load_components
+from tasksrunner.component.registry import ComponentRegistry
+from tasksrunner.component.spec import parse_component
+from tasksrunner.errors import (
+    ChaosInjectedError,
+    CircuitOpenError,
+    ComponentError,
+    PubSubError,
+)
+from tasksrunner.observability.metrics import metrics
+from tasksrunner.pubsub.base import Message
+from tasksrunner.pubsub.sqlite import SqliteBroker
+from tasksrunner.resiliency import ResiliencyPolicies, parse_resiliency
+from tasksrunner.runtime import Runtime
+from tasksrunner.state.memory import InMemoryStateStore
+
+
+def chaos_doc(**spec) -> dict:
+    return {
+        "apiVersion": "tasksrunner/v1alpha1",
+        "kind": "Chaos",
+        "metadata": {"name": "test-chaos"},
+        "spec": spec,
+    }
+
+
+# ---------------------------------------------------------------------------
+# spec: round-trip + load-time validation
+# ---------------------------------------------------------------------------
+
+
+def test_parse_roundtrip_all_fault_kinds():
+    doc = chaos_doc(
+        seed=42,
+        faults={
+            "slow": {"latency": {"duration": "20ms", "jitter": "10ms"}},
+            "flaky": {"error": {"probability": 0.1, "raise": "OSError"}},
+            "fivehundred": {"error": {"status": 503}},
+            "dead": {"blackhole": {"deadline": "2s"}},
+            "poison": {"crashEveryN": {"n": 5, "raise": "PubSubError"}},
+        },
+        targets={
+            "apps": {"backend": ["dead"]},
+            "components": {
+                "statestore": {"outbound": ["slow", "flaky"]},
+                "taskspubsub": {"inbound": "poison", "outbound": ["fivehundred"]},
+            },
+        },
+    )
+    doc["scopes"] = ["backend"]
+    spec = parse_chaos(doc)
+    assert spec.name == "test-chaos" and spec.seed == 42
+    assert spec.scopes == ["backend"]
+    assert set(spec.rules) == {"slow", "flaky", "fivehundred", "dead", "poison"}
+    assert spec.rules["slow"].fault.duration == pytest.approx(0.02)
+    assert spec.rules["slow"].fault.jitter == pytest.approx(0.01)
+    assert spec.rules["flaky"].fault.probability == pytest.approx(0.1)
+    assert spec.rules["fivehundred"].fault.status == 503
+    assert spec.rules["dead"].fault.deadline == pytest.approx(2.0)
+    assert spec.rules["poison"].fault.n == 5
+    assert spec.app_targets == {"backend": ("dead",)}
+    assert spec.component_targets["statestore"]["outbound"] == ("slow", "flaky")
+    # single rule name normalizes to a tuple
+    assert spec.component_targets["taskspubsub"]["inbound"] == ("poison",)
+    assert spec.in_scope("backend") and not spec.in_scope("other")
+
+
+@pytest.mark.parametrize("faults,targets,fragment", [
+    # dangling rule reference must fail startup, not inject nothing
+    ({"f": {"error": {"raise": "OSError"}}},
+     {"components": {"s": {"outbound": ["typo"]}}}, "unknown fault rule"),
+    ({"f": {"error": {"raise": "NoSuchError"}}}, {}, "unknown fault error class"),
+    ({"f": {"error": {"probability": 1.5, "raise": "OSError"}}}, {},
+     "probability"),
+    ({"f": {"error": {"raise": "OSError", "status": 500}}}, {}, "exactly one"),
+    ({"f": {"error": {"status": 77}}}, {}, "not an HTTP status"),
+    ({"f": {"crashEveryN": {"n": 0}}}, {}, "n >= 1"),
+    ({"f": {"teleport": {}}}, {}, "unknown fault kind"),
+    ({"f": {"latency": {"duration": "1s"}, "error": {"status": 500}}}, {},
+     "exactly one"),
+])
+def test_validation_fails_at_load_time(faults, targets, fragment):
+    with pytest.raises(ComponentError, match=fragment):
+        parse_chaos(chaos_doc(faults=faults, targets=targets))
+
+
+def test_loader_skips_chaos_docs_and_load_chaos_collects(tmp_path):
+    (tmp_path / "all.yaml").write_text(
+        "\n".join([
+            "componentType: state.in-memory",
+            "metadata: []",
+            "---",
+            "kind: Chaos",
+            "metadata: {name: c1}",
+            "spec:",
+            "  faults:",
+            "    f: {error: {raise: OSError}}",
+            "  targets:",
+            "    components:",
+            "      all: {outbound: [f]}",
+        ]))
+    comps = load_components(tmp_path)
+    assert [c.type for c in comps] == ["state.in-memory"]
+    specs = load_chaos(tmp_path)
+    assert [s.name for s in specs] == ["c1"]
+    # and a missing dir is simply no chaos
+    assert load_chaos(tmp_path / "nope") == []
+
+
+# ---------------------------------------------------------------------------
+# engine: determinism, toggles, metrics
+# ---------------------------------------------------------------------------
+
+
+def _flaky_spec(probability=0.4, seed=7):
+    return parse_chaos(chaos_doc(
+        seed=seed,
+        faults={"flaky": {"error": {"probability": probability,
+                                    "raise": "OSError"}}},
+        targets={"components": {"statestore": {"outbound": ["flaky"]}}},
+    ))
+
+
+async def _verdict_sequence(spec, n=40):
+    """Drive the statestore injector n times, recording inject/pass."""
+    policies = ChaosPolicies([spec])
+    store = ChaosStateStore(InMemoryStateStore("statestore"),
+                            policies.for_component("statestore"))
+    out = []
+    for i in range(n):
+        try:
+            await store.set(f"k{i}", i)
+            out.append(0)
+        except OSError:
+            out.append(1)
+    return out
+
+
+@pytest.mark.asyncio
+async def test_seeded_injection_bit_for_bit_reproducible():
+    """The acceptance bar: two invocations of the same seeded scenario
+    produce the identical fault sequence (string seeding is sha512-based
+    in CPython, so this holds across processes too, independent of
+    PYTHONHASHSEED)."""
+    spec = _flaky_spec()
+    first = await _verdict_sequence(spec)
+    second = await _verdict_sequence(_flaky_spec())
+    assert first == second
+    assert 0 < sum(first) < len(first)  # actually probabilistic, not const
+    # a different seed produces a different (but equally stable) run
+    assert first != await _verdict_sequence(_flaky_spec(seed=8))
+
+
+@pytest.mark.asyncio
+async def test_injection_counts_into_metrics():
+    spec = _flaky_spec(probability=1.0)
+    policies = ChaosPolicies([spec])
+    store = ChaosStateStore(InMemoryStateStore("statestore"),
+                            policies.for_component("statestore"))
+    before = metrics.get("chaos_injected_total",
+                         target="components/statestore/outbound", fault="flaky")
+    for _ in range(3):
+        with pytest.raises(OSError):
+            await store.get("k")
+    after = metrics.get("chaos_injected_total",
+                        target="components/statestore/outbound", fault="flaky")
+    assert after - before == 3
+
+
+@pytest.mark.asyncio
+async def test_disable_enable_toggle_and_describe():
+    spec = _flaky_spec(probability=1.0)
+    policies = ChaosPolicies([spec])
+    store = ChaosStateStore(InMemoryStateStore("statestore"),
+                            policies.for_component("statestore"))
+    with pytest.raises(OSError):
+        await store.get("k")
+    policies.disable("flaky")
+    assert (await store.get("k")) is None  # fault switched off mid-run
+    assert policies.describe()[0]["disabled"] is True
+    policies.enable("flaky")
+    with pytest.raises(OSError):
+        await store.get("k")
+    desc = policies.describe()
+    assert desc[0]["rule"] == "flaky"
+    assert desc[0]["targets"] == ["components/statestore/outbound"]
+
+
+@pytest.mark.asyncio
+async def test_status_fault_raises_chaos_injected_on_component_seam():
+    spec = parse_chaos(chaos_doc(
+        faults={"fivehundred": {"error": {"status": 503}}},
+        targets={"components": {"statestore": {"outbound": ["fivehundred"]}}},
+    ))
+    policies = ChaosPolicies([spec])
+    store = ChaosStateStore(InMemoryStateStore("statestore"),
+                            policies.for_component("statestore"))
+    with pytest.raises(ChaosInjectedError) as err:
+        await store.get("k")
+    assert err.value.status == 503
+
+
+@pytest.mark.asyncio
+async def test_blackhole_hangs_then_times_out():
+    spec = parse_chaos(chaos_doc(
+        faults={"dead": {"blackhole": {"deadline": "50ms"}}},
+        targets={"components": {"statestore": {"outbound": ["dead"]}}},
+    ))
+    policies = ChaosPolicies([spec])
+    store = ChaosStateStore(InMemoryStateStore("statestore"),
+                            policies.for_component("statestore"))
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        await store.get("k")
+    assert time.monotonic() - t0 >= 0.05
+
+
+@pytest.mark.asyncio
+async def test_crash_every_n_is_exact():
+    spec = parse_chaos(chaos_doc(
+        faults={"poison": {"crashEveryN": {"n": 3}}},
+        targets={"components": {"statestore": {"outbound": ["poison"]}}},
+    ))
+    policies = ChaosPolicies([spec])
+    store = ChaosStateStore(InMemoryStateStore("statestore"),
+                            policies.for_component("statestore"))
+    outcomes = []
+    for i in range(9):
+        try:
+            await store.set(f"k{i}", i)
+            outcomes.append("ok")
+        except OSError:
+            outcomes.append("boom")
+    assert outcomes == ["ok", "ok", "boom"] * 3
+
+
+def test_scoping_filters_specs():
+    spec = _flaky_spec()
+    spec.scopes = ["backend"]
+    assert ChaosPolicies([spec], app_id="frontend").for_component(
+        "statestore") is None
+    assert ChaosPolicies([spec], app_id="backend").for_component(
+        "statestore") is not None
+
+
+# ---------------------------------------------------------------------------
+# wiring: the gate and the wrap-at-build seam
+# ---------------------------------------------------------------------------
+
+
+def test_gate_is_off_by_default(monkeypatch):
+    monkeypatch.delenv("TASKSRUNNER_CHAOS", raising=False)
+    assert chaos_enabled() is False
+    monkeypatch.setenv("TASKSRUNNER_CHAOS", "1")
+    assert chaos_enabled() is True
+
+
+def test_registry_wraps_only_targeted_components():
+    specs = [
+        parse_component({"componentType": "state.in-memory"},
+                        default_name="statestore"),
+        parse_component({"componentType": "state.in-memory"},
+                        default_name="other"),
+    ]
+    # no chaos at all → bare instances (the production path)
+    bare = ComponentRegistry(specs, app_id="app")
+    assert type(bare.get("statestore")) is InMemoryStateStore
+    # chaos naming one component → only that one is wrapped
+    chaotic = ComponentRegistry(specs, app_id="app",
+                                chaos=ChaosPolicies([_flaky_spec()]))
+    assert isinstance(chaotic.get("statestore"), ChaosStateStore)
+    assert type(chaotic.get("other")) is InMemoryStateStore
+
+
+def test_wrap_component_dispatches_by_block():
+    from tasksrunner.bindings.base import BindingResponse, OutputBinding
+
+    class NoopOut(OutputBinding):
+        async def invoke(self, operation, data, metadata=None):
+            return BindingResponse(data=None)
+
+    spec = parse_chaos(chaos_doc(
+        faults={"f": {"error": {"raise": "BindingError"}}},
+        targets={"components": {"outb": {"outbound": ["f"]}}},
+    ))
+    chaos = ChaosPolicies([spec])
+    cspec = parse_component({"componentType": "bindings.noop"},
+                            default_name="outb")
+    wrapped = wrap_component(NoopOut("outb"), cspec, chaos)
+    assert isinstance(wrapped, ChaosOutputBinding)
+    # an untargeted sibling stays bare
+    other = parse_component({"componentType": "bindings.noop"},
+                            default_name="other")
+    inner = NoopOut("other")
+    assert wrap_component(inner, other, chaos) is inner
+
+
+# ---------------------------------------------------------------------------
+# the guarantees: retries, breaker schedule, DLQ drain
+# ---------------------------------------------------------------------------
+
+RETRY_DOC = {
+    "kind": "Resiliency",
+    "metadata": {"name": "r"},
+    "spec": {
+        "policies": {"retries": {"fast": {"duration": "1ms", "maxRetries": 5}}},
+        "targets": {"components": {"statestore": {"retry": "fast"}}},
+    },
+}
+
+
+@pytest.mark.asyncio
+async def test_retries_recover_sub_threshold_errors_no_lost_writes():
+    """A 25% injected error rate sits well under what 5 retries absorb:
+    every write must land, and the retry counters must show the faults
+    were real (injected and retried), not absent."""
+    policies = ChaosPolicies([_flaky_spec(probability=0.25, seed=7)])
+    registry = ComponentRegistry(
+        [parse_component({"componentType": "state.in-memory"},
+                         default_name="statestore")],
+        app_id="app", chaos=policies)
+    runtime = Runtime(
+        "app", registry,
+        resiliency=ResiliencyPolicies([parse_resiliency(RETRY_DOC)],
+                                      app_id="app"))
+    for i in range(40):
+        await runtime.save_state("statestore", [{"key": f"k{i}", "value": i}])
+    injected = metrics.get("chaos_injected_total",
+                           target="components/statestore/outbound",
+                           fault="flaky")
+    assert injected > 0  # the adversary really fired…
+    for i in range(40):  # …and no write was lost
+        item = await runtime.get_state("statestore", f"k{i}")
+        assert item is not None and item.value == i
+
+
+BREAKER_DOC = {
+    "kind": "Resiliency",
+    "metadata": {"name": "r"},
+    "spec": {
+        "policies": {"circuitBreakers": {
+            "cb": {"timeout": "50ms", "trip": "consecutiveFailures >= 2"},
+        }},
+        "targets": {"components": {"statestore": {"circuitBreaker": "cb"}}},
+    },
+}
+
+
+@pytest.mark.asyncio
+async def test_breaker_open_half_open_closed_under_sustained_chaos():
+    """Sustained 100% failure trips the breaker after exactly the trip
+    threshold; while open, calls shed WITHOUT reaching the store; after
+    the documented timeout one probe goes through (half-open) — failing
+    re-opens, succeeding closes — and the state gauge tracks it."""
+    policies = ChaosPolicies([_flaky_spec(probability=1.0)])
+    registry = ComponentRegistry(
+        [parse_component({"componentType": "state.in-memory"},
+                         default_name="statestore")],
+        app_id="app", chaos=policies)
+    runtime = Runtime(
+        "app", registry,
+        resiliency=ResiliencyPolicies([parse_resiliency(BREAKER_DOC)],
+                                      app_id="app"))
+    policies.for_component("statestore")  # populate the lazy injector map
+    injector = policies._injectors[("flaky", "components/statestore/outbound")]
+
+    def gauge():
+        return metrics.get("resiliency_breaker_state",
+                           policy="cb", target="statestore")
+
+    for _ in range(2):  # trip threshold
+        with pytest.raises(OSError):
+            await runtime.get_state("statestore", "k")
+    assert gauge() == 2  # OPEN
+    with pytest.raises(CircuitOpenError):
+        await runtime.get_state("statestore", "k")
+    assert injector.calls == 2  # the shed call never reached the store
+
+    await asyncio.sleep(0.07)  # > breaker timeout → next call probes
+    with pytest.raises(OSError):  # half-open probe fails → re-open
+        await runtime.get_state("statestore", "k")
+    assert injector.calls == 3  # the probe DID go through to the store
+    assert gauge() == 2
+
+    await asyncio.sleep(0.07)
+    policies.disable("flaky")  # fault clears → probe succeeds → closed
+    assert (await runtime.get_state("statestore", "k")) is None
+    assert gauge() == 0
+
+
+@pytest.mark.asyncio
+async def test_poisoned_deliveries_reach_dlq_and_requeue_drains(tmp_path):
+    """Inbound chaos raises in the delivery path, which the broker
+    counts as a nack: redelivery runs, attempts exhaust, the messages
+    dead-letter. Clearing the fault and requeueing drains the DLQ
+    through the normal delivery machinery — nothing is lost."""
+    spec = parse_chaos(chaos_doc(
+        faults={"poison": {"error": {"raise": "PubSubError"}}},
+        targets={"components": {"tp": {"inbound": ["poison"]}}},
+    ))
+    policies = ChaosPolicies([spec])
+    inner = SqliteBroker("tp", tmp_path / "broker.db",
+                         max_attempts=2, retry_delay=0.01, poll_interval=0.01)
+    broker = ChaosPubSubBroker(
+        inner,
+        policies.for_component("tp", "outbound"),
+        policies.for_component("tp", "inbound"))
+    received = []
+
+    async def handler(msg: Message) -> bool:
+        received.append(msg.data["n"])
+        return True
+
+    try:
+        sub = await broker.subscribe("t", "g", handler)
+        for n in range(3):
+            await broker.publish("t", {"n": n})
+        for _ in range(500):
+            if len(inner.dead_letters("t", "g")) == 3:
+                break
+            await asyncio.sleep(0.01)
+        assert len(inner.dead_letters("t", "g")) == 3
+        assert received == []  # chaos fired before the handler every time
+
+        policies.disable("poison")
+        # driver extras pass through the wrapper untouched
+        assert broker.requeue_dead_letters("t", "g") == 3
+        for _ in range(500):
+            if len(received) == 3:
+                break
+            await asyncio.sleep(0.01)
+        assert sorted(received) == [0, 1, 2]
+        assert inner.dead_letters("t", "g") == []
+        assert inner.backlog("t", "g") == 0
+        await sub.cancel()
+    finally:
+        await broker.aclose()
+
+
+# ---------------------------------------------------------------------------
+# invoke seam: app-targeted rules run per attempt inside resiliency
+# ---------------------------------------------------------------------------
+
+
+class CountingChannel:
+    def __init__(self, replies=None):
+        self.calls = 0
+        self.replies = replies
+
+    async def request(self, method, path, *, query="", headers=None, body=b""):
+        self.calls += 1
+        if self.replies:
+            reply = self.replies.pop(0)
+            if isinstance(reply, Exception):
+                raise reply
+        return 200, {}, b"ok"
+
+
+@pytest.mark.asyncio
+async def test_invoke_status_fault_synthesizes_reply_without_reaching_peer():
+    spec = parse_chaos(chaos_doc(
+        faults={"down": {"error": {"status": 503}}},
+        targets={"apps": {"backend": ["down"]}},
+    ))
+    channel = CountingChannel()
+    runtime = Runtime("caller", ComponentRegistry([], app_id="caller"),
+                      chaos=ChaosPolicies([spec], app_id="caller"))
+    runtime.peers["backend"] = channel
+    status, headers, body = await runtime.invoke("backend", "/api/x")
+    assert status == 503
+    assert headers["x-tasksrunner-chaos"] == "injected"
+    assert json.loads(body)["message"].startswith("chaos")
+    assert channel.calls == 0  # synthesized before the wire
+
+
+@pytest.mark.asyncio
+async def test_invoke_raised_fault_is_retried_by_resiliency():
+    """An app-targeted raising fault looks like a transport failure, so
+    the declarative retry policy absorbs it — chaos exercises the real
+    resiliency machinery, per attempt."""
+    spec = parse_chaos(chaos_doc(
+        faults={"flaky": {"crashEveryN": {"n": 2, "raise": "OSError"}}},
+        targets={"apps": {"backend": ["flaky"]}},
+    ))
+    doc = {
+        "kind": "Resiliency", "metadata": {"name": "r"},
+        "spec": {
+            "policies": {"retries": {"fast": {"duration": "1ms",
+                                              "maxRetries": 3}}},
+            "targets": {"apps": {"backend": {"retry": "fast"}}},
+        },
+    }
+    channel = CountingChannel()
+    runtime = Runtime(
+        "caller", ComponentRegistry([], app_id="caller"),
+        resiliency=ResiliencyPolicies([parse_resiliency(doc)], app_id="caller"),
+        chaos=ChaosPolicies([spec], app_id="caller"))
+    runtime.peers["backend"] = channel
+    # attempts 1,3 pass the injector (crash every 2nd), so each invoke
+    # needs at most one retry and always lands
+    for _ in range(4):
+        status, _, _ = await runtime.invoke("backend", "/api/x")
+        assert status == 200
+
+
+# ---------------------------------------------------------------------------
+# satellites: jitter, breaker gauge, timeoutPolicy, inbound delivery path
+# ---------------------------------------------------------------------------
+
+
+def test_retry_jitter_zero_preserves_exact_schedule():
+    import itertools
+    from tasksrunner.resiliency.policy import RetrySpec
+    spec = RetrySpec(policy="exponential", duration=0.5, max_interval=4.0,
+                     max_retries=5)
+    assert list(spec.delays()) == [0.5, 1.0, 2.0, 4.0, 4.0]
+    # jitter is opt-in: the default spec is bit-identical to before
+    assert spec.jitter == 0.0
+
+
+def test_retry_jitter_is_bounded_and_seedable():
+    import random
+    from tasksrunner.resiliency.policy import RetrySpec
+    spec = RetrySpec(policy="exponential", duration=0.1, max_interval=2.0,
+                     max_retries=50, jitter=1.0)
+    a = list(spec.delays(random.Random(42)))
+    b = list(spec.delays(random.Random(42)))
+    assert a == b  # seedable → reproducible
+    # fully-decorrelated delays stay inside [duration, maxInterval]
+    assert all(0.1 <= d <= 2.0 for d in a)
+    assert len(set(round(d, 6) for d in a)) > 5  # actually jittered
+    # a 0.5 blend lands between the deterministic and jittered schedules
+    blend = RetrySpec(policy="constant", duration=0.1, max_interval=2.0,
+                      max_retries=20, jitter=0.5)
+    for d in blend.delays(random.Random(1)):
+        assert 0.1 * 0.5 + 0.1 * 0.5 <= d <= 0.5 * 0.1 + 0.5 * 2.0
+
+
+def test_retry_jitter_parses_and_validates():
+    doc = {
+        "kind": "Resiliency", "metadata": {"name": "r"},
+        "spec": {"policies": {"retries": {
+            "j": {"duration": "100ms", "maxRetries": 3, "jitter": 0.8},
+        }}},
+    }
+    assert parse_resiliency(doc).retries["j"].jitter == pytest.approx(0.8)
+    doc["spec"]["policies"]["retries"]["j"]["jitter"] = 1.5
+    with pytest.raises(ComponentError, match="jitter"):
+        parse_resiliency(doc)
+
+
+def test_breaker_state_gauge_tracks_transitions():
+    from tasksrunner.resiliency.policy import CircuitBreaker, CircuitBreakerSpec
+    cb = CircuitBreaker(
+        CircuitBreakerSpec(name="g", trip_threshold=2, timeout=0.01),
+        target="gauge-target")
+
+    def gauge():
+        return metrics.get("resiliency_breaker_state",
+                           policy="g", target="gauge-target")
+
+    assert gauge() == 0  # closed at birth
+    cb.record_failure()
+    cb.record_failure()
+    assert gauge() == 2  # open
+    time.sleep(0.02)
+    cb.before_call()  # timeout elapsed → half-open probe admitted
+    assert gauge() == 1
+    cb.record_success()
+    assert gauge() == 0
+
+
+@pytest.mark.asyncio
+async def test_retry_counters_from_execute():
+    from tasksrunner.resiliency.policy import RetrySpec, TargetPolicy
+    policy = TargetPolicy(target="ctr-target",
+                          retry=RetrySpec(duration=0.001, max_retries=2))
+    calls = 0
+
+    async def flaky():
+        nonlocal calls
+        calls += 1
+        if calls < 3:
+            raise OSError("transient")
+        return "ok"
+
+    r0 = metrics.get("resiliency_retry_total", target="ctr-target")
+    assert await policy.execute(flaky) == "ok"
+    assert metrics.get("resiliency_retry_total", target="ctr-target") - r0 == 2
+
+    calls = -100  # never recovers → retries exhaust
+    e0 = metrics.get("resiliency_retry_exhausted_total", target="ctr-target")
+    with pytest.raises(OSError):
+        await policy.execute(flaky)
+    assert metrics.get("resiliency_retry_exhausted_total",
+                       target="ctr-target") - e0 == 1
+
+
+def test_timeout_policy_parses_and_validates():
+    doc = {
+        "kind": "Resiliency", "metadata": {"name": "r"},
+        "spec": {
+            "policies": {"timeouts": {"slow": "200ms"}},
+            "targets": {"components": {"s": {
+                "outbound": {"timeout": "slow", "timeoutPolicy": "total"},
+            }}},
+        },
+    }
+    pol = ResiliencyPolicies([parse_resiliency(doc)]).for_component("s")
+    assert pol.timeout_policy == "total"
+    assert pol.timeout == pytest.approx(0.2)
+    doc["spec"]["targets"]["components"]["s"]["outbound"]["timeoutPolicy"] = "sometimes"
+    with pytest.raises(ComponentError, match="timeoutPolicy"):
+        parse_resiliency(doc)
+
+
+@pytest.mark.asyncio
+async def test_timeout_policy_total_is_a_budget_across_attempts():
+    """perAttempt (historical default) restarts the clock every try;
+    total is an overall budget covering attempts AND backoff sleeps."""
+    from tasksrunner.resiliency.policy import RetrySpec, TargetPolicy
+
+    async def always_failing():
+        await asyncio.sleep(0.02)
+        raise OSError("down")
+
+    total = TargetPolicy(
+        target="t", timeout=0.08, timeout_policy="total",
+        retry=RetrySpec(duration=0.02, max_retries=50))
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="total budget"):
+        await total.execute(always_failing)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 0.5  # ~50 attempts * 40ms would be ~2s without the budget
+
+    # the same policy perAttempt keeps retrying well past 80ms
+    per_attempt = TargetPolicy(
+        target="t", timeout=0.08, timeout_policy="perAttempt",
+        retry=RetrySpec(duration=0.02, max_retries=5))
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        await per_attempt.execute(always_failing)
+    assert time.monotonic() - t0 > 0.12  # 6 attempts * 20ms + sleeps
+
+
+@pytest.mark.asyncio
+async def test_timeout_policy_total_caps_a_hanging_call():
+    from tasksrunner.resiliency.policy import TargetPolicy
+
+    async def hangs():
+        await asyncio.sleep(60)
+
+    policy = TargetPolicy(target="t", timeout=0.05, timeout_policy="total")
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        await policy.execute(hangs)
+    assert time.monotonic() - t0 < 1.0
+
+
+class FlakyThenOkChannel:
+    """App channel that fails the first N deliveries with a transport
+    error, then answers 200 — the shape of an app mid-restart."""
+
+    def __init__(self, failures=2):
+        self.calls = 0
+        self.failures = failures
+
+    async def request(self, method, path, *, query="", headers=None, body=b""):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise OSError("app not up yet")
+        return 200, {}, b"ok"
+
+
+INBOUND_DOC = {
+    "kind": "Resiliency", "metadata": {"name": "r"},
+    "spec": {
+        "policies": {"retries": {"fast": {"duration": "1ms", "maxRetries": 5}}},
+        "targets": {"components": {"tp": {"inbound": {"retry": "fast"}}}},
+    },
+}
+
+
+@pytest.mark.asyncio
+async def test_inbound_policy_retries_subscription_delivery():
+    """The inbound direction of a component target guards the
+    sidecar→app hop: a transiently-failing handler is retried locally
+    and the delivery still acks — it never counts as a nack."""
+    channel = FlakyThenOkChannel(failures=2)
+    resiliency = ResiliencyPolicies([parse_resiliency(INBOUND_DOC)],
+                                    app_id="app")
+    runtime = Runtime("app", ComponentRegistry([], app_id="app"),
+                      app_channel=channel, resiliency=resiliency)
+    # direction separation: inbound config must not leak outbound
+    assert resiliency.for_component("tp", "outbound") is None
+    assert resiliency.for_component("tp", "inbound") is not None
+
+    deliver = runtime._make_subscription_handler("tp", "/on")
+    ok = await deliver(Message(id="m1", topic="t", data={"n": 1}))
+    assert ok is True
+    assert channel.calls == 3  # two retries absorbed the failures
+
+
+@pytest.mark.asyncio
+async def test_inbound_policy_retries_binding_delivery():
+    from tasksrunner.bindings.base import BindingEvent, InputBinding
+
+    class Stub(InputBinding):
+        async def start(self, sink):  # pragma: no cover - not started here
+            pass
+
+        async def stop(self):  # pragma: no cover
+            pass
+
+    doc = {
+        "kind": "Resiliency", "metadata": {"name": "r"},
+        "spec": {
+            "policies": {"retries": {"fast": {"duration": "1ms",
+                                              "maxRetries": 5}}},
+            "targets": {"components": {"inq": {"inbound": {"retry": "fast"}}}},
+        },
+    }
+    channel = FlakyThenOkChannel(failures=1)
+    runtime = Runtime(
+        "app", ComponentRegistry([], app_id="app"), app_channel=channel,
+        resiliency=ResiliencyPolicies([parse_resiliency(doc)], app_id="app"))
+    sink = runtime._make_binding_sink(Stub("inq"))
+    ok = await sink(BindingEvent(binding="inq", data={"n": 1}, metadata={}))
+    assert ok is True
+    assert channel.calls == 2
+
+
+@pytest.mark.asyncio
+async def test_inbound_retries_exhausted_still_nacks():
+    """When the app stays down past the retry budget the delivery must
+    report False (nack) so the broker's redelivery/DLQ machinery — not
+    the inbound policy — owns the message's fate."""
+    channel = FlakyThenOkChannel(failures=99)
+    runtime = Runtime(
+        "app", ComponentRegistry([], app_id="app"), app_channel=channel,
+        resiliency=ResiliencyPolicies([parse_resiliency(INBOUND_DOC)],
+                                      app_id="app"))
+    deliver = runtime._make_subscription_handler("tp", "/on")
+    ok = await deliver(Message(id="m1", topic="t", data={"n": 1}))
+    assert ok is False
+    assert channel.calls == 6  # 1 + 5 retries, then gave up
+
+
+# ---------------------------------------------------------------------------
+# CLI admin surface
+# ---------------------------------------------------------------------------
+
+CHAOS_YAML = """\
+kind: Chaos
+metadata: {name: cli-chaos}
+spec:
+  seed: 9
+  faults:
+    flaky: {error: {probability: 0.2, raise: OSError}}
+  targets:
+    components:
+      statestore: {outbound: [flaky]}
+"""
+
+
+def test_cli_chaos_status_gate_off_warns_and_exits_3(tmp_path, capsys,
+                                                     monkeypatch):
+    from tasksrunner.cli import main
+    monkeypatch.delenv("TASKSRUNNER_CHAOS", raising=False)
+    (tmp_path / "chaos.yaml").write_text(CHAOS_YAML)
+    with pytest.raises(SystemExit) as err:
+        main(["chaos", "status", "--resources", str(tmp_path)])
+    assert err.value.code == 3  # scriptable "documents present but inert"
+    out = capsys.readouterr().out
+    assert "flaky" in out and "statestore" in out
+    assert "TASKSRUNNER_CHAOS=1" in out
+
+
+def test_cli_chaos_status_json(tmp_path, capsys, monkeypatch):
+    from tasksrunner.cli import main
+    monkeypatch.setenv("TASKSRUNNER_CHAOS", "1")
+    (tmp_path / "chaos.yaml").write_text(CHAOS_YAML)
+    main(["chaos", "status", "--resources", str(tmp_path), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["enabled"] is True
+    assert payload["documents"] == 1
+    assert payload["rules"][0]["rule"] == "flaky"
+    assert payload["rules"][0]["targets"] == ["components/statestore/outbound"]
+
+
+def test_cli_chaos_status_rejects_malformed_documents(tmp_path, monkeypatch):
+    from tasksrunner.cli import main
+    (tmp_path / "chaos.yaml").write_text(
+        "kind: Chaos\nspec:\n  targets:\n    components:\n"
+        "      s: {outbound: [nope]}\n")
+    with pytest.raises(SystemExit, match="unknown fault rule"):
+        main(["chaos", "status", "--resources", str(tmp_path)])

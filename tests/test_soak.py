@@ -131,3 +131,90 @@ async def test_no_per_message_memory_retention_bounded(tmp_path):
 async def test_no_per_message_memory_retention(tmp_path):
     """The full-size opt-in soak (release checks, leak hunts)."""
     await _retention_probe(tmp_path, warmup=1000, measured=5000)
+
+
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_tasks_pipeline_converges_despite_faulty_broker(
+        tmp_path, monkeypatch):
+    """Chaos soak (tasksrunner/chaos): the tasks-tracker-shaped pipeline
+    — publish → subscribe → per-task state write — converges to exactly
+    the published task set even when ~10% of deliveries fail with an
+    injected broker-side fault. Redelivery absorbs the faults; nothing
+    is lost, nothing is processed into a wrong state.
+
+    The scenario is fully deterministic: the injector PRNG is seeded, so
+    a failure here reproduces bit-for-bit on every run.
+    """
+    from tasksrunner.chaos import parse_chaos
+    from tasksrunner.observability.metrics import metrics
+
+    monkeypatch.setenv("TASKSRUNNER_CHAOS", "1")
+    total = 300
+    specs = [
+        parse_component({
+            "componentType": "pubsub.sqlite",
+            "metadata": [
+                {"name": "brokerPath", "value": str(tmp_path / "broker.db")},
+                {"name": "pollIntervalSeconds", "value": "0.002"},
+                {"name": "retryDelaySeconds", "value": "0.01"},
+                # enough redelivery budget that a 10% fault rate cannot
+                # plausibly exhaust it (p(dead-letter) = 0.1^6 per msg)
+                {"name": "maxRetries", "value": "6"},
+            ]}, default_name="taskspubsub"),
+        parse_component({"componentType": "state.in-memory"},
+                        default_name="statestore"),
+    ]
+    chaos = parse_chaos({
+        "kind": "Chaos",
+        "metadata": {"name": "soak-chaos"},
+        "spec": {
+            "seed": 1337,
+            "faults": {"flakyBroker": {
+                "error": {"probability": 0.1, "raise": "PubSubError"}}},
+            "targets": {"components": {
+                "taskspubsub": {"inbound": ["flakyBroker"]}}},
+        },
+    })
+
+    done = asyncio.Event()
+    seen: dict[str, int] = {}
+    app = App("processor")
+
+    @app.subscribe(pubsub="taskspubsub", topic="tasks", route="/on-task")
+    async def on_task(req):
+        task_id = req.data["taskId"]
+        # redelivery makes at-least-once visible: count arrivals, store once
+        seen[task_id] = seen.get(task_id, 0) + 1
+        await app.client.save_state("statestore", task_id, req.data)
+        if len(seen) >= total:
+            done.set()
+        return 200
+
+    pub = App("frontend")
+    cluster = InProcCluster(specs, chaos_specs=[chaos])
+    cluster.add_app(app)
+    cluster.add_app(pub)
+    await cluster.start()
+    try:
+        assert cluster.chaos is not None  # the gate really is on
+        client = cluster.client("frontend")
+        for i in range(total):
+            await client.publish_event(
+                "taskspubsub", "tasks", {"taskId": f"task-{i}", "n": i})
+        await asyncio.wait_for(done.wait(), timeout=120)
+        # convergence: every published task landed in the store exactly
+        # under its own key, despite the injected failures
+        runtime = cluster.runtimes["processor"]
+        for i in range(total):
+            item = await runtime.get_state("statestore", f"task-{i}")
+            assert item is not None and item.value["n"] == i
+        injected = metrics.get(
+            "chaos_injected_total",
+            target="components/taskspubsub/inbound", fault="flakyBroker")
+        assert injected > 0  # the adversary genuinely interfered
+        # ~10% of ~total+injected deliveries failed → redeliveries ≈ injected
+        redelivered = sum(seen.values()) - len(seen)
+        assert redelivered <= injected  # every extra arrival traces to a fault
+    finally:
+        await cluster.stop()
