@@ -1,9 +1,14 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test soak bench bench-state chaos sweep-flash run validate docs-serve docs-build clean
+.PHONY: test lint-metrics soak bench bench-state bench-hist chaos sweep-flash run validate docs-serve docs-build clean
 
-test:
+test: lint-metrics
 	python -m pytest tests/ -q
+
+# every metrics.inc/set_gauge/observe site must use a name declared in
+# tasksrunner/observability/names.py — catches series-forking typos
+lint-metrics:
+	python scripts/check_metrics.py
 
 soak:
 	TASKSRUNNER_SOAK=1 python -m pytest tests/test_soak.py -q
@@ -16,6 +21,11 @@ bench:
 # one-commit-per-call path, plus the read cache — seconds, not minutes
 bench-state:
 	python bench.py --state-bench
+
+# histogram hot-path cost: histograms-on vs -off on the write-heavy
+# state path and the publish/deliver path (must stay < 3%)
+bench-hist:
+	python bench.py --hist-bench
 
 # chaos verification: the deterministic fault-injection harness, the
 # faulty-broker convergence soak, and the proof that the disabled gate

@@ -33,6 +33,9 @@ Also reported (in the final line's ``extras``):
   alone: write-heavy concurrent upserts through the group-commit queue
   vs the seed one-commit-per-call path in the same run, plus read-heavy
   point gets with and without the write-through LRU read cache;
+* ``histogram_overhead`` — the latency-histogram instrumentation
+  measured on vs off (``TASKSRUNNER_HISTOGRAMS=0``) on the write-heavy
+  state path and the publish/deliver path (must stay <3%);
 * a 5-replica competing-consumer throughput figure (KEDA-style
   scale-out semantics, SURVEY.md §5.8);
 * the in-process cluster number (continuity with round 1);
@@ -753,6 +756,134 @@ async def run_chaos_overhead_bench(n_ops: int = 12000, *, concurrency: int = 64,
     }
 
 
+async def run_histogram_overhead_bench(n_ops: int = 12000, *,
+                                       concurrency: int = 64,
+                                       rounds: int = 5, n_keys: int = 512,
+                                       n_msgs: int = 3000) -> dict:
+    """``histogram_overhead``: the latency-histogram instrumentation's
+    hot-path cost, measured through the real instrumented layers.
+
+    Two paths, each measured with ``TASKSRUNNER_HISTOGRAMS`` on and off
+    (the flag ``metrics.observe`` gates on):
+
+    * write-heavy state: ``Runtime.save_state`` through the group-commit
+      sqlite store — pays the per-op ``state_op_latency_seconds``
+      observe plus the per-row queue-wait / per-batch commit observes
+      inside the store;
+    * publish/deliver: ``Runtime.publish`` through the real broker write
+      queue plus the subscription handler delivering to a null app
+      channel — pays ``publish_latency_seconds`` and
+      ``delivery_latency_seconds``.
+
+    on/off alternate order each round and the overhead is the median of
+    PAIRED per-round ratios (the chaos bench's methodology), so whole-
+    round host noise cancels out of the number.
+    """
+    from tasksrunner.component.registry import ComponentRegistry
+    from tasksrunner.component.spec import parse_component
+    from tasksrunner.observability.metrics import metrics
+    from tasksrunner.pubsub.base import Message
+    from tasksrunner.runtime import Runtime
+
+    tmp = tempfile.mkdtemp(prefix="tasksrunner-bench-hist-")
+    keys = [f"k{i}" for i in range(n_keys)]
+
+    class _NullChannel:
+        async def request(self, method, path, query="", headers=None,
+                          body=b""):
+            return 200, {}, b"{}"
+
+        async def close(self):
+            pass
+
+    registry = ComponentRegistry(
+        [parse_component({
+            "componentType": "state.sqlite",
+            "metadata": [{"name": "databasePath",
+                          "value": f"{tmp}/state.db"}],
+        }, default_name="statestore"),
+         parse_component({
+            "componentType": "pubsub.sqlite",
+            "metadata": [{"name": "brokerPath",
+                          "value": f"{tmp}/broker.db"}],
+        }, default_name="taskspubsub")],
+        app_id="bench")
+    runtime = Runtime("bench", registry, app_channel=_NullChannel())
+    deliver = runtime._make_subscription_handler(
+        "taskspubsub", "/api/bench/tasksaved")
+
+    async def save_rate(n: int) -> float:
+        per_worker = n // concurrency
+
+        async def worker(w: int) -> None:
+            base = w * per_worker
+            for i in range(base, base + per_worker):
+                await runtime.save_state("statestore", [
+                    {"key": keys[i % len(keys)],
+                     "value": {"taskId": f"t{i}", "n": i}}])
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker(w) for w in range(concurrency)))
+        return (per_worker * concurrency) / (time.perf_counter() - t0)
+
+    async def pubsub_rate(n: int) -> float:
+        per_worker = n // concurrency
+
+        async def worker(w: int) -> None:
+            base = w * per_worker
+            for i in range(base, base + per_worker):
+                await runtime.publish("taskspubsub", "tasksaved", {"n": i})
+                await deliver(Message(id=f"m{w}-{i}", topic="tasksaved",
+                                      data={"n": i}))
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker(w) for w in range(concurrency)))
+        # each iteration is one publish + one delivery
+        return (2 * per_worker * concurrency) / (time.perf_counter() - t0)
+
+    configs = [("hist_on", True), ("hist_off", False)]
+    rates: dict[str, dict[str, list[float]]] = {
+        "state": {name: [] for name, _ in configs},
+        "pubsub": {name: [] for name, _ in configs},
+    }
+    was_enabled = metrics.histograms_enabled
+    try:
+        await save_rate(max(200, n_ops // 4))  # warmup round, discarded
+        await pubsub_rate(max(200, n_msgs // 4))
+        for r in range(rounds):
+            for name, enabled in (configs if r % 2 == 0
+                                  else list(reversed(configs))):
+                metrics.histograms_enabled = enabled
+                rates["state"][name].append(await save_rate(n_ops))
+                rates["pubsub"][name].append(await pubsub_rate(n_msgs))
+    finally:
+        metrics.histograms_enabled = was_enabled
+        await runtime.stop()
+
+    def section(path: str) -> dict:
+        med = {name: statistics.median(rs)
+               for name, rs in rates[path].items()}
+        per_round = [1.0 - rates[path]["hist_on"][r] / rates[path]["hist_off"][r]
+                     for r in range(rounds)]
+        return {
+            "hist_on_ops_per_sec": round(med["hist_on"], 1),
+            "hist_off_ops_per_sec": round(med["hist_off"], 1),
+            "overhead_pct": round(statistics.median(per_round) * 100.0, 2),
+        }
+
+    return {
+        "state_write": section("state"),
+        "publish_deliver": section("pubsub"),
+        "concurrency": concurrency,
+        "note": "histograms-on vs TASKSRUNNER_HISTOGRAMS=0 through the "
+                "real instrumented layers (Runtime + group-commit store "
+                "+ broker write queue + subscription delivery); paired "
+                "per-round ratios with alternating order, median of "
+                f"{rounds} rounds — the acceptance bar is <3% on both "
+                "paths",
+    }
+
+
 # ---------------------------------------------------------------------------
 # optional: ML-extension step time on the real chip (EXTENSION ONLY)
 # ---------------------------------------------------------------------------
@@ -982,6 +1113,11 @@ def main() -> None:
                         help="run ONLY the chaos-overhead section "
                              "(`make chaos`): proves the disabled gate "
                              "adds <1%% to the write-heavy state path")
+    parser.add_argument("--hist-bench", action="store_true",
+                        help="run ONLY the histogram-overhead section "
+                             "(`make bench-hist`): histograms-on vs -off "
+                             "on the write-heavy state path and the "
+                             "publish/deliver path (<3%% bar)")
     args = parser.parse_args()
 
     if args.tpu_bench:
@@ -1010,6 +1146,17 @@ def main() -> None:
         print(json.dumps({"chaos_overhead": chaos_overhead}))
         return
 
+    if args.hist_bench:
+        _log("histogram overhead (state write + publish/deliver) ...")
+        hist_overhead = asyncio.run(run_histogram_overhead_bench())
+        s, p = hist_overhead["state_write"], hist_overhead["publish_deliver"]
+        _log(f"  -> state write {s['hist_on_ops_per_sec']} ops/s on vs "
+             f"{s['hist_off_ops_per_sec']} off ({s['overhead_pct']:+.2f}%), "
+             f"publish/deliver {p['hist_on_ops_per_sec']} ops/s on vs "
+             f"{p['hist_off_ops_per_sec']} off ({p['overhead_pct']:+.2f}%)")
+        print(json.dumps({"histogram_overhead": hist_overhead}))
+        return
+
     if args.worker:
         profile_dir = os.environ.get("BENCH_PROFILE_DIR")
         if profile_dir:
@@ -1031,7 +1178,7 @@ def main() -> None:
     # the chip section runs FIRST: it is the scarcest measurement (the
     # tunnel has documented multi-hour outages) and must not queue
     # behind minutes of CPU benches that could overlap an outage window
-    _log("bench 1/7: ML-extension train step on the attached chip ...")
+    _log("bench 1/8: ML-extension train step on the attached chip ...")
     # belt over braces: the section is internally fault-tolerant, but
     # it also runs FIRST now — nothing it could raise may be allowed
     # to cost the CPU sections their numbers
@@ -1050,7 +1197,7 @@ def main() -> None:
     # the component the e2e write path bottlenecks on, measured alone —
     # and the seed write path measured in the SAME run, so the group-
     # commit speedup is a same-host apples-to-apples figure
-    _log("bench 2/7: state-store ops/s (group-commit write queue) ...")
+    _log("bench 2/8: state-store ops/s (group-commit write queue) ...")
     state_ops = asyncio.run(run_state_bench())
     _log(f"  -> write-heavy {state_ops['write_heavy']['ops_per_sec']} ops/s "
          f"({state_ops['write_heavy']['speedup']}x vs pre-change), "
@@ -1059,13 +1206,22 @@ def main() -> None:
 
     # the chaos gate's "free when off" claim, measured on the same
     # write-heavy path (docs/modules/16-chaos.md quotes this number)
-    _log("bench 3/7: chaos-gate overhead on the write-heavy state path ...")
+    _log("bench 3/8: chaos-gate overhead on the write-heavy state path ...")
     chaos_overhead = asyncio.run(run_chaos_overhead_bench())
     _log(f"  -> gate-off {chaos_overhead['gate_off_overhead_pct']:+.2f}% vs "
          f"baseline {chaos_overhead['baseline_ops_per_sec']} ops/s, "
          f"wrapped-idle {chaos_overhead['wrapped_idle_overhead_pct']:+.2f}%")
 
-    _log("bench 4/7: cross-process write path (faithful [PB] topology) ...")
+    # the latency-histogram instrumentation's "free when off, cheap when
+    # on" claim on the same two hot paths (docs/modules/08 quotes this)
+    _log("bench 4/8: histogram overhead (state write + publish/deliver) ...")
+    hist_overhead = asyncio.run(run_histogram_overhead_bench())
+    _hs = hist_overhead["state_write"]
+    _hp = hist_overhead["publish_deliver"]
+    _log(f"  -> state write {_hs['overhead_pct']:+.2f}%, "
+         f"publish/deliver {_hp['overhead_pct']:+.2f}% (bar <3%)")
+
+    _log("bench 5/8: cross-process write path (faithful [PB] topology) ...")
     xproc = asyncio.run(run_xproc(latency_probe=True, rounds=5))
     _log(f"  -> {xproc['throughput']} tasks/s, "
          f"p50 {xproc['p50_ms']} ms, p99 {xproc['p99_ms']} ms (conc=8)")
@@ -1074,7 +1230,7 @@ def main() -> None:
     # workload certs, every peer hop on the authenticated mesh lane —
     # module 15 quotes this delta instead of recommending an unmeasured
     # configuration
-    _log("bench 5/7: cross-process write path under mesh mTLS ...")
+    _log("bench 6/8: cross-process write path under mesh mTLS ...")
     # same rounds as the plaintext headline — an asymmetric pair would
     # bake an ordering/averaging confound into the published delta
     mtls = asyncio.run(run_xproc(latency_probe=True, rounds=5,
@@ -1089,7 +1245,7 @@ def main() -> None:
     # reference processor's SendGrid call) consumers are the
     # bottleneck; 5 competing replicas vs 1 shows the KEDA-style
     # scale-out actually scaling (SURVEY.md §5.8)
-    _log("bench 6/7: competing-consumer scale-out (20 ms work/message) ...")
+    _log("bench 7/8: competing-consumer scale-out (20 ms work/message) ...")
     one = asyncio.run(run_xproc(n_tasks=300, n_processors=1, rounds=2,
                                 work_ms=20.0))
     five = asyncio.run(run_xproc(n_tasks=300, n_processors=5, rounds=2,
@@ -1098,7 +1254,7 @@ def main() -> None:
     _log(f"  -> 1 replica: {one['throughput']} tasks/s; "
          f"5 replicas: {five['throughput']} tasks/s ({speedup}x)")
 
-    _log("bench 7/7: in-process cluster (round-1 continuity) ...")
+    _log("bench 8/8: in-process cluster (round-1 continuity) ...")
     inproc = asyncio.run(run_inproc())
     _log(f"  -> {inproc} tasks/s")
 
@@ -1155,6 +1311,7 @@ def main() -> None:
             "inproc_tasks_per_sec": inproc,
             "state_ops_per_sec": state_ops,
             "chaos_overhead": chaos_overhead,
+            "histogram_overhead": hist_overhead,
             "ml_extension_tpu": tpu,
             **({} if tpu else {"ml_extension_note":
                 "chip bench skipped (no TPU reachable within the "

@@ -231,13 +231,18 @@ def _cmd_deploy(args) -> None:
 
 def _cmd_traces(args) -> None:
     import pathlib
+    import sys
 
     from tasksrunner.observability.spans import list_traces, service_map, trace_spans
 
     db = args.db
-    if not pathlib.Path(db).is_file():
-        raise SystemExit(f"no trace database at {db} "
-                         "(services record to .tasksrunner/traces.db by default)")
+    if not db or not pathlib.Path(db).is_file():
+        # exit 2 = "nothing to inspect", distinct from a failed query
+        # against a real database (and never a raw sqlite traceback)
+        print(f"no trace database at {db or '(unset)'} "
+              "(services record to .tasksrunner/traces.db by default)",
+              file=sys.stderr)
+        raise SystemExit(2)
 
     if args.action == "list":
         rows = list_traces(db, limit=args.limit)
@@ -675,31 +680,149 @@ def _cmd_secret(args) -> None:
     _sidecar_request(args, "GET", f"secrets/{args.store}/{args.key}")
 
 
-def _cmd_metrics(args) -> None:
-    """An app's counters from its sidecar metadata (≙ the App
-    Insights metrics view, SURVEY §5.5): invokes, state ops,
-    publishes, deliveries — per label."""
+def _fetch_metadata(url: str, headers: dict, app_id: str) -> dict:
     import json as json_mod
     import urllib.error
     import urllib.request
+
+    req = urllib.request.Request(url, headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json_mod.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        hint = (" (set TASKSRUNNER_API_TOKEN — the sidecar requires it)"
+                if exc.code == 401 else "")
+        raise SystemExit(f"sidecar of {app_id!r} returned "
+                         f"HTTP {exc.code}{hint}")
+    except OSError as exc:
+        raise SystemExit(f"cannot reach sidecar of {app_id!r}: {exc}")
+
+
+def _fetch_all_replica_metadata(args) -> list[dict]:
+    """Metadata from EVERY registered replica of the app — the
+    percentile/exemplar views must merge the whole app, not sample
+    whichever replica the round-robin resolver lands on."""
+    import os
+
+    from tasksrunner.errors import AppNotFound
+    from tasksrunner.invoke.resolver import NameResolver
+    from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER
+
+    resolver = NameResolver(registry_file=args.registry_file)
+    try:
+        addrs = resolver.resolve_all(args.app_id)
+    except AppNotFound:
+        addrs = []
+    if not addrs:
+        known = ", ".join(resolver.known_apps()) or "(none registered)"
+        raise SystemExit(
+            f"app {args.app_id!r} is not registered; running apps: {known}")
+    headers = {}
+    token = os.environ.get(TOKEN_ENV)
+    if token:
+        headers[TOKEN_HEADER] = token
+    payloads = []
+    for addr in addrs:
+        try:
+            payloads.append(_fetch_metadata(
+                f"{addr.base_url}/v1.0/metadata", headers, args.app_id))
+        except SystemExit:
+            continue  # a dead replica must not fail the merged view
+    if not payloads:
+        raise SystemExit(f"no reachable replica of {args.app_id!r}")
+    return payloads
+
+
+def _metrics_percentiles(args) -> None:
+    import json as json_mod
+
+    from tasksrunner.observability.metrics import (
+        merge_histogram_snapshots,
+        summarize_histograms,
+    )
+
+    payloads = _fetch_all_replica_metadata(args)
+    merged = merge_histogram_snapshots(
+        p.get("histograms") or {} for p in payloads)
+    rows = summarize_histograms(merged)
+    if args.json:
+        print(json_mod.dumps(
+            {"replicas": len(payloads), "percentiles": rows}, indent=2))
+        return
+    if not rows:
+        print(f"no latency histograms recorded for {args.app_id} "
+              "(is TASKSRUNNER_HISTOGRAMS=0 set?)")
+        return
+    print(f"# merged across {len(payloads)} replica(s); values in ms")
+    name_of = lambda r: (  # noqa: E731
+        r["name"] + ("{" + ",".join(
+            f"{k}={v}" for k, v in sorted(r["labels"].items())) + "}"
+            if r["labels"] else ""))
+    width = max(len(name_of(r)) for r in rows)
+    print(f"{'series':<{width}}  {'count':>7}  {'p50':>8}  {'p95':>8}  {'p99':>8}")
+    for r in rows:
+        print(f"{name_of(r):<{width}}  {r['count']:>7}  "
+              f"{r['p50'] * 1000:>8.2f}  {r['p95'] * 1000:>8.2f}  "
+              f"{r['p99'] * 1000:>8.2f}")
+
+
+def _metrics_slow(args) -> None:
+    import json as json_mod
+
+    from tasksrunner.observability.metrics import merge_histogram_snapshots
+
+    payloads = _fetch_all_replica_metadata(args)
+    merged = merge_histogram_snapshots(
+        p.get("histograms") or {} for p in payloads)
+    hits = []
+    for name, hist in sorted(merged.items()):
+        if args.slow not in name:
+            continue
+        for series in hist["series"]:
+            for trace_id, value, when in series.get("exemplars", ()):
+                hits.append({"name": name, "labels": series["labels"],
+                             "trace_id": trace_id, "seconds": value,
+                             "time": when})
+    hits.sort(key=lambda h: h["seconds"], reverse=True)
+    if args.json:
+        print(json_mod.dumps(
+            {"replicas": len(payloads), "slow": hits}, indent=2))
+        return
+    if not hits:
+        print(f"no slow-call exemplars matching {args.slow!r} "
+              "(observations must exceed TASKSRUNNER_SLOW_THRESHOLD_SECONDS, "
+              "default 0.25, inside a trace)")
+        return
+    print(f"# slowest observations matching {args.slow!r} "
+          f"across {len(payloads)} replica(s)")
+    for h in hits:
+        tag = ",".join(f"{k}={v}" for k, v in sorted(h["labels"].items()))
+        print(f"{h['seconds'] * 1000:9.1f} ms  {h['name']}"
+              f"{'{' + tag + '}' if tag else ''}  trace {h['trace_id']}")
+    print(f"# drill down: tasksrunner traces show {hits[0]['trace_id']}")
+
+
+def _cmd_metrics(args) -> None:
+    """An app's counters from its sidecar metadata (≙ the App
+    Insights metrics view, SURVEY §5.5): invokes, state ops,
+    publishes, deliveries — per label. ``--percentiles`` and
+    ``--slow`` merge latency histograms/exemplars across every
+    replica."""
+    import json as json_mod
 
     args.app_id = args.app_id or args.app_id_pos
     if not args.app_id:
         raise SystemExit("metrics: an app id is required "
                          "(tasksrunner metrics <app-id>)")
+    if getattr(args, "percentiles", False):
+        _metrics_percentiles(args)
+        return
+    if getattr(args, "slow", None):
+        _metrics_slow(args)
+        return
     addr, headers = _resolve_sidecar(args)
-    req = urllib.request.Request(f"{addr.base_url}/v1.0/metadata",
-                                 headers=headers)
-    try:
-        with urllib.request.urlopen(req, timeout=10) as resp:
-            meta = json_mod.loads(resp.read())
-    except urllib.error.HTTPError as exc:
-        hint = (" (set TASKSRUNNER_API_TOKEN — the sidecar requires it)"
-                if exc.code == 401 else "")
-        raise SystemExit(f"sidecar of {args.app_id!r} returned "
-                         f"HTTP {exc.code}{hint}")
-    except OSError as exc:
-        raise SystemExit(f"cannot reach sidecar of {args.app_id!r}: {exc}")
+    meta = _fetch_metadata(f"{addr.base_url}/v1.0/metadata", headers,
+                           args.app_id)
     metrics = meta.get("metrics") or {}
     if args.json:
         print(json_mod.dumps(metrics, indent=2))
@@ -1177,6 +1300,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("app_id_pos", nargs="?", default=None, metavar="app_id")
     p.add_argument("--app-id", dest="app_id", default=None)
     p.add_argument("--json", action="store_true")
+    p.add_argument("--percentiles", action="store_true",
+                   help="p50/p95/p99 latency per histogram series, merged "
+                        "across every replica of the app")
+    p.add_argument("--slow", default=None, metavar="NAME",
+                   help="trace exemplars behind the latency tail: slow "
+                        "observations of histograms matching NAME, with "
+                        "trace ids for `tasksrunner traces show`")
     p.add_argument("--registry-file", **registry_arg)
     p.set_defaults(fn=_cmd_metrics)
 

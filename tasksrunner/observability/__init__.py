@@ -1,6 +1,14 @@
 from tasksrunner.observability.tracing import TraceContext, current_trace, trace_scope
 from tasksrunner.observability.logging import configure_logging, service_logger
-from tasksrunner.observability.metrics import MetricsRegistry, metrics
+from tasksrunner.observability.metrics import (
+    Histogram,
+    MetricsRegistry,
+    estimate_percentile,
+    merge_histogram_snapshots,
+    metrics,
+    render_prometheus,
+)
+from tasksrunner.observability.probes import EventLoopLagProbe
 
 __all__ = [
     "TraceContext",
@@ -8,6 +16,11 @@ __all__ = [
     "trace_scope",
     "configure_logging",
     "service_logger",
+    "Histogram",
     "MetricsRegistry",
     "metrics",
+    "estimate_percentile",
+    "merge_histogram_snapshots",
+    "render_prometheus",
+    "EventLoopLagProbe",
 ]

@@ -1,54 +1,529 @@
-"""In-process metrics counters/gauges, exported via the sidecar's
-``/v1.0/metadata`` route.
+"""In-process metrics: counters, gauges, and latency histograms.
 
-The reference's metrics (CPU/memory/replica counts, request rates) come
-from the platform + App Insights (SURVEY.md §5.5); the framework-level
-equivalents here are request/publish/delivery counters every sidecar
-maintains, which the orchestrator and autoscaler read.
+The reference's metrics (CPU/memory/replica counts, request rates,
+percentile latencies) come from the platform + App Insights (SURVEY.md
+§5.5); the framework-level equivalents here are maintained per sidecar
+process and exported three ways:
+
+* raw counters/gauges + histogram bucket arrays via ``/v1.0/metadata``
+  (what the orchestrator admin and ``tasksrunner metrics`` merge
+  across replicas — bucket arrays with identical bounds add
+  element-wise, so cross-replica percentiles are exact up to bucket
+  resolution),
+* Prometheus text exposition via the sidecar's ``GET /metrics`` route
+  (:func:`render_prometheus`),
+* trace exemplars: an observation slower than
+  ``TASKSRUNNER_SLOW_THRESHOLD_SECONDS`` captures the current trace id
+  so ``tasksrunner metrics --slow`` can hand the tail straight to
+  ``tasksrunner traces show``.
+
+Histograms use fixed log-spaced bounds (100µs · 2^i). The hot path
+never touches the bucket arrays: an observation is one lock-free
+append onto the series' packed pending buffer (plus a float compare
+for the exemplar threshold), and buffers fold into buckets in batches
+— sort the pending values, then one ``bisect`` per *bound* instead of
+one per value. That is the same shape as the rest of the runtime's
+hot paths (group-commit writes, the span buffer): enqueue cheap,
+aggregate in bulk. Per-request call sites go one step further and
+cache a :meth:`MetricsRegistry.recorder` closure so they skip the
+name/label resolution entirely. ``TASKSRUNNER_HISTOGRAMS=0`` turns
+every entry point into an early return; ``bench.py --hist-bench``
+measures the on/off delta.
+
+Every metric name must be declared in :mod:`tasksrunner.observability.names`
+(enforced by ``scripts/check_metrics.py``), and one name may only ever
+be used as one instrument kind — the registry raises on a kind
+collision instead of letting two series shadow each other.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
-from collections import defaultdict
+from array import array
+from bisect import bisect_right
+from typing import Any, Iterable
+
+from tasksrunner.observability.tracing import current_trace
+
+ENV_HISTOGRAMS = "TASKSRUNNER_HISTOGRAMS"
+ENV_SLOW_THRESHOLD = "TASKSRUNNER_SLOW_THRESHOLD_SECONDS"
+
+#: 100µs .. ~105s in factor-of-2 steps; the +Inf overflow bucket is implicit
+#: (len(bounds)+1 counts slots). Identical everywhere, so snapshots merge.
+DEFAULT_BOUNDS: tuple[float, ...] = tuple(1e-4 * 2.0**i for i in range(21))
+
+#: newest-N exemplar ring per label set
+MAX_EXEMPLARS = 8
+
+DEFAULT_SLOW_THRESHOLD = 0.25
+
+#: fold a series' pending buffer into its bucket array once it holds
+#: this many raw values (snapshots fold whatever is left). Sized to
+#: keep the resident cost of an un-scraped series small — ~512 floats
+#: is ~12 KiB worst case — while the sort+bisect fold cost stays
+#: amortised well under the <3% histogram-overhead budget.
+FOLD_AT = 512
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+def _slow_threshold() -> float:
+    raw = os.environ.get(ENV_SLOW_THRESHOLD)
+    if not raw:
+        return DEFAULT_SLOW_THRESHOLD
+    try:
+        return float(raw)
+    except ValueError:
+        return DEFAULT_SLOW_THRESHOLD
+
+
+class _HistogramSeries:
+    """Bucket counts + pending buffer + exemplars for one label set."""
+
+    __slots__ = ("counts", "sum", "count", "pending", "exemplars")
+
+    def __init__(self, n_buckets: int) -> None:
+        self.counts = [0] * n_buckets
+        self.sum = 0.0
+        self.count = 0
+        # raw observations awaiting a fold; appended lock-free (append
+        # is atomic under the GIL), drained head-first by _fold. Never
+        # rebound — recorder closures hold a direct reference. A packed
+        # double array, not a list: an idle (un-scraped) series then
+        # retains 8 bytes per pending value instead of a boxed float +
+        # pointer, which keeps per-process residency trivial even with
+        # many live series.
+        self.pending: array = array("d")
+        # (trace_id, value, unix_time) newest last, capped at MAX_EXEMPLARS
+        self.exemplars: list[tuple[str, float, float]] = []
+
+
+class Histogram:
+    """Fixed-bound latency histogram with per-label-set bucket arrays.
+
+    One instance per metric name; label sets materialise series lazily.
+    The bounds are shared process-wide (``DEFAULT_BOUNDS``) so snapshots
+    from different replicas merge by element-wise addition.
+
+    Observations append to a per-series pending buffer; :meth:`_fold`
+    turns a buffer into bucket increments in one pass — sort the
+    values (C-speed), then bisect once per *bound* and add the
+    position deltas. Folds run when a buffer reaches ``FOLD_AT`` and
+    at snapshot time, so scrapes always see up-to-date buckets.
+    """
+
+    __slots__ = ("name", "bounds", "_series", "_lock")
+
+    def __init__(self, name: str, bounds: tuple[float, ...] = DEFAULT_BOUNDS) -> None:
+        self.name = name
+        self.bounds = bounds
+        self._series: dict[tuple[tuple[str, str], ...], _HistogramSeries] = {}
+        self._lock = threading.Lock()
+
+    def _fold(self, series: _HistogramSeries) -> None:
+        """Drain ``series.pending`` into the bucket array.
+
+        Appenders never take the lock, so only the head of the pending
+        list is drained: the copy + del-slice pair below each run as a
+        single C call under the GIL, and appends racing with the fold
+        land at the tail, surviving for the next fold. Folders
+        serialise on the histogram lock.
+        """
+        with self._lock:
+            raw = series.pending[:]
+            if not raw:
+                return
+            del series.pending[:len(raw)]
+            vals = sorted(raw)
+            n = len(vals)
+            counts = series.counts
+            prev = 0
+            for i, bound in enumerate(self.bounds):
+                pos = bisect_right(vals, bound)
+                if pos != prev:
+                    counts[i] += pos - prev
+                    prev = pos
+                if pos == n:
+                    break
+            if prev != n:
+                counts[len(self.bounds)] += n - prev
+            series.sum += sum(vals)
+            series.count += n
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            items = list(self._series.items())
+        for _, s in items:
+            if s.pending:
+                self._fold(s)
+        with self._lock:
+            series = [
+                {
+                    "labels": dict(key),
+                    "counts": list(s.counts),
+                    "sum": s.sum,
+                    "count": s.count,
+                    "exemplars": [list(e) for e in s.exemplars],
+                }
+                for key, s in sorted(items)
+                # a recorder() materialises its series eagerly; hide it
+                # until something is actually observed
+                if s.count
+            ]
+        return {"bounds": list(self.bounds), "series": series}
 
 
 class MetricsRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._counters: dict[str, float] = defaultdict(float)
-        self._gauges: dict[str, float] = {}
+        self._counters: dict[tuple[str, tuple[tuple[str, str], ...]], float] = {}
+        self._gauges: dict[tuple[str, tuple[tuple[str, str], ...]], float] = {}
+        self._histograms: dict[str, Histogram] = {}
+        # name -> instrument kind; snapshot() injects uptime, so its kind
+        # is claimed up front.
+        self._kinds: dict[str, str] = {"uptime_seconds": "gauge"}
         self.started_at = time.time()
+        self.histograms_enabled = _env_flag(ENV_HISTOGRAMS, True)
+        self.slow_threshold = _slow_threshold()
+
+    def _claim_kind(self, name: str, kind: str) -> None:
+        # caller holds self._lock
+        prev = self._kinds.get(name)
+        if prev is None:
+            self._kinds[name] = kind
+        elif prev != kind:
+            raise ValueError(
+                f"metric {name!r} already registered as {prev}, cannot reuse as {kind}"
+            )
 
     def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
+        key = (name, tuple(sorted(labels.items())))
         with self._lock:
-            self._counters[self._key(name, labels)] += value
+            self._claim_kind(name, "counter")
+            self._counters[key] = self._counters.get(key, 0.0) + value
 
     def set_gauge(self, name: str, value: float, **labels: str) -> None:
+        key = (name, tuple(sorted(labels.items())))
         with self._lock:
-            self._gauges[self._key(name, labels)] = value
+            self._claim_kind(name, "gauge")
+            self._gauges[key] = value
+
+    def _materialize_histogram(self, name: str) -> Histogram:
+        with self._lock:
+            self._claim_kind(name, "histogram")
+            hist = self._histograms.get(name)
+            if hist is None:
+                hist = self._histograms[name] = Histogram(name)
+        return hist
+
+    def _series_for(
+        self, name: str, labels: dict[str, str]
+    ) -> tuple[Histogram, _HistogramSeries]:
+        # label keys skip sorted() for the 0/1-label case: call sites
+        # pass kwargs in a fixed order, and snapshot/merge/render
+        # re-sort anyway
+        hist = self._histograms.get(name)
+        if hist is None:
+            hist = self._materialize_histogram(name)
+        key = (
+            tuple(sorted(labels.items()))
+            if len(labels) > 1
+            else tuple(labels.items())
+        )
+        series = hist._series.get(key)
+        if series is None:
+            with hist._lock:
+                series = hist._series.get(key)
+                if series is None:
+                    series = hist._series[key] = _HistogramSeries(
+                        len(hist.bounds) + 1)
+        return hist, series
+
+    def _record_slow(
+        self, hist: Histogram, series: _HistogramSeries, value: float
+    ) -> None:
+        # exemplar capture — rare by construction (value crossed the
+        # slow threshold), so the trace lookup, clock read, and lock
+        # all live here instead of on the fast path
+        ctx = current_trace()
+        if ctx is None:
+            return
+        exemplar = (ctx.trace_id, value, time.time())
+        with hist._lock:
+            if len(series.exemplars) >= MAX_EXEMPLARS:
+                del series.exemplars[0]
+            series.exemplars.append(exemplar)
+
+    def observe(self, name: str, value: float, **labels: str) -> None:
+        if not self.histograms_enabled:
+            return
+        hist, series = self._series_for(name, labels)
+        if value >= self.slow_threshold:
+            self._record_slow(hist, series, value)
+        series.pending.append(value)
+        if len(series.pending) >= FOLD_AT:
+            hist._fold(series)
+
+    def observe_many(self, name: str, values: list[float], **labels: str) -> None:
+        """Bulk observe: one series resolution + one C-speed extend for
+        a whole batch. Used by the group-commit writer for per-row
+        queue-wait — a 64-row batch would otherwise pay per-call
+        overhead 64 times on the writer thread (which still contends
+        for the GIL). Exemplars are not captured here; batch work runs
+        off the request's trace."""
+        if not self.histograms_enabled or not values:
+            return
+        hist, series = self._series_for(name, labels)
+        series.pending.extend(values)
+        if len(series.pending) >= FOLD_AT:
+            hist._fold(series)
+
+    def recorder(self, name: str, **labels: str):
+        """Return a ``record(value)`` closure bound to one series.
+
+        The per-request call sites (state ops, publish, delivery,
+        invoke, sidecar requests) cache one of these instead of calling
+        :meth:`observe`: the closure skips the kwargs/key/dict work so
+        an observation is a float compare plus a lock-free append.
+        Toggling ``histograms_enabled`` is honoured live — the closure
+        re-reads it on every call (``bench.py --hist-bench`` flips it
+        between rounds).
+        """
+        hist, series = self._series_for(name, labels)
+        pending = series.pending
+        append = pending.append
+        fold = hist._fold
+        record_slow = self._record_slow
+        registry = self
+
+        def record(value: float) -> None:
+            if not registry.histograms_enabled:
+                return
+            if value >= registry.slow_threshold:
+                record_slow(hist, series, value)
+            append(value)
+            if len(pending) >= FOLD_AT:
+                fold(series)
+
+        return record
 
     def get(self, name: str, **labels: str) -> float:
-        key = self._key(name, labels)
+        key = (name, tuple(sorted(labels.items())))
         with self._lock:
-            if key in self._gauges:
-                return self._gauges[key]
+            kind = self._kinds.get(name)
+            if kind == "gauge":
+                return self._gauges.get(key, 0.0)
             return self._counters.get(key, 0.0)
 
     @staticmethod
-    def _key(name: str, labels: dict[str, str]) -> str:
+    def _key(name: str, labels: Iterable[tuple[str, str]]) -> str:
+        labels = tuple(labels)
         if not labels:
             return name
-        tag = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+        tag = ",".join(f"{k}={v}" for k, v in labels)
         return f"{name}{{{tag}}}"
 
     def snapshot(self) -> dict[str, float]:
         with self._lock:
-            out = dict(self._counters)
-            out.update(self._gauges)
+            out = {self._key(n, ls): v for (n, ls), v in self._counters.items()}
+            out.update({self._key(n, ls): v for (n, ls), v in self._gauges.items()})
             out["uptime_seconds"] = time.time() - self.started_at
             return out
+
+    def snapshot_histograms(self) -> dict[str, dict[str, Any]]:
+        with self._lock:
+            hists = list(self._histograms.items())
+        return {name: h.snapshot() for name, h in sorted(hists)}
+
+    def snapshot_kinds(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._kinds)
+
+
+def merge_histogram_snapshots(
+    snaps: Iterable[dict[str, dict[str, Any]]],
+) -> dict[str, dict[str, Any]]:
+    """Merge per-replica ``snapshot_histograms()`` payloads.
+
+    Series with the same name + label set add element-wise; bounds must
+    match (they always do — every process uses DEFAULT_BOUNDS), else the
+    offending series is skipped rather than merged wrongly.
+    """
+    merged: dict[str, dict[str, Any]] = {}
+    for snap in snaps:
+        for name, hist in snap.items():
+            target = merged.setdefault(name, {"bounds": list(hist["bounds"]), "series": {}})
+            if target["bounds"] != list(hist["bounds"]):
+                continue
+            for series in hist["series"]:
+                key = tuple(sorted(series["labels"].items()))
+                slot = target["series"].get(key)
+                if slot is None:
+                    target["series"][key] = {
+                        "labels": dict(series["labels"]),
+                        "counts": list(series["counts"]),
+                        "sum": float(series["sum"]),
+                        "count": int(series["count"]),
+                        "exemplars": [list(e) for e in series.get("exemplars", ())],
+                    }
+                else:
+                    slot["counts"] = [a + b for a, b in zip(slot["counts"], series["counts"])]
+                    slot["sum"] += float(series["sum"])
+                    slot["count"] += int(series["count"])
+                    slot["exemplars"].extend(list(e) for e in series.get("exemplars", ()))
+    return {
+        name: {
+            "bounds": hist["bounds"],
+            "series": [hist["series"][k] for k in sorted(hist["series"])],
+        }
+        for name, hist in merged.items()
+    }
+
+
+def merge_flat_snapshots(
+    snaps: Iterable[dict[str, float]],
+    kinds: dict[str, str] | None = None,
+) -> dict[str, float]:
+    """Merge per-replica ``snapshot()`` payloads (flat ``name{labels}``
+    keys): counters sum across replicas, gauges take the max (summing
+    uptimes or queue depths would invent a replica that doesn't exist).
+    Unknown kinds are treated as counters."""
+    kinds = kinds or {}
+    out: dict[str, float] = {}
+    for snap in snaps:
+        for key, value in snap.items():
+            base = key.split("{", 1)[0]
+            if kinds.get(base) == "gauge":
+                out[key] = max(out.get(key, float("-inf")), float(value))
+            else:
+                out[key] = out.get(key, 0.0) + float(value)
+    return out
+
+
+def summarize_histograms(
+    merged: dict[str, dict[str, Any]],
+    quantiles: tuple[float, ...] = (0.5, 0.95, 0.99),
+) -> list[dict[str, Any]]:
+    """Flatten merged histograms into per-series percentile rows, the
+    shape the admin API and ``tasksrunner metrics --percentiles``
+    print."""
+    rows: list[dict[str, Any]] = []
+    for name, hist in sorted(merged.items()):
+        bounds = hist["bounds"]
+        for series in hist["series"]:
+            row: dict[str, Any] = {
+                "name": name,
+                "labels": dict(series["labels"]),
+                "count": series["count"],
+                "sum": series["sum"],
+            }
+            for q in quantiles:
+                row[f"p{int(q * 100)}"] = estimate_percentile(
+                    bounds, series["counts"], q)
+            rows.append(row)
+    return rows
+
+
+def estimate_percentile(bounds: list[float], counts: list[int], q: float) -> float:
+    """Estimate the q-quantile (0..1) from cumulative bucket counts.
+
+    Linear interpolation within the containing bucket; observations in
+    the +Inf overflow bucket clamp to the top finite bound.
+    """
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        prev_cum = cum
+        cum += c
+        if cum >= rank:
+            if i >= len(bounds):
+                return bounds[-1]
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            frac = (rank - prev_cum) / c
+            return lo + (hi - lo) * frac
+    return bounds[-1]
+
+
+def _prom_label_escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_labels(labels: dict[str, str], extra: tuple[str, str] | None = None) -> str:
+    parts = [f'{k}="{_prom_label_escape(str(v))}"' for k, v in sorted(labels.items())]
+    if extra is not None:
+        parts.append(f'{extra[0]}="{_prom_label_escape(extra[1])}"')
+    if not parts:
+        return ""
+    return "{" + ",".join(parts) + "}"
+
+
+def _format_value(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def render_prometheus(registry: MetricsRegistry, help_texts: dict[str, str] | None = None) -> str:
+    """Render the registry as Prometheus text exposition (version 0.0.4)."""
+    if help_texts is None:
+        from tasksrunner.observability import names as _names
+
+        help_texts = _names.ALL
+    kinds = registry.snapshot_kinds()
+    lines: list[str] = []
+
+    with registry._lock:
+        counters = sorted(registry._counters.items())
+        gauges = sorted(registry._gauges.items())
+        uptime = time.time() - registry.started_at
+    gauges.append((("uptime_seconds", ()), uptime))
+    gauges.sort()
+
+    def scalar_block(items: list, prom_type: str) -> None:
+        last_name = None
+        for (name, label_items), value in items:
+            if name != last_name:
+                help_text = help_texts.get(name, name)
+                lines.append(f"# HELP {name} {help_text}")
+                lines.append(f"# TYPE {name} {prom_type}")
+                last_name = name
+            lines.append(f"{name}{_prom_labels(dict(label_items))} {_format_value(value)}")
+
+    scalar_block(counters, "counter")
+    scalar_block(gauges, "gauge")
+
+    for name, hist in sorted(registry.snapshot_histograms().items()):
+        help_text = help_texts.get(name, name)
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} histogram")
+        bounds = hist["bounds"]
+        for series in hist["series"]:
+            labels = series["labels"]
+            cum = 0
+            for i, bound in enumerate(bounds):
+                cum += series["counts"][i]
+                lines.append(
+                    f"{name}_bucket{_prom_labels(labels, ('le', repr(float(bound))))} {cum}"
+                )
+            cum += series["counts"][len(bounds)]
+            lines.append(f"{name}_bucket{_prom_labels(labels, ('le', '+Inf'))} {cum}")
+            lines.append(f"{name}_sum{_prom_labels(labels)} {repr(series['sum'])}")
+            lines.append(f"{name}_count{_prom_labels(labels)} {series['count']}")
+    lines.append("")
+    return "\n".join(lines)
 
 
 #: process-global default registry

@@ -1,0 +1,62 @@
+"""The single registry of every metric name the runtime emits.
+
+Time series fork silently: a typo'd name or an instrument-kind switch
+("publish" as a counter here, a gauge there) produces two series that
+dashboards and the autoscaler then disagree about. Every
+``metrics.inc`` / ``set_gauge`` / ``observe`` call site must use a name
+declared here — ``scripts/check_metrics.py`` (``make lint-metrics``)
+greps the instrumentation sites and fails the build on an undeclared
+name, and ``MetricsRegistry`` refuses at registration time to reuse
+one name across two instrument kinds.
+
+Latency histograms follow the Prometheus convention of naming the unit
+(``*_seconds``); the exposition route derives ``_bucket``/``_sum``/
+``_count`` series from them.
+"""
+
+from __future__ import annotations
+
+#: monotonically increasing event counts
+COUNTERS: dict[str, str] = {
+    "state_save": "state items written via the runtime",
+    "state_get": "state point reads via the runtime",
+    "state_delete": "state deletes via the runtime",
+    "state_bulk_get": "keys fetched via bulk state reads",
+    "state_query": "state query executions",
+    "state_transact": "state transactions",
+    "publish": "messages published",
+    "pubsub_delivery": "pub/sub deliveries to app routes, by status",
+    "binding_invoke": "output-binding invocations",
+    "binding_delivery": "input-binding deliveries to app routes, by status",
+    "invoke": "service invocations issued, by target app",
+    "invoke_transport": "invocation attempts per transport lane (mesh/http)",
+    "chaos_injected_total": "faults injected by the chaos engine",
+    "resiliency_retry_total": "resiliency-policy retry attempts",
+    "resiliency_retry_exhausted_total": "retry budgets exhausted",
+}
+
+#: point-in-time levels (the saturation probes live here)
+GAUGES: dict[str, str] = {
+    "uptime_seconds": "seconds since this registry was created",
+    "resiliency_breaker_state": "circuit breaker state (0 closed/2 open)",
+    "event_loop_lag_seconds": "asyncio timer drift sampled per process",
+    "state_write_queue_depth": "pending writes in the state group-commit queue",
+    "broker_publish_queue_depth": "pending publishes in the broker write queue",
+    "broker_dlq_depth": "dead-lettered messages per topic/group",
+    "span_buffer_depth": "spans buffered in the recorder awaiting flush",
+}
+
+#: latency distributions (seconds); exposed as _bucket/_sum/_count
+HISTOGRAMS: dict[str, str] = {
+    "sidecar_request_latency_seconds": "sidecar HTTP API handling, per route",
+    "invoke_latency_seconds": "service invocation client, per target app",
+    "state_op_latency_seconds": "runtime state operations, per store and op",
+    "state_queue_wait_seconds": "group-commit queue wait (enqueue to batch start)",
+    "state_commit_seconds": "group-commit batch execution (begin to resolve)",
+    "publish_latency_seconds": "pub/sub publish, per pubsub and topic",
+    "delivery_latency_seconds": "pub/sub delivery to the app, per route",
+    "binding_latency_seconds": "output-binding invocation, per binding and op",
+    "binding_delivery_latency_seconds": "input-binding delivery, per binding",
+}
+
+ALL: dict[str, str] = {**COUNTERS, **GAUGES, **HISTOGRAMS}

@@ -1,0 +1,51 @@
+"""Runtime saturation probes.
+
+The queue-depth gauges (state write queue, broker publish queue, DLQ,
+span buffer) are set inline where the queues live; this module holds
+the one probe that needs its own task: event-loop lag. A coroutine
+sleeps for a fixed interval and reports how late the loop woke it —
+the canonical timer-drift measure of how saturated the loop is with
+callbacks. Autoscale on this before anything else: a loop that is 100ms
+late is 100ms of latency added to *every* request the replica serves.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+
+from tasksrunner.observability.metrics import MetricsRegistry, metrics as default_metrics
+
+DEFAULT_INTERVAL = 0.5
+
+
+class EventLoopLagProbe:
+    """Periodic timer-drift sampler feeding ``event_loop_lag_seconds``."""
+
+    def __init__(
+        self,
+        interval: float = DEFAULT_INTERVAL,
+        registry: MetricsRegistry | None = None,
+    ) -> None:
+        self.interval = interval
+        self.registry = registry if registry is not None else default_metrics
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(self.interval)
+            lag = loop.time() - t0 - self.interval
+            self.registry.set_gauge("event_loop_lag_seconds", max(0.0, lag))

@@ -25,6 +25,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from tasksrunner.observability.metrics import metrics
 from tasksrunner.observability.tracing import current_trace
 
 _SCHEMA = """
@@ -96,15 +97,22 @@ class SpanRecorder:
         self.retention_seconds = retention_seconds
         self._last_prune = 0.0
         self._timer: threading.Timer | None = None
+        self._closed = False
         atexit.register(self.flush)
         self._schedule()
 
     def _schedule(self) -> None:
+        # _closed guard: a _tick() already past close()'s cancel would
+        # otherwise resurrect the flush timer on a closed recorder
+        if self._closed:
+            return
         self._timer = threading.Timer(self.flush_interval, self._tick)
         self._timer.daemon = True
         self._timer.start()
 
     def _tick(self) -> None:
+        if self._closed:
+            return
         try:
             self.flush()
         finally:
@@ -133,14 +141,17 @@ class SpanRecorder:
         )
         with self._lock:
             self._buffer.append(span)
+            depth = len(self._buffer)
             # no inline flush: record() runs on the event loop and must
             # never pay sqlite I/O; the timer thread drains the buffer
+        metrics.set_gauge("span_buffer_depth", depth)
 
     def flush(self) -> None:
         with self._lock:
             batch, self._buffer = self._buffer, []
         if not batch:
             return
+        metrics.set_gauge("span_buffer_depth", 0)
         # I/O outside the buffer lock so record() never waits on sqlite;
         # _io_lock serialises the writers (timer thread + close)
         with self._io_lock:
@@ -167,6 +178,7 @@ class SpanRecorder:
             self._conn.commit()
 
     def close(self) -> None:
+        self._closed = True
         if self._timer is not None:
             self._timer.cancel()
         self.flush()

@@ -18,6 +18,7 @@ one token protects the whole control plane.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import os
@@ -68,6 +69,7 @@ class AdminServer:
         app.router.add_post("/admin/apps/{app_id}/restart", self._restart)
         app.router.add_post("/admin/apps/{app_id}/env", self._env)
         app.router.add_post("/admin/apps/{app_id}/scale", self._scale)
+        app.router.add_get("/admin/apps/{app_id}/metrics", self._metrics)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         self._site = web.TCPSite(self._runner, self.host, self.port)
@@ -161,6 +163,51 @@ class AdminServer:
         entry = await self.orch.update_env(
             app_id, set_env=set_env, remove=[str(k) for k in remove])
         return web.json_response({"updated": app_id, "revision": entry})
+
+    async def _metrics(self, request):
+        """Cross-replica metrics: fan out to every replica sidecar's
+        ``/v1.0/metadata``, sum counters / max gauges, and merge
+        histogram bucket arrays so the percentiles are computed over
+        the app, not one replica."""
+        import aiohttp
+        from aiohttp import web
+
+        from tasksrunner.observability.metrics import (
+            merge_flat_snapshots,
+            merge_histogram_snapshots,
+            summarize_histograms,
+        )
+
+        app_id = self._resolve_app(request)
+        token = os.environ.get(TOKEN_ENV)
+        headers = {TOKEN_HEADER: token} if token else {}
+        payloads = []
+        async with aiohttp.ClientSession() as session:
+            for replica in self.orch.replicas.get(app_id, []):
+                if not replica.ports:
+                    continue
+                url = f"http://127.0.0.1:{replica.ports[1]}/v1.0/metadata"
+                try:
+                    async with session.get(
+                            url, headers=headers,
+                            timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                        if resp.status == 200:
+                            payloads.append(await resp.json())
+                except (aiohttp.ClientError, asyncio.TimeoutError):
+                    continue  # a dead replica must not fail the view
+        kinds: dict[str, str] = {}
+        for p in payloads:
+            kinds.update(p.get("metric_kinds") or {})
+        merged_hist = merge_histogram_snapshots(
+            p.get("histograms") or {} for p in payloads)
+        return web.json_response({
+            "app_id": app_id,
+            "replicas": len(payloads),
+            "metrics": merge_flat_snapshots(
+                (p.get("metrics") or {} for p in payloads), kinds),
+            "percentiles": summarize_histograms(merged_hist),
+            "histograms": merged_hist,
+        })
 
     async def _scale(self, request):
         from aiohttp import web

@@ -29,6 +29,7 @@ from typing import Any
 from tasksrunner.component.registry import driver
 from tasksrunner.component.spec import ComponentSpec
 from tasksrunner.ids import hex16
+from tasksrunner.observability.metrics import metrics
 from tasksrunner.pubsub.base import Handler, Message, PubSubBroker, Subscription
 
 logger = logging.getLogger(__name__)
@@ -251,6 +252,10 @@ class SqliteBroker(PubSubBroker):
                 self._pub_flushing = False
                 return
             self._pub_pending = []
+        # depth the publish queue reached before this flush drained it;
+        # sampled once per batch on the db thread, off the event loop
+        metrics.set_gauge("broker_publish_queue_depth", len(batch),
+                          pubsub=self.name)
         try:
             with self._db_lock:
                 self._publish_rows([b[:4] for b in batch])
@@ -418,6 +423,15 @@ class SqliteBroker(PubSubBroker):
             [(until, m, group) for m in msg_ids]))
         return until
 
+    def _dlq_gauge(self, topic: str, group: str) -> None:
+        """Refresh broker_dlq_depth for one topic/group (db thread,
+        caller holds _db_lock)."""
+        row = self._conn.execute(
+            "SELECT COUNT(*) FROM deliveries WHERE topic = ? AND grp = ? "
+            "AND done = 2", (topic, group)).fetchone()
+        metrics.set_gauge("broker_dlq_depth", float(row[0]),
+                          topic=topic, group=group)
+
     @_locked
     def _nack(self, msg: Message, group: str) -> None:
         if msg.attempt >= self.max_attempts:
@@ -428,6 +442,7 @@ class SqliteBroker(PubSubBroker):
             self._write_txn(lambda cur: cur.execute(
                 "UPDATE deliveries SET done = 2 WHERE msg_id = ? AND grp = ?",
                 (msg.id, group)))
+            self._dlq_gauge(msg.topic, group)
         else:
             self._write_txn(lambda cur: cur.execute(
                 "UPDATE deliveries SET visible_at = ?, claimed_until = 0 "
@@ -570,7 +585,9 @@ class SqliteBroker(PubSubBroker):
                 return 0
             sql += f" AND msg_id IN ({', '.join('?' for _ in msg_ids)})"
             params.extend(msg_ids)
-        return self._write_txn(lambda cur: cur.execute(sql, params)).rowcount
+        requeued = self._write_txn(lambda cur: cur.execute(sql, params)).rowcount
+        self._dlq_gauge(topic, group)
+        return requeued
 
     @_locked
     def gc(self, *, older_than: float = 3600.0) -> int:
@@ -608,7 +625,9 @@ class SqliteBroker(PubSubBroker):
                 return 0
             sql += f" AND msg_id IN ({', '.join('?' for _ in msg_ids)})"
             params.extend(msg_ids)
-        return self._write_txn(lambda cur: cur.execute(sql, params)).rowcount
+        purged = self._write_txn(lambda cur: cur.execute(sql, params)).rowcount
+        self._dlq_gauge(topic, group)
+        return purged
 
     def close_sync(self) -> None:
         """Synchronous close for out-of-band (no event loop) users —

@@ -173,6 +173,15 @@ class Runtime:
         from tasksrunner.envflag import env_flag
         self._mesh_enabled = env_flag("TASKSRUNNER_MESH")
         self._started = False
+        # cached metrics.recorder() closures for the per-request latency
+        # histograms, keyed by the one label that varies per call — a
+        # recorder observation is a float compare + list append, so the
+        # hot paths skip the per-call label packing of metrics.observe()
+        self._rec_state_save: dict[str, Any] = {}
+        self._rec_state_get: dict[str, Any] = {}
+        self._rec_state_transact: dict[str, Any] = {}
+        self._rec_publish: dict[tuple[str, str], Any] = {}
+        self._rec_invoke: dict[str, Any] = {}
 
     # -- helpers ---------------------------------------------------------
 
@@ -211,6 +220,7 @@ class Runtime:
         # guard per item, not per batch: a retry must re-run only the
         # failing write — re-running completed etag-guarded items would
         # turn a transient blip into a spurious 409 conflict
+        started = time.perf_counter()
         for item in items:
             key = prefixer.apply(str(item["key"]))
             await self._guarded(
@@ -218,12 +228,25 @@ class Runtime:
                 lambda k=key, it=item: store.set(k, it.get("value"),
                                                  etag=it.get("etag")))
         metrics.inc("state_save", len(items), store=store_name)
+        rec = self._rec_state_save.get(store_name)
+        if rec is None:
+            rec = self._rec_state_save[store_name] = metrics.recorder(
+                "state_op_latency_seconds", store=store_name, op="save")
+        rec(time.perf_counter() - started)
 
     async def get_state(self, store_name: str, key: str):
         self._authorize(store_name, "read")
         store, prefixer = self._state_store(store_name)
         metrics.inc("state_get", store=store_name)
-        return await self._guarded(store_name, lambda: store.get(prefixer.apply(key)))
+        started = time.perf_counter()
+        item = await self._guarded(
+            store_name, lambda: store.get(prefixer.apply(key)))
+        rec = self._rec_state_get.get(store_name)
+        if rec is None:
+            rec = self._rec_state_get[store_name] = metrics.recorder(
+                "state_op_latency_seconds", store=store_name, op="get")
+        rec(time.perf_counter() - started)
+        return item
 
     async def delete_state(self, store_name: str, key: str, *, etag=None) -> bool:
         self._authorize(store_name, "write")
@@ -280,8 +303,14 @@ class Runtime:
             ))
         # a transaction is atomic in the store, so whole-call retry is
         # safe (unlike the per-item save loop above)
+        started = time.perf_counter()
         await self._guarded(store_name, lambda: store.transact(ops))
         metrics.inc("state_transact", store=store_name)
+        rec = self._rec_state_transact.get(store_name)
+        if rec is None:
+            rec = self._rec_state_transact[store_name] = metrics.recorder(
+                "state_op_latency_seconds", store=store_name, op="transact")
+        rec(time.perf_counter() - started)
 
     # -- secrets ---------------------------------------------------------
 
@@ -320,6 +349,11 @@ class Runtime:
         msg_id = await self._guarded(
             pubsub_name, lambda: broker.publish(topic, envelope, metadata=meta))
         metrics.inc("publish", pubsub=pubsub_name, topic=topic)
+        rec = self._rec_publish.get((pubsub_name, topic))
+        if rec is None:
+            rec = self._rec_publish[(pubsub_name, topic)] = metrics.recorder(
+                "publish_latency_seconds", pubsub=pubsub_name, topic=topic)
+        rec(time.time() - started)
         record_span(kind="producer", name=f"publish {pubsub_name}/{topic}",
                     status=200, start=started, duration=time.time() - started,
                     attrs={"target": f"{pubsub_name}/{topic}"},
@@ -335,8 +369,13 @@ class Runtime:
         if not isinstance(binding, OutputBinding):
             raise BindingError(f"component {name!r} is not an output binding")
         metrics.inc("binding_invoke", binding=name, operation=operation)
-        return await self._guarded(
+        started = time.perf_counter()
+        result = await self._guarded(
             name, lambda: binding.invoke(operation, data, metadata))
+        metrics.observe("binding_latency_seconds",
+                        time.perf_counter() - started,
+                        binding=name, operation=operation)
+        return result
 
     # -- service invocation ----------------------------------------------
 
@@ -360,9 +399,15 @@ class Runtime:
         started = time.time()
 
         def _spanned(result: tuple[int, dict[str, str], bytes]):
+            elapsed = time.time() - started
+            rec = self._rec_invoke.get(target_app_id)
+            if rec is None:
+                rec = self._rec_invoke[target_app_id] = metrics.recorder(
+                    "invoke_latency_seconds", target=target_app_id)
+            rec(elapsed)
             record_span(kind="client", name=f"invoke {target_app_id}{path}",
                         status=result[0], start=started,
-                        duration=time.time() - started,
+                        duration=elapsed,
                         attrs={"target": target_app_id},
                         span_id=child.span_id, parent_id=base_ctx.span_id)
             return result
@@ -609,6 +654,10 @@ class Runtime:
 
     def _make_subscription_handler(self, pubsub_name: str, route: str):
         policy = self._inbound_policy(pubsub_name)
+        # bound once per subscription: delivery observations are a
+        # closure call, no per-message label resolution
+        record_delivery = metrics.recorder(
+            "delivery_latency_seconds", route=route)
 
         async def deliver(msg: Message) -> bool:
             ctx = ensure_trace(msg.metadata.get(TRACEPARENT_HEADER))
@@ -624,6 +673,7 @@ class Runtime:
                     return await self.app_channel.request(
                         "POST", route, headers=headers, body=body)
 
+                started = time.perf_counter()
                 try:
                     if policy is not None:
                         status, _, _ = await policy.execute(
@@ -634,6 +684,7 @@ class Runtime:
                     logger.exception("delivery to %s failed", route)
                     return False
                 metrics.inc("pubsub_delivery", route=route, status=str(status))
+                record_delivery(time.perf_counter() - started)
                 # delivery visibility in the multiplexed logs (the
                 # sidecar→app hop is an in-process call in host mode,
                 # so no access-log line marks it); honors the same
@@ -645,6 +696,8 @@ class Runtime:
 
     def _make_binding_sink(self, binding: InputBinding):
         policy = self._inbound_policy(binding.name)
+        record_delivery = metrics.recorder(
+            "binding_delivery_latency_seconds", binding=binding.name)
 
         async def sink(event: BindingEvent) -> bool:
             ctx = ensure_trace(None)
@@ -658,6 +711,7 @@ class Runtime:
                     return await self.app_channel.request(
                         "POST", binding.route, headers=headers, body=body)
 
+                started = time.perf_counter()
                 try:
                     if policy is not None:
                         status, _, _ = await policy.execute(
@@ -669,6 +723,7 @@ class Runtime:
                     return False
                 metrics.inc("binding_delivery", binding=binding.name,
                             status=str(status))
+                record_delivery(time.perf_counter() - started)
                 if _delivery_logs():
                     logger.info('binding %s delivery "POST %s" %d',
                                 binding.name, binding.route, status)
@@ -688,6 +743,8 @@ class Runtime:
                 {"topic": s.topic, "group": s.group} for s in self._subscriptions
             ],
             "metrics": metrics.snapshot(),
+            "histograms": metrics.snapshot_histograms(),
+            "metric_kinds": metrics.snapshot_kinds(),
         }
 
     async def stop(self) -> None:

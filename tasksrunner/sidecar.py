@@ -22,11 +22,14 @@ from __future__ import annotations
 import json
 import logging
 import os
+import time
 
 from aiohttp import web
 
 from tasksrunner.errors import TasksRunnerError, ValidationError
 from tasksrunner.invoke.headers import inward_headers, outward_headers
+from tasksrunner.observability.metrics import metrics, render_prometheus
+from tasksrunner.observability.probes import EventLoopLagProbe
 from tasksrunner.observability.tracing import (
     TRACEPARENT_HEADER,
     ensure_trace,
@@ -80,6 +83,12 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
         # never diverge. Another app's identity must not unlock this
         # app's state, pub/sub, bindings, or secrets.
         def deco(handler):
+            route_label = handler.__name__
+            # bound once per route at decoration time — request
+            # observations are a closure call, no label resolution
+            record_latency = metrics.recorder(
+                "sidecar_request_latency_seconds", route=route_label)
+
             async def wrapped(request: web.Request):
                 if api_token is not None:
                     supplied = request.headers.get(TOKEN_HEADER)
@@ -90,11 +99,14 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
                         return web.json_response(
                             {"error": "missing or bad api token"}, status=401)
                 ctx = ensure_trace(request.headers.get(TRACEPARENT_HEADER))
+                started = time.perf_counter()
                 with trace_scope(ctx):
                     try:
                         return await handler(request)
                     except Exception as exc:  # noqa: BLE001 - mapped to status
                         return _json_error(exc)
+                    finally:
+                        record_latency(time.perf_counter() - started)
             return wrapped
         return deco if handler is None else deco(handler)
 
@@ -228,6 +240,22 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
         # inventory and metrics are exactly what the token protects
         return web.json_response(runtime.metadata())
 
+    @routes.get("/metrics")
+    async def prometheus_metrics(request: web.Request):
+        # Prometheus text exposition at the conventional scrape path.
+        # Token check done by hand (same policy as _traced) so the
+        # scrape itself never shows up in its own request histogram.
+        if api_token is not None:
+            if request.headers.get(TOKEN_HEADER) != api_token:
+                return web.json_response(
+                    {"error": "missing or bad api token"}, status=401)
+        body = render_prometheus(metrics)
+        # aiohttp's content_type kwarg rejects parameters, so the
+        # versioned exposition type goes through the headers dict
+        return web.Response(
+            body=body.encode(),
+            headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"})
+
     app = web.Application(client_max_size=16 * 1024 * 1024)
     app.add_routes(routes)
     return app
@@ -247,6 +275,7 @@ class Sidecar:
         self._http = build_sidecar_app(runtime)
         self._runner: web.AppRunner | None = None
         self._mesh = None
+        self._lag_probe = EventLoopLagProbe()
 
     async def start(self) -> None:
         from tasksrunner.envflag import env_flag
@@ -265,10 +294,12 @@ class Sidecar:
             await self._mesh.start()
             self.mesh_port = self._mesh.port
         await self.runtime.start()
+        self._lag_probe.start()
         logger.info("sidecar for %s listening on %s:%d (mesh :%s)",
                     self.runtime.app_id, self.host, self.port, self.mesh_port)
 
     async def stop(self) -> None:
+        await self._lag_probe.stop()
         await self.runtime.stop()
         if self._mesh is not None:
             await self._mesh.stop()

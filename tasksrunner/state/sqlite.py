@@ -49,6 +49,7 @@ from typing import Any
 from tasksrunner.component.registry import driver
 from tasksrunner.component.spec import ComponentSpec, metadata_bool, metadata_int
 from tasksrunner.errors import EtagMismatch, QueryError, StateError
+from tasksrunner.observability.metrics import metrics
 from tasksrunner.state.base import QueryResponse, StateItem, StateStore, TransactionOp
 from tasksrunner.state.query import validate_filter
 
@@ -145,13 +146,16 @@ def _encode(key: str, value: Any) -> str:
 class _PendingWrite:
     """One enqueued write op + the caller's loop/future to resolve."""
 
-    __slots__ = ("op", "loop", "future")
+    __slots__ = ("op", "loop", "future", "enqueued")
 
     def __init__(self, op: tuple, loop: asyncio.AbstractEventLoop,
                  future: asyncio.Future):
         self.op = op
         self.loop = loop
         self.future = future
+        # monotonic enqueue time: the queue-wait half of the
+        # state_queue_wait_seconds / state_commit_seconds latency split
+        self.enqueued = time.monotonic()
 
 
 def _resolve(row: _PendingWrite, value: Any, exc: BaseException | None) -> None:
@@ -425,6 +429,11 @@ class SqliteStateStore(StateStore):
                 self._q_flushing = False
                 return
             self._q_pending = []
+        # depth the queue reached before this flush drained it; sampled
+        # once per batch on the writer thread so the event loop never
+        # pays for the gauge
+        metrics.set_gauge("state_write_queue_depth", len(batch),
+                          store=self.name)
         self._exec_batch(batch)
         with self._q_lock:
             if self._q_pending:
@@ -447,6 +456,11 @@ class SqliteStateStore(StateStore):
         ops queued before it exactly as if each had committed alone."""
         results: list[tuple[Any, BaseException | None]] = [None] * len(batch)
         mutations: list[tuple] = []
+        batch_start = time.monotonic()
+        if metrics.histograms_enabled:
+            metrics.observe_many(
+                "state_queue_wait_seconds",
+                [batch_start - row.enqueued for row in batch], store=self.name)
         cur = self._conn.cursor()
         try:
             self._begin_immediate(cur)
@@ -498,6 +512,8 @@ class SqliteStateStore(StateStore):
             return
         self._dirty = True
         self._cache_apply(mutations)
+        metrics.observe("state_commit_seconds",
+                        time.monotonic() - batch_start, store=self.name)
         _resolve_batch([(row, value, exc)
                         for row, (value, exc) in zip(batch, results)])
 

@@ -1,0 +1,428 @@
+"""Latency-histogram distribution layer (the App Insights percentile
+charts analog): bucket math, cross-replica merging, Prometheus
+exposition, saturation gauges, and the slow-call exemplar → trace
+round trip."""
+
+import argparse
+import asyncio
+import re
+
+import pytest
+
+from tasksrunner.observability.metrics import (
+    DEFAULT_BOUNDS,
+    FOLD_AT,
+    MetricsRegistry,
+    estimate_percentile,
+    merge_flat_snapshots,
+    merge_histogram_snapshots,
+    render_prometheus,
+    summarize_histograms,
+)
+
+
+# -- histogram core -------------------------------------------------------
+
+def test_observe_lands_in_the_right_bucket():
+    reg = MetricsRegistry()
+    # bounds are 1e-4 * 2^i: 3e-4 falls in (2e-4, 4e-4] = index 2
+    reg.observe("state_op_latency_seconds", 3e-4, store="s", op="save")
+    snap = reg.snapshot_histograms()["state_op_latency_seconds"]
+    (series,) = snap["series"]
+    assert series["labels"] == {"store": "s", "op": "save"}
+    assert series["counts"][2] == 1
+    assert sum(series["counts"]) == series["count"] == 1
+    assert series["sum"] == pytest.approx(3e-4)
+
+
+def test_overflow_goes_to_inf_bucket_and_percentile_clamps():
+    reg = MetricsRegistry()
+    reg.observe("invoke_latency_seconds", 1e6, target="api")
+    snap = reg.snapshot_histograms()["invoke_latency_seconds"]
+    (series,) = snap["series"]
+    assert series["counts"][len(DEFAULT_BOUNDS)] == 1
+    assert estimate_percentile(
+        snap["bounds"], series["counts"], 0.99) == DEFAULT_BOUNDS[-1]
+
+
+def test_pending_folds_at_threshold_without_a_snapshot():
+    reg = MetricsRegistry()
+    for _ in range(FOLD_AT):
+        reg.observe("invoke_latency_seconds", 1e-3, target="api")
+    hist = reg._histograms["invoke_latency_seconds"]
+    (series,) = hist._series.values()
+    # the FOLD_AT-th observation triggered the inline fold
+    assert series.count == FOLD_AT
+    assert not series.pending
+
+
+def test_recorder_closure_observes_and_honours_live_toggle():
+    reg = MetricsRegistry()
+    rec = reg.recorder("delivery_latency_seconds", route="/on-saved")
+    rec(2e-4)
+    reg.histograms_enabled = False
+    rec(2e-4)  # dropped
+    reg.histograms_enabled = True
+    rec(9e-4)
+    snap = reg.snapshot_histograms()["delivery_latency_seconds"]
+    (series,) = snap["series"]
+    assert series["count"] == 2
+    assert series["labels"] == {"route": "/on-saved"}
+
+
+def test_unused_recorder_series_is_hidden_from_snapshots():
+    reg = MetricsRegistry()
+    reg.recorder("sidecar_request_latency_seconds", route="healthz")
+    snap = reg.snapshot_histograms()["sidecar_request_latency_seconds"]
+    assert snap["series"] == []
+
+
+def test_observe_many_counts_every_value():
+    reg = MetricsRegistry()
+    reg.observe_many("state_queue_wait_seconds",
+                     [1e-4, 2e-4, 5e-2, 1e6], store="s")
+    snap = reg.snapshot_histograms()["state_queue_wait_seconds"]
+    (series,) = snap["series"]
+    assert series["count"] == 4
+    assert sum(series["counts"]) == 4
+    assert series["sum"] == pytest.approx(1e-4 + 2e-4 + 5e-2 + 1e6)
+
+
+def test_disabled_histograms_are_a_noop():
+    reg = MetricsRegistry()
+    reg.histograms_enabled = False
+    reg.observe("invoke_latency_seconds", 0.5, target="api")
+    reg.observe_many("state_queue_wait_seconds", [0.1], store="s")
+    assert reg.snapshot_histograms() == {}
+
+
+def test_percentile_estimates_are_bucket_accurate():
+    reg = MetricsRegistry()
+    # 90 fast (≤ bucket of 1ms) + 10 slow (~0.1s): p50 must sit in the
+    # fast bucket, p99 in the slow one
+    reg.observe_many("invoke_latency_seconds", [1e-3] * 90, target="api")
+    reg.observe_many("invoke_latency_seconds", [0.1] * 10, target="api")
+    rows = summarize_histograms(reg.snapshot_histograms())
+    (row,) = rows
+    assert row["count"] == 100
+    assert row["p50"] <= 2e-3
+    assert 0.05 <= row["p99"] <= 0.2
+
+
+# -- kind collisions ------------------------------------------------------
+
+def test_metric_kind_collision_raises():
+    reg = MetricsRegistry()
+    reg.inc("publish", pubsub="p", topic="t")
+    with pytest.raises(ValueError, match="already registered as counter"):
+        reg.observe("publish", 0.1, pubsub="p", topic="t")
+    with pytest.raises(ValueError, match="already registered as counter"):
+        reg.set_gauge("publish", 1.0)
+
+
+def test_uptime_kind_is_claimed_up_front():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError, match="already registered as gauge"):
+        reg.inc("uptime_seconds")
+
+
+# -- merging across replicas ----------------------------------------------
+
+def _replica_payload(reg: MetricsRegistry) -> dict:
+    """The /v1.0/metadata shape the CLI and admin merge."""
+    return {
+        "metrics": reg.snapshot(),
+        "histograms": reg.snapshot_histograms(),
+        "metric_kinds": reg.snapshot_kinds(),
+    }
+
+
+def test_histogram_merge_adds_bucket_arrays_elementwise():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.observe_many("invoke_latency_seconds", [1e-3] * 3, target="api")
+    b.observe_many("invoke_latency_seconds", [1e-3] * 5, target="api")
+    b.observe("invoke_latency_seconds", 1e-3, target="other")
+    merged = merge_histogram_snapshots(
+        [a.snapshot_histograms(), b.snapshot_histograms()])
+    series = {tuple(sorted(s["labels"].items())): s
+              for s in merged["invoke_latency_seconds"]["series"]}
+    assert series[(("target", "api"),)]["count"] == 8
+    assert sum(series[(("target", "api"),)]["counts"]) == 8
+    assert series[(("target", "other"),)]["count"] == 1
+
+
+def test_flat_merge_sums_counters_and_maxes_gauges():
+    merged = merge_flat_snapshots(
+        [{"publish{topic=t}": 2, "uptime_seconds": 10.0},
+         {"publish{topic=t}": 3, "uptime_seconds": 99.0}],
+        kinds={"publish": "counter", "uptime_seconds": "gauge"},
+    )
+    assert merged["publish{topic=t}"] == 5
+    assert merged["uptime_seconds"] == 99.0
+
+
+def test_cli_percentiles_merges_across_two_replicas(monkeypatch, capsys):
+    """`tasksrunner metrics --percentiles` must aggregate EVERY replica
+    of the app, not whichever one the resolver round-robins to."""
+    import tasksrunner.cli as cli
+
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.observe_many("invoke_latency_seconds", [1e-3] * 40, target="api")
+    b.observe_many("invoke_latency_seconds", [1e-3] * 60, target="api")
+    payloads = [_replica_payload(a), _replica_payload(b)]
+    monkeypatch.setattr(cli, "_fetch_all_replica_metadata",
+                        lambda args: payloads)
+    args = argparse.Namespace(app_id="api", json=False, percentiles=True,
+                              slow=None)
+    cli._metrics_percentiles(args)
+    out = capsys.readouterr().out
+    assert "# merged across 2 replica(s)" in out
+    row = next(line for line in out.splitlines()
+               if line.startswith("invoke_latency_seconds{target=api}"))
+    assert re.search(r"\s100\s", row), row  # 40 + 60 merged
+
+
+# -- Prometheus exposition -------------------------------------------------
+
+def test_render_prometheus_exposition_format():
+    reg = MetricsRegistry()
+    reg.inc("pubsub_delivery", route="/on-saved", status="200")
+    reg.set_gauge("broker_dlq_depth", 2.0, topic="t", group="g")
+    reg.observe("invoke_latency_seconds", 3e-4, target="api")
+    text = render_prometheus(reg)
+
+    assert "# TYPE pubsub_delivery counter" in text
+    assert "# TYPE broker_dlq_depth gauge" in text
+    assert "# TYPE invoke_latency_seconds histogram" in text
+    assert '# HELP invoke_latency_seconds' in text
+    assert 'pubsub_delivery{route="/on-saved",status="200"} 1' in text
+    assert 'broker_dlq_depth{group="g",topic="t"} 2' in text
+    # cumulative buckets: the 3e-4 observation is inside every le ≥ 4e-4
+    assert re.search(
+        r'invoke_latency_seconds_bucket\{target="api",le="0\.0004"\} 1', text)
+    assert 'invoke_latency_seconds_bucket{target="api",le="+Inf"} 1' in text
+    assert 'invoke_latency_seconds_count{target="api"} 1' in text
+    assert 'invoke_latency_seconds_sum{target="api"} 0.0003' in text
+    assert re.search(r'uptime_seconds \d', text)
+    assert text.endswith("\n")
+    # buckets are cumulative and monotone
+    cums = [int(m.group(1)) for m in re.finditer(
+        r'invoke_latency_seconds_bucket\{[^}]*\} (\d+)', text)]
+    assert cums == sorted(cums) and cums[-1] == 1
+
+
+@pytest.mark.asyncio
+async def test_sidecar_metrics_route_serves_prometheus_text(tmp_path):
+    """GET /metrics on a live sidecar returns the exposition including
+    histogram buckets (the acceptance scrape)."""
+    import aiohttp
+
+    from tasksrunner.component.registry import ComponentRegistry
+    from tasksrunner.observability.metrics import metrics
+    from tasksrunner.runtime import Runtime
+    from tasksrunner.sidecar import Sidecar
+
+    class NullChannel:
+        async def request(self, method, path, *, query="", headers=None,
+                          body=b""):
+            return 200, {}, b"{}"
+
+        async def close(self):
+            pass
+
+    runtime = Runtime("metrics-app", ComponentRegistry([]),
+                      app_channel=NullChannel())
+    # exercise a real instrumented client path so the scrape has data
+    runtime.peers["peer"] = NullChannel()
+    await runtime.invoke("peer", "api/tasks", body=b"{}")
+    sidecar = Sidecar(runtime, port=0)
+    await sidecar.start()
+    try:
+        async with aiohttp.ClientSession() as session:
+            resp = await session.get(
+                f"http://127.0.0.1:{sidecar.port}/metrics")
+            body = await resp.text()
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4")
+        assert re.search(
+            r'invoke_latency_seconds_bucket\{target="peer",le="\+Inf"\} \d',
+            body)
+        assert "# TYPE invoke_latency_seconds histogram" in body
+        # the uninstrumented scrape itself registered nothing weird
+        assert metrics.snapshot_kinds()["invoke_latency_seconds"] == "histogram"
+    finally:
+        await sidecar.stop()
+
+
+# -- saturation gauges -----------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_event_loop_lag_probe_sets_gauge():
+    from tasksrunner.observability.probes import EventLoopLagProbe
+
+    reg = MetricsRegistry()
+    probe = EventLoopLagProbe(interval=0.02, registry=reg)
+    probe.start()
+    await asyncio.sleep(0.08)
+    await probe.stop()
+    snap = reg.snapshot()
+    assert "event_loop_lag_seconds" in snap
+    assert snap["event_loop_lag_seconds"] >= 0.0
+
+
+@pytest.mark.asyncio
+async def test_state_write_queue_metrics_flow(tmp_path):
+    """The group-commit store reports queue depth and the queue-wait /
+    commit latency split."""
+    from tasksrunner.observability.metrics import metrics
+    from tasksrunner.state.sqlite import SqliteStateStore
+
+    store = SqliteStateStore("qstore", tmp_path / "s.db")
+    try:
+        await asyncio.gather(*(store.set(f"k{i}", {"v": i})
+                               for i in range(16)))
+    finally:
+        store.close()
+    hists = metrics.snapshot_histograms()
+    waits = [s for s in hists["state_queue_wait_seconds"]["series"]
+             if s["labels"] == {"store": "qstore"}]
+    commits = [s for s in hists["state_commit_seconds"]["series"]
+               if s["labels"] == {"store": "qstore"}]
+    assert waits and waits[0]["count"] >= 16
+    assert commits and commits[0]["count"] >= 1
+    assert "state_write_queue_depth{store=qstore}" in metrics.snapshot()
+
+
+# -- exemplars → traces ----------------------------------------------------
+
+def test_slow_observation_captures_trace_exemplar(monkeypatch):
+    from tasksrunner.observability import tracing
+    from tasksrunner.observability.tracing import TraceContext, trace_scope
+
+    reg = MetricsRegistry()
+    reg.slow_threshold = 0.05
+    ctx = TraceContext.new()
+    with trace_scope(ctx):
+        reg.observe("invoke_latency_seconds", 0.2, target="api")
+    # outside any trace: no exemplar (clear any context an earlier test
+    # set without a scope)
+    tracing._current.set(None)
+    reg.observe("invoke_latency_seconds", 0.2, target="api")
+    (series,) = reg.snapshot_histograms()["invoke_latency_seconds"]["series"]
+    assert len(series["exemplars"]) == 1
+    trace_id, value, when = series["exemplars"][0]
+    assert trace_id == ctx.trace_id
+    assert value == pytest.approx(0.2)
+    assert series["count"] == 2  # slow observations still count in buckets
+
+
+def test_exemplar_ring_keeps_newest(monkeypatch):
+    from tasksrunner.observability.metrics import MAX_EXEMPLARS
+    from tasksrunner.observability.tracing import TraceContext, trace_scope
+
+    reg = MetricsRegistry()
+    reg.slow_threshold = 0.0
+    ids = []
+    for _ in range(MAX_EXEMPLARS + 3):
+        ctx = TraceContext.new()
+        ids.append(ctx.trace_id)
+        with trace_scope(ctx):
+            reg.observe("invoke_latency_seconds", 0.1, target="api")
+    (series,) = reg.snapshot_histograms()["invoke_latency_seconds"]["series"]
+    kept = [e[0] for e in series["exemplars"]]
+    assert kept == ids[-MAX_EXEMPLARS:]
+
+
+@pytest.mark.asyncio
+async def test_slow_invoke_exemplar_resolves_to_recorded_trace(
+        tmp_path, monkeypatch, capsys):
+    """The drill-down loop: a slow call inside a traced request leaves
+    an exemplar whose trace id `metrics --slow` prints and the span
+    store can resolve — percentile tail to full trace tree, no log
+    spelunking."""
+    import tasksrunner.cli as cli
+    from tasksrunner.component.registry import ComponentRegistry
+    from tasksrunner.observability import spans as spans_mod
+    from tasksrunner.observability.metrics import metrics
+    from tasksrunner.observability.tracing import TraceContext, trace_scope
+    from tasksrunner.runtime import Runtime
+
+    db = tmp_path / "traces.db"
+    rec = spans_mod.configure_spans("api", db)
+    monkeypatch.setattr(metrics, "slow_threshold", 0.01)
+
+    class SlowChannel:
+        async def request(self, method, path, *, query="", headers=None,
+                          body=b""):
+            await asyncio.sleep(0.03)
+            return 200, {}, b"{}"
+
+        async def close(self):
+            pass
+
+    runtime = Runtime("api", ComponentRegistry([]))
+    runtime.peers["backend"] = SlowChannel()
+    ctx = TraceContext.new()
+    try:
+        with trace_scope(ctx):
+            status, _, _ = await runtime.invoke("backend", "api/tasks",
+                                                body=b"{}")
+        assert status == 200
+    finally:
+        await runtime.stop()
+        rec.flush()
+        rec.close()
+        spans_mod._recorder = None
+
+    # the exemplar carries the request's trace id
+    series = [
+        s for s in metrics.snapshot_histograms()
+        ["invoke_latency_seconds"]["series"]
+        if s["labels"] == {"target": "backend"}]
+    exemplars = [e for s in series for e in s["exemplars"]]
+    assert any(e[0] == ctx.trace_id for e in exemplars)
+
+    # `tasksrunner metrics --slow` surfaces it with the drill-down hint
+    payloads = [{"metrics": metrics.snapshot(),
+                 "histograms": metrics.snapshot_histograms(),
+                 "metric_kinds": metrics.snapshot_kinds()}]
+    monkeypatch.setattr(cli, "_fetch_all_replica_metadata",
+                        lambda args: payloads)
+    cli._metrics_slow(argparse.Namespace(
+        app_id="api", json=False, slow="invoke_latency"))
+    out = capsys.readouterr().out
+    assert f"trace {ctx.trace_id}" in out
+    assert "tasksrunner traces show" in out
+
+    # and the span store resolves that trace id to the recorded span
+    spans = spans_mod.trace_spans(str(db), ctx.trace_id)
+    assert any(s["name"] == "invoke backend/api/tasks" for s in spans)
+
+
+# -- CLI ergonomics --------------------------------------------------------
+
+def test_traces_cli_missing_db_exits_2(tmp_path, capsys):
+    from tasksrunner.cli import _cmd_traces
+
+    args = argparse.Namespace(action="list", db=str(tmp_path / "absent.db"),
+                              trace_id=None, limit=5, mermaid=False)
+    with pytest.raises(SystemExit) as exc:
+        _cmd_traces(args)
+    assert exc.value.code == 2
+    err = capsys.readouterr().err
+    assert "no trace database" in err
+    assert ".tasksrunner/traces.db" in err
+
+
+def test_metric_name_lint_passes_on_the_tree():
+    import subprocess
+    import sys
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "check_metrics.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+    assert "metric-name lint OK" in proc.stdout

@@ -135,6 +135,88 @@ def test_span_retention_prunes_old_spans(tmp_path, monkeypatch):
     assert names == ["new"]
 
 
+def test_retention_sweep_runs_at_most_once_a_minute(tmp_path):
+    """The prune rides a flush but is rate-limited: back-to-back
+    flushes inside the 60 s window must not re-scan the table."""
+    import sqlite3
+    import time as time_mod
+
+    from tasksrunner.observability.tracing import TraceContext, trace_scope
+
+    db = tmp_path / "traces.db"
+    rec = spans_mod.SpanRecorder("api", db, flush_interval=999,
+                                 retention_seconds=3600)
+    try:
+        with trace_scope(TraceContext.new()):
+            rec.record(kind="server", name="a", status=200,
+                       start=time_mod.time(), duration=0.01)
+        rec.flush()  # first flush sweeps and stamps _last_prune
+        first_prune = rec._last_prune
+        assert first_prune > 0
+        with trace_scope(TraceContext.new()):
+            # old enough to be prunable — but the sweep must not rerun yet
+            rec.record(kind="server", name="expired", status=200,
+                       start=time_mod.time() - 7200, duration=0.01)
+        rec.flush()
+        assert rec._last_prune == first_prune
+        names = {r[0] for r in sqlite3.connect(db).execute(
+            "SELECT name FROM spans").fetchall()}
+        assert names == {"a", "expired"}
+        # a minute later (simulated) the next flush prunes it
+        rec._last_prune = time_mod.time() - 61
+        with trace_scope(TraceContext.new()):
+            rec.record(kind="server", name="b", status=200,
+                       start=time_mod.time(), duration=0.01)
+        rec.flush()
+        names = {r[0] for r in sqlite3.connect(db).execute(
+            "SELECT name FROM spans").fetchall()}
+        assert names == {"a", "b"}
+    finally:
+        rec.close()
+
+
+def test_nonpositive_retention_keeps_everything(tmp_path):
+    import sqlite3
+    import time as time_mod
+
+    from tasksrunner.observability.tracing import TraceContext, trace_scope
+
+    db = tmp_path / "traces.db"
+    rec = spans_mod.SpanRecorder("api", db, flush_interval=999,
+                                 retention_seconds=0)
+    try:
+        with trace_scope(TraceContext.new()):
+            rec.record(kind="server", name="ancient", status=200,
+                       start=time_mod.time() - 10 * 365 * 24 * 3600,
+                       duration=0.01)
+        rec.flush()
+        names = [r[0] for r in sqlite3.connect(db).execute(
+            "SELECT name FROM spans").fetchall()]
+        assert names == ["ancient"]
+    finally:
+        rec.close()
+
+
+def test_close_wins_race_against_inflight_tick(tmp_path):
+    """A _tick() that already fired when close() cancelled the timer
+    must not resurrect the flush loop: post-close, no new timer may be
+    scheduled and late records must not crash."""
+    rec = spans_mod.SpanRecorder("api", tmp_path / "traces.db",
+                                 flush_interval=999)
+    rec.close()
+    closed_timer = rec._timer
+    # simulate the in-flight tick finishing after close
+    rec._tick()
+    assert rec._closed
+    assert rec._timer is closed_timer  # _schedule refused to rearm
+    # cancel() set the timer's finished event; it will never fire
+    assert rec._timer.finished.is_set()
+    rec._schedule()
+    assert rec._timer is closed_timer
+    # close is idempotent
+    rec.close()
+
+
 def test_service_map_aggregates_per_edge_not_per_operation(tmp_path):
     """Two different operations against the same target are ONE
     App-Map edge: span names embed the method path, so grouping by
