@@ -1,14 +1,21 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test lint-metrics soak bench bench-state bench-hist chaos sweep-flash run validate docs-serve docs-build clean
+.PHONY: test lint lint-metrics soak bench bench-state bench-hist chaos sweep-flash run validate docs-serve docs-build clean
 
-test: lint-metrics
+test: lint
 	python -m pytest tests/ -q
 
-# every metrics.inc/set_gauge/observe site must use a name declared in
-# tasksrunner/observability/names.py — catches series-forking typos
+# tasklint: AST enforcement of the runtime's invariants — no blocking
+# calls on the event loop, declared metric names, env_flag for every
+# boolean knob, errors.py taxonomy on sidecar-facing paths
+# (docs/modules/17-static-analysis.md)
+lint:
+	python -m tasksrunner.analysis
+
+# back-compat alias: the metric-name check is now the tasklint
+# `metric-names` rule
 lint-metrics:
-	python scripts/check_metrics.py
+	python -m tasksrunner.analysis --rules metric-names
 
 soak:
 	TASKSRUNNER_SOAK=1 python -m pytest tests/test_soak.py -q

@@ -1,72 +1,23 @@
 #!/usr/bin/env python
-"""Metric-name lint: every instrumentation site must use a name
-declared in tasksrunner/observability/names.py, under the right
-instrument kind.
+"""Metric-name lint — thin alias over the tasklint ``metric-names`` rule.
 
-A typo'd name (or the same name used as two kinds) forks a time series
-silently — dashboards, the autoscaler, and the percentile views then
-disagree about which series is real. This script greps every
-``metrics.inc(...)`` / ``set_gauge(...)`` / ``observe(...)`` call in
-the package and fails (exit 1) on any name the registry doesn't
-declare for that kind. Run via ``make lint-metrics`` (wired into
-``make test``).
+The regex-based checker that used to live here was absorbed into the
+AST engine (``tasksrunner/analysis/rules/metricnames.py``), where it
+shares inline suppressions, the baseline, ``--json`` output, and the
+per-file cache with every other invariant rule. This shim keeps
+``python scripts/check_metrics.py`` and the ``make lint-metrics``
+workflow working unchanged.
 """
 
 from __future__ import annotations
 
 import pathlib
-import re
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-from tasksrunner.observability import names  # noqa: E402
-
-# metrics.inc("name", ...) / registry.set_gauge("name", ...) — the
-# receiver is unconstrained so helper registries are linted too
-CALL_RE = re.compile(
-    r"\.(inc|set_gauge|observe_many|observe|recorder)\("
-    r"\s*\n?\s*[\"']([A-Za-z0-9_]+)[\"']")
-
-KIND_TABLE = {
-    "inc": ("counter", names.COUNTERS),
-    "set_gauge": ("gauge", names.GAUGES),
-    "observe": ("histogram", names.HISTOGRAMS),
-    "observe_many": ("histogram", names.HISTOGRAMS),
-    "recorder": ("histogram", names.HISTOGRAMS),
-}
-
-
-def main() -> int:
-    problems: list[str] = []
-    sites = 0
-    for path in sorted((REPO / "tasksrunner").rglob("*.py")):
-        text = path.read_text()
-        for match in CALL_RE.finditer(text):
-            method, name = match.group(1), match.group(2)
-            kind, table = KIND_TABLE[method]
-            sites += 1
-            if name not in table:
-                line = text.count("\n", 0, match.start()) + 1
-                where = f"{path.relative_to(REPO)}:{line}"
-                if name in names.ALL:
-                    problems.append(
-                        f"{where}: {name!r} used as {kind} but declared as "
-                        "a different kind in observability/names.py")
-                else:
-                    problems.append(
-                        f"{where}: {kind} name {name!r} not declared in "
-                        "observability/names.py")
-    if problems:
-        print("metric-name lint FAILED:", file=sys.stderr)
-        for p in problems:
-            print(f"  {p}", file=sys.stderr)
-        return 1
-    print(f"metric-name lint OK ({sites} instrumentation sites, "
-          f"{len(names.ALL)} declared names)")
-    return 0
-
+from tasksrunner.analysis.engine import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--rules", "metric-names", *sys.argv[1:]]))

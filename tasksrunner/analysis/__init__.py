@@ -1,0 +1,30 @@
+"""tasklint — AST-based static analysis for the runtime's invariants.
+
+The architecture built in PRs 1-3 rests on conventions no type checker
+sees: all SQLite I/O runs on dedicated off-loop threads, hot-path
+instrumentation must use names declared in ``observability/names.py``,
+boolean env knobs must go through ``envflag.env_flag``, and
+sidecar-facing paths raise the taxonomy in ``errors.py``. A single
+blocking call or typo'd flag silently regresses p99 latency or forks a
+metric series — so the rules here turn each convention into a CI
+failure.
+
+Entry points:
+
+* ``python -m tasksrunner.analysis`` / ``tasksrunner lint`` — the CLI
+  (``make lint``, wired into ``make test``).
+* :func:`tasksrunner.analysis.engine.run` — programmatic API used by
+  the test suite.
+
+Mechanics (see ``docs/modules/17-static-analysis.md``): a rule registry
+(:mod:`.core`), per-file result caching keyed on content+ruleset
+(:mod:`.cache`), inline suppressions (``# tasklint: disable=<rule>``),
+and a checked-in baseline for grandfathered findings
+(:mod:`.baseline`).
+"""
+
+from __future__ import annotations
+
+from tasksrunner.analysis.core import RULES, Finding, Rule, register
+
+__all__ = ["RULES", "Finding", "Rule", "register"]

@@ -1,0 +1,10 @@
+"""``python -m tasksrunner.analysis`` — the tasklint CLI."""
+
+from __future__ import annotations
+
+import sys
+
+from tasksrunner.analysis.engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,82 @@
+"""Checked-in baseline of grandfathered findings.
+
+The baseline lets tasklint land with strict rules on an imperfect tree:
+pre-existing findings are recorded (fingerprint → count) and stop
+failing the build, while anything *new* still does. The shipped
+baseline is empty — every finding on the current tree was fixed or
+explicitly suppressed inline — but the mechanism stays so a future rule
+can be introduced without a flag day.
+
+Format (JSON, sorted, diff-friendly)::
+
+    {"version": 1,
+     "findings": {"<fingerprint>": {"rule": ..., "path": ...,
+                                    "message": ..., "count": N}}}
+
+Fingerprints exclude line numbers (see ``Finding.fingerprint``), so
+unrelated edits don't churn this file. Entries that no longer match
+anything are *stale*; ``--update-baseline`` expires them (and records
+any new findings).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import pathlib
+
+from tasksrunner.analysis.core import Finding
+
+VERSION = 1
+
+
+def load(path: pathlib.Path) -> dict[str, dict]:
+    """fingerprint → entry; empty dict when the file is absent."""
+    if not path.is_file():
+        return {}
+    doc = json.loads(path.read_text() or "{}")
+    if doc.get("version") not in (None, VERSION):
+        raise ValueError(
+            f"baseline {path} has version {doc.get('version')!r}, "
+            f"this engine understands {VERSION}")
+    return dict(doc.get("findings") or {})
+
+
+def apply(findings: list[Finding], baseline: dict[str, dict],
+          ) -> tuple[list[Finding], int, dict[str, dict]]:
+    """Split findings against the baseline.
+
+    Returns ``(new_findings, matched_count, stale_entries)`` where
+    ``stale_entries`` are baseline records that matched nothing — the
+    grandfathered problem was fixed and the entry should be expired.
+    """
+    budget = {fp: int(entry.get("count", 1))
+              for fp, entry in baseline.items()}
+    fresh: list[Finding] = []
+    matched = 0
+    for f in findings:
+        fp = f.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            matched += 1
+        else:
+            fresh.append(f)
+    stale = {fp: baseline[fp] for fp, left in budget.items()
+             if left == int(baseline[fp].get("count", 1))}
+    return fresh, matched, stale
+
+
+def write(path: pathlib.Path, findings: list[Finding]) -> dict[str, dict]:
+    """Rewrite the baseline to exactly the given findings (add new,
+    expire stale) and return the written table."""
+    table: dict[str, dict] = {}
+    counts = collections.Counter(f.fingerprint() for f in findings)
+    for f in findings:
+        fp = f.fingerprint()
+        table[fp] = {"rule": f.rule, "path": f.path,
+                     "message": f.message, "count": counts[fp]}
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(
+        {"version": VERSION, "findings": dict(sorted(table.items()))},
+        indent=2, sort_keys=False) + "\n")
+    return table

@@ -1,0 +1,78 @@
+"""Per-file result cache.
+
+Linting the whole package parses ~80 modules; editors and `make test`
+run it repeatedly, so unchanged files must be free. The cache maps
+absolute path → (mtime, size, ruleset signature, findings). The
+signature hashes the *source of the analysis package itself* plus the
+selected rule ids, so editing any rule — or selecting a different
+subset — invalidates every entry without a manual version bump.
+
+Suppression comments live in the linted file, so cached findings are
+post-suppression; the baseline is applied after the cache by the
+engine (the baseline file can change independently of the sources).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import pathlib
+
+from tasksrunner.analysis.core import Finding
+
+_PKG = pathlib.Path(__file__).resolve().parent
+
+
+def ruleset_signature(rule_ids: tuple[str, ...]) -> str:
+    h = hashlib.sha1()
+    for src in sorted(_PKG.rglob("*.py")):
+        h.update(src.name.encode())
+        h.update(src.read_bytes())
+    h.update("|".join(rule_ids).encode())
+    return h.hexdigest()[:16]
+
+
+class ResultCache:
+    def __init__(self, path: pathlib.Path | None, signature: str):
+        self.path = path
+        self.signature = signature
+        self.hits = 0
+        self._dirty = False
+        self._table: dict[str, dict] = {}
+        if path is not None and path.is_file():
+            try:
+                self._table = json.loads(path.read_text()) or {}
+            except ValueError:  # corrupt cache: rebuild silently
+                self._table = {}
+
+    def get(self, path: pathlib.Path) -> list[Finding] | None:
+        entry = self._table.get(str(path))
+        if entry is None or entry.get("sig") != self.signature:
+            return None
+        stat = path.stat()
+        if entry.get("mtime") != stat.st_mtime_ns or \
+                entry.get("size") != stat.st_size:
+            return None
+        self.hits += 1
+        return [Finding.from_json(d) for d in entry.get("findings", [])]
+
+    def put(self, path: pathlib.Path, findings: list[Finding]) -> None:
+        stat = path.stat()
+        self._table[str(path)] = {
+            "sig": self.signature,
+            "mtime": stat.st_mtime_ns,
+            "size": stat.st_size,
+            "findings": [f.to_json() for f in findings],
+        }
+        self._dirty = True
+
+    def save(self) -> None:
+        if self.path is None or not self._dirty:
+            return
+        # entries from older rulesets are dead weight — drop them
+        live = {k: v for k, v in self._table.items()
+                if v.get("sig") == self.signature}
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = self.path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(live))
+        tmp.replace(self.path)

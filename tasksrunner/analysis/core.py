@@ -1,0 +1,193 @@
+"""Rule-engine primitives: findings, file context, and the registry.
+
+A rule is a stateless object with an ``id``, a one-line ``doc``, and a
+``check(ctx)`` generator over :class:`Finding`. Rules register
+themselves with the :func:`register` decorator at import time
+(``rules/__init__.py`` imports every rule module), so the engine, the
+CLI's ``--rules`` filter, and the suppression validator all share one
+table.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import pathlib
+import re
+from typing import Iterable, Iterator
+
+#: ``tasklint: disable=<rule>[,<rule>]`` in a comment suppresses those
+#: rules' findings on that line; ``disable-file=<rule>`` anywhere
+#: suppresses for the whole file.
+SUPPRESS_RE = re.compile(
+    r"#\s*tasklint:\s*(disable|disable-file)=([A-Za-z0-9_,\- ]+)")
+
+#: ``# tasklint: off-loop`` on a ``def`` line declares the function a
+#: dedicated-thread entrypoint: blocking calls inside it are expected.
+OFF_LOOP_RE = re.compile(r"#\s*tasklint:\s*off-loop\b")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    path: str  # repo-relative posix path
+    line: int
+    col: int  # 1-based, for editors
+    rule: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def fingerprint(self) -> str:
+        """Baseline identity. Deliberately excludes the line number so
+        unrelated edits above a grandfathered finding don't churn the
+        baseline file; two identical findings in one file share a
+        fingerprint and are matched by count."""
+        raw = f"{self.rule}|{self.path}|{self.message}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "fingerprint": self.fingerprint(),
+        }
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "Finding":
+        return cls(path=doc["path"], line=doc["line"], col=doc["col"],
+                   rule=doc["rule"], message=doc["message"])
+
+
+class FileContext:
+    """Everything a rule may inspect about one source file."""
+
+    def __init__(self, path: pathlib.Path, relpath: str, source: str,
+                 tree: ast.Module):
+        self.path = path
+        #: repo-relative posix path ("tasksrunner/state/sqlite.py") —
+        #: rules scope themselves by prefix on this
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self._constants: dict[str, str] | None = None
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(path=self.relpath,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       rule=rule, message=message)
+
+    @property
+    def constants(self) -> dict[str, str]:
+        """Module-level ``NAME = "literal"`` assignments — lets rules
+        see through the ``TOKEN_ENV = "TASKSRUNNER_API_TOKEN"`` idiom."""
+        if self._constants is None:
+            table: dict[str, str] = {}
+            for node in self.tree.body:
+                if (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            table[tgt.id] = node.value.value
+            self._constants = table
+        return self._constants
+
+    def resolve_str(self, node: ast.AST) -> str | None:
+        """A string literal, or a Name bound to one at module level."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            return self.constants.get(node.id)
+        return None
+
+    def marked_off_loop(self, node: ast.AST) -> bool:
+        """``# tasklint: off-loop`` on the def line (or any decorator
+        line) of a function node."""
+        first = min(getattr(node, "lineno", 1),
+                    *[d.lineno for d in getattr(node, "decorator_list", [])]
+                    or [getattr(node, "lineno", 1)])
+        for lineno in range(first, getattr(node, "lineno", first) + 1):
+            if 0 < lineno <= len(self.lines) and \
+                    OFF_LOOP_RE.search(self.lines[lineno - 1]):
+                return True
+        return False
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """"time.sleep" for ``Attribute(Name)`` chains; None otherwise."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def import_table(tree: ast.Module) -> dict[str, str]:
+    """local alias → fully qualified name, from import statements.
+
+    ``import time`` → {"time": "time"}; ``from time import sleep as s``
+    → {"s": "time.sleep"}. Lets rules match on canonical names no
+    matter how the module spells the import.
+    """
+    table: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                table[alias.asname or alias.name.split(".")[0]] = \
+                    alias.name if alias.asname else alias.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module and not node.level:
+            for alias in node.names:
+                table[alias.asname or alias.name] = \
+                    f"{node.module}.{alias.name}"
+    return table
+
+
+def resolve_call(ctx_imports: dict[str, str], func: ast.AST) -> str | None:
+    """Canonical dotted name of a call target, resolving import
+    aliases: ``s(...)`` after ``from time import sleep as s`` resolves
+    to "time.sleep"."""
+    dotted = dotted_name(func)
+    if dotted is None:
+        return None
+    head, _, rest = dotted.partition(".")
+    base = ctx_imports.get(head, head)
+    return f"{base}.{rest}" if rest else base
+
+
+class Rule:
+    """Base class; subclasses set ``id``/``doc`` and yield findings."""
+
+    id: str = ""
+    doc: str = ""
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def walk(self, ctx: FileContext) -> Iterator[ast.AST]:
+        yield from ast.walk(ctx.tree)
+
+
+#: rule id → singleton instance; populated at import of ``.rules``
+RULES: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    inst = cls()
+    if not inst.id:
+        raise ValueError(f"{cls.__name__} has no rule id")
+    if inst.id in RULES:
+        raise ValueError(f"duplicate rule id {inst.id!r}")
+    RULES[inst.id] = inst
+    return cls

@@ -1,0 +1,240 @@
+"""File walking, suppression handling, baseline plumbing, and the CLI.
+
+Exit codes: 0 clean, 1 findings (or stale-baseline when ``--strict``),
+2 usage error. ``--json`` emits one machine-readable document::
+
+    {"version": 1,
+     "findings": [{"rule", "path", "line", "col", "message",
+                   "fingerprint"}, ...],
+     "files": N, "suppressed": N, "baselined": N,
+     "stale_baseline": [...]}
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import pathlib
+import sys
+from typing import Iterable, TextIO
+
+from tasksrunner.analysis import baseline as baseline_mod
+from tasksrunner.analysis import rules  # noqa: F401 - populates RULES
+from tasksrunner.analysis.cache import ResultCache, ruleset_signature
+from tasksrunner.analysis.core import RULES, Finding, SUPPRESS_RE
+
+#: repo root = parent of the tasksrunner package
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+DEFAULT_TARGET = REPO_ROOT / "tasksrunner"
+DEFAULT_BASELINE = REPO_ROOT / "tasklint-baseline.json"
+DEFAULT_CACHE = REPO_ROOT / ".tasksrunner" / "tasklint-cache.json"
+
+JSON_VERSION = 1
+
+
+def relpath(path: pathlib.Path) -> str:
+    try:
+        return path.resolve().relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return path.resolve().as_posix()
+
+
+def iter_py_files(paths: Iterable[pathlib.Path]) -> list[pathlib.Path]:
+    out: list[pathlib.Path] = []
+    for p in paths:
+        if p.is_dir():
+            out.extend(sorted(f for f in p.rglob("*.py")
+                              if "__pycache__" not in f.parts))
+        else:
+            out.append(p)
+    return out
+
+
+def _suppressions(source: str) -> tuple[dict[int, set[str]], set[str],
+                                        list[tuple[int, str]]]:
+    """(per-line rule sets, whole-file rule set, unknown-rule sites)."""
+    per_line: dict[int, set[str]] = {}
+    whole_file: set[str] = set()
+    unknown: list[tuple[int, str]] = []
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        for match in SUPPRESS_RE.finditer(line):
+            scope, raw = match.group(1), match.group(2)
+            for rid in (r.strip() for r in raw.split(",")):
+                if not rid:
+                    continue
+                if rid not in RULES:
+                    unknown.append((lineno, rid))
+                elif scope == "disable-file":
+                    whole_file.add(rid)
+                else:
+                    per_line.setdefault(lineno, set()).add(rid)
+    return per_line, whole_file, unknown
+
+
+def lint_file(path: pathlib.Path, rule_ids: tuple[str, ...],
+              ) -> tuple[list[Finding], int]:
+    """(findings, suppressed-count) for one file — cache-independent."""
+    rel = relpath(path)
+    try:
+        source = path.read_text()
+    except (OSError, UnicodeDecodeError) as exc:
+        return [Finding(path=rel, line=1, col=1, rule="parse-error",
+                        message=f"cannot read file: {exc}")], 0
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:
+        return [Finding(path=rel, line=exc.lineno or 1, col=1,
+                        rule="parse-error",
+                        message=f"syntax error: {exc.msg}")], 0
+
+    from tasksrunner.analysis.core import FileContext
+    ctx = FileContext(path, rel, source, tree)
+    raw: list[Finding] = []
+    for rid in rule_ids:
+        raw.extend(RULES[rid].check(ctx))
+
+    per_line, whole_file, unknown = _suppressions(source)
+    findings: list[Finding] = []
+    suppressed = 0
+    for f in raw:
+        if f.rule in whole_file or f.rule in per_line.get(f.line, ()):
+            suppressed += 1
+        else:
+            findings.append(f)
+    for lineno, rid in unknown:
+        # an unknown id in a suppression is itself a finding — a typo
+        # here silently re-enables the check it meant to switch off
+        findings.append(Finding(
+            path=rel, line=lineno, col=1, rule="bad-suppression",
+            message=f"unknown rule id {rid!r} in tasklint suppression "
+                    f"(known: {', '.join(sorted(RULES))})"))
+    return sorted(findings), suppressed
+
+
+def run(paths: list[pathlib.Path], rule_ids: tuple[str, ...], *,
+        baseline_path: pathlib.Path | None = None,
+        update_baseline: bool = False,
+        cache_path: pathlib.Path | None = None,
+        json_out: bool = False,
+        out: TextIO = sys.stdout) -> int:
+    files = iter_py_files(paths)
+    cache = ResultCache(cache_path, ruleset_signature(rule_ids))
+    all_findings: list[Finding] = []
+    suppressed = 0
+    for path in files:
+        cached = cache.get(path)
+        if cached is not None:
+            all_findings.extend(cached)
+            continue
+        findings, nsup = lint_file(path, rule_ids)
+        suppressed += nsup
+        cache.put(path, findings)
+        all_findings.extend(findings)
+    cache.save()
+    all_findings.sort()
+
+    base = baseline_mod.load(baseline_path) if baseline_path else {}
+    if update_baseline:
+        assert baseline_path is not None
+        table = baseline_mod.write(baseline_path, all_findings)
+        print(f"tasklint: baseline {relpath(baseline_path)} rewritten: "
+              f"{len(table)} entries "
+              f"({len(all_findings)} findings recorded, stale expired)",
+              file=out)
+        return 0
+    fresh, matched, stale = baseline_mod.apply(all_findings, base)
+
+    if json_out:
+        json.dump({
+            "version": JSON_VERSION,
+            "findings": [f.to_json() for f in fresh],
+            "files": len(files),
+            "suppressed": suppressed,
+            "baselined": matched,
+            "stale_baseline": [dict(entry, fingerprint=fp)
+                               for fp, entry in sorted(stale.items())],
+        }, out, indent=2)
+        out.write("\n")
+    else:
+        for f in fresh:
+            print(f.format(), file=out)
+        for fp, entry in sorted(stale.items()):
+            print(f"tasklint: note: baseline entry {fp} "
+                  f"({entry.get('rule')} in {entry.get('path')}) no longer "
+                  "matches — run --update-baseline to expire it", file=out)
+        status = "FAILED" if fresh else "OK"
+        extras = []
+        if suppressed:
+            extras.append(f"{suppressed} suppressed inline")
+        if matched:
+            extras.append(f"{matched} baselined")
+        if cache.hits:
+            extras.append(f"{cache.hits} cached")
+        print(f"tasklint {status}: {len(fresh)} finding(s) over "
+              f"{len(files)} file(s), {len(rule_ids)} rule(s)"
+              + (f" ({', '.join(extras)})" if extras else ""), file=out)
+    return 1 if fresh else 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tasksrunner lint",
+        description="tasklint: AST checks for the runtime's concurrency, "
+                    "env-flag, metric-name, and error-taxonomy invariants.")
+    parser.add_argument("paths", nargs="*", type=pathlib.Path,
+                        help="files or directories (default: the "
+                             "tasksrunner package)")
+    parser.add_argument("--rules", default=None, metavar="CSV",
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    parser.add_argument("--json", action="store_true", dest="json_out",
+                        help="machine-readable findings on stdout")
+    parser.add_argument("--baseline", type=pathlib.Path,
+                        default=DEFAULT_BASELINE,
+                        help="grandfathered-findings file "
+                             "(default: tasklint-baseline.json)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline to the current findings "
+                             "(records new, expires stale) and exit 0")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="ignore and don't write the per-file cache")
+    parser.add_argument("--cache", type=pathlib.Path, default=DEFAULT_CACHE,
+                        help="cache location (default: "
+                             ".tasksrunner/tasklint-cache.json)")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        width = max(len(r) for r in RULES)
+        for rid in sorted(RULES):
+            print(f"{rid:<{width}}  {RULES[rid].doc}")
+        return 0
+    if args.rules:
+        rule_ids = tuple(r.strip() for r in args.rules.split(",") if r.strip())
+        unknown = [r for r in rule_ids if r not in RULES]
+        if unknown:
+            print(f"tasklint: unknown rule(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(RULES))})", file=sys.stderr)
+            return 2
+    else:
+        rule_ids = tuple(sorted(RULES))
+    paths = args.paths or [DEFAULT_TARGET]
+    missing = [p for p in paths if not p.exists()]
+    if missing:
+        print("tasklint: no such path: "
+              + ", ".join(str(p) for p in missing), file=sys.stderr)
+        return 2
+    return run(paths, rule_ids,
+               baseline_path=args.baseline,
+               update_baseline=args.update_baseline,
+               cache_path=None if args.no_cache else args.cache,
+               json_out=args.json_out)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,12 @@
+"""Rule modules — importing this package populates ``core.RULES``."""
+
+from __future__ import annotations
+
+from tasksrunner.analysis.rules import (  # noqa: F401
+    blocking,
+    coroutines,
+    envflags,
+    locks,
+    metricnames,
+    taxonomy,
+)

@@ -1,0 +1,168 @@
+"""blocking-call-in-async — the event loop must never block.
+
+The whole latency story of this runtime (group-commit writes, sub-ms
+busy backoff, p99 histograms) assumes the asyncio loop is free to
+schedule: every SQLite statement, file read, and sleep runs on the
+dedicated reader/writer threads of ``state/sqlite.py`` and
+``pubsub/sqlite.py``. One synchronous ``conn.execute`` or
+``time.sleep`` inside an ``async def`` stalls every request in the
+process — and profiles as "mysterious p99 spikes", not as an error.
+
+Two checks:
+
+* inside ``async def`` bodies (nested synchronous ``def``/``lambda``
+  scopes are excluded — they run wherever they're called, typically on
+  an executor thread): any call matching the blocking table below;
+* ``time.sleep`` anywhere else — a sync helper sleeping is only
+  legitimate on a dedicated thread, which the code must declare, either
+  in :data:`OFF_LOOP_ENTRYPOINTS` or with ``# tasklint: off-loop`` on
+  the ``def`` line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from tasksrunner.analysis.core import (
+    FileContext, Finding, Rule, import_table, register, resolve_call,
+)
+
+#: canonical dotted call targets that park the calling thread
+BLOCKING_CALLS = {
+    "time.sleep": "time.sleep() parks the event loop; use await asyncio.sleep() "
+                  "or run the helper on an executor thread",
+    "sqlite3.connect": "sqlite3.connect() does disk I/O; open connections on the "
+                       "store's dedicated thread",
+    "subprocess.run": "subprocess.run() blocks until the child exits; use "
+                      "asyncio.create_subprocess_exec",
+    "subprocess.call": "subprocess.call() blocks; use asyncio.create_subprocess_exec",
+    "subprocess.check_call": "subprocess.check_call() blocks; use "
+                             "asyncio.create_subprocess_exec",
+    "subprocess.check_output": "subprocess.check_output() blocks; use "
+                               "asyncio.create_subprocess_exec",
+    "os.system": "os.system() blocks; use asyncio.create_subprocess_exec",
+    "socket.create_connection": "socket.create_connection() blocks on the "
+                                "handshake; use loop.create_connection",
+    "urllib.request.urlopen": "urlopen() blocks on the whole response; use the "
+                              "async invoke client",
+}
+
+#: builtins / bare names that block
+BLOCKING_NAMES = {
+    "open": "open() does disk I/O on the loop; read the file on an executor "
+            "thread (run_in_executor / asyncio.to_thread)",
+}
+
+#: attribute calls that are blocking on the objects this codebase uses
+#: them on (sqlite3 connections/cursors, pathlib.Path)
+BLOCKING_ATTRS = {
+    "execute": "sqlite .execute() runs SQL on the calling thread; submit it to "
+               "the store's reader/writer executor",
+    "executemany": "sqlite .executemany() blocks; submit it to the store's "
+                   "executor",
+    "executescript": "sqlite .executescript() blocks; submit it to the store's "
+                     "executor",
+    "read_text": "Path.read_text() does disk I/O; move it off-loop",
+    "write_text": "Path.write_text() does disk I/O; move it off-loop",
+    "read_bytes": "Path.read_bytes() does disk I/O; move it off-loop",
+    "write_bytes": "Path.write_bytes() does disk I/O; move it off-loop",
+}
+
+#: declared dedicated-thread entrypoints: sync helpers that *may* block
+#: because the architecture guarantees they only ever run on the
+#: store's own threads (see module docstrings of both engines). Keyed
+#: by repo-relative path. Kept here — next to the rule — so the
+#: allowlist is reviewed whenever the rule is.
+OFF_LOOP_ENTRYPOINTS: dict[str, frozenset[str]] = {
+    "tasksrunner/state/sqlite.py": frozenset({
+        "_begin_immediate",   # writer thread: sub-ms busy backoff
+        "_checkpoint_loop",   # dedicated PASSIVE-checkpoint thread
+    }),
+    "tasksrunner/pubsub/sqlite.py": frozenset({
+        "_write_txn",         # db thread: sub-ms busy backoff
+        "_checkpoint_loop",   # dedicated PASSIVE-checkpoint thread
+    }),
+}
+
+
+class _FnCtx:
+    __slots__ = ("node", "is_async", "allowed")
+
+    def __init__(self, node: ast.AST, is_async: bool, allowed: bool):
+        self.node = node
+        self.is_async = is_async
+        self.allowed = allowed
+
+
+@register
+class BlockingCallInAsync(Rule):
+    id = "blocking-call-in-async"
+    doc = ("no synchronous I/O or sleeps on the event loop; sync helpers "
+           "that block must be declared off-loop")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        imports = import_table(ctx.tree)
+        allowed_here = OFF_LOOP_ENTRYPOINTS.get(ctx.relpath, frozenset())
+        yield from self._scan(ctx, imports, ctx.tree.body, None, allowed_here)
+
+    def _scan(self, ctx: FileContext, imports: dict[str, str],
+              body: list[ast.stmt], fn: _FnCtx | None,
+              allowed_here: frozenset[str]) -> Iterator[Finding]:
+        for stmt in body:
+            yield from self._visit(ctx, imports, stmt, fn, allowed_here)
+
+    def _visit(self, ctx: FileContext, imports: dict[str, str],
+               node: ast.AST, fn: _FnCtx | None,
+               allowed_here: frozenset[str]) -> Iterator[Finding]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            allowed = (node.name in allowed_here
+                       or ctx.marked_off_loop(node))
+            sub = _FnCtx(node, isinstance(node, ast.AsyncFunctionDef), allowed)
+            for child in ast.iter_child_nodes(node):
+                yield from self._visit(ctx, imports, child, sub, allowed_here)
+            return
+        if isinstance(node, ast.Lambda):
+            # a lambda body runs wherever it is *called*; don't blame
+            # the enclosing async scope for it
+            return
+        if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+            # an awaited call is an async API (resiliency .execute(),
+            # aiosqlite-style drivers): arguments still get scanned,
+            # the call itself is not blocking
+            call = node.value
+            for child in ast.iter_child_nodes(call):
+                yield from self._visit(ctx, imports, child, fn, allowed_here)
+            return
+        if isinstance(node, ast.Call):
+            yield from self._check_call(ctx, imports, node, fn)
+        for child in ast.iter_child_nodes(node):
+            yield from self._visit(ctx, imports, child, fn, allowed_here)
+
+    def _check_call(self, ctx: FileContext, imports: dict[str, str],
+                    call: ast.Call, fn: _FnCtx | None) -> Iterator[Finding]:
+        target = resolve_call(imports, call.func)
+        in_async = fn is not None and fn.is_async
+        allowed = fn is not None and fn.allowed
+        if target in BLOCKING_CALLS:
+            if target == "time.sleep":
+                # blocking everywhere except declared off-loop helpers
+                if not allowed:
+                    where = ("inside async def" if in_async else
+                             "in a function not declared off-loop")
+                    yield ctx.finding(
+                        self.id, call,
+                        f"{BLOCKING_CALLS[target]} ({where}; declare the "
+                        "helper in OFF_LOOP_ENTRYPOINTS or mark it "
+                        "'# tasklint: off-loop' if it only runs on a "
+                        "dedicated thread)")
+            elif in_async and not allowed:
+                yield ctx.finding(self.id, call, BLOCKING_CALLS[target])
+            return
+        if not in_async or allowed:
+            return
+        if isinstance(call.func, ast.Name) and call.func.id in BLOCKING_NAMES:
+            yield ctx.finding(self.id, call, BLOCKING_NAMES[call.func.id])
+        elif isinstance(call.func, ast.Attribute) and \
+                call.func.attr in BLOCKING_ATTRS:
+            yield ctx.finding(self.id, call, BLOCKING_ATTRS[call.func.attr])

@@ -1,0 +1,81 @@
+"""unawaited-coroutine — discarded coroutines and orphaned tasks.
+
+Calling an ``async def`` without ``await`` builds a coroutine object
+and throws it away: the operation silently never runs (Python warns
+only at GC time, to stderr, in whatever process happened to collect
+it). ``asyncio.create_task`` without a retained reference is subtler —
+the event loop holds tasks weakly, so a GC pass can cancel a running
+task mid-flight; every long-lived task in this codebase is retained on
+``self`` (see ``pubsub/sqlite.py`` poll loops) for exactly that reason.
+
+Detection is name-based within the file: a bare expression statement
+calling a function *defined* ``async def`` in the same module (by name
+for module-level functions, by ``self.<attr>`` for methods) is flagged,
+as is a bare ``asyncio.create_task(...)`` / ``ensure_future`` /
+``loop.create_task(...)`` whose result nothing captures.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from tasksrunner.analysis.core import (
+    FileContext, Finding, Rule, import_table, register, resolve_call,
+)
+
+_SPAWNERS = {"asyncio.create_task", "asyncio.ensure_future"}
+
+
+def _async_names(tree: ast.Module) -> set[str]:
+    """Names defined *only* as async in this module — a name that is
+    also a sync ``def`` somewhere (cli.py's module-level ``main`` vs
+    the nested ``async def main`` helpers) is ambiguous and skipped."""
+    async_names = {node.name for node in ast.walk(tree)
+                   if isinstance(node, ast.AsyncFunctionDef)}
+    sync_names = {node.name for node in ast.walk(tree)
+                  if isinstance(node, ast.FunctionDef)}
+    return async_names - sync_names
+
+
+@register
+class UnawaitedCoroutine(Rule):
+    id = "unawaited-coroutine"
+    doc = ("bare calls to local coroutine functions and create_task "
+           "without a retained reference")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        imports = import_table(ctx.tree)
+        async_names = _async_names(ctx.tree)
+        for node in self.walk(ctx):
+            if not (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            call = node.value
+            yield from self._check_bare_call(ctx, imports, async_names, call)
+
+    def _check_bare_call(self, ctx: FileContext, imports: dict[str, str],
+                         async_names: set[str], call: ast.Call,
+                         ) -> Iterator[Finding]:
+        target = resolve_call(imports, call.func)
+        if target in _SPAWNERS or (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr == "create_task"):
+            yield ctx.finding(
+                self.id, call,
+                "task reference discarded: the loop holds tasks weakly, so "
+                "GC can cancel it mid-flight — retain it (self._task = ...) "
+                "and cancel it on close")
+            return
+        name: str | None = None
+        if isinstance(call.func, ast.Name):
+            name = call.func.id
+        elif (isinstance(call.func, ast.Attribute)
+              and isinstance(call.func.value, ast.Name)
+              and call.func.value.id in ("self", "cls")):
+            name = call.func.attr
+        if name is not None and name in async_names:
+            yield ctx.finding(
+                self.id, call,
+                f"coroutine {name!r} called without await — the call builds "
+                "a coroutine object and discards it; the body never runs")

@@ -1,0 +1,106 @@
+"""env-flag-discipline — every boolean knob goes through envflag.
+
+``envflag.env_flag`` exists because a per-call-site spelling tuple
+drifts: one reader learns "off", another only knows "0", and the same
+deploy config flips one subsystem but not the other. The inventory in
+``envflag.FLAGS`` extends that contract to *existence*: a knob nobody
+declared is a knob the docs, the inventory test, and operators can't
+see.
+
+Flags:
+
+* any raw ``os.environ.get`` / ``os.getenv`` / ``os.environ[...]`` /
+  ``... in os.environ`` read of a ``TASKSRUNNER_*`` name declared
+  boolean in the inventory — those must call ``env_flag``;
+* any ``TASKSRUNNER_*`` name read that the inventory doesn't declare
+  at all.
+
+Names are resolved through module-level string constants, so the
+``TOKEN_ENV = "TASKSRUNNER_API_TOKEN"`` idiom is seen through.
+``envflag.py`` itself is exempt (it is the sanctioned reader).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from tasksrunner.analysis.core import (
+    FileContext, Finding, Rule, import_table, register, resolve_call,
+)
+
+_EXEMPT = ("tasksrunner/envflag.py",)
+
+
+def _environ_sites(tree: ast.Module, imports: dict[str, str],
+                   ) -> Iterator[tuple[ast.AST, ast.AST]]:
+    """(site node, name-expression node) for every os.environ read."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            target = resolve_call(imports, node.func)
+            if target in ("os.getenv",) and node.args:
+                yield node, node.args[0]
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "get"
+                  and _is_environ(node.func.value, imports) and node.args):
+                yield node, node.args[0]
+        elif isinstance(node, ast.Subscript) and \
+                _is_environ(node.value, imports):
+            yield node, node.slice
+        elif isinstance(node, ast.Compare) and \
+                len(node.ops) == 1 and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                and _is_environ(node.comparators[0], imports):
+            yield node, node.left
+
+
+def _is_environ(node: ast.AST, imports: dict[str, str]) -> bool:
+    if isinstance(node, ast.Attribute) and node.attr == "environ" and \
+            isinstance(node.value, ast.Name) and \
+            imports.get(node.value.id, node.value.id) == "os":
+        return True
+    # "from os import environ"
+    return isinstance(node, ast.Name) and \
+        imports.get(node.id) == "os.environ"
+
+
+@register
+class EnvFlagDiscipline(Rule):
+    id = "env-flag-discipline"
+    doc = ("TASKSRUNNER_* booleans must be read via envflag.env_flag and "
+           "every flag must be declared in envflag.FLAGS")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.relpath in _EXEMPT:
+            return
+        from tasksrunner.envflag import BOOL_FLAGS, FLAGS
+        imports = import_table(ctx.tree)
+        # env_flag("TASKSRUNNER_X") with an undeclared name: the right
+        # reader, but the knob is still invisible to the inventory
+        for node in self.walk(ctx):
+            if not isinstance(node, ast.Call):
+                continue
+            target = resolve_call(imports, node.func) or ""
+            if not (target == "env_flag" or target.endswith(".env_flag")):
+                continue
+            name = ctx.resolve_str(node.args[0]) if node.args else None
+            if name and name.startswith("TASKSRUNNER_") and name not in FLAGS:
+                yield ctx.finding(
+                    self.id, node,
+                    f"{name} is not declared in envflag.FLAGS — add it to "
+                    "the inventory (name, kind, default, doc)")
+        for site, name_node in _environ_sites(ctx.tree, imports):
+            name = ctx.resolve_str(name_node)
+            if name is None or not name.startswith("TASKSRUNNER_"):
+                continue
+            if name in BOOL_FLAGS:
+                yield ctx.finding(
+                    self.id, site,
+                    f"boolean flag {name} read via os.environ — use "
+                    "envflag.env_flag so every knob accepts the same "
+                    "on/off spellings")
+            elif name not in FLAGS:
+                yield ctx.finding(
+                    self.id, site,
+                    f"{name} is not declared in envflag.FLAGS — add it to "
+                    "the inventory (name, kind, default, doc) so operators "
+                    "and the docs can see it")

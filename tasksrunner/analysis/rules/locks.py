@@ -1,0 +1,194 @@
+"""lock-discipline — shared mutable state needs a declared lock.
+
+The SQLite engines hand work between the event loop and dedicated
+threads; the contract (PR 1) is that every attribute both sides mutate
+is guarded by a ``threading.Lock`` held at *every* write. A write that
+skips the lock is invisible until a torn list or lost update shows up
+under load — exactly the class of bug code review misses because each
+side looks correct alone.
+
+Per class, the rule:
+
+* finds lock attributes (``self.x = threading.Lock()`` /
+  ``RLock`` / ``Condition``);
+* finds *thread-context* methods — those passed to
+  ``threading.Thread(target=self.m)``, ``executor.submit(self.m)`` or
+  ``run_in_executor(..., self.m)``;
+* flags attributes assigned both in a thread-context method and in an
+  ``async def`` (loop-context) method when either write site is not
+  inside a ``with self.<lock>:`` block (``__init__`` is exempt —
+  construction happens-before both sides);
+* flags inconsistent lock *ordering*: ``with self.a: with self.b:`` in
+  one method and ``with self.b: with self.a:`` in another is a latent
+  deadlock.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from tasksrunner.analysis.core import (
+    FileContext, Finding, Rule, import_table, register, resolve_call,
+)
+
+_LOCK_FACTORIES = {"threading.Lock", "threading.RLock",
+                   "threading.Condition", "threading.Semaphore",
+                   "threading.BoundedSemaphore"}
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, cls: ast.ClassDef, imports: dict[str, str]):
+        self.node = cls
+        self.locks: set[str] = set()
+        self.thread_methods: set[str] = set()
+        self.methods: dict[str, ast.FunctionDef | ast.AsyncFunctionDef] = {}
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+        for node in ast.walk(cls):
+            # self.x = threading.Lock()
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                target = resolve_call(imports, node.value.func)
+                if target in _LOCK_FACTORIES:
+                    for tgt in node.targets:
+                        attr = _self_attr(tgt)
+                        if attr:
+                            self.locks.add(attr)
+            # Thread(target=self.m) / submit(self.m) / run_in_executor(_, self.m)
+            if isinstance(node, ast.Call):
+                tname = resolve_call(imports, node.func) or ""
+                attr_call = (node.func.attr
+                             if isinstance(node.func, ast.Attribute) else "")
+                candidates: list[ast.AST] = []
+                if tname.endswith("threading.Thread") or tname == "Thread":
+                    candidates += [kw.value for kw in node.keywords
+                                   if kw.arg == "target"]
+                elif attr_call == "submit" and node.args:
+                    candidates.append(node.args[0])
+                elif attr_call == "run_in_executor" and len(node.args) >= 2:
+                    candidates.append(node.args[1])
+                for cand in candidates:
+                    attr = _self_attr(cand)
+                    if attr:
+                        self.thread_methods.add(attr)
+
+
+@register
+class LockDiscipline(Rule):
+    id = "lock-discipline"
+    doc = ("attributes mutated from both thread and loop contexts must "
+           "hold a declared lock; nested locks must acquire in one order")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        imports = import_table(ctx.tree)
+        for node in self.walk(ctx):
+            if isinstance(node, ast.ClassDef):
+                info = _ClassInfo(node, imports)
+                if info.locks:
+                    yield from self._check_shared_writes(ctx, info)
+                    yield from self._check_ordering(ctx, info)
+
+    # -- unguarded cross-context writes ---------------------------------
+
+    def _writes(self, fn: ast.AST, locks: set[str],
+                ) -> Iterator[tuple[str, ast.AST, bool]]:
+        """(attr, site, guarded) for each ``self.attr`` store in fn.
+        ``guarded`` means the write sits inside ``with self.<lock>:``."""
+
+        def visit(node: ast.AST, held: bool) -> Iterator[tuple[str, ast.AST, bool]]:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                return  # nested scope: runs elsewhere
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr in locks:
+                        held = True
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    attr = _self_attr(tgt)
+                    if attr and attr not in locks:
+                        yield attr, node, held
+                # slice stores: self.x[k] = v mutates self.x
+                for tgt in targets:
+                    if isinstance(tgt, ast.Subscript):
+                        attr = _self_attr(tgt.value)
+                        if attr and attr not in locks:
+                            yield attr, node, held
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, held)
+
+        yield from visit(fn, False)
+
+    def _check_shared_writes(self, ctx: FileContext, info: _ClassInfo,
+                             ) -> Iterator[Finding]:
+        per_method: dict[str, list[tuple[str, ast.AST, bool]]] = {}
+        for name, fn in info.methods.items():
+            if name == "__init__":
+                continue
+            per_method[name] = list(self._writes(fn, info.locks))
+
+        def written_in(names: Iterable[str]) -> set[str]:
+            return {attr for m in names for attr, _, _ in per_method.get(m, ())}
+
+        thread_side = written_in(info.thread_methods)
+        loop_side = written_in(
+            m for m, fn in info.methods.items()
+            if isinstance(fn, ast.AsyncFunctionDef)
+            and m not in info.thread_methods)
+        shared = thread_side & loop_side
+        for method, writes in per_method.items():
+            is_thread = method in info.thread_methods
+            is_loop = isinstance(info.methods[method], ast.AsyncFunctionDef)
+            if not (is_thread or is_loop):
+                continue
+            for attr, site, guarded in writes:
+                if attr in shared and not guarded:
+                    side = "thread" if is_thread else "event-loop"
+                    yield ctx.finding(
+                        self.id, site,
+                        f"self.{attr} is written from both thread and loop "
+                        f"contexts but this {side}-side write in "
+                        f"{info.node.name}.{method}() holds none of the "
+                        f"declared locks ({', '.join(sorted(info.locks))})")
+
+    # -- acquisition ordering -------------------------------------------
+
+    def _check_ordering(self, ctx: FileContext, info: _ClassInfo,
+                        ) -> Iterator[Finding]:
+        pairs: dict[tuple[str, str], ast.AST] = {}
+
+        def visit(node: ast.AST, held: tuple[str, ...]) -> Iterator[Finding]:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr in info.locks:
+                        for outer in held:
+                            if outer != attr:
+                                pair = (outer, attr)
+                                pairs.setdefault(pair, node)
+                                if (attr, outer) in pairs:
+                                    yield ctx.finding(
+                                        self.id, node,
+                                        f"lock order conflict in "
+                                        f"{info.node.name}: self.{attr} is "
+                                        f"taken while holding self.{outer} "
+                                        f"here, but elsewhere (line "
+                                        f"{pairs[(attr, outer)].lineno}) the "
+                                        "same two locks nest the other way — "
+                                        "latent deadlock")
+                        held = held + (attr,)
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child, held)
+
+        yield from visit(info.node, ())

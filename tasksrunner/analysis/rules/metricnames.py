@@ -1,0 +1,67 @@
+"""metric-names — instrumentation uses the declared name registry.
+
+A typo'd metric name (or one name used as two instrument kinds) forks
+a time series silently: dashboards, the autoscaler, and the percentile
+views then disagree about which series is real. Every
+``metrics.inc(...)`` / ``set_gauge(...)`` / ``observe(...)`` /
+``recorder(...)`` call with a literal name must use a name declared in
+``tasksrunner/observability/names.py`` under the matching kind.
+
+This is the AST successor of ``scripts/check_metrics.py`` (the script
+survives as a thin alias); being a registered rule it now shares
+suppressions, the baseline, JSON output, and the cache with every
+other invariant check.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tasksrunner.analysis.core import FileContext, Finding, Rule, register
+
+
+def _kind_table() -> dict[str, tuple[str, dict]]:
+    from tasksrunner.observability import names
+    return {
+        "inc": ("counter", names.COUNTERS),
+        "set_gauge": ("gauge", names.GAUGES),
+        "observe": ("histogram", names.HISTOGRAMS),
+        "observe_many": ("histogram", names.HISTOGRAMS),
+        "recorder": ("histogram", names.HISTOGRAMS),
+    }
+
+
+@register
+class MetricNames(Rule):
+    id = "metric-names"
+    doc = ("every instrumentation site uses a name declared in "
+           "observability/names.py, under the right instrument kind")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        from tasksrunner.observability import names
+        table = _kind_table()
+        for node in self.walk(ctx):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in table):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue  # dynamic names are the caller's problem
+            kind, declared = table[node.func.attr]
+            name = node.args[0].value
+            if name in declared:
+                continue
+            if name in names.ALL:
+                yield ctx.finding(
+                    self.id, node,
+                    f"{name!r} used as a {kind} but declared as a different "
+                    "kind in observability/names.py — one name, one "
+                    "instrument kind")
+            else:
+                yield ctx.finding(
+                    self.id, node,
+                    f"{kind} name {name!r} is not declared in "
+                    "observability/names.py — declare it (with a doc line) "
+                    "or fix the typo before it forks a series")

@@ -1,0 +1,117 @@
+"""error-taxonomy — sidecar-facing paths speak errors.py.
+
+The sidecar API maps exceptions to HTTP statuses through the
+``http_status`` attribute of ``tasksrunner.errors`` types; an ad-hoc
+``ValueError`` on a delivery or state path surfaces as a bare 500 with
+no taxonomy, breaking both the client-side status mapping and every
+dashboard that groups failures by error class. Similarly, a handler
+that swallows ``except Exception: pass`` on a hot path turns real
+faults into silent latency.
+
+Scope: the sidecar-facing modules listed in :data:`HOT_PATHS` (plus any
+file outside the ``tasksrunner`` package — e.g. test fixtures — so the
+rule is testable in isolation). Checks:
+
+* ``raise`` of a generic builtin (``Exception``, ``RuntimeError``,
+  ``ValueError``, ``TypeError``, ``KeyError``) — use or subclass a
+  type from ``tasksrunner/errors.py``;
+* a locally defined exception class whose bases are only builtins —
+  it belongs in the central taxonomy (or must subclass it);
+* ``except Exception:`` / ``except BaseException:`` whose body is only
+  ``pass``/``...`` — swallowing on a hot path hides faults;
+* a bare ``except:`` anywhere (it catches ``KeyboardInterrupt`` too).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from tasksrunner.analysis.core import FileContext, Finding, Rule, register
+
+#: repo-relative prefixes of the sidecar-facing request/delivery paths
+HOT_PATHS = (
+    "tasksrunner/sidecar.py",
+    "tasksrunner/runtime.py",
+    "tasksrunner/client.py",
+    "tasksrunner/app.py",
+    "tasksrunner/state/",
+    "tasksrunner/pubsub/",
+    "tasksrunner/bindings/",
+    "tasksrunner/invoke/",
+    "tasksrunner/component/",
+    "tasksrunner/secrets/",
+)
+
+_GENERIC = {"Exception", "RuntimeError", "ValueError", "TypeError", "KeyError"}
+_BUILTIN_BASES = _GENERIC | {"BaseException", "OSError", "IOError",
+                             "LookupError", "ArithmeticError"}
+
+
+def _on_hot_path(relpath: str) -> bool:
+    if not relpath.startswith("tasksrunner/"):
+        return True  # out-of-package targets (fixtures) get full checking
+    return relpath.startswith(HOT_PATHS)
+
+
+def _exc_name(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Call):
+        node = node.func
+    return node.id if isinstance(node, ast.Name) else None
+
+
+@register
+class ErrorTaxonomy(Rule):
+    id = "error-taxonomy"
+    doc = ("sidecar-facing paths raise errors.py types; no swallowed or "
+           "bare excepts on hot paths")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        hot = _on_hot_path(ctx.relpath)
+        for node in self.walk(ctx):
+            if isinstance(node, ast.ExceptHandler):
+                yield from self._check_handler(ctx, node, hot)
+            elif not hot:
+                continue
+            elif isinstance(node, ast.Raise) and node.exc is not None:
+                name = _exc_name(node.exc)
+                if name in _GENERIC:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"raise {name} on a sidecar-facing path — raise a "
+                        "type from tasksrunner/errors.py so the API maps it "
+                        "to a status (ValidationError for bad input, "
+                        "StateError/PubSubError/... for backend faults)")
+            elif isinstance(node, ast.ClassDef):
+                bases = {b.id for b in node.bases if isinstance(b, ast.Name)}
+                if bases and bases <= _BUILTIN_BASES:
+                    yield ctx.finding(
+                        self.id, node,
+                        f"exception class {node.name} defined outside the "
+                        "taxonomy — move it to tasksrunner/errors.py or "
+                        "subclass TasksRunnerError so http_status mapping "
+                        "and error dashboards see it")
+
+    def _check_handler(self, ctx: FileContext, node: ast.ExceptHandler,
+                       hot: bool) -> Iterator[Finding]:
+        if node.type is None:
+            yield ctx.finding(
+                self.id, node,
+                "bare 'except:' catches KeyboardInterrupt/SystemExit — "
+                "name the exception (at minimum 'except Exception')")
+            return
+        if not hot:
+            return
+        caught = _exc_name(node.type)
+        swallows = all(
+            isinstance(stmt, ast.Pass)
+            or (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is ...)
+            for stmt in node.body)
+        if caught in ("Exception", "BaseException") and swallows:
+            yield ctx.finding(
+                self.id, node,
+                f"'except {caught}: pass' swallows every fault on a hot "
+                "path — log it, narrow the type, or suppress with a "
+                "justifying comment")

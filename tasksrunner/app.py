@@ -31,7 +31,7 @@ from typing import Any, Awaitable, Callable
 from urllib.parse import parse_qsl
 
 from tasksrunner import cloudevents
-from tasksrunner.errors import TasksRunnerError
+from tasksrunner.errors import TasksRunnerError, ValidationError
 from tasksrunner.observability.spans import record_span
 from tasksrunner.observability.tracing import (
     TRACEPARENT_HEADER,
@@ -260,7 +260,7 @@ class App:
             )
             if existing is not None:
                 if existing.handler is not handler:
-                    raise ValueError(
+                    raise ValidationError(
                         f"route {route!r} is already bound to a different "
                         "subscription handler; stacking topics on one route "
                         "requires the same handler"

@@ -10,6 +10,7 @@ binding exposes.
 
 from __future__ import annotations
 
+import asyncio
 import json
 import os
 import pathlib
@@ -19,6 +20,25 @@ from tasksrunner.bindings.base import BindingResponse, OutputBinding
 from tasksrunner.component.registry import driver
 from tasksrunner.component.spec import ComponentSpec
 from tasksrunner.errors import BindingError
+
+
+# module-level, plain args, dispatched via run_in_executor — NOT
+# per-call closures via asyncio.to_thread: to_thread copies the
+# caller's contextvars Context into the work item, and an idle executor
+# worker pins its last work item until the next one arrives, so every
+# worker thread would retain a whole request's context (payload, span
+# state); measured as real per-message retention under soak load
+def _write_blob(path: str, payload: bytes) -> None:  # tasklint: off-loop
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(payload)
+
+
+def _read_blob(path: str) -> bytes | None:  # tasklint: off-loop
+    if not os.path.isfile(path):
+        return None
+    with open(path, "rb") as f:
+        return f.read()
 
 
 class LocalBlobStoreBinding(OutputBinding):
@@ -70,7 +90,6 @@ class LocalBlobStoreBinding(OutputBinding):
         path = self._path(blob_name)
 
         if operation == "create":
-            os.makedirs(os.path.dirname(path), exist_ok=True)
             # utf-8 explicitly (write_text used the locale encoding;
             # a deliberate, portable choice beats a host-dependent one)
             if isinstance(data, (bytes, bytearray)):
@@ -79,15 +98,19 @@ class LocalBlobStoreBinding(OutputBinding):
                 payload = data.encode("utf-8")
             else:
                 payload = json.dumps(data, indent=2).encode("utf-8")
-            with open(path, "wb") as f:
-                f.write(payload)
+
+            # disk I/O off the event loop: a slow volume must degrade
+            # this one invoke, not every request in the process
+            await asyncio.get_running_loop().run_in_executor(
+                None, _write_blob, path, payload)
             return BindingResponse(metadata={"blobName": blob_name})
         if operation == "get":
-            if not os.path.isfile(path):
+            blob = await asyncio.get_running_loop().run_in_executor(
+                None, _read_blob, path)
+            if blob is None:
                 raise BindingError(f"blob {blob_name!r} does not exist")
-            with open(path, "rb") as f:
-                return BindingResponse(data=f.read(),
-                                       metadata={"blobName": blob_name})
+            return BindingResponse(data=blob,
+                                   metadata={"blobName": blob_name})
         if operation == "delete":
             existed = os.path.isfile(path)
             if existed:

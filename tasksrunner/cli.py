@@ -483,6 +483,14 @@ def _cmd_ps(args) -> None:
         raise SystemExit(2)
 
 
+def _cmd_lint(args) -> None:
+    from tasksrunner.analysis.engine import main as tasklint_main
+    # argparse.REMAINDER keeps a leading "--" separator; drop it
+    lint_args = [a for i, a in enumerate(args.lint_args)
+                 if not (i == 0 and a == "--")]
+    raise SystemExit(tasklint_main(lint_args))
+
+
 def _cmd_components(args) -> None:
     from tasksrunner.component.loader import load_components
     from tasksrunner.component.registry import registered_types
@@ -1236,6 +1244,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true",
                    help="machine-readable output")
     p.set_defaults(fn=_cmd_ps)
+
+    p = sub.add_parser(
+        "lint",
+        help="tasklint: AST checks for the runtime's concurrency, "
+             "env-flag, metric-name, and error-taxonomy invariants")
+    # everything after `lint` goes verbatim to the tasklint argparser
+    # (python -m tasksrunner.analysis is the same entrypoint)
+    p.add_argument("lint_args", nargs=argparse.REMAINDER, metavar="...",
+                   help="tasklint arguments; try `tasksrunner lint -- --help`")
+    p.set_defaults(fn=_cmd_lint)
 
     p = sub.add_parser("components", help="validate a components directory")
     p.add_argument("path")

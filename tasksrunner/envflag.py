@@ -1,22 +1,117 @@
-"""One parser for boolean environment knobs.
+"""One parser — and one inventory — for environment knobs.
 
 Every on/off env toggle (TASKSRUNNER_ACCESS_LOG, TASKSRUNNER_FLASH,
 TASKSRUNNER_PERF_TESTS, ...) must accept the same spellings — a
 per-call-site tuple would drift the moment one copy learns a new
 spelling.
+
+:data:`FLAGS` is the central inventory of every ``TASKSRUNNER_*``
+variable the runtime reads: name, kind, default, one-line doc. The
+``env-flag-discipline`` tasklint rule fails the build on any raw
+``os.environ`` read of a declared boolean (must use :func:`env_flag`)
+and on any undeclared ``TASKSRUNNER_*`` read; ``env_flag`` itself
+refuses undeclared names at runtime, and ``tests/test_flag_inventory``
+asserts the inventory and the docs agree.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
 
 _FALSE = frozenset({"0", "false", "off", "no"})
 
 
+@dataclasses.dataclass(frozen=True)
+class Flag:
+    """One declared environment variable."""
+
+    name: str
+    kind: str      # "bool" | "int" | "float" | "string" | "path" | "enum" | "json"
+    default: str   # human-readable default ("on"/"off" for bools)
+    doc: str
+
+
+def _f(name: str, kind: str, default: str, doc: str) -> tuple[str, Flag]:
+    return name, Flag(name, kind, default, doc)
+
+
+#: every TASKSRUNNER_* variable any part of the repo reads. Keep the
+#: table alphabetical; the docs table in module 31 must list every name
+#: here (asserted by tests/test_flag_inventory.py).
+FLAGS: dict[str, Flag] = dict([
+    _f("TASKSRUNNER_ACCESS_LOG", "bool", "on",
+       "per-request access-log lines from app servers and sidecars"),
+    _f("TASKSRUNNER_ACT_F32", "bool", "off",
+       "keep ML activations in float32 instead of the platform default"),
+    _f("TASKSRUNNER_API_TOKEN", "string", "unset",
+       "bearer token the sidecar and admin APIs require when set"),
+    _f("TASKSRUNNER_APP_ID", "string", "unset",
+       "app-id grants are evaluated against (injected by the orchestrator)"),
+    _f("TASKSRUNNER_BENCH_TPU_FORCE", "bool", "off",
+       "force the TPU benchmark sections to run even off-TPU"),
+    _f("TASKSRUNNER_CHAOS", "bool", "off",
+       "master gate for declarative fault injection (kind: Chaos)"),
+    _f("TASKSRUNNER_FLASH", "bool", "on",
+       "flash-attention path in the ML extension"),
+    _f("TASKSRUNNER_FLASH_BWD_DELTA", "enum", "fused",
+       "flash backward delta strategy (fused | precompute)"),
+    _f("TASKSRUNNER_FLASH_HBLK_BWD", "int", "auto",
+       "head-block size override for the flash backward kernel"),
+    _f("TASKSRUNNER_FLASH_HBLK_FWD", "int", "auto",
+       "head-block size override for the flash forward kernel"),
+    _f("TASKSRUNNER_FLASH_HBLK_RING", "int", "auto",
+       "head-block size override for the ring-attention kernel"),
+    _f("TASKSRUNNER_GRANTS", "json", "unset",
+       "JSON grants document applied to the app (orchestrator-injected)"),
+    _f("TASKSRUNNER_HISTOGRAMS", "bool", "on",
+       "latency-histogram recording kill switch"),
+    _f("TASKSRUNNER_HTTP_PORT", "int", "3500",
+       "sidecar port AppClient.from_env connects to"),
+    _f("TASKSRUNNER_MESH", "bool", "on",
+       "framed sidecar-to-sidecar transport lane"),
+    _f("TASKSRUNNER_MESH_CA", "path", "unset",
+       "CA bundle path; with CERT and KEY enables mesh mTLS"),
+    _f("TASKSRUNNER_MESH_CERT", "path", "unset",
+       "mesh mTLS certificate path"),
+    _f("TASKSRUNNER_MESH_KEY", "path", "unset",
+       "mesh mTLS private-key path"),
+    _f("TASKSRUNNER_PERF_TESTS", "bool", "off",
+       "opt-in performance assertions in the test suite"),
+    _f("TASKSRUNNER_REPLICA", "int", "0",
+       "replica index injected by the orchestrator"),
+    _f("TASKSRUNNER_SLOW_THRESHOLD_SECONDS", "float", "0.25",
+       "latency above which histogram observations capture trace exemplars"),
+    _f("TASKSRUNNER_SOAK", "bool", "off",
+       "opt-in long-running soak tests"),
+    _f("TASKSRUNNER_TOKENS_FILE", "path", "unset",
+       "per-app API-token table used by the orchestrator"),
+    _f("TASKSRUNNER_TRACE_DB", "path", ".tasksrunner/traces.db",
+       "span-recorder SQLite path (set empty to disable recording)"),
+    _f("TASKSRUNNER_TRACE_RETENTION_SECONDS", "float", "2592000",
+       "span retention sweep horizon in seconds (<= 0 keeps everything)"),
+])
+
+#: names env_flag accepts — the env-flag-discipline rule sends every
+#: raw os.environ read of these through here
+BOOL_FLAGS = frozenset(n for n, f in FLAGS.items() if f.kind == "bool")
+
+
 def env_flag(name: str, default: bool = True) -> bool:
     """True unless the variable is set to an explicit disable value
-    (case-insensitive: 0 / false / off / no). Unset → ``default``."""
+    (case-insensitive: 0 / false / off / no). Unset or empty →
+    ``default``.
+
+    ``TASKSRUNNER_*`` names must be declared in :data:`FLAGS` — an
+    undeclared knob is invisible to operators, the docs, and the
+    static analysis, so it is refused loudly here rather than parsed
+    quietly.
+    """
+    if name.startswith("TASKSRUNNER_") and name not in FLAGS:
+        raise LookupError(
+            f"{name} is not declared in tasksrunner.envflag.FLAGS — "
+            "add it to the inventory (name, kind, default, doc)")
     raw = os.environ.get(name)
-    if raw is None:
+    if raw is None or not raw.strip():
         return default
     return raw.strip().lower() not in _FALSE

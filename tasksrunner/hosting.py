@@ -16,6 +16,7 @@ Two shapes, behaviorally identical (SURVEY.md §7.4 hard part #1):
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
 
@@ -211,7 +212,10 @@ class AppHost:
         # replica list, so every serving replica is in the invoke
         # rotation — then hand the app its client
         if self.register:
-            self.resolver.register(AppAddress(
+            # off-loop: the registry mutation busy-waits on a lock file
+            # (worst case seconds if a crashed holder left it behind)
+            # and must not stall this replica's event loop at startup
+            await asyncio.to_thread(self.resolver.register, AppAddress(
                 app_id=self.app.app_id, host=self.host,
                 sidecar_port=self.sidecar_port, app_port=self.app_port,
                 mesh_port=self.sidecar.mesh_port,
@@ -230,9 +234,11 @@ class AppHost:
         await self.app.shutdown()
         if self.register:
             # scoped to THIS replica's entry: a stopping replica must
-            # not deregister its siblings
-            self.resolver.unregister(self.app.app_id, pid=os.getpid(),
-                                     sidecar_port=self.sidecar_port)
+            # not deregister its siblings; off-loop for the same
+            # lock-file busy-wait reason as register above
+            await asyncio.to_thread(
+                self.resolver.unregister, self.app.app_id, pid=os.getpid(),
+                sidecar_port=self.sidecar_port)
         if self.client is not None:
             await self.client.close()
         if self.sidecar is not None:

@@ -185,8 +185,13 @@ class NameResolver:
         self._mtime = 0.0  # force re-read on the next resolve
         return dead
 
-    def _mutate(self, fn) -> None:
-        """Atomic read-modify-write with a lock file (cross-process)."""
+    def _mutate(self, fn) -> None:  # tasklint: off-loop
+        """Atomic read-modify-write with a lock file (cross-process).
+
+        Busy-waits up to seconds on a contended/stale lock file, so
+        async callers must dispatch via ``asyncio.to_thread`` — see
+        hosting.AppHost.start/stop and orchestrator/run.py.
+        """
         assert self.registry_file is not None
         self.registry_file.parent.mkdir(parents=True, exist_ok=True)
         lock = self.registry_file.with_suffix(".lock")

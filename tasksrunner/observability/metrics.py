@@ -45,6 +45,7 @@ from array import array
 from bisect import bisect_right
 from typing import Any, Iterable
 
+from tasksrunner.envflag import env_flag
 from tasksrunner.observability.tracing import current_trace
 
 ENV_HISTOGRAMS = "TASKSRUNNER_HISTOGRAMS"
@@ -65,13 +66,6 @@ DEFAULT_SLOW_THRESHOLD = 0.25
 #: is ~12 KiB worst case — while the sort+bisect fold cost stays
 #: amortised well under the <3% histogram-overhead budget.
 FOLD_AT = 512
-
-
-def _env_flag(name: str, default: bool) -> bool:
-    raw = os.environ.get(name)
-    if raw is None:
-        return default
-    return raw.strip().lower() not in ("0", "false", "no", "off", "")
 
 
 def _slow_threshold() -> float:
@@ -190,7 +184,7 @@ class MetricsRegistry:
         # is claimed up front.
         self._kinds: dict[str, str] = {"uptime_seconds": "gauge"}
         self.started_at = time.time()
-        self.histograms_enabled = _env_flag(ENV_HISTOGRAMS, True)
+        self.histograms_enabled = env_flag(ENV_HISTOGRAMS, default=True)
         self.slow_threshold = _slow_threshold()
 
     def _claim_kind(self, name: str, kind: str) -> None:

@@ -78,11 +78,18 @@ class AdminServer:
         self.port = actual_port
 
         self._info_file = info_path(self.orch.config.registry_path)
-        self._info_file.parent.mkdir(parents=True, exist_ok=True)
-        self._info_file.write_text(json.dumps({
+        info = json.dumps({
             "admin_url": f"http://{self.host}:{actual_port}",
             "pid": os.getpid(),
-        }))
+        })
+
+        def write_info() -> None:  # tasklint: off-loop
+            self._info_file.parent.mkdir(parents=True, exist_ok=True)
+            self._info_file.write_text(info)
+
+        # startup disk write off-loop: the supervisor loop is already
+        # scheduling replica starts at this point
+        await asyncio.to_thread(write_info)
         logger.info("orchestrator admin API on http://%s:%d", self.host, actual_port)
 
     async def stop(self) -> None:

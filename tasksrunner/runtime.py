@@ -590,7 +590,9 @@ class Runtime:
                     "GET", "/tasksrunner/healthz")
                 if status < 500:
                     return
-            except Exception:
+            # readiness poll: any failure means "not up yet" and is
+            # retried until the deadline converts it to InvocationError
+            except Exception:  # tasklint: disable=error-taxonomy (poll)
                 pass
             if asyncio.get_running_loop().time() > deadline:
                 raise InvocationError(

@@ -1,0 +1,98 @@
+"""The TASKSRUNNER_* flag inventory stays in sync with reality.
+
+Three parties must agree on the flag set: the code that reads the
+variables, the :data:`tasksrunner.envflag.FLAGS` inventory, and the
+operator docs. Each pair is asserted here, so a flag can't be added in
+one place and forgotten in another — the failure names the missing
+entry and where to add it.
+"""
+
+import ast
+import pathlib
+import re
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tasksrunner.envflag import BOOL_FLAGS, FLAGS, Flag, env_flag
+
+_NAME = re.compile(r"^TASKSRUNNER_[A-Z0-9_]+$")
+
+
+def _flag_literals():
+    """Every well-formed TASKSRUNNER_* string literal in the package,
+    with the files that contain it. AST-based, so comments and prose
+    docstrings don't count."""
+    sites = {}
+    for path in sorted((REPO / "tasksrunner").rglob("*.py")):
+        for node in ast.walk(ast.parse(path.read_text())):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and _NAME.match(node.value)):
+                sites.setdefault(node.value, set()).add(
+                    str(path.relative_to(REPO)))
+    return sites
+
+
+def test_every_flag_read_in_the_package_is_declared():
+    undeclared = {
+        name: sorted(files)
+        for name, files in _flag_literals().items()
+        if name not in FLAGS
+    }
+    assert not undeclared, (
+        f"undeclared TASKSRUNNER_* reads {undeclared} — declare them in "
+        "tasksrunner/envflag.py FLAGS (name, kind, default, doc)")
+
+
+def test_every_declared_flag_is_actually_read():
+    dead = sorted(set(FLAGS) - set(_flag_literals()))
+    assert not dead, (
+        f"flags declared but never read anywhere in the package: {dead} "
+        "— remove them from FLAGS or wire them up")
+
+
+def test_every_declared_flag_appears_in_docs():
+    docs = "\n".join(
+        p.read_text() for p in sorted((REPO / "docs").rglob("*.md")))
+    missing = sorted(name for name in FLAGS if name not in docs)
+    assert not missing, (
+        f"flags missing from docs/: {missing} — add them to the flag "
+        "inventory table in docs/modules/31-appendix-variables.md")
+
+
+def test_inventory_entries_are_well_formed():
+    assert list(FLAGS) == sorted(FLAGS), "keep the FLAGS table alphabetical"
+    kinds = {"bool", "int", "float", "string", "path", "enum", "json"}
+    for name, flag in FLAGS.items():
+        assert isinstance(flag, Flag) and flag.name == name
+        assert flag.kind in kinds, f"{name}: unknown kind {flag.kind!r}"
+        assert flag.doc.strip(), f"{name}: doc line required"
+        if flag.kind == "bool":
+            assert flag.default in {"on", "off"}, (
+                f"{name}: bool defaults are spelled 'on'/'off'")
+    assert BOOL_FLAGS == frozenset(
+        n for n, f in FLAGS.items() if f.kind == "bool")
+
+
+def test_env_flag_refuses_undeclared_names():
+    with pytest.raises(LookupError, match="TASKSRUNNER_NO_SUCH_FLAG"):
+        env_flag("TASKSRUNNER_NO_SUCH_FLAG")
+    # non-namespaced names stay permissive (external integrations)
+    assert env_flag("SOME_OTHER_TOGGLE", default=True) is True
+
+
+def test_env_flag_parses_declared_flags(monkeypatch):
+    monkeypatch.delenv("TASKSRUNNER_CHAOS", raising=False)
+    assert env_flag("TASKSRUNNER_CHAOS", default=False) is False
+    for raw, expect in [("1", True), ("true", True), ("ON", True),
+                        ("0", False), ("false", False), ("Off", False),
+                        ("no", False), ("", False), ("   ", False)]:
+        monkeypatch.setenv("TASKSRUNNER_CHAOS", raw)
+        assert env_flag("TASKSRUNNER_CHAOS", default=False) is expect, raw
+    # empty/unset falls back to the caller's default, whatever it is
+    monkeypatch.setenv("TASKSRUNNER_CHAOS", "")
+    assert env_flag("TASKSRUNNER_CHAOS", default=True) is True

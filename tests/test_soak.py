@@ -93,6 +93,14 @@ async def _retention_probe(tmp_path, *, warmup: int, measured: int) -> int:
             for i in range(start, start + n):
                 await client.publish_event("pubsub", "t", {"taskId": f"s{i}"})
             await asyncio.wait_for(done.wait(), timeout=240)
+            # quiesce before any snapshot: done fires when the LAST
+            # handler returns, but broker acks, coalesced writes, and
+            # executor work items trail it — that in-flight tail is
+            # load-dependent transient state, not per-message
+            # retention, and must not be measured as such. A real leak
+            # (the pathlib interning this soak exists to catch)
+            # survives quiescence untouched.
+            await asyncio.sleep(0.5)
 
         await drive(warmup, 0)        # warmup: caches, pools, lazy init
         gc.collect()

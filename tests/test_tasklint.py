@@ -1,0 +1,427 @@
+"""tasklint engine mechanics + one seeded-bad-code fixture per rule.
+
+Two layers: the fixtures prove each rule actually fires (a rule that
+never fires is worse than none — it certifies invariants it doesn't
+check), and the mechanics tests pin the suppression / baseline / cache
+/ JSON contracts the workflow depends on. The final test runs the real
+engine over the real package and asserts zero non-baselined findings —
+CI is green-by-construction, and any future regression fails here even
+if `make lint` is skipped.
+"""
+
+import io
+import json
+import pathlib
+import sys
+import textwrap
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tasksrunner.analysis.cache import ResultCache, ruleset_signature
+from tasksrunner.analysis.core import RULES
+from tasksrunner.analysis.engine import (
+    DEFAULT_BASELINE, DEFAULT_TARGET, lint_file, run,
+)
+
+ALL_RULES = tuple(sorted(RULES))
+
+
+def _lint_source(tmp_path, source, rules=ALL_RULES, name="fixture.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    findings, suppressed = lint_file(path, rules)
+    return findings, suppressed
+
+
+def _rules_fired(findings):
+    return {f.rule for f in findings}
+
+
+# -- per-rule seeded-bad-code fixtures ----------------------------------
+
+
+def test_blocking_rule_fires_on_async_sleep_sqlite_and_open(tmp_path):
+    findings, _ = _lint_source(tmp_path, """\
+        import sqlite3
+        import time
+
+        async def handler():
+            time.sleep(0.1)
+            conn = sqlite3.connect("x.db")
+            data = open("f").read()
+        """, rules=("blocking-call-in-async",))
+    assert len(findings) == 3
+    assert _rules_fired(findings) == {"blocking-call-in-async"}
+    assert [f.line for f in findings] == [5, 6, 7]
+
+
+def test_blocking_rule_fires_on_sync_sleep_without_offloop_declaration(tmp_path):
+    findings, _ = _lint_source(tmp_path, """\
+        import time
+
+        def busy_backoff():
+            time.sleep(0.001)
+        """, rules=("blocking-call-in-async",))
+    assert len(findings) == 1
+    assert "off-loop" in findings[0].message
+
+
+def test_blocking_rule_honors_offloop_marker_and_awaited_calls(tmp_path):
+    findings, _ = _lint_source(tmp_path, """\
+        import time
+
+        def busy_backoff():  # tasklint: off-loop
+            time.sleep(0.001)
+
+        async def ok():
+            await policy.execute(fn)
+        """, rules=("blocking-call-in-async",))
+    assert findings == []
+
+
+def test_unawaited_rule_fires_on_discarded_coroutine_and_orphan_task(tmp_path):
+    findings, _ = _lint_source(tmp_path, """\
+        import asyncio
+
+        async def work():
+            return 1
+
+        async def main():
+            work()
+            asyncio.create_task(work())
+        """, rules=("unawaited-coroutine",))
+    assert len(findings) == 2
+    assert "without await" in findings[0].message or \
+        "without await" in findings[1].message
+
+
+def test_unawaited_rule_allows_awaited_and_retained(tmp_path):
+    findings, _ = _lint_source(tmp_path, """\
+        import asyncio
+
+        async def work():
+            return 1
+
+        async def main(self):
+            await work()
+            self._task = asyncio.create_task(work())
+        """, rules=("unawaited-coroutine",))
+    assert findings == []
+
+
+def test_lock_rule_fires_on_unguarded_cross_context_write(tmp_path):
+    findings, _ = _lint_source(tmp_path, """\
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pending = []
+                threading.Thread(target=self._drain).start()
+
+            def _drain(self):
+                self._pending = []
+
+            async def submit(self, item):
+                with self._lock:
+                    self._pending = [item]
+        """, rules=("lock-discipline",))
+    assert len(findings) == 1
+    assert "_pending" in findings[0].message
+    assert findings[0].line == 10  # the unguarded thread-side write
+
+
+def test_lock_rule_fires_on_inconsistent_lock_ordering(tmp_path):
+    findings, _ = _lint_source(tmp_path, """\
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def one(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def two(self):
+                with self._b:
+                    with self._a:
+                        pass
+        """, rules=("lock-discipline",))
+    assert len(findings) == 1
+    assert "lock order conflict" in findings[0].message
+
+
+def test_envflag_rule_fires_on_raw_bool_read_and_undeclared_flag(tmp_path):
+    findings, _ = _lint_source(tmp_path, """\
+        import os
+
+        FLAG = "TASKSRUNNER_MESH"
+
+        gate = os.environ.get("TASKSRUNNER_CHAOS")
+        undeclared = os.getenv("TASKSRUNNER_NOT_A_FLAG")
+        via_const = os.environ[FLAG]
+        """, rules=("env-flag-discipline",))
+    msgs = "\n".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "TASKSRUNNER_CHAOS" in msgs and "env_flag" in msgs
+    assert "TASKSRUNNER_NOT_A_FLAG" in msgs and "inventory" in msgs
+    assert "TASKSRUNNER_MESH" in msgs  # resolved through the constant
+
+
+def test_envflag_rule_fires_on_env_flag_call_with_undeclared_name(tmp_path):
+    findings, _ = _lint_source(tmp_path, """\
+        from tasksrunner.envflag import env_flag
+
+        gate = env_flag("TASKSRUNNER_BRAND_NEW_KNOB", default=False)
+        """, rules=("env-flag-discipline",))
+    assert len(findings) == 1
+    assert "TASKSRUNNER_BRAND_NEW_KNOB" in findings[0].message
+
+
+def test_taxonomy_rule_fires_on_generic_raise_swallow_and_adhoc_class(tmp_path):
+    findings, _ = _lint_source(tmp_path, """\
+        class AdHocError(Exception):
+            pass
+
+        def validate(doc):
+            raise ValueError("bad doc")
+
+        async def deliver():
+            try:
+                pass
+            except Exception:
+                pass
+
+        def cleanup():
+            try:
+                pass
+            except:
+                raise
+        """, rules=("error-taxonomy",))
+    msgs = "\n".join(f.message for f in findings)
+    assert len(findings) == 4
+    assert "raise ValueError" in msgs
+    assert "swallows" in msgs
+    assert "AdHocError" in msgs
+    assert "bare 'except:'" in msgs
+
+
+def test_metric_names_rule_fires_on_typo_and_kind_mismatch(tmp_path):
+    findings, _ = _lint_source(tmp_path, """\
+        def instrument(metrics):
+            metrics.inc("not_a_declared_metric")
+            metrics.observe("state_save", 1.0)
+        """, rules=("metric-names",))
+    assert len(findings) == 2
+    assert "not declared" in findings[0].message
+    assert "different" in findings[1].message  # counter used as histogram
+
+
+# -- engine mechanics ---------------------------------------------------
+
+
+def test_inline_suppression_is_honored_and_counted(tmp_path):
+    findings, suppressed = _lint_source(tmp_path, """\
+        import time
+
+        async def handler():
+            time.sleep(0.1)  # tasklint: disable=blocking-call-in-async
+        """)
+    assert findings == []
+    assert suppressed == 1
+
+
+def test_disable_file_suppresses_everywhere(tmp_path):
+    findings, suppressed = _lint_source(tmp_path, """\
+        # tasklint: disable-file=blocking-call-in-async
+        import time
+
+        async def a():
+            time.sleep(1)
+
+        async def b():
+            time.sleep(2)
+        """)
+    assert findings == []
+    assert suppressed == 2
+
+
+def test_unknown_rule_in_suppression_is_rejected(tmp_path):
+    findings, _ = _lint_source(tmp_path, """\
+        x = 1  # tasklint: disable=not-a-rule
+        """)
+    assert len(findings) == 1
+    assert findings[0].rule == "bad-suppression"
+    assert "not-a-rule" in findings[0].message
+    # the known-rule list is printed so the typo is a one-edit fix
+    assert "blocking-call-in-async" in findings[0].message
+
+
+def test_syntax_error_becomes_parse_error_finding(tmp_path):
+    findings, _ = _lint_source(tmp_path, "def broken(:\n")
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+BAD = """\
+import time
+
+async def handler():
+    time.sleep(0.1)
+"""
+
+GOOD = """\
+import asyncio
+
+async def handler():
+    await asyncio.sleep(0.1)
+"""
+
+
+def _run(paths, **kw):
+    out = io.StringIO()
+    rc = run(paths, kw.pop("rules", ALL_RULES), out=out, **kw)
+    return rc, out.getvalue()
+
+
+def test_baseline_add_then_expire(tmp_path):
+    target = tmp_path / "mod.py"
+    target.write_text(BAD)
+    baseline = tmp_path / "baseline.json"
+
+    # no baseline: fails
+    rc, _ = _run([target], baseline_path=baseline)
+    assert rc == 1
+
+    # --update-baseline grandfathers the finding...
+    rc, text = _run([target], baseline_path=baseline, update_baseline=True)
+    assert rc == 0
+    doc = json.loads(baseline.read_text())
+    assert doc["version"] == 1 and len(doc["findings"]) == 1
+
+    # ...so the next run is green, with the match reported
+    rc, text = _run([target], baseline_path=baseline)
+    assert rc == 0
+    assert "1 baselined" in text
+
+    # the finding is fixed: entry goes stale (noted, still green)...
+    target.write_text(GOOD)
+    rc, text = _run([target], baseline_path=baseline)
+    assert rc == 0
+    assert "no longer matches" in text
+
+    # ...and --update-baseline expires it
+    rc, _ = _run([target], baseline_path=baseline, update_baseline=True)
+    assert rc == 0
+    assert json.loads(baseline.read_text())["findings"] == {}
+
+
+def test_baseline_matches_by_count(tmp_path):
+    """Two identical findings share a fingerprint; baselining one
+    occurrence must not grandfather a second one."""
+    target = tmp_path / "mod.py"
+    target.write_text(BAD)
+    baseline = tmp_path / "baseline.json"
+    _run([target], baseline_path=baseline, update_baseline=True)
+
+    target.write_text(BAD + "\n\nasync def handler2():\n    time.sleep(0.1)\n")
+    rc, text = _run([target], baseline_path=baseline)
+    assert rc == 1  # the new duplicate is NOT covered
+
+
+def test_json_output_schema(tmp_path):
+    target = tmp_path / "mod.py"
+    target.write_text(BAD)
+    out = io.StringIO()
+    rc = run([target], ALL_RULES, json_out=True, out=out)
+    assert rc == 1
+    doc = json.loads(out.getvalue())
+    assert doc["version"] == 1
+    assert doc["files"] == 1
+    assert isinstance(doc["suppressed"], int)
+    assert isinstance(doc["baselined"], int)
+    assert doc["stale_baseline"] == []
+    (finding,) = doc["findings"]
+    assert finding["rule"] == "blocking-call-in-async"
+    assert finding["path"].endswith("mod.py")
+    assert finding["line"] == 4 and finding["col"] >= 1
+    assert "time.sleep" in finding["message"]
+    assert finding["fingerprint"]
+
+
+def test_cache_roundtrip_and_invalidation(tmp_path):
+    target = tmp_path / "mod.py"
+    target.write_text(BAD)
+    sig = ruleset_signature(ALL_RULES)
+
+    cache_file = tmp_path / "cache.json"
+    cache = ResultCache(cache_file, sig)
+    assert cache.get(target) is None
+    findings, _ = lint_file(target, ALL_RULES)
+    cache.put(target, findings)
+    cache.save()
+
+    # fresh instance: hit, identical findings
+    cache2 = ResultCache(cache_file, sig)
+    assert cache2.get(target) == findings
+    assert cache2.hits == 1
+
+    # content change invalidates (mtime_ns + size)
+    target.write_text(GOOD)
+    assert ResultCache(cache_file, sig).get(target) is None
+
+    # ruleset change invalidates
+    target.write_text(BAD)
+    cache3 = ResultCache(cache_file, sig)
+    cache3.put(target, findings)
+    cache3.save()
+    assert ResultCache(cache_file, "other-signature").get(target) is None
+
+
+def test_engine_uses_cache_across_runs(tmp_path):
+    target = tmp_path / "mod.py"
+    target.write_text(BAD)
+    cache_file = tmp_path / "cache.json"
+    rc1, _ = _run([target], cache_path=cache_file)
+    rc2, text = _run([target], cache_path=cache_file)
+    assert (rc1, rc2) == (1, 1)
+    assert "1 cached" in text
+
+
+def test_rules_filter_limits_what_fires(tmp_path):
+    findings, _ = _lint_source(tmp_path, """\
+        import time
+
+        async def handler(metrics):
+            time.sleep(0.1)
+            metrics.inc("not_a_declared_metric")
+        """, rules=("metric-names",))
+    assert _rules_fired(findings) == {"metric-names"}
+
+
+# -- the tree itself ----------------------------------------------------
+
+
+def test_package_has_zero_nonbaselined_findings():
+    """Green-by-construction: the shipped baseline is EMPTY and the
+    whole package passes every rule. Any new finding fails this test
+    even if `make lint` is skipped."""
+    out = io.StringIO()
+    rc = run([DEFAULT_TARGET], ALL_RULES,
+             baseline_path=DEFAULT_BASELINE, cache_path=None, out=out)
+    assert rc == 0, out.getvalue()
+    baseline = json.loads(DEFAULT_BASELINE.read_text())
+    assert baseline["findings"] == {}, \
+        "the shipped baseline must stay empty — fix or suppress inline"
+
+
+def test_cli_wiring_runs_tasklint(capsys):
+    from tasksrunner.cli import main as cli_main
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["lint", "--", "--list-rules"])
+    assert exc.value.code == 0
+    assert "blocking-call-in-async" in capsys.readouterr().out
