@@ -1,6 +1,6 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test lint lint-metrics soak bench bench-state bench-hist chaos sweep-flash run validate docs-serve docs-build clean
+.PHONY: test lint lint-metrics soak bench bench-state bench-shard bench-hist chaos sweep-flash run validate docs-serve docs-build clean
 
 test: lint
 	python -m pytest tests/ -q
@@ -28,6 +28,12 @@ bench:
 # one-commit-per-call path, plus the read cache — seconds, not minutes
 bench-state:
 	python bench.py --state-bench
+
+# sharded state plane: write-heavy ops/s swept over shards {1,2,4,8};
+# the speedup needs cores (N writer threads) — on a 1-core host this
+# measures the facade's overhead, not the parallel-commit gain
+bench-shard:
+	python bench.py --shard-bench
 
 # histogram hot-path cost: histograms-on vs -off on the write-heavy
 # state path and the publish/deliver path (must stay < 3%)

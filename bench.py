@@ -655,6 +655,68 @@ async def run_state_bench(n_ops: int = 4000, *, concurrency: int = 64,
     }
 
 
+async def run_shard_scaling_bench(n_ops: int = 6000, *, concurrency: int = 64,
+                                  rounds: int = 3, n_keys: int = 2048,
+                                  shard_counts: tuple = (1, 2, 4, 8)) -> dict:
+    """``state_shard_scaling``: write-heavy throughput vs shard count.
+
+    The same write-heavy mix as ``state_ops_per_sec`` (concurrent
+    upserts over a shared key set), swept across the ``shards``
+    component knob. ``shards: 1`` is the exact code path a default
+    component gets (a plain SqliteStateStore, no facade) so its lane
+    doubles as the no-regression control; N > 1 lanes run the
+    rendezvous-sharded facade — N write queues, N writer threads, N
+    WALs. Keys spread ~uniformly, so N shards ≈ N independent
+    group-commit engines; scaling is bounded by cores and by the
+    shared event loop issuing the ops.
+    """
+    from tasksrunner.state.sqlite import SqliteStateStore, build_sharded_store
+
+    tmp = tempfile.mkdtemp(prefix="tasksrunner-bench-shard-")
+    keys = [f"task-{i}" for i in range(n_keys)]
+
+    async def measure(store) -> float:
+        rates = []
+        await _state_op_rate(store, "write", max(200, n_ops // 4),
+                             concurrency, keys)  # warmup round, discarded
+        for _ in range(rounds):
+            rates.append(await _state_op_rate(store, "write", n_ops,
+                                              concurrency, keys))
+        return statistics.median(rates)
+
+    lanes: dict[int, float] = {}
+    for n in shard_counts:
+        path = f"{tmp}/shards{n}/state.db"
+        store = (SqliteStateStore(f"bench-shard{n}", path) if n == 1
+                 else build_sharded_store(f"bench-shard{n}", path, shards=n))
+        try:
+            lanes[n] = await measure(store)
+        finally:
+            store.close()
+
+    base = lanes[shard_counts[0]]
+    return {
+        "write_heavy": {
+            str(n): {
+                "ops_per_sec": round(rate, 1),
+                "speedup_vs_shards1": round(rate / base, 2) if base else None,
+            }
+            for n, rate in lanes.items()
+        },
+        "concurrency": concurrency,
+        "n_keys": n_keys,
+        "host_cpus": os.cpu_count(),
+        "note": "write-heavy mix (concurrent upserts) swept over the "
+                "`shards` component knob; shards:1 is the plain "
+                "single-file engine (no facade) and the control lane, "
+                "N>1 is the rendezvous-sharded facade with N "
+                "independent group-commit write queues. Scaling needs "
+                "cores for the N writer threads: on a 1-core host the "
+                "sweep measures the facade's routing/fan-out overhead, "
+                "not the parallel-commit speedup",
+    }
+
+
 async def run_chaos_overhead_bench(n_ops: int = 12000, *, concurrency: int = 64,
                                    rounds: int = 5, n_keys: int = 512) -> dict:
     """``chaos_overhead``: the fault-injection subsystem's "free when
@@ -1109,6 +1171,10 @@ def main() -> None:
     parser.add_argument("--state-bench", action="store_true",
                         help="run ONLY the state-store ops/s section "
                              "(`make bench-state`) and print its JSON")
+    parser.add_argument("--shard-bench", action="store_true",
+                        help="run ONLY the state shard-scaling sweep "
+                             "(`make bench-shard`): write-heavy ops/s "
+                             "for shards in {1,2,4,8} and print its JSON")
     parser.add_argument("--chaos-bench", action="store_true",
                         help="run ONLY the chaos-overhead section "
                              "(`make chaos`): proves the disabled gate "
@@ -1133,6 +1199,15 @@ def main() -> None:
              f"read-heavy {r['ops_per_sec']} ops/s "
              f"(cached {r['cached_ops_per_sec']}, {r['cache_speedup']}x)")
         print(json.dumps({"state_ops_per_sec": state_ops}))
+        return
+
+    if args.shard_bench:
+        _log("state shard-scaling sweep (write-heavy mix) ...")
+        shard_scaling = asyncio.run(run_shard_scaling_bench())
+        for n, lane in shard_scaling["write_heavy"].items():
+            _log(f"  -> shards={n}: {lane['ops_per_sec']} ops/s "
+                 f"({lane['speedup_vs_shards1']}x vs shards=1)")
+        print(json.dumps({"state_shard_scaling": shard_scaling}))
         return
 
     if args.chaos_bench:
@@ -1178,7 +1253,7 @@ def main() -> None:
     # the chip section runs FIRST: it is the scarcest measurement (the
     # tunnel has documented multi-hour outages) and must not queue
     # behind minutes of CPU benches that could overlap an outage window
-    _log("bench 1/8: ML-extension train step on the attached chip ...")
+    _log("bench 1/9: ML-extension train step on the attached chip ...")
     # belt over braces: the section is internally fault-tolerant, but
     # it also runs FIRST now — nothing it could raise may be allowed
     # to cost the CPU sections their numbers
@@ -1197,16 +1272,25 @@ def main() -> None:
     # the component the e2e write path bottlenecks on, measured alone —
     # and the seed write path measured in the SAME run, so the group-
     # commit speedup is a same-host apples-to-apples figure
-    _log("bench 2/8: state-store ops/s (group-commit write queue) ...")
+    _log("bench 2/9: state-store ops/s (group-commit write queue) ...")
     state_ops = asyncio.run(run_state_bench())
     _log(f"  -> write-heavy {state_ops['write_heavy']['ops_per_sec']} ops/s "
          f"({state_ops['write_heavy']['speedup']}x vs pre-change), "
          f"read-heavy {state_ops['read_heavy']['ops_per_sec']} ops/s "
          f"(cached {state_ops['read_heavy']['cached_ops_per_sec']})")
 
+    # the sharded state plane's scaling claim: N writer shards ≈ N
+    # independent group-commit engines (docs/modules/04 quotes this)
+    _log("bench 3/9: state shard-scaling sweep (write-heavy mix) ...")
+    shard_scaling = asyncio.run(run_shard_scaling_bench())
+    _log("  -> " + ", ".join(
+        f"shards={n}: {lane['ops_per_sec']} ops/s "
+        f"({lane['speedup_vs_shards1']}x)"
+        for n, lane in shard_scaling["write_heavy"].items()))
+
     # the chaos gate's "free when off" claim, measured on the same
     # write-heavy path (docs/modules/16-chaos.md quotes this number)
-    _log("bench 3/8: chaos-gate overhead on the write-heavy state path ...")
+    _log("bench 4/9: chaos-gate overhead on the write-heavy state path ...")
     chaos_overhead = asyncio.run(run_chaos_overhead_bench())
     _log(f"  -> gate-off {chaos_overhead['gate_off_overhead_pct']:+.2f}% vs "
          f"baseline {chaos_overhead['baseline_ops_per_sec']} ops/s, "
@@ -1214,14 +1298,14 @@ def main() -> None:
 
     # the latency-histogram instrumentation's "free when off, cheap when
     # on" claim on the same two hot paths (docs/modules/08 quotes this)
-    _log("bench 4/8: histogram overhead (state write + publish/deliver) ...")
+    _log("bench 5/9: histogram overhead (state write + publish/deliver) ...")
     hist_overhead = asyncio.run(run_histogram_overhead_bench())
     _hs = hist_overhead["state_write"]
     _hp = hist_overhead["publish_deliver"]
     _log(f"  -> state write {_hs['overhead_pct']:+.2f}%, "
          f"publish/deliver {_hp['overhead_pct']:+.2f}% (bar <3%)")
 
-    _log("bench 5/8: cross-process write path (faithful [PB] topology) ...")
+    _log("bench 6/9: cross-process write path (faithful [PB] topology) ...")
     xproc = asyncio.run(run_xproc(latency_probe=True, rounds=5))
     _log(f"  -> {xproc['throughput']} tasks/s, "
          f"p50 {xproc['p50_ms']} ms, p99 {xproc['p99_ms']} ms (conc=8)")
@@ -1230,22 +1314,30 @@ def main() -> None:
     # workload certs, every peer hop on the authenticated mesh lane —
     # module 15 quotes this delta instead of recommending an unmeasured
     # configuration
-    _log("bench 6/8: cross-process write path under mesh mTLS ...")
+    _log("bench 7/9: cross-process write path under mesh mTLS ...")
     # same rounds as the plaintext headline — an asymmetric pair would
     # bake an ordering/averaging confound into the published delta
     mtls = asyncio.run(run_xproc(latency_probe=True, rounds=5,
                                  mesh_tls=True))
-    mtls_overhead = round(
-        (xproc["throughput"] - mtls["throughput"])
-        / xproc["throughput"] * 100.0, 1)
+    # a lane that completed zero ops (wedged processor, chaos drill run
+    # against the bench) reports throughput 0; the delta is undefined
+    # then, not a division crash that loses the whole bench run
+    if xproc["throughput"]:
+        mtls_overhead = round(
+            (xproc["throughput"] - mtls["throughput"])
+            / xproc["throughput"] * 100.0, 1)
+        overhead_note = f" ({mtls_overhead:+.1f}% vs plaintext)"
+    else:
+        mtls_overhead = None
+        overhead_note = " (overhead undefined: plaintext lane completed 0 ops)"
     _log(f"  -> {mtls['throughput']} tasks/s, p50 {mtls['p50_ms']} ms, "
-         f"p99 {mtls['p99_ms']} ms ({mtls_overhead:+.1f}% vs plaintext)")
+         f"p99 {mtls['p99_ms']} ms{overhead_note}")
 
     # scale-out: with 20 ms of simulated work per message (≙ the
     # reference processor's SendGrid call) consumers are the
     # bottleneck; 5 competing replicas vs 1 shows the KEDA-style
     # scale-out actually scaling (SURVEY.md §5.8)
-    _log("bench 7/8: competing-consumer scale-out (20 ms work/message) ...")
+    _log("bench 8/9: competing-consumer scale-out (20 ms work/message) ...")
     one = asyncio.run(run_xproc(n_tasks=300, n_processors=1, rounds=2,
                                 work_ms=20.0))
     five = asyncio.run(run_xproc(n_tasks=300, n_processors=5, rounds=2,
@@ -1254,7 +1346,7 @@ def main() -> None:
     _log(f"  -> 1 replica: {one['throughput']} tasks/s; "
          f"5 replicas: {five['throughput']} tasks/s ({speedup}x)")
 
-    _log("bench 8/8: in-process cluster (round-1 continuity) ...")
+    _log("bench 9/9: in-process cluster (round-1 continuity) ...")
     inproc = asyncio.run(run_inproc())
     _log(f"  -> {inproc} tasks/s")
 
@@ -1310,6 +1402,7 @@ def main() -> None:
             },
             "inproc_tasks_per_sec": inproc,
             "state_ops_per_sec": state_ops,
+            "state_shard_scaling": shard_scaling,
             "chaos_overhead": chaos_overhead,
             "histogram_overhead": hist_overhead,
             "ml_extension_tpu": tpu,

@@ -120,9 +120,10 @@ def main() -> None:
         for r in ok:
             print(f"  {r['step_ms']:8.2f} ms  {r['variant']}")
         best = ok[0]
-        print(f"\nbest: {best['variant']} — export "
-              + " ".join(f"{k}={v}" for k, v in best["env"].items())
-              or "(baseline: no overrides)")
+        exports = " ".join(f"{k}={v}" for k, v in best["env"].items())
+        suffix = (f" — export {exports}" if best["env"]
+                  else " (baseline: no overrides)")
+        print(f"\nbest: {best['variant']}{suffix}")
     print(f"\nwrote {OUT}")
 
 

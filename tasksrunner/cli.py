@@ -411,7 +411,8 @@ def _cmd_ps(args) -> None:
             # entries behind) — report it as such instead of probing
             # ports a NEW incarnation may have reclaimed, which would
             # show the ghost as healthy
-            if NameResolver.local_pid_dead(addr.host, addr.pid):
+            if NameResolver.local_pid_dead(addr.host, addr.pid,
+                                           addr.registered_at):
                 row["health"] = "stale"
                 return row
             try:

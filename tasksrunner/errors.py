@@ -82,6 +82,19 @@ class EtagMismatch(StateError):
     http_status = 409
 
 
+class CrossShardAtomicityError(StateError):
+    """A cross-shard state transaction lost atomicity: one or more
+    shards committed before a later shard's commit failed, and the
+    committed shards cannot be rolled back (SQLite has no distributed
+    coordinator log). The message names the committed/uncommitted
+    split; the repair is to re-read the affected keys and reconcile.
+    Raised only by the sharded facade's two-phase commit path — a
+    failure during the *stage* phase, or on the *first* commit, aborts
+    cleanly with the original error instead (nothing was durable)."""
+
+    http_status = 500
+
+
 class QueryError(StateError):
     """Malformed state query or store without query support.
 

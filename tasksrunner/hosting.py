@@ -171,6 +171,18 @@ class AppHost:
         self.client: AppClient | None = None
 
     async def start(self) -> None:
+        # Any failure past the first bind must unwind what already
+        # started: a PortInUseError on the SIDECAR port would otherwise
+        # leave the APP port silently held by this half-started host,
+        # so the operator's retry (or the next replica) hits a second,
+        # self-inflicted PortInUseError on a port nothing serves.
+        try:
+            await self._start_inner()
+        except BaseException:
+            await self._unwind_start()
+            raise
+
+    async def _start_inner(self) -> None:
         # 1. the app's own HTTP server. Access logging is on by default
         # (the workshop reads those lines); TASKSRUNNER_ACCESS_LOG=0
         # disables it — measured at ~2x request throughput on the write
@@ -229,6 +241,39 @@ class AppHost:
         await self.app.startup()
         logger.info("app %s on :%d, sidecar on :%d",
                     self.app.app_id, self.app_port, self.sidecar_port)
+
+    async def _unwind_start(self) -> None:
+        """Tear down whatever a failed start() got through, in reverse
+        order, keeping the original exception the caller sees. Each
+        step is best-effort: a secondary teardown failure is logged,
+        never allowed to mask why startup failed."""
+        if self.register:
+            try:
+                # scoped to this replica; a no-op if registration never
+                # happened (unregister filters by pid + sidecar port)
+                await asyncio.to_thread(
+                    self.resolver.unregister, self.app.app_id,
+                    pid=os.getpid(), sidecar_port=self.sidecar_port)
+            except Exception:
+                logger.exception("start unwind: unregister failed")
+        if self.client is not None:
+            try:
+                await self.client.close()
+            except Exception:
+                logger.exception("start unwind: client close failed")
+            self.client = None
+        if self.sidecar is not None:
+            try:
+                await self.sidecar.stop()
+            except Exception:
+                logger.exception("start unwind: sidecar stop failed")
+            self.sidecar = None
+        if self._app_runner is not None:
+            try:
+                await self._app_runner.cleanup()
+            except Exception:
+                logger.exception("start unwind: app runner cleanup failed")
+            self._app_runner = None
 
     async def stop(self) -> None:
         await self.app.shutdown()

@@ -38,6 +38,26 @@ class AppAddress:
         return f"http://{self.host}:{self.sidecar_port}"
 
 
+def _pid_started_at(pid: int) -> float | None:
+    """Wall-clock time the process holding ``pid`` was created, from
+    /proc (Linux). None when undeterminable — non-Linux hosts, the
+    process exiting mid-read, malformed stat — in which case callers
+    must fall back to plain pid-exists liveness."""
+    try:
+        stat = pathlib.Path(f"/proc/{pid}/stat").read_bytes()
+        # fields after the last ')' (comm may embed spaces and parens):
+        # the first is field 3 (state); starttime is field 22, so
+        # index 19 here — clock ticks since boot
+        ticks = int(stat[stat.rindex(b")") + 2:].split()[19])
+        for line in pathlib.Path("/proc/stat").read_text().splitlines():
+            if line.startswith("btime "):
+                boot = int(line.split()[1])
+                return boot + ticks / os.sysconf("SC_CLK_TCK")
+        return None
+    except (OSError, ValueError, IndexError):
+        return None
+
+
 def _same_replica(a: dict, b: dict) -> bool:
     """Entry identity for replace-on-reregister: one replica = one
     (pid, sidecar_port) pair. pid alone is not enough — several
@@ -123,23 +143,40 @@ class NameResolver:
         self._mutate(mutate)
 
     @staticmethod
-    def local_pid_dead(host: str | None, pid: int | None) -> bool:
+    def local_pid_dead(host: str | None, pid: int | None,
+                       registered_at: float | None = None) -> bool:
         """True iff the entry was registered on THIS host (loopback)
         with a pid that no longer exists — the signature of SIGKILL
         debris. The ONE liveness predicate: `ps` and the prune sweep
         must never drift apart on what counts as stale. For a remote
-        host a missing local pid proves nothing → False."""
+        host a missing local pid proves nothing → False.
+
+        ``registered_at`` closes the pid-recycling window: a pid that
+        *exists* may belong to a NEW, unrelated process that inherited
+        the dead replica's number (Linux wraps at pid_max). When the
+        registration time is known and the current holder of the pid
+        was born *after* it, the replica that registered is gone and
+        the entry is debris — os.kill(pid, 0) succeeding proves only
+        that the number is in use, not that it is still ours."""
         if host not in ("127.0.0.1", "localhost"):
             return False
         if not pid or pid == os.getpid():
             return False
         try:
             os.kill(pid, 0)
-            return False
         except ProcessLookupError:
             return True
         except PermissionError:  # exists, owned by someone else
             return False
+        if registered_at:
+            started = _pid_started_at(pid)
+            # 2 s slack: /proc btime is whole seconds and the replica
+            # sets registered_at after its process start — only a
+            # clearly-later birth proves recycling; unknown (non-Linux,
+            # proc race) falls back to today's pid-exists answer
+            if started is not None and started > registered_at + 2.0:
+                return True
+        return False
 
     def prune_dead_local(self) -> list[tuple[str, int]]:
         """Remove replicas registered on THIS host whose pid no longer
@@ -152,7 +189,8 @@ class NameResolver:
         dead: list[tuple[str, int]] = []
 
         def is_dead(e: dict) -> bool:
-            return self.local_pid_dead(e.get("host"), e.get("pid"))
+            return self.local_pid_dead(e.get("host"), e.get("pid"),
+                                       e.get("registered_at"))
 
         if self.registry_file is None:
             for app_id, replicas in list(self._static.items()):

@@ -2,7 +2,10 @@ from tasksrunner.state.base import StateItem, StateStore, TransactionOp
 from tasksrunner.state.keyprefix import KeyPrefixer
 from tasksrunner.state.memory import InMemoryStateStore
 from tasksrunner.state.redis import RedisStateStore
-from tasksrunner.state.sqlite import SqliteStateStore
+from tasksrunner.state.sharding import ShardedStateStore, ShardRouter
+from tasksrunner.state.sqlite import (
+    SqliteStateStore, StagedTransaction, build_sharded_store,
+)
 
 __all__ = [
     "StateItem",
@@ -11,5 +14,9 @@ __all__ = [
     "KeyPrefixer",
     "InMemoryStateStore",
     "RedisStateStore",
+    "ShardedStateStore",
+    "ShardRouter",
     "SqliteStateStore",
+    "StagedTransaction",
+    "build_sharded_store",
 ]

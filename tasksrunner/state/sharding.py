@@ -1,0 +1,294 @@
+"""Sharded state plane: hash-partitioned multi-writer stores.
+
+PR 1's group-commit made one SQLite file fast, but a single write
+queue + flusher is still the throughput ceiling: every write in the
+component serializes through one writer thread and one WAL. This
+module partitions a state component across N independent child stores
+— N write queues, N writer threads, N WALs — behind one ``StateStore``
+facade, the same shape as SNIPPETS.md's ``shard_map`` exemplars
+(shard by the leading dim, mesh of independent executors).
+
+Routing — rendezvous (highest-random-weight) hashing
+----------------------------------------------------
+
+Each shard ``i`` gets a salt derived from ``(hashSeed, i)``; a key
+lands on the shard whose ``mix(key_hash ^ salt_i)`` is largest.
+Compared to ``hash(key) % N`` this buys the reshard property for free:
+growing ``N → N+1`` leaves salts ``0..N-1`` unchanged, so a key moves
+only if the *new* shard wins its rendezvous — an expected ``1/(N+1)``
+of the key space, the provable minimum for a balanced reshard (modulo
+hashing, by contrast, moves ``1 - 1/lcm(N, N+1)`` ≈ all of it).
+Assignment depends only on ``(key, hashSeed, shards)`` — no state, no
+ring file — so every replica and every restart routes identically.
+
+Cross-shard transactions — ordered two-phase commit
+---------------------------------------------------
+
+``transact`` over keys that all land on one shard stays exactly PR 1's
+single ``BEGIN IMMEDIATE … COMMIT``. Ops spanning shards run two-phase:
+
+1. **Stage** on every touched shard in ascending shard-index order:
+   each shard's writer thread opens its transaction, validates etags,
+   applies the ops, and parks holding the commit slot. Ordered
+   acquisition makes concurrent cross-shard transactions deadlock-free
+   (any holder of shard ``i``'s slot already holds all its lower
+   shards, so the wait graph cannot cycle). A stage failure
+   (``EtagMismatch``, lock deadline) rolls back every staged shard and
+   re-raises — nothing committed, the all-or-nothing contract intact.
+2. **Commit** in the same ascending order. If the *first* commit
+   fails, the rest roll back — still atomic. If a commit fails after
+   one or more shards already committed, atomicity is gone and the
+   facade raises :class:`~tasksrunner.errors.CrossShardAtomicityError`
+   naming the committed/uncommitted split — the documented ambiguity
+   window of two-phase commit without a coordinator log. Callers that
+   cannot tolerate it should keep transaction keys on one shard (same
+   rendezvous input, e.g. a shared key prefix routed via a designated
+   key) or treat the error as "repair by re-read".
+
+While a shard's transaction is staged its writer thread is parked, so
+queued group-commit flushes on that shard wait behind the decision —
+the commit slot IS the writer thread, no second lock to leak.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import heapq
+from typing import Any, Sequence
+
+from tasksrunner.errors import (
+    ComponentError, CrossShardAtomicityError, QueryError, StateError,
+)
+from tasksrunner.state.base import (
+    QueryResponse, StateItem, StateStore, TransactionOp,
+)
+from tasksrunner.state.query import paginate, sort_items, validate_filter
+
+_MASK64 = (1 << 64) - 1
+
+#: hard ceiling on shard count — each shard is a file + 2-3 threads +
+#: 2 sqlite connections; past this the fan-out costs more than it buys
+MAX_SHARDS = 64
+
+
+def _blake64(data: bytes) -> int:
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=8).digest(), "big")
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer: full-avalanche mix of a 64-bit value, so
+    one flipped bit of ``key_hash ^ salt`` reshuffles the whole
+    rendezvous weight (bare xor would correlate weights across shards
+    and skew the balance)."""
+    x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9 & _MASK64
+    x = (x ^ (x >> 27)) * 0x94D049BB133111EB & _MASK64
+    return (x ^ (x >> 31)) & _MASK64
+
+
+class ShardRouter:
+    """Pure key → shard-index routing via rendezvous hashing.
+
+    Stateless and deterministic in ``(shards, seed)``; reusable by any
+    sharded component (the broker's partitioned topics are next).
+    """
+
+    __slots__ = ("shards", "seed", "_salts", "_cache")
+
+    #: bounded key→shard memo: real key spaces revisit keys constantly
+    #: and the rendezvous argmax is pure-Python work per lookup; the
+    #: memo turns the hot-key path into one dict hit. Assignment is a
+    #: pure function of (key, seed, shards), so cached entries can
+    #: never go stale within a router instance.
+    _CACHE_MAX = 65536
+
+    def __init__(self, shards: int, seed: str = ""):
+        if not isinstance(shards, int) or shards < 1:
+            raise ComponentError(
+                f"shards must be a positive integer, not {shards!r}")
+        if shards > MAX_SHARDS:
+            raise ComponentError(
+                f"shards must be <= {MAX_SHARDS}, not {shards}")
+        self.shards = shards
+        self.seed = seed
+        # salt i depends only on (seed, i): growing the shard count
+        # appends salts without touching existing ones — the minimal-
+        # movement property rests exactly here
+        self._salts = tuple(
+            _blake64(f"{seed}|{i}".encode("utf-8")) for i in range(shards))
+        self._cache: dict[str, int] = {}
+
+    def shard_of(self, key: str) -> int:
+        if self.shards == 1:
+            return 0
+        hit = self._cache.get(key)
+        if hit is not None:
+            return hit
+        h = _blake64(key.encode("utf-8"))
+        best_i = 0
+        best_w = -1
+        for i, salt in enumerate(self._salts):
+            w = _mix64(h ^ salt)
+            if w > best_w:
+                best_w = w
+                best_i = i
+        if len(self._cache) >= self._CACHE_MAX:
+            # rare full reset beats per-hit LRU bookkeeping: the memo
+            # refills from the live key distribution in one pass
+            self._cache.clear()
+        self._cache[key] = best_i
+        return best_i
+
+    def spread(self, keys: Sequence[str]) -> list[int]:
+        """Shard index per key; diagnostics and tests."""
+        return [self.shard_of(k) for k in keys]
+
+
+class ShardedStateStore(StateStore):
+    """One ``StateStore`` facade over N child stores + a router.
+
+    Children are full independent engines (own writer/flusher threads,
+    WAL, checkpointer when SQLite-backed); the facade only routes,
+    fans out, and merges. Cross-shard ``transact`` requires children
+    implementing the ``stage_transact`` two-phase protocol (the sqlite
+    engine does); single-shard transactions work on any child.
+    """
+
+    supports_query = True
+
+    def __init__(self, name: str, shards: Sequence[StateStore], *,
+                 hash_seed: str = ""):
+        super().__init__(name)
+        if not shards:
+            raise ComponentError(f"sharded store {name!r} needs >= 1 shard")
+        self._shards = list(shards)
+        self.router = ShardRouter(len(self._shards), hash_seed)
+
+    # -- routing -----------------------------------------------------------
+
+    def shard_for(self, key: str) -> StateStore:
+        return self._shards[self.router.shard_of(key)]
+
+    @property
+    def shard_count(self) -> int:
+        return len(self._shards)
+
+    # -- single-key ops: pure routing -------------------------------------
+
+    async def get(self, key: str) -> StateItem | None:
+        return await self.shard_for(key).get(key)
+
+    async def set(self, key: str, value: Any, *, etag: str | None = None) -> str:
+        return await self.shard_for(key).set(key, value, etag=etag)
+
+    async def delete(self, key: str, *, etag: str | None = None) -> bool:
+        return await self.shard_for(key).delete(key, etag=etag)
+
+    # -- fan-out reads -----------------------------------------------------
+
+    async def bulk_get(self, keys: list[str]) -> list[StateItem | None]:
+        out: list[StateItem | None] = [None] * len(keys)
+        by_shard: dict[int, list[int]] = {}
+        for i, key in enumerate(keys):
+            by_shard.setdefault(self.router.shard_of(key), []).append(i)
+        async def _one(shard_idx: int, idxs: list[int]) -> None:
+            items = await self._shards[shard_idx].bulk_get(
+                [keys[i] for i in idxs])
+            for i, item in zip(idxs, items):
+                out[i] = item
+        await asyncio.gather(
+            *(_one(s, idxs) for s, idxs in by_shard.items()))
+        return out
+
+    async def keys(self, *, prefix: str = "") -> list[str]:
+        per_shard = await asyncio.gather(
+            *(s.keys(prefix=prefix) for s in self._shards))
+        # children return sorted lists; k-way merge keeps the facade's
+        # answer identical to the single-file engine's ORDER BY key
+        return list(heapq.merge(*per_shard))
+
+    async def query(self, query: dict, *, key_prefix: str = "") -> QueryResponse:
+        """Scatter the filter, gather + merge, then sort/page at the
+        facade. Children get the filter only — sort and page must see
+        the *global* result set, so they run here on the merged items
+        via the same ``state/query.py`` pipeline the memory engine
+        uses; semantics stay contract-suite identical to one shard."""
+        if not isinstance(query, dict):
+            raise QueryError("query must be a JSON object")
+        filt = query.get("filter")
+        validate_filter(filt)
+        per_shard = await asyncio.gather(
+            *(s.query({"filter": filt}, key_prefix=key_prefix)
+              for s in self._shards))
+        items = list(heapq.merge(
+            *(r.items for r in per_shard), key=lambda it: it.key))
+        items = sort_items(items, query.get("sort"))
+        items, token = paginate(items, query.get("page"))
+        return QueryResponse(items=items, token=token)
+
+    # -- transactions ------------------------------------------------------
+
+    async def transact(self, ops: list[TransactionOp]) -> None:
+        by_shard: dict[int, list[TransactionOp]] = {}
+        for op in ops:
+            by_shard.setdefault(self.router.shard_of(op.key), []).append(op)
+        if len(by_shard) <= 1:
+            # the hot path: all keys rendezvous to one shard — exactly
+            # PR 1's single BEGIN IMMEDIATE..COMMIT, no staging at all
+            for shard_idx, shard_ops in by_shard.items():
+                await self._shards[shard_idx].transact(shard_ops)
+            return
+        await self._transact_cross_shard(by_shard)
+
+    async def _transact_cross_shard(
+            self, by_shard: dict[int, list[TransactionOp]]) -> None:
+        order = sorted(by_shard)
+        staged = []
+        try:
+            for shard_idx in order:
+                child = self._shards[shard_idx]
+                stage = getattr(child, "stage_transact", None)
+                if stage is None:
+                    raise StateError(
+                        f"store {self.name!r}: cross-shard transactions "
+                        f"need shards that support staged commits; shard "
+                        f"{shard_idx} ({type(child).__name__}) does not")
+                staged.append((shard_idx, await stage(by_shard[shard_idx])))
+        except BaseException:
+            # stage phase failed: nothing committed anywhere; unwind
+            # every already-staged shard and surface the original error
+            await self._rollback_staged(staged)
+            raise
+        committed: list[int] = []
+        for pos, (shard_idx, txn) in enumerate(staged):
+            try:
+                await txn.commit()
+            except BaseException as exc:
+                await self._rollback_staged(staged[pos + 1:])
+                if committed:
+                    raise CrossShardAtomicityError(
+                        f"store {self.name!r}: cross-shard transaction "
+                        f"committed on shard(s) {committed} but failed on "
+                        f"shard {shard_idx}; remaining shards rolled back "
+                        f"— repair by re-reading the affected keys"
+                    ) from exc
+                raise
+            committed.append(shard_idx)
+
+    async def _rollback_staged(self, staged: list) -> None:
+        for _shard_idx, txn in staged:
+            await txn.rollback()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        first: BaseException | None = None
+        for child in self._shards:
+            try:
+                child.close()
+            except Exception as exc:
+                if first is None:
+                    first = exc
+        if first is not None:
+            raise first

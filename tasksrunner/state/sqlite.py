@@ -48,7 +48,9 @@ from typing import Any
 
 from tasksrunner.component.registry import driver
 from tasksrunner.component.spec import ComponentSpec, metadata_bool, metadata_int
-from tasksrunner.errors import EtagMismatch, QueryError, StateError
+from tasksrunner.errors import (
+    ComponentError, EtagMismatch, QueryError, StateError,
+)
 from tasksrunner.observability.metrics import metrics
 from tasksrunner.state.base import QueryResponse, StateItem, StateStore, TransactionOp
 from tasksrunner.state.query import validate_filter
@@ -199,15 +201,115 @@ def _resolve_batch(
             pass
 
 
+class StagedTransaction:
+    """Coordinator handle for one store's staged, uncommitted
+    transaction — the per-shard half of the two-phase cross-shard
+    commit in ``state/sharding.py``.
+
+    ``SqliteStateStore.stage_transact`` returns one of these only
+    after the writer thread has opened the transaction, validated
+    every etag, and applied the ops; the transaction is then HELD OPEN
+    with the writer thread parked on the coordinator's decision.
+    Exactly one of :meth:`commit` / :meth:`rollback` must be awaited.
+    The writer thread enforces a decision deadline
+    (``SqliteStateStore._STAGE_DECISION_TIMEOUT``): past it the shard
+    rolls back unilaterally and a late ``commit()`` raises
+    ``StateError`` rather than pretending to have committed.
+    """
+
+    __slots__ = ("_loop", "_staged", "_done", "_lock", "_evt", "_decision")
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self._loop = loop
+        #: resolves once the ops are applied inside the open
+        #: transaction (or with the stage-phase failure)
+        self._staged: asyncio.Future = loop.create_future()
+        #: resolves with the final outcome: "committed"/"rolledback",
+        #: or the commit/rollback-phase exception
+        self._done: asyncio.Future = loop.create_future()
+        self._lock = threading.Lock()
+        self._evt = threading.Event()
+        self._decision: str | None = None
+
+    # -- coordinator side (event loop) ------------------------------------
+
+    def _decide(self, decision: str) -> None:
+        # first decision wins: the writer thread's timeout races a late
+        # coordinator; the lock makes the race deterministic
+        with self._lock:
+            if self._decision is None:
+                self._decision = decision
+        self._evt.set()
+
+    async def commit(self) -> None:
+        """Commit the staged transaction. Raises the commit failure,
+        or ``StateError`` if the shard already rolled back because the
+        decision deadline passed."""
+        self._decide("commit")
+        outcome = await self._done
+        if outcome != "committed":
+            raise StateError(
+                "staged transaction was rolled back before the commit "
+                "decision arrived (decision deadline exceeded)")
+
+    async def rollback(self) -> None:
+        """Roll the staged transaction back; idempotent with the
+        writer-side timeout rollback."""
+        self._decide("rollback")
+        await self._done
+
+    # -- writer-thread side ------------------------------------------------
+
+    def _await_decision(self, timeout: float) -> str:
+        if not self._evt.wait(timeout):
+            self._decide("timeout")
+        with self._lock:
+            return self._decision or "timeout"
+
+    def _resolve_staged(self, exc: BaseException | None) -> None:
+        self._post(self._staged, None, exc)
+
+    def _finish(self, outcome: str | None, exc: BaseException | None) -> None:
+        self._post(self._done, outcome, exc)
+
+    def _post(self, fut: asyncio.Future, value: Any,
+              exc: BaseException | None) -> None:
+        def _set() -> None:
+            if fut.done():
+                return
+            if exc is None:
+                fut.set_result(value)
+            else:
+                fut.set_exception(exc)
+        try:
+            self._loop.call_soon_threadsafe(_set)
+        except RuntimeError:  # coordinator's loop closed (shutdown)
+            pass
+
+
 class SqliteStateStore(StateStore):
     #: RETURNING needs sqlite >= 3.35 (2021); fall back to the
     #: two-statement form on older system libsqlite3 builds
     _HAS_RETURNING = sqlite3.sqlite_version_info >= (3, 35, 0)
 
+    #: how long a staged cross-shard transaction may hold the commit
+    #: slot waiting for the coordinator's decision before the writer
+    #: thread rolls it back (class attr so tests can shrink it)
+    _STAGE_DECISION_TIMEOUT = 30.0
+
     def __init__(self, name: str, path: str | pathlib.Path = ":memory:", *,
-                 group_commit: bool = True, cache_size: int = 0):
+                 group_commit: bool = True, cache_size: int = 0,
+                 shard: int | None = None):
         super().__init__(name)
         self.path = str(path)
+        #: shard index when this store is one partition of a sharded
+        #: component (state/sharding.py); None = standalone. Only
+        #: affects observability: the queue-depth gauge gains a
+        #: ``shard`` label and thread names a ``.N`` suffix — latency
+        #: histograms keep ``store=name`` so per-store series aggregate
+        #: across the partition set.
+        self.shard = shard
+        thread_tag = name if shard is None else f"{name}.{shard}"
         self._is_file = self.path != ":memory:"
         if self._is_file:
             pathlib.Path(self.path).parent.mkdir(parents=True, exist_ok=True)
@@ -245,12 +347,12 @@ class SqliteStateStore(StateStore):
         # ":memory:" databases are private per connection, so there the
         # reader shares the writer's thread and connection.
         self._write_exec = concurrent.futures.ThreadPoolExecutor(
-            max_workers=1, thread_name_prefix=f"state-w-{name}")
+            max_workers=1, thread_name_prefix=f"state-w-{thread_tag}")
         if self._is_file:
             self._rconn = sqlite3.connect(self.path, check_same_thread=False)
             self._rconn.execute("PRAGMA busy_timeout=5000")
             self._read_exec = concurrent.futures.ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix=f"state-r-{name}")
+                max_workers=1, thread_name_prefix=f"state-r-{thread_tag}")
         else:
             self._rconn = self._conn
             self._read_exec = self._write_exec
@@ -261,7 +363,7 @@ class SqliteStateStore(StateStore):
         if self._is_file:
             self._ckpt_thread = threading.Thread(
                 target=self._checkpoint_loop,
-                name=f"state-ckpt-{name}", daemon=True)
+                name=f"state-ckpt-{thread_tag}", daemon=True)
             self._ckpt_thread.start()
 
         # Group-commit write queue (≙ the broker's publish queue):
@@ -432,8 +534,14 @@ class SqliteStateStore(StateStore):
         # depth the queue reached before this flush drained it; sampled
         # once per batch on the writer thread so the event loop never
         # pays for the gauge
-        metrics.set_gauge("state_write_queue_depth", len(batch),
-                          store=self.name)
+        if self.shard is None:
+            metrics.set_gauge("state_write_queue_depth", len(batch),
+                              store=self.name)
+        else:
+            # one gauge series per shard: saturation on a hot partition
+            # must be visible as THAT shard's depth, not averaged away
+            metrics.set_gauge("state_write_queue_depth", len(batch),
+                              store=self.name, shard=self.shard)
         self._exec_batch(batch)
         with self._q_lock:
             if self._q_pending:
@@ -646,6 +754,72 @@ class SqliteStateStore(StateStore):
         ]
         await self._submit_write(("transact", encoded))
 
+    # -- staged (two-phase) transactions ----------------------------------
+
+    async def stage_transact(self, ops: list[TransactionOp]) -> StagedTransaction:
+        """Open this store's transaction, validate every etag, apply
+        ``ops``, and return with the transaction HELD OPEN awaiting
+        :meth:`StagedTransaction.commit` / ``rollback``.
+
+        This is the per-shard primitive of the sharded facade's
+        cross-shard commit (state/sharding.py). While staged, the
+        writer thread is parked — it IS the commit slot, so queued
+        group-commit flushes on this store wait behind the decision.
+        A stage-phase failure (EtagMismatch, lock deadline) rolls back
+        before this coroutine returns and re-raises: a failed stage
+        never leaves a transaction open."""
+        encoded = [
+            (op.operation, op.key,
+             _encode(op.key, op.value) if op.operation == "upsert" else None,
+             op.etag)
+            for op in ops
+        ]
+        loop = asyncio.get_running_loop()
+        txn = StagedTransaction(loop)
+        with self._q_lock:
+            if self._closed:
+                raise StateError(f"state store {self.name!r} is closed")
+            try:
+                self._write_exec.submit(self._stage_job, encoded, txn)
+            except RuntimeError:
+                raise StateError(
+                    f"state store {self.name!r} is closed") from None
+        await txn._staged
+        return txn
+
+    def _stage_job(self, ops: list[tuple], txn: StagedTransaction) -> None:
+        """Writer thread: BEGIN + validate + apply, park on the
+        coordinator's decision, then COMMIT or ROLLBACK."""
+        cur = self._conn.cursor()
+        mutations: list[tuple] = []
+        try:
+            self._begin_immediate(cur)
+            try:
+                need = sum(1 for o in ops if o[0] == "upsert")
+                seq = iter(range(self._reserve_etags(cur, need),
+                                 2 ** 63)) if need else iter(())
+                self._apply_transact(cur, ops, mutations,
+                                     lambda: str(next(seq)))
+            except BaseException:
+                self._conn.rollback()
+                raise
+        except BaseException as exc:
+            txn._resolve_staged(exc)
+            return
+        txn._resolve_staged(None)
+        decision = txn._await_decision(self._STAGE_DECISION_TIMEOUT)
+        try:
+            if decision == "commit":
+                self._conn.commit()
+                self._dirty = True
+                self._cache_apply(mutations)
+                txn._finish("committed", None)
+            else:
+                self._conn.rollback()
+                txn._finish("rolledback", None)
+        except BaseException as exc:  # pragma: no cover - disk-level failure
+            txn._finish(None, exc)
+
     # -- query -------------------------------------------------------------
 
     async def query(self, query: dict, *, key_prefix: str = "") -> QueryResponse:
@@ -751,18 +925,78 @@ class SqliteStateStore(StateStore):
         self._conn.close()
 
 
+def _shard_path(path: str, index: int) -> str:
+    """Shard ``index``'s file for a component rooted at ``path``:
+    ``tasks.db`` → ``tasks-shard0.db``, ``tasks-shard1.db``, …
+    ``":memory:"`` passes through — every sqlite connection to it gets
+    a private database, which is exactly one private shard."""
+    if path == ":memory:":
+        return path
+    p = pathlib.Path(path)
+    return str(p.with_name(f"{p.stem}-shard{index}{p.suffix}"))
+
+
+def build_sharded_store(name: str, path: str | pathlib.Path = ":memory:", *,
+                        shards: int, hash_seed: str = "",
+                        group_commit: bool = True,
+                        cache_size: int = 0) -> "ShardedStateStore":
+    """N independent group-commit engines behind one facade.
+
+    Each child is a full :class:`SqliteStateStore` (own writer/flusher
+    threads, WAL, checkpointer) on its own ``-shardN`` file; the
+    facade routes by rendezvous hash (state/sharding.py). The read
+    cache budget is split across shards so the component's total
+    memory stays what ``readCacheSize`` promised."""
+    from tasksrunner.state.sharding import MAX_SHARDS, ShardedStateStore
+    if shards < 1 or shards > MAX_SHARDS:
+        # validate BEFORE constructing children: each child spins up
+        # threads and connections that a late router error would leak
+        raise ComponentError(
+            f"state store {name!r}: shards must be in 1..{MAX_SHARDS}, "
+            f"not {shards}")
+    per_shard_cache = (max(1, cache_size // shards) if cache_size else 0)
+    children = [
+        SqliteStateStore(
+            name, _shard_path(str(path), i),
+            group_commit=group_commit,
+            cache_size=per_shard_cache,
+            shard=i)
+        for i in range(shards)
+    ]
+    return ShardedStateStore(name, children, hash_seed=hash_seed)
+
+
 @driver("state.sqlite", "state.azure.cosmosdb", "state.postgresql")
-def _sqlite_state(spec: ComponentSpec, metadata: dict[str, str]) -> SqliteStateStore:
+def _sqlite_state(spec: ComponentSpec, metadata: dict[str, str]) -> StateStore:
     """Durable local engine; `databasePath` metadata picks the file
     (defaults to in-memory). Cloud-typed component files (cosmos/postgres)
     map here so they run unchanged in local mode. ``groupCommit``
     (default true) coalesces concurrent writes into one transaction;
     ``readCacheSize`` (default 0 = off) bounds the write-through LRU
     read cache — enable it only where this app is the file's sole
-    writer."""
-    return SqliteStateStore(
-        spec.name,
-        metadata.get("databasePath", ":memory:"),
-        group_commit=metadata_bool(metadata, "groupCommit", True),
-        cache_size=metadata_int(metadata, "readCacheSize", 0),
+    writer.
+
+    ``shards`` (default 1) partitions the component across N shard
+    files by rendezvous key hash, each with its own writer/flusher/
+    checkpointer — the write-throughput scaling knob. ``shards: 1``
+    keeps today's single-file layout and code path bit-for-bit (a
+    plain SqliteStateStore, no facade). ``hashSeed`` (default empty)
+    perturbs the key→shard assignment; it must be identical on every
+    replica opening the same files."""
+    shards = metadata_int(metadata, "shards", 1)
+    path = metadata.get("databasePath", ":memory:")
+    group_commit = metadata_bool(metadata, "groupCommit", True)
+    cache_size = metadata_int(metadata, "readCacheSize", 0)
+    if shards == 1:
+        # no facade, no -shard0 rename: the single-shard layout stays
+        # bit-for-bit today's (hashSeed is moot — one shard wins every
+        # rendezvous regardless of seed)
+        return SqliteStateStore(
+            spec.name, path,
+            group_commit=group_commit, cache_size=cache_size,
+        )
+    return build_sharded_store(
+        spec.name, path, shards=shards,
+        hash_seed=metadata.get("hashSeed", ""),
+        group_commit=group_commit, cache_size=cache_size,
     )

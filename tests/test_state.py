@@ -18,12 +18,19 @@ from tasksrunner.state import (
     KeyPrefixer,
     SqliteStateStore,
     TransactionOp,
+    build_sharded_store,
 )
 
 ENGINES = {
     "memory": lambda tmp_path: InMemoryStateStore("s"),
     "sqlite-mem": lambda tmp_path: SqliteStateStore("s"),
     "sqlite-file": lambda tmp_path: SqliteStateStore("s", tmp_path / "state.db"),
+    # the rendezvous-sharded facade must be contract-identical to one
+    # file: same CRUD/etag/transact/query semantics, merged across 3
+    # independent shard engines (tests/test_state_sharding.py covers
+    # the sharding-specific invariants on top)
+    "sqlite-sharded": lambda tmp_path: build_sharded_store(
+        "s", tmp_path / "state.db", shards=3, hash_seed="contract"),
 }
 
 

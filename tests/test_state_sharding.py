@@ -1,0 +1,303 @@
+"""Sharded state plane invariants (tasksrunner/state/sharding.py).
+
+The contract suite in tests/test_state.py already runs the full
+CRUD/etag/transact/query battery against a 3-shard facade; this file
+covers what sharding adds on top: routing stability, minimal key
+movement on reshard, the cross-shard two-phase commit contract, the
+``shards: 1`` compatibility promise, and the per-shard saturation
+gauges.
+"""
+
+import asyncio
+import sqlite3
+
+import pytest
+
+from tasksrunner.errors import (
+    ComponentError, CrossShardAtomicityError, EtagMismatch, StateError,
+)
+from tasksrunner.observability.metrics import metrics
+from tasksrunner.state import (
+    ShardedStateStore, ShardRouter, SqliteStateStore, TransactionOp,
+    build_sharded_store,
+)
+
+KEYS = [f"task-{i}" for i in range(2000)]
+
+
+# -- routing ----------------------------------------------------------------
+
+def test_routing_stable_under_fixed_seed():
+    """Assignment is a pure function of (key, seed, shards): two router
+    instances — two processes, two restarts — must agree on every key,
+    or replicas would read shards their peers never wrote."""
+    a = ShardRouter(4, "seed-a")
+    b = ShardRouter(4, "seed-a")
+    assert a.spread(KEYS) == b.spread(KEYS)
+
+
+def test_routing_golden_snapshot():
+    """A pinned sample of assignments: any change to the hash/mix/salt
+    scheme strands every existing shard file's keys — it must show up
+    as THIS test failing, never as silent data loss after an upgrade."""
+    r = ShardRouter(4, "")
+    assert r.spread(["task-0", "task-1", "task-2", "task-3", "task-4",
+                     "alpha", "beta", "gamma", "", "k"]) == \
+        [1, 3, 2, 0, 0, 0, 1, 0, 3, 3]
+
+
+def test_routing_seed_changes_assignment():
+    a = ShardRouter(8, "")
+    b = ShardRouter(8, "other")
+    assert a.spread(KEYS) != b.spread(KEYS)
+
+
+def test_routing_balance():
+    counts = [0] * 8
+    r = ShardRouter(8, "bal")
+    for k in KEYS:
+        counts[r.shard_of(k)] += 1
+    # uniform expectation 250/shard; rendezvous should stay well
+    # inside ±40% even on a 2000-key sample
+    assert min(counts) > 150 and max(counts) < 350
+
+
+@pytest.mark.parametrize("n", [1, 2, 4, 7])
+def test_minimal_movement_on_reshard(n):
+    """Growing N→N+1 must move only the keys the NEW shard wins —
+    expected 1/(N+1) of the space. Modulo hashing moves nearly all of
+    them; this property is why reshard is an operation, not a rebuild."""
+    before = ShardRouter(n, "grow")
+    after = ShardRouter(n + 1, "grow")
+    moved = [k for k in KEYS
+             if before.shard_of(k) != after.shard_of(k)]
+    expected = len(KEYS) / (n + 1)
+    assert len(moved) < expected * 1.35
+    # every moved key moved TO the new shard (salts 0..n-1 unchanged)
+    assert all(after.shard_of(k) == n for k in moved)
+
+
+def test_router_rejects_bad_shard_counts():
+    with pytest.raises(ComponentError):
+        ShardRouter(0)
+    with pytest.raises(ComponentError):
+        ShardRouter(-3)
+    with pytest.raises(ComponentError):
+        ShardRouter(65)
+
+
+# -- cross-shard transactions ----------------------------------------------
+
+def _cross_shard_keys(store, want=2):
+    """First key found on each of ``want`` distinct shards."""
+    found = {}
+    for k in KEYS:
+        found.setdefault(store.router.shard_of(k), k)
+        if len(found) >= want:
+            break
+    return [found[i] for i in sorted(found)][:want]
+
+
+@pytest.mark.asyncio
+async def test_cross_shard_transact_commits_atomically(tmp_path):
+    s = build_sharded_store("x", tmp_path / "x.db", shards=3)
+    try:
+        ka, kb = _cross_shard_keys(s)
+        await s.transact([TransactionOp("upsert", ka, {"v": 1}),
+                          TransactionOp("upsert", kb, {"v": 2})])
+        assert (await s.get(ka)).value == {"v": 1}
+        assert (await s.get(kb)).value == {"v": 2}
+    finally:
+        s.close()
+
+
+@pytest.mark.asyncio
+async def test_cross_shard_transact_aborts_atomically(tmp_path):
+    """A stage-phase etag refusal on ANY shard rolls back EVERY shard:
+    all-or-nothing holds across files, and the caller sees the
+    original EtagMismatch, not an atomicity error (nothing committed)."""
+    s = build_sharded_store("x", tmp_path / "x.db", shards=3)
+    try:
+        ka, kb = _cross_shard_keys(s)
+        await s.set(ka, {"v": 0})
+        await s.set(kb, {"v": 0})
+        with pytest.raises(EtagMismatch):
+            await s.transact([
+                TransactionOp("upsert", ka, {"v": 9}),
+                TransactionOp("upsert", kb, {"v": 9}, etag="999999999"),
+            ])
+        assert (await s.get(ka)).value == {"v": 0}
+        assert (await s.get(kb)).value == {"v": 0}
+    finally:
+        s.close()
+
+
+@pytest.mark.asyncio
+async def test_cross_shard_transact_concurrent_no_deadlock(tmp_path):
+    """Concurrent cross-shard transactions over the same shard pair:
+    ascending shard-index staging means ordered lock acquisition —
+    they serialize, they never deadlock."""
+    s = build_sharded_store("x", tmp_path / "x.db", shards=3)
+    try:
+        ka, kb = _cross_shard_keys(s)
+        await asyncio.wait_for(asyncio.gather(*(
+            s.transact([TransactionOp("upsert", ka, {"i": i}),
+                        TransactionOp("upsert", kb, {"i": i})])
+            for i in range(12))), timeout=30)
+        assert (await s.get(ka)).value == (await s.get(kb)).value
+    finally:
+        s.close()
+
+
+@pytest.mark.asyncio
+async def test_staged_transaction_decision_timeout(tmp_path, monkeypatch):
+    """A coordinator that never decides must not wedge the shard: past
+    the decision deadline the writer thread rolls back unilaterally
+    and a late commit() raises instead of claiming durability."""
+    monkeypatch.setattr(SqliteStateStore, "_STAGE_DECISION_TIMEOUT", 0.1)
+    s = SqliteStateStore("t", tmp_path / "t.db")
+    try:
+        txn = await s.stage_transact([TransactionOp("upsert", "k", {"v": 1})])
+        await asyncio.sleep(0.4)  # decision deadline passes
+        with pytest.raises(StateError):
+            await txn.commit()
+        assert await s.get("k") is None  # rolled back, nothing durable
+        # the shard is NOT wedged: normal writes proceed
+        await asyncio.wait_for(s.set("k2", {"v": 2}), timeout=5)
+    finally:
+        s.close()
+
+
+@pytest.mark.asyncio
+async def test_staged_transaction_holds_commit_slot(tmp_path):
+    """While staged, the shard's writer thread is parked: a queued
+    write completes only after the decision."""
+    s = SqliteStateStore("t", tmp_path / "t.db")
+    try:
+        txn = await s.stage_transact([TransactionOp("upsert", "k", {"v": 1})])
+        queued = asyncio.ensure_future(s.set("other", {"v": 2}))
+        done, _pending = await asyncio.wait({queued}, timeout=0.3)
+        assert not done  # blocked behind the staged transaction
+        await txn.commit()
+        await asyncio.wait_for(queued, timeout=5)
+        assert (await s.get("k")).value == {"v": 1}
+    finally:
+        s.close()
+
+
+def test_cross_shard_atomicity_error_taxonomy():
+    """The partial-failure ambiguity surfaces as a StateError subclass
+    with a 500, so the sidecar's error mapping needs no special case."""
+    assert issubclass(CrossShardAtomicityError, StateError)
+    assert CrossShardAtomicityError.http_status == 500
+
+
+@pytest.mark.asyncio
+async def test_cross_shard_needs_staging_support():
+    """Children without the staging protocol get a clean taxonomy
+    error on cross-shard ops, not an AttributeError mid-commit."""
+    from tasksrunner.state.memory import InMemoryStateStore
+    s = ShardedStateStore(
+        "m", [InMemoryStateStore("m"), InMemoryStateStore("m")])
+    ka, kb = _cross_shard_keys(s)
+    with pytest.raises(StateError, match="staged"):
+        await s.transact([TransactionOp("upsert", ka, {}),
+                          TransactionOp("upsert", kb, {})])
+    # single-shard transactions still work on any child
+    await s.transact([TransactionOp("upsert", ka, {"v": 1})])
+    assert (await s.get(ka)).value == {"v": 1}
+
+
+# -- shards: 1 compatibility ------------------------------------------------
+
+def _build_driver_store(tmp_path, extra_metadata):
+    from tasksrunner.component.registry import ComponentRegistry
+    from tasksrunner.component.spec import parse_component
+    spec = parse_component({
+        "componentType": "state.sqlite",
+        "metadata": [
+            {"name": "databasePath", "value": str(tmp_path / "s.db")},
+            *extra_metadata,
+        ],
+    }, default_name="st")
+    return ComponentRegistry([spec]).get("st")
+
+
+@pytest.mark.asyncio
+async def test_shards_1_is_plain_single_file_store(tmp_path):
+    """``shards: 1`` (the default) keeps today's layout and code path:
+    a plain SqliteStateStore on the configured file — no facade, no
+    -shard0 rename — and the file stays readable by the seed layout."""
+    store = _build_driver_store(tmp_path, [{"name": "shards", "value": "1"}])
+    try:
+        assert type(store) is SqliteStateStore
+        assert store.path == str(tmp_path / "s.db")
+        await store.set("k", {"v": 1})
+    finally:
+        store.close()
+    assert (tmp_path / "s.db").exists()
+    assert not (tmp_path / "s-shard0.db").exists()
+    # raw sqlite sees the exact seed schema on the exact configured path
+    conn = sqlite3.connect(tmp_path / "s.db")
+    try:
+        assert conn.execute("SELECT value FROM state WHERE key='k'")\
+            .fetchone() == ('{"v":1}',)
+    finally:
+        conn.close()
+
+
+@pytest.mark.asyncio
+async def test_sharded_driver_builds_facade(tmp_path):
+    store = _build_driver_store(tmp_path, [
+        {"name": "shards", "value": "4"},
+        {"name": "hashSeed", "value": "prod"},
+    ])
+    try:
+        assert isinstance(store, ShardedStateStore)
+        assert store.shard_count == 4
+        assert store.router.seed == "prod"
+        for i, k in enumerate(KEYS[:40]):
+            await store.set(k, {"i": i})
+        assert len(await store.keys()) == 40
+    finally:
+        store.close()
+    present = sorted(p.name for p in tmp_path.glob("s-shard*.db"))
+    assert present == ["s-shard0.db", "s-shard1.db",
+                       "s-shard2.db", "s-shard3.db"]
+
+
+def test_driver_rejects_bad_shard_counts(tmp_path):
+    with pytest.raises(ComponentError, match="shards"):
+        _build_driver_store(tmp_path, [{"name": "shards", "value": "0"}])
+    with pytest.raises(ComponentError, match="shards"):
+        _build_driver_store(tmp_path, [{"name": "shards", "value": "65"}])
+    with pytest.raises(ComponentError, match="shards"):
+        _build_driver_store(tmp_path, [{"name": "shards", "value": "many"}])
+
+
+# -- observability ----------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_per_shard_queue_depth_gauges(tmp_path):
+    """Each shard reports its own write-queue depth: saturation on a
+    hot partition must be visible as THAT shard's series."""
+    s = build_sharded_store("gaugestore", tmp_path / "g.db", shards=2)
+    try:
+        await asyncio.gather(*(s.set(k, {"i": 1}) for k in KEYS[:64]))
+    finally:
+        s.close()
+    snap = metrics.snapshot()
+    for i in (0, 1):
+        assert f"state_write_queue_depth{{shard={i},store=gaugestore}}" in snap
+
+
+@pytest.mark.asyncio
+async def test_standalone_gauge_label_unchanged(tmp_path):
+    """A non-sharded store keeps the PR 3 gauge identity (store label
+    only) — dashboards keyed on it must not break."""
+    s = SqliteStateStore("plaingauge", tmp_path / "p.db")
+    try:
+        await asyncio.gather(*(s.set(k, {"i": 1}) for k in KEYS[:16]))
+    finally:
+        s.close()
+    assert "state_write_queue_depth{store=plaingauge}" in metrics.snapshot()
