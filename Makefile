@@ -1,6 +1,6 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test lint lint-metrics soak bench bench-state bench-shard bench-hist chaos sweep-flash run validate docs-serve docs-build clean
+.PHONY: test lint lint-metrics soak bench bench-state bench-shard bench-hist bench-overload chaos sweep-flash run validate docs-serve docs-build clean
 
 test: lint
 	python -m pytest tests/ -q
@@ -39,6 +39,13 @@ bench-shard:
 # state path and the publish/deliver path (must stay < 3%)
 bench-hist:
 	python bench.py --hist-bench
+
+# overload protection: the drill test (shed -> scale out -> recover,
+# zero lost acks), then the bench section — admission-gate overhead on
+# the ingress path (<1% when off) + the drill's measured trajectory
+bench-overload:
+	python -m pytest tests/test_overload_drill.py -q -m "not slow"
+	python bench.py --overload-bench
 
 # chaos verification: the deterministic fault-injection harness, the
 # faulty-broker convergence soak, and the proof that the disabled gate

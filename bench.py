@@ -946,6 +946,137 @@ async def run_histogram_overhead_bench(n_ops: int = 12000, *,
     }
 
 
+async def run_admission_overhead_bench(n_ops: int = 3000, *,
+                                       concurrency: int = 32,
+                                       rounds: int = 5) -> dict:
+    """``admission_overhead``: the admission controller's "free when
+    off" claim, measured on the ingress path it guards.
+
+    Three configurations of the SAME echo app behind the real aiohttp
+    app server (``hosting.build_app_server``), flooded over localhost:
+
+    * ``baseline`` — no controller (``admission=None``), the code path
+      before this subsystem existed;
+    * ``gate_off`` — the production default: ``TASKSRUNNER_ADMISSION``
+      unset, ``from_env()`` returns None — asserted structurally AND
+      measured, because the <1% acceptance bar is a number, not an
+      argument;
+    * ``attached_idle`` — the enabled-but-admitting worst quiet case:
+      a live controller (sampler running) that never sheds, so every
+      request pays the ``admission.shedding`` check and nothing else.
+
+    Order rotates each round; the overhead is the median of PAIRED
+    per-round ratios (the chaos bench's methodology).
+    """
+    import aiohttp
+    from aiohttp import web
+
+    from tasksrunner.app import App
+    from tasksrunner.hosting import build_app_server
+    from tasksrunner.observability.admission import AdmissionController
+    from tasksrunner.observability.metrics import MetricsRegistry
+
+    prev_flag = os.environ.pop("TASKSRUNNER_ADMISSION", None)
+    controller = AdmissionController(
+        max_lag_seconds=0.25, max_queue_depth=512, max_inflight=10 ** 9,
+        registry=MetricsRegistry())
+
+    def make_server(admission):
+        app = App("bench-admission")
+
+        @app.post("/api/echo")
+        async def echo(req):
+            return {"ok": True}
+
+        return build_app_server(app, admission=admission)
+
+    runners, ports = [], {}
+    try:
+        gate_off = AdmissionController.from_env()
+        assert gate_off is None, \
+            "gate-off from_env() must return no controller"
+        configs = [("baseline", make_server(None)),
+                   ("gate_off", make_server(gate_off)),
+                   ("attached_idle", make_server(controller))]
+        for name, server in configs:
+            runner = web.AppRunner(server)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            runners.append(runner)
+            ports[name] = runner.addresses[0][1]
+        controller.start()
+
+        rates: dict[str, list[float]] = {name: [] for name, _ in configs}
+        per_worker = n_ops // concurrency
+
+        async with aiohttp.ClientSession() as session:
+
+            async def rate(name: str, n_per_worker: int) -> float:
+                url = f"http://127.0.0.1:{ports[name]}/api/echo"
+
+                async def worker() -> None:
+                    for _ in range(n_per_worker):
+                        async with session.post(url, json={}) as resp:
+                            await resp.read()
+                            assert resp.status == 200
+
+                t0 = time.perf_counter()
+                await asyncio.gather(*(worker() for _ in range(concurrency)))
+                return (n_per_worker * concurrency) / (time.perf_counter() - t0)
+
+            for name, _ in configs:  # warmup round, discarded
+                await rate(name, max(2, per_worker // 4))
+            for r in range(rounds):
+                order = configs[r % len(configs):] + configs[:r % len(configs)]
+                for name, _ in order:
+                    rates[name].append(await rate(name, per_worker))
+    finally:
+        await controller.stop()
+        for runner in runners:
+            await runner.cleanup()
+        if prev_flag is not None:
+            os.environ["TASKSRUNNER_ADMISSION"] = prev_flag
+
+    med = {name: statistics.median(rs) for name, rs in rates.items()}
+
+    def overhead_pct(name: str) -> float:
+        per_round = [1.0 - rates[name][r] / rates["baseline"][r]
+                     for r in range(len(rates[name]))]
+        return round(statistics.median(per_round) * 100.0, 2)
+
+    return {
+        "baseline_req_per_sec": round(med["baseline"], 1),
+        "gate_off_req_per_sec": round(med["gate_off"], 1),
+        "gate_off_overhead_pct": overhead_pct("gate_off"),
+        "gate_off_is_none": True,
+        "attached_idle_req_per_sec": round(med["attached_idle"], 1),
+        "attached_idle_overhead_pct": overhead_pct("attached_idle"),
+        "concurrency": concurrency,
+        "note": "ingress path (real aiohttp app server, localhost "
+                "flood). gate_off is the production default "
+                "(TASKSRUNNER_ADMISSION unset -> no controller object "
+                "at all), so its delta vs baseline is pure host noise "
+                "— the acceptance bar is <1% net of that noise. "
+                "attached_idle is the per-request cost of one attribute "
+                "check plus a background sampler at 4 Hz",
+    }
+
+
+async def run_overload_drill_bench() -> dict:
+    """``overload_drill``: the closed loop (shed → scale out → recover,
+    zero lost acks) run end to end against real subprocess replicas and
+    a chaos-slowed store; prints the measured trajectory. The test
+    suite asserts this trajectory (tests/test_overload_drill.py); the
+    bench records it next to the numbers docs module 09 quotes."""
+    import pathlib
+
+    from tasksrunner.testing.overload import run_overload_drill
+
+    tmp = tempfile.mkdtemp(prefix="tasksrunner-bench-overload-")
+    return await run_overload_drill(pathlib.Path(tmp))
+
+
 # ---------------------------------------------------------------------------
 # optional: ML-extension step time on the real chip (EXTENSION ONLY)
 # ---------------------------------------------------------------------------
@@ -1184,6 +1315,12 @@ def main() -> None:
                              "(`make bench-hist`): histograms-on vs -off "
                              "on the write-heavy state path and the "
                              "publish/deliver path (<3%% bar)")
+    parser.add_argument("--overload-bench", action="store_true",
+                        help="run ONLY the overload section "
+                             "(`make bench-overload`): admission-gate "
+                             "overhead on the ingress path (<1%% bar "
+                             "when off) plus the chaos overload drill's "
+                             "shed/scale/recover trajectory")
     args = parser.parse_args()
 
     if args.tpu_bench:
@@ -1232,6 +1369,27 @@ def main() -> None:
         print(json.dumps({"histogram_overhead": hist_overhead}))
         return
 
+    if args.overload_bench:
+        _log("admission-gate overhead on the ingress path ...")
+        admission_overhead = asyncio.run(run_admission_overhead_bench())
+        _log(f"  -> baseline {admission_overhead['baseline_req_per_sec']} "
+             f"req/s, gate-off {admission_overhead['gate_off_req_per_sec']} "
+             f"req/s ({admission_overhead['gate_off_overhead_pct']:+.2f}%), "
+             f"attached-idle "
+             f"{admission_overhead['attached_idle_req_per_sec']} req/s "
+             f"({admission_overhead['attached_idle_overhead_pct']:+.2f}%)")
+        _log("chaos overload drill (shed -> scale out -> recover) ...")
+        drill = asyncio.run(run_overload_drill_bench())
+        _log(f"  -> acked {drill['acked']}, shed {drill['shed']} "
+             f"(Retry-After {drill['retry_after_min']}..{drill['retry_after_max']}s), "
+             f"fleet peak {drill['max_replicas_seen']} "
+             f"(desired peak {drill['desired_gauge_peak']:.0f}), "
+             f"recovered_to_min={drill['recovered_to_min']}, "
+             f"lost acked keys: {len(drill['lost_acked_keys'])}")
+        print(json.dumps({"admission_overhead": admission_overhead,
+                          "overload_drill": drill}))
+        return
+
     if args.worker:
         profile_dir = os.environ.get("BENCH_PROFILE_DIR")
         if profile_dir:
@@ -1253,7 +1411,7 @@ def main() -> None:
     # the chip section runs FIRST: it is the scarcest measurement (the
     # tunnel has documented multi-hour outages) and must not queue
     # behind minutes of CPU benches that could overlap an outage window
-    _log("bench 1/9: ML-extension train step on the attached chip ...")
+    _log("bench 1/10: ML-extension train step on the attached chip ...")
     # belt over braces: the section is internally fault-tolerant, but
     # it also runs FIRST now — nothing it could raise may be allowed
     # to cost the CPU sections their numbers
@@ -1272,7 +1430,7 @@ def main() -> None:
     # the component the e2e write path bottlenecks on, measured alone —
     # and the seed write path measured in the SAME run, so the group-
     # commit speedup is a same-host apples-to-apples figure
-    _log("bench 2/9: state-store ops/s (group-commit write queue) ...")
+    _log("bench 2/10: state-store ops/s (group-commit write queue) ...")
     state_ops = asyncio.run(run_state_bench())
     _log(f"  -> write-heavy {state_ops['write_heavy']['ops_per_sec']} ops/s "
          f"({state_ops['write_heavy']['speedup']}x vs pre-change), "
@@ -1281,7 +1439,7 @@ def main() -> None:
 
     # the sharded state plane's scaling claim: N writer shards ≈ N
     # independent group-commit engines (docs/modules/04 quotes this)
-    _log("bench 3/9: state shard-scaling sweep (write-heavy mix) ...")
+    _log("bench 3/10: state shard-scaling sweep (write-heavy mix) ...")
     shard_scaling = asyncio.run(run_shard_scaling_bench())
     _log("  -> " + ", ".join(
         f"shards={n}: {lane['ops_per_sec']} ops/s "
@@ -1290,7 +1448,7 @@ def main() -> None:
 
     # the chaos gate's "free when off" claim, measured on the same
     # write-heavy path (docs/modules/16-chaos.md quotes this number)
-    _log("bench 4/9: chaos-gate overhead on the write-heavy state path ...")
+    _log("bench 4/10: chaos-gate overhead on the write-heavy state path ...")
     chaos_overhead = asyncio.run(run_chaos_overhead_bench())
     _log(f"  -> gate-off {chaos_overhead['gate_off_overhead_pct']:+.2f}% vs "
          f"baseline {chaos_overhead['baseline_ops_per_sec']} ops/s, "
@@ -1298,14 +1456,29 @@ def main() -> None:
 
     # the latency-histogram instrumentation's "free when off, cheap when
     # on" claim on the same two hot paths (docs/modules/08 quotes this)
-    _log("bench 5/9: histogram overhead (state write + publish/deliver) ...")
+    _log("bench 5/10: histogram overhead (state write + publish/deliver) ...")
     hist_overhead = asyncio.run(run_histogram_overhead_bench())
     _hs = hist_overhead["state_write"]
     _hp = hist_overhead["publish_deliver"]
     _log(f"  -> state write {_hs['overhead_pct']:+.2f}%, "
          f"publish/deliver {_hp['overhead_pct']:+.2f}% (bar <3%)")
 
-    _log("bench 6/9: cross-process write path (faithful [PB] topology) ...")
+    # the overload-protection loop's two numbers: the admission gate is
+    # free when off (<1% bar, docs module 09 quotes this) and the full
+    # shed -> scale out -> recover trajectory holds end to end
+    _log("bench 6/10: admission-gate overhead + chaos overload drill ...")
+    admission_overhead = asyncio.run(run_admission_overhead_bench())
+    _log(f"  -> gate-off {admission_overhead['gate_off_overhead_pct']:+.2f}% "
+         f"vs baseline {admission_overhead['baseline_req_per_sec']} req/s, "
+         f"attached-idle "
+         f"{admission_overhead['attached_idle_overhead_pct']:+.2f}% (bar <1%)")
+    overload_drill = asyncio.run(run_overload_drill_bench())
+    _log(f"  -> drill: shed {overload_drill['shed']}, fleet peak "
+         f"{overload_drill['max_replicas_seen']}, recovered_to_min="
+         f"{overload_drill['recovered_to_min']}, lost acked keys "
+         f"{len(overload_drill['lost_acked_keys'])}")
+
+    _log("bench 7/10: cross-process write path (faithful [PB] topology) ...")
     xproc = asyncio.run(run_xproc(latency_probe=True, rounds=5))
     _log(f"  -> {xproc['throughput']} tasks/s, "
          f"p50 {xproc['p50_ms']} ms, p99 {xproc['p99_ms']} ms (conc=8)")
@@ -1314,7 +1487,7 @@ def main() -> None:
     # workload certs, every peer hop on the authenticated mesh lane —
     # module 15 quotes this delta instead of recommending an unmeasured
     # configuration
-    _log("bench 7/9: cross-process write path under mesh mTLS ...")
+    _log("bench 8/10: cross-process write path under mesh mTLS ...")
     # same rounds as the plaintext headline — an asymmetric pair would
     # bake an ordering/averaging confound into the published delta
     mtls = asyncio.run(run_xproc(latency_probe=True, rounds=5,
@@ -1337,7 +1510,7 @@ def main() -> None:
     # reference processor's SendGrid call) consumers are the
     # bottleneck; 5 competing replicas vs 1 shows the KEDA-style
     # scale-out actually scaling (SURVEY.md §5.8)
-    _log("bench 8/9: competing-consumer scale-out (20 ms work/message) ...")
+    _log("bench 9/10: competing-consumer scale-out (20 ms work/message) ...")
     one = asyncio.run(run_xproc(n_tasks=300, n_processors=1, rounds=2,
                                 work_ms=20.0))
     five = asyncio.run(run_xproc(n_tasks=300, n_processors=5, rounds=2,
@@ -1346,7 +1519,7 @@ def main() -> None:
     _log(f"  -> 1 replica: {one['throughput']} tasks/s; "
          f"5 replicas: {five['throughput']} tasks/s ({speedup}x)")
 
-    _log("bench 9/9: in-process cluster (round-1 continuity) ...")
+    _log("bench 10/10: in-process cluster (round-1 continuity) ...")
     inproc = asyncio.run(run_inproc())
     _log(f"  -> {inproc} tasks/s")
 
@@ -1405,6 +1578,8 @@ def main() -> None:
             "state_shard_scaling": shard_scaling,
             "chaos_overhead": chaos_overhead,
             "histogram_overhead": hist_overhead,
+            "admission_overhead": admission_overhead,
+            "overload_drill": overload_drill,
             "ml_extension_tpu": tpu,
             **({} if tpu else {"ml_extension_note":
                 "chip bench skipped (no TPU reachable within the "

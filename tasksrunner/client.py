@@ -30,6 +30,7 @@ from tasksrunner.errors import (
     InvocationError,
     InvocationStatusError,
     QueryError,
+    SaturatedError,
     SecretNotFound,
     TasksRunnerError,
 )
@@ -39,6 +40,33 @@ from tasksrunner.state.base import StateItem
 
 DEFAULT_SIDECAR_PORT = 3500
 PORT_ENV = "TASKSRUNNER_HTTP_PORT"
+
+
+def _retry_after_seconds(headers: dict[str, str] | None) -> float | None:
+    """Seconds from a Retry-After header, if present and numeric.
+
+    A shedding replica (429) or an open breaker / protected target
+    (503) tells clients how long to stay away; the resiliency retry
+    loop stretches its next delay to honor it. The HTTP-date form is
+    ignored — the runtime only ever emits delta-seconds."""
+    if not headers:
+        return None
+    raw = next((v for k, v in headers.items()
+                if k.lower() == "retry-after"), None)
+    if raw is None:
+        return None
+    try:
+        return max(0.0, float(raw))
+    except (TypeError, ValueError):
+        return None
+
+
+def _attach_retry_after(exc: Exception, status: int,
+                        headers: dict[str, str] | None) -> None:
+    if status in (429, 503):
+        hint = _retry_after_seconds(headers)
+        if hint is not None:
+            exc.retry_after = hint
 
 
 class InvocationResponse:
@@ -59,9 +87,11 @@ class InvocationResponse:
     def raise_for_status(self) -> "InvocationResponse":
         if not self.ok:
             detail = self.body[:300].decode("utf-8", "replace")
-            raise InvocationStatusError(
+            exc = InvocationStatusError(
                 f"invocation returned {self.status}: {detail}",
                 status=self.status)
+            _attach_retry_after(exc, self.status, self.headers)
+            raise exc
         return self
 
 
@@ -171,7 +201,8 @@ class _HTTPTransport(_Transport):
             raise InvocationError(f"sidecar unreachable at {url}: {exc}") from exc
 
     @staticmethod
-    def _raise(status: int, body: bytes, *, context: str) -> None:
+    def _raise(status: int, body: bytes, *, context: str,
+               headers: dict[str, str] | None = None) -> None:
         try:
             message = json.loads(body).get("error", "")
         except (ValueError, AttributeError):
@@ -179,6 +210,8 @@ class _HTTPTransport(_Transport):
         exc_type: type[TasksRunnerError]
         if status == 409:
             exc_type = EtagMismatch
+        elif status == 429:
+            exc_type = SaturatedError
         elif status == 404 and "secret" in context:
             exc_type = SecretNotFound
         elif status == 400 and "query" in context:
@@ -187,66 +220,67 @@ class _HTTPTransport(_Transport):
             exc_type = TasksRunnerError
         exc = exc_type(f"{context}: {message or status}")
         exc.http_status = status
+        _attach_retry_after(exc, status, headers)
         raise exc
 
     async def save_state(self, store, items):
-        status, _, body = await self._request(
+        status, headers, body = await self._request(
             "POST", f"/v1.0/state/{store}", json_body=items)
         if status >= 300:
-            self._raise(status, body, context=f"save state {store}")
+            self._raise(status, body, context=f"save state {store}", headers=headers)
 
     async def get_state(self, store, key):
         status, headers, body = await self._request("GET", f"/v1.0/state/{store}/{key}")
         if status == 204 or (status == 200 and not body):
             return None
         if status >= 300:
-            self._raise(status, body, context=f"get state {store}")
+            self._raise(status, body, context=f"get state {store}", headers=headers)
         return StateItem(key=key, value=json.loads(body),
                          etag=headers.get("etag", ""))
 
     async def delete_state(self, store, key, etag):
         headers = {"if-match": etag} if etag else {}
-        status, _, body = await self._request(
+        status, headers, body = await self._request(
             "DELETE", f"/v1.0/state/{store}/{key}", headers=headers)
         if status >= 300:
-            self._raise(status, body, context=f"delete state {store}")
+            self._raise(status, body, context=f"delete state {store}", headers=headers)
 
     async def bulk_get_state(self, store, keys):
-        status, _, body = await self._request(
+        status, headers, body = await self._request(
             "POST", f"/v1.0/state/{store}/bulk", json_body={"keys": keys})
         if status >= 300:
-            self._raise(status, body, context=f"bulk get state {store}")
+            self._raise(status, body, context=f"bulk get state {store}", headers=headers)
         return json.loads(body)
 
     async def query_state(self, store, query):
-        status, _, body = await self._request(
+        status, headers, body = await self._request(
             "POST", f"/v1.0/state/{store}/query", json_body=query)
         if status >= 300:
-            self._raise(status, body, context=f"query state {store}")
+            self._raise(status, body, context=f"query state {store}", headers=headers)
         return json.loads(body)
 
     async def transact_state(self, store, operations):
-        status, _, body = await self._request(
+        status, headers, body = await self._request(
             "POST", f"/v1.0/state/{store}/transaction",
             json_body={"operations": operations})
         if status >= 300:
-            self._raise(status, body, context=f"state transaction {store}")
+            self._raise(status, body, context=f"state transaction {store}", headers=headers)
 
     async def publish(self, pubsub, topic, data, raw):
         params = {"metadata.rawPayload": "true"} if raw else None
-        status, _, body = await self._request(
+        status, headers, body = await self._request(
             "POST", f"/v1.0/publish/{pubsub}/{topic}", json_body=data,
             params=params)
         if status >= 300:
-            self._raise(status, body, context=f"publish {pubsub}/{topic}")
+            self._raise(status, body, context=f"publish {pubsub}/{topic}", headers=headers)
 
     async def invoke_binding(self, name, operation, data, metadata):
-        status, _, body = await self._request(
+        status, headers, body = await self._request(
             "POST", f"/v1.0/bindings/{name}",
             json_body={"operation": operation, "data": data,
                        "metadata": metadata or {}})
         if status >= 300:
-            self._raise(status, body, context=f"binding {name}")
+            self._raise(status, body, context=f"binding {name}", headers=headers)
         doc = json.loads(body)
         return BindingResponse(data=doc.get("data"),
                                metadata=doc.get("metadata") or {})
@@ -258,15 +292,15 @@ class _HTTPTransport(_Transport):
         return await self._request(http_method, path, headers=headers, data=body)
 
     async def get_secret(self, store, key):
-        status, _, body = await self._request("GET", f"/v1.0/secrets/{store}/{key}")
+        status, headers, body = await self._request("GET", f"/v1.0/secrets/{store}/{key}")
         if status >= 300:
-            self._raise(status, body, context=f"secret {store}")
+            self._raise(status, body, context=f"secret {store}", headers=headers)
         return json.loads(body)
 
     async def bulk_secrets(self, store):
-        status, _, body = await self._request("GET", f"/v1.0/secrets/{store}/bulk")
+        status, headers, body = await self._request("GET", f"/v1.0/secrets/{store}/bulk")
         if status >= 300:
-            self._raise(status, body, context=f"secret {store}")
+            self._raise(status, body, context=f"secret {store}", headers=headers)
         return json.loads(body)
 
     async def close(self):

@@ -44,6 +44,14 @@ FLAGS: dict[str, Flag] = dict([
        "per-request access-log lines from app servers and sidecars"),
     _f("TASKSRUNNER_ACT_F32", "bool", "off",
        "keep ML activations in float32 instead of the platform default"),
+    _f("TASKSRUNNER_ADMISSION", "bool", "off",
+       "per-replica admission control (shed with 429 when saturated)"),
+    _f("TASKSRUNNER_ADMISSION_MAX_INFLIGHT", "int", "64",
+       "in-flight app requests at which the saturation score reaches 1.0"),
+    _f("TASKSRUNNER_ADMISSION_MAX_LAG_SECONDS", "float", "0.25",
+       "event-loop lag at which the saturation score reaches 1.0"),
+    _f("TASKSRUNNER_ADMISSION_MAX_QUEUE_DEPTH", "int", "512",
+       "state/broker write-queue depth at which the score reaches 1.0"),
     _f("TASKSRUNNER_API_TOKEN", "string", "unset",
        "bearer token the sidecar and admin APIs require when set"),
     _f("TASKSRUNNER_APP_ID", "string", "unset",

@@ -146,6 +146,20 @@ class ChaosInjectedError(TasksRunnerError):
         self.status = status
 
 
+class SaturatedError(TasksRunnerError):
+    """The replica's admission controller shed this request (429).
+
+    The server is alive but refusing non-exempt work until its
+    saturation score drops (observability/admission.py). When the 429
+    carried a ``Retry-After`` header, :attr:`retry_after` holds it in
+    seconds and the resiliency retry loop stretches its next delay to
+    honor it (still inside the policy's total budget)."""
+
+    http_status = 429
+    #: seconds the server asked us to stay away, or None
+    retry_after: float | None = None
+
+
 class CircuitOpenError(TasksRunnerError):
     """A resiliency circuit breaker is open — the call was rejected
     without being attempted (fail-fast). Maps to 503 so callers can

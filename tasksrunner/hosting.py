@@ -30,6 +30,8 @@ from tasksrunner.component.loader import load_components
 from tasksrunner.component.registry import ComponentRegistry
 from tasksrunner.component.spec import ComponentSpec
 from tasksrunner.invoke.resolver import AppAddress, NameResolver
+from tasksrunner.observability.admission import AdmissionController
+from tasksrunner.observability.metrics import metrics
 from tasksrunner.observability.tracing import (
     TRACEPARENT_HEADER,
     ensure_trace,
@@ -39,7 +41,7 @@ from tasksrunner.resiliency.policy import ResiliencyPolicies
 from tasksrunner.resiliency.spec import ResiliencySpec, load_resiliency
 from tasksrunner.runtime import InProcAppChannel, Runtime
 from tasksrunner.security import AppGrants, grants_from_env
-from tasksrunner.sidecar import Sidecar
+from tasksrunner.sidecar import Sidecar, shed_response
 
 logger = logging.getLogger(__name__)
 
@@ -56,14 +58,21 @@ def _access_log():
     return access_logger
 
 
-def build_app_server(app: App) -> web.Application:
+def build_app_server(app: App, admission=None) -> web.Application:
     """aiohttp adapter serving an App over HTTP (the app's own port).
 
     Tracks request concurrency and serves it at
     ``GET /tasksrunner/stats`` — the measurement source for the
     ``http-concurrency`` autoscale rule (the orchestrator polls each
     replica, the way ACA's HTTP scaler watches concurrent requests,
-    docs/aca/09-aca-autoscale-keda/index.md:27-35)."""
+    docs/aca/09-aca-autoscale-keda/index.md:27-35).
+
+    When an :class:`AdmissionController` is attached and shedding,
+    ingress traffic is answered 429 + Retry-After before it reaches the
+    app. Exempt: ``/healthz`` (shedding liveness probes would get an
+    overloaded replica *restarted*, converting load into an outage) and
+    the reserved ``/tasksrunner/*`` namespace (the scaler's stats probe
+    must keep measuring exactly when the replica is saturated)."""
     async def dispatch(request: web.Request) -> web.Response:
         if request.method == "GET" and request.path == "/tasksrunner/stats":
             # not counted as load: the scaler's own probe must not
@@ -86,6 +95,11 @@ def build_app_server(app: App) -> web.Application:
             return web.json_response(
                 {"inflight": app.inflight,
                  "requests_total": app.requests_total})
+        if (admission is not None and admission.shedding
+                and request.path != "/healthz"
+                and not request.path.startswith("/tasksrunner/")):
+            metrics.inc("admission_shed_total", route="app")
+            return shed_response(admission)
         ctx = ensure_trace(request.headers.get(TRACEPARENT_HEADER))
         with trace_scope(ctx):
             body = await request.read()
@@ -169,6 +183,13 @@ class AppHost:
         self._app_runner: web.AppRunner | None = None
         self.sidecar: Sidecar | None = None
         self.client: AppClient | None = None
+        #: one admission controller per replica (None unless
+        #: TASKSRUNNER_ADMISSION=1), shared by the app server and the
+        #: sidecar so both shed on the same saturation state; it reads
+        #: App.inflight as its in-flight signal. The sidecar owns its
+        #: start/stop alongside the loop-lag probe.
+        self.admission = AdmissionController.from_env(
+            inflight=lambda: self.app.inflight)
 
     async def start(self) -> None:
         # Any failure past the first bind must unwind what already
@@ -188,7 +209,8 @@ class AppHost:
         # disables it — measured at ~2x request throughput on the write
         # path (see BASELINE.md), the first tuning for a hot deployment.
         self._app_runner = web.AppRunner(
-            build_app_server(self.app), access_log=_access_log())
+            build_app_server(self.app, admission=self.admission),
+            access_log=_access_log())
         await self._app_runner.setup()
         site = web.TCPSite(self._app_runner, self.bind, self.app_port)
         await _bind_or_explain(site, "app", self.bind, self.app_port)
@@ -216,7 +238,8 @@ class AppHost:
             grants=self.grants,
             chaos=chaos,
         )
-        self.sidecar = Sidecar(runtime, host=self.host, port=self.sidecar_port)
+        self.sidecar = Sidecar(runtime, host=self.host, port=self.sidecar_port,
+                               admission=self.admission)
         await self.sidecar.start()
         self.sidecar_port = self.sidecar.port
 

@@ -1,0 +1,196 @@
+"""Per-replica admission control: shed load before the replica collapses.
+
+An overloaded replica that keeps accepting work converts overload into
+timeouts, connection resets, and (worst) acknowledged-then-lost writes
+when the process finally dies. This controller samples the saturation
+signals module 08 already publishes — event-loop lag, the state/broker
+write-queue depths, and the app's in-flight request count — folds them
+into one score, and flips the replica into *shedding* when the score
+crosses 1.0. While shedding, non-exempt HTTP requests are answered
+``429`` with a ``Retry-After`` derived from the score instead of being
+queued; health, metrics, and admin/metadata endpoints stay open so
+probes and the autoscaler never go blind exactly when they matter.
+
+Two design points keep this safe:
+
+* **Hysteresis.** Shedding starts at score >= 1.0 but only stops below
+  ``exit_ratio`` (default 0.75). Without the band the controller flaps
+  at the threshold — admit a burst, saturate, shed, drain, admit —
+  turning one overload into a square wave of them.
+* **Zero cost when off.** The ``TASKSRUNNER_ADMISSION`` gate decides at
+  construction time: :meth:`AdmissionController.from_env` returns
+  ``None`` and the request paths guard on ``admission is not None``,
+  so the disabled path costs one identity check (the chaos-gate bar of
+  <1%, proven by ``bench.py --overload-bench``).
+
+The score is the max of the per-signal ratios (a replica is as
+saturated as its worst resource): ``lag / max_lag``, worst write-queue
+``depth / max_depth``, and ``inflight / max_inflight``. Thresholds come
+from ``TASKSRUNNER_ADMISSION_MAX_*``; setting one to 0 disables that
+signal. Shedding state and the raw score are published as
+``admission_state`` / ``admission_saturation`` gauges and every shed
+request increments ``admission_shed_total`` — the drill in
+``tests/test_overload_drill.py`` asserts the whole trajectory off the
+``/metrics`` exposition.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import math
+import os
+from typing import Callable
+
+from tasksrunner.envflag import env_flag
+from tasksrunner.observability.metrics import MetricsRegistry, metrics as default_metrics
+
+logger = logging.getLogger(__name__)
+
+#: loop-lag gauge sampled from the registry (set by EventLoopLagProbe)
+LAG_GAUGE = "event_loop_lag_seconds"
+#: write-queue depth gauges; the worst series across all label sets
+#: (per store / per shard / per broker) counts
+QUEUE_GAUGES = ("state_write_queue_depth", "broker_publish_queue_depth")
+
+DEFAULT_INTERVAL = 0.25
+#: shedding stops only when the score drops below this fraction of the
+#: entry threshold — the hysteresis band that prevents flapping
+DEFAULT_EXIT_RATIO = 0.75
+
+DEFAULT_MAX_LAG_SECONDS = 0.25
+DEFAULT_MAX_QUEUE_DEPTH = 512
+DEFAULT_MAX_INFLIGHT = 64
+
+#: Retry-After is ceil(score) seconds — deeper saturation pushes
+#: clients further away — clamped to this ceiling so a pathological
+#: score can't park clients for minutes
+MAX_RETRY_AFTER_SECONDS = 30
+
+
+def _env_number(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r; using %s", name, raw, default)
+        return default
+
+
+class AdmissionController:
+    """Saturation sampler + hysteresis gate for one replica.
+
+    The hot path reads :attr:`shedding` (a plain bool attribute — no
+    lock, no call) and, when shedding, :meth:`retry_after_seconds`.
+    The sampling loop runs as an asyncio task owned by the sidecar,
+    mirroring :class:`~tasksrunner.observability.probes.EventLoopLagProbe`.
+    """
+
+    def __init__(
+        self,
+        *,
+        max_lag_seconds: float = DEFAULT_MAX_LAG_SECONDS,
+        max_queue_depth: float = DEFAULT_MAX_QUEUE_DEPTH,
+        max_inflight: float = DEFAULT_MAX_INFLIGHT,
+        inflight: Callable[[], int] | None = None,
+        interval: float = DEFAULT_INTERVAL,
+        exit_ratio: float = DEFAULT_EXIT_RATIO,
+        registry: MetricsRegistry | None = None,
+    ) -> None:
+        self.max_lag_seconds = max_lag_seconds
+        self.max_queue_depth = max_queue_depth
+        self.max_inflight = max_inflight
+        self.inflight = inflight
+        self.interval = interval
+        self.exit_ratio = exit_ratio
+        self.registry = registry if registry is not None else default_metrics
+        self.shedding = False
+        self.score = 0.0
+        self._task: asyncio.Task | None = None
+        self._publish()
+
+    @classmethod
+    def from_env(
+        cls,
+        *,
+        inflight: Callable[[], int] | None = None,
+        registry: MetricsRegistry | None = None,
+    ) -> AdmissionController | None:
+        """The gate: ``None`` unless ``TASKSRUNNER_ADMISSION`` is on."""
+        if not env_flag("TASKSRUNNER_ADMISSION", default=False):
+            return None
+        return cls(
+            max_lag_seconds=_env_number(
+                "TASKSRUNNER_ADMISSION_MAX_LAG_SECONDS", DEFAULT_MAX_LAG_SECONDS),
+            max_queue_depth=_env_number(
+                "TASKSRUNNER_ADMISSION_MAX_QUEUE_DEPTH", DEFAULT_MAX_QUEUE_DEPTH),
+            max_inflight=_env_number(
+                "TASKSRUNNER_ADMISSION_MAX_INFLIGHT", DEFAULT_MAX_INFLIGHT),
+            inflight=inflight,
+            registry=registry,
+        )
+
+    # -- scoring ---------------------------------------------------------
+
+    def sample(self) -> float:
+        """Recompute the score and apply the hysteresis transition.
+
+        Called from the sampling task; also callable directly by tests
+        (and anything else that just changed a signal and can't wait an
+        interval).
+        """
+        score = 0.0
+        if self.max_lag_seconds > 0:
+            lag = self.registry.get(LAG_GAUGE)
+            score = max(score, lag / self.max_lag_seconds)
+        if self.max_queue_depth > 0:
+            for name in QUEUE_GAUGES:
+                for depth in self.registry.gauge_values(name):
+                    score = max(score, depth / self.max_queue_depth)
+        if self.max_inflight > 0 and self.inflight is not None:
+            score = max(score, self.inflight() / self.max_inflight)
+        self.score = score
+        if not self.shedding and score >= 1.0:
+            self.shedding = True
+            logger.warning(
+                "admission: shedding (saturation %.2f >= 1.0; "
+                "Retry-After %ds)", score, self.retry_after_seconds())
+        elif self.shedding and score < self.exit_ratio:
+            self.shedding = False
+            logger.info(
+                "admission: admitting again (saturation %.2f < %.2f)",
+                score, self.exit_ratio)
+        self._publish()
+        return score
+
+    def retry_after_seconds(self) -> int:
+        """Back clients off proportionally to how saturated we are."""
+        return max(1, min(MAX_RETRY_AFTER_SECONDS, math.ceil(self.score)))
+
+    def _publish(self) -> None:
+        self.registry.set_gauge("admission_state", 1.0 if self.shedding else 0.0)
+        self.registry.set_gauge("admission_saturation", self.score)
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._task
+            self._task = None
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                self.sample()
+            except Exception:  # pragma: no cover - registry bugs only
+                logger.exception("admission: sampler failed; retrying")

@@ -316,6 +316,16 @@ class MetricsRegistry:
                 return self._gauges.get(key, 0.0)
             return self._counters.get(key, 0.0)
 
+    def gauge_values(self, name: str) -> list[float]:
+        """Every live value of ``name`` across its label sets.
+
+        The admission controller samples queue-depth gauges this way:
+        it cares about the worst series (one saturated shard is enough
+        to shed), not any single label combination.
+        """
+        with self._lock:
+            return [v for (n, _), v in self._gauges.items() if n == name]
+
     @staticmethod
     def _key(name: str, labels: Iterable[tuple[str, str]]) -> str:
         labels = tuple(labels)

@@ -30,6 +30,7 @@ COUNTERS: dict[str, str] = {
     "binding_delivery": "input-binding deliveries to app routes, by status",
     "invoke": "service invocations issued, by target app",
     "invoke_transport": "invocation attempts per transport lane (mesh/http)",
+    "admission_shed_total": "requests shed with 429 by admission control",
     "chaos_injected_total": "faults injected by the chaos engine",
     "resiliency_retry_total": "resiliency-policy retry attempts",
     "resiliency_retry_exhausted_total": "retry budgets exhausted",
@@ -38,6 +39,9 @@ COUNTERS: dict[str, str] = {
 #: point-in-time levels (the saturation probes live here)
 GAUGES: dict[str, str] = {
     "uptime_seconds": "seconds since this registry was created",
+    "admission_state": "admission controller state (0 admitting / 1 shedding)",
+    "admission_saturation": "saturation score (>= 1.0 trips shedding)",
+    "autoscale_desired_replicas": "replica count the autoscaler last computed",
     "resiliency_breaker_state": "circuit breaker state (0 closed/2 open)",
     "event_loop_lag_seconds": "asyncio timer drift sampled per process",
     "state_write_queue_depth": "pending writes in the state group-commit queue",

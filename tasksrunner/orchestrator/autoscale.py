@@ -23,7 +23,22 @@ The full ACA trigger taxonomy
                      (measured from /proc/<pid>/stat deltas)
 ``memory``           ≙ the Memory rule: +1 replica per ``megabytes``
                      of total RSS (measured from /proc/<pid>/status)
+``target-p99``       latency-target rule: reads each replica's merged
+                     histogram view (sidecar ``/v1.0/metadata``),
+                     windows the p99 between evaluations, and sizes
+                     the fleet to ``ceil(n * p99 / targetSeconds)``
+``loop-lag``         saturation rule: +1 replica while any replica's
+                     ``event_loop_lag_seconds`` exceeds
+                     ``maxLagSeconds`` — the earliest overload signal
+                     (docs module 08)
 ==================  ====================================================
+
+The last two close the loop the observability layer opened: the
+autoscaler consumes the replicas' own telemetry instead of polling
+proc files, so latency — not just backlog — adds replicas. Their
+signal source is the sidecar metadata endpoint, which is
+admission-exempt (sidecar.py): a shedding replica still reports the
+saturation that should scale it out.
 
 Scale-to-zero is deliberately NOT implemented, for the reason the
 workshop rejects it: it would starve cron and input bindings
@@ -42,7 +57,12 @@ from typing import Callable
 
 from tasksrunner.component.spec import ComponentSpec
 from tasksrunner.errors import ComponentError
-from tasksrunner.orchestrator.config import AppSpec, ScaleRule
+from tasksrunner.observability.metrics import (
+    estimate_percentile,
+    merge_histogram_snapshots,
+    metrics,
+)
+from tasksrunner.orchestrator.config import RULE_TYPES, AppSpec, ScaleRule
 
 logger = logging.getLogger(__name__)
 
@@ -82,10 +102,6 @@ def read_backlog(rule: ScaleRule, *, app_id: str,
     raise ComponentError(f"unknown scale rule type {rule.type!r}")
 
 
-RULE_TYPES = ("pubsub-backlog", "queue-backlog", "http-concurrency",
-              "cpu", "memory")
-
-
 def _read_inflight(replicas: list[dict], timeout: float = 0.5,
                    api_token: str | None = None) -> int:
     """Sum in-flight requests across replicas by polling each one's
@@ -114,6 +130,37 @@ def _read_inflight(replicas: list[dict], timeout: float = 0.5,
         except (OSError, ValueError):
             continue
     return total
+
+
+def _fetch_replica_metadata(replicas: list[dict], timeout: float = 0.5,
+                            api_token: str | None = None) -> list[dict]:
+    """GET each replica's sidecar ``/v1.0/metadata`` — the merged
+    metrics view PR 3 built (flat snapshot + histograms + kinds).
+    Unreachable replicas are skipped, same posture as the stats probe:
+    a replica mid-boot or mid-restart must not wedge the scaler.
+    Runs inside ``asyncio.to_thread`` via ``desired_replicas``."""
+    import json as _json
+    import urllib.request
+
+    from tasksrunner.security import TOKEN_HEADER
+
+    docs = []
+    for info in replicas:
+        port = info.get("sidecar_port")
+        if not port:
+            continue
+        host = info.get("host") or "127.0.0.1"
+        if host in ("", "0.0.0.0"):
+            host = "127.0.0.1"
+        try:
+            req = urllib.request.Request(
+                f"http://{host}:{port}/v1.0/metadata",
+                headers={TOKEN_HEADER: api_token} if api_token else {})
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                docs.append(_json.loads(resp.read()))
+        except (OSError, ValueError):
+            continue
+    return docs
 
 
 def _read_proc_cpu_ticks(pid: int) -> int | None:
@@ -170,6 +217,15 @@ class AutoscaleController:
         #: pid -> (monotonic_time, cpu_ticks) from the previous poll,
         #: for CPU-utilization deltas
         self._cpu_prev: dict[int, tuple[float, int]] = {}
+        #: metric name -> summed bucket counts at the previous
+        #: evaluation, for the target-p99 delta window
+        self._p99_prev: dict[str, list[int]] = {}
+        #: per-desired_replicas-call metadata cache: one sidecar sweep
+        #: feeds every telemetry rule in the same evaluation
+        self._metadata_docs: list[dict] | None = None
+        #: rules that already logged a full traceback (keyed by
+        #: rule type + exception class) — repeats log one line
+        self._rule_failed: set[tuple[str, str]] = set()
 
     def _cpu_percent_total(self, replicas: list[dict]) -> float:
         """Summed per-process CPU%, from /proc tick deltas between
@@ -202,6 +258,77 @@ class AutoscaleController:
             if pid not in live:
                 del self._cpu_prev[pid]
         return total
+
+    def _replica_metadata(self) -> list[dict]:
+        """Sidecar metadata docs for this evaluation (fetched once per
+        ``desired_replicas`` call, shared by every telemetry rule)."""
+        if self._metadata_docs is None:
+            self._metadata_docs = _fetch_replica_metadata(
+                self.replica_info(), api_token=self.api_token)
+        return self._metadata_docs
+
+    def _target_p99_desired(self, rule: ScaleRule) -> int:
+        """Latency-target rule: size the fleet so the *recent* p99 of
+        ``metric`` stays at or under ``targetSeconds``.
+
+        Histogram counts are cumulative since process start, so the raw
+        p99 would remember the overload forever and the fleet would
+        never scale back in. Instead each evaluation diffs the summed
+        bucket counts against the previous evaluation (the ``rate()``
+        a Prometheus deployment would take) and estimates p99 over just
+        that window. Negative deltas — a replica restarted or left the
+        fleet — clamp to 0. Fewer than ``minSamples`` new observations
+        means no verdict, not pressure.
+        """
+        meta = rule.metadata
+        metric = meta.get("metric", "sidecar_request_latency_seconds")
+        target = max(float(meta.get("targetSeconds", 0.5)), 1e-6)
+        min_samples = max(int(meta.get("minSamples", 10)), 1)
+        docs = self._replica_metadata()
+        merged = merge_histogram_snapshots(
+            [d.get("histograms") or {} for d in docs])
+        hist = merged.get(metric)
+        if hist is None:
+            self._p99_prev.pop(metric, None)
+            return 0
+        bounds = hist["bounds"]
+        totals = [0] * (len(bounds) + 1)
+        for series in hist["series"]:
+            for i, c in enumerate(series["counts"]):
+                totals[i] += int(c)
+        prev = self._p99_prev.get(metric)
+        self._p99_prev[metric] = totals
+        if prev is None or len(prev) != len(totals):
+            window = totals  # first sight: all-time is the best window
+        else:
+            window = [max(0, c - p) for c, p in zip(totals, prev)]
+        if sum(window) < min_samples:
+            return 0
+        p99 = estimate_percentile(bounds, window, 0.99)
+        if p99 <= target:
+            return 0
+        # latency scales down roughly with fleet size when the load is
+        # parallelizable — ask for the proportional fleet, clamped to
+        # max_replicas by the caller
+        live = max(len(docs), 1)
+        return math.ceil(live * p99 / target)
+
+    def _loop_lag_desired(self, rule: ScaleRule) -> int:
+        """Saturation rule: any replica's event loop running
+        ``maxLagSeconds`` late adds that much latency to everything it
+        serves — add a replica until no loop lags. Incremental (+1 per
+        evaluation) rather than proportional: lag does not predict how
+        many replicas the work needs, only that this fleet is too
+        small."""
+        max_lag = max(float(rule.metadata.get("maxLagSeconds", 0.1)), 1e-6)
+        worst = 0.0
+        for doc in self._replica_metadata():
+            for key, value in (doc.get("metrics") or {}).items():
+                if key.split("{", 1)[0] == "event_loop_lag_seconds":
+                    worst = max(worst, float(value))
+        if worst <= max_lag:
+            return 0
+        return self.current + 1
 
     def _rule_desired(self, rule: ScaleRule) -> int:
         meta = rule.metadata
@@ -241,17 +368,51 @@ class AutoscaleController:
             mean_term = math.ceil((sum(rss) / n) / per_mb)
             sum_term = min(n, math.ceil(sum(rss) / per_mb))
             return max(mean_term, sum_term)
+        if rule.type == "target-p99":
+            return self._target_p99_desired(rule)
+        if rule.type == "loop-lag":
+            return self._loop_lag_desired(rule)
         raise ComponentError(f"unknown scale rule type {rule.type!r} "
                              f"(known: {RULE_TYPES})")
 
     def desired_replicas(self) -> int:
         """Max over all rules' desired counts, clamped to bounds —
-        the KEDA multi-trigger formula."""
+        the KEDA multi-trigger formula.
+
+        Rules are isolated: one raising rule (a deleted queue file, an
+        unreachable replica set) is logged and skipped, not allowed to
+        abort the evaluation — the old behavior silently froze ALL
+        scaling while one signal was broken. Only if every rule fails
+        does the scaler hold the current count (a telemetry blackout
+        is not evidence that the load went away). The verdict lands in
+        the ``autoscale_desired_replicas`` gauge either way, so the
+        decision stream is observable next to the signals that fed it.
+        """
         scale = self.app.scale
+        self._metadata_docs = None  # fresh sidecar sweep per evaluation
         if not scale.rules:
             return scale.min_replicas
-        desired = max(self._rule_desired(rule) for rule in scale.rules)
-        return max(scale.min_replicas, min(scale.max_replicas, desired))
+        verdicts = []
+        for rule in scale.rules:
+            try:
+                verdicts.append(self._rule_desired(rule))
+            except Exception as exc:
+                key = (rule.type, type(exc).__name__)
+                if key not in self._rule_failed:
+                    self._rule_failed.add(key)
+                    logger.exception(
+                        "scale rule %s for %s failed; skipping it",
+                        rule.type, self.app.app_id)
+                else:
+                    logger.warning(
+                        "scale rule %s for %s still failing (%s); "
+                        "skipping it", rule.type, self.app.app_id, exc)
+        desired = max(verdicts) if verdicts else self.current
+        desired = max(scale.min_replicas, min(scale.max_replicas, desired))
+        # set_gauge is thread-safe; this runs under asyncio.to_thread
+        metrics.set_gauge("autoscale_desired_replicas", float(desired),
+                          app=self.app.app_id)
+        return desired
 
     async def step(self) -> int:
         desired = await asyncio.to_thread(self.desired_replicas)

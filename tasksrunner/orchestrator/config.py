@@ -42,9 +42,16 @@ import yaml
 from tasksrunner.errors import ComponentError
 
 
+#: every scale-rule type the autoscaler implements (autoscale.py
+#: dispatches on these; load_run_config rejects anything else at parse
+#: time so `deploy validate` catches the typo, not the first step())
+RULE_TYPES = ("pubsub-backlog", "queue-backlog", "http-concurrency",
+              "cpu", "memory", "target-p99", "loop-lag")
+
+
 @dataclass
 class ScaleRule:
-    type: str  # pubsub-backlog | queue-backlog
+    type: str  # one of RULE_TYPES
     metadata: dict[str, str] = field(default_factory=dict)
 
 
@@ -181,6 +188,11 @@ def load_run_config(path: str | pathlib.Path) -> RunConfig:
             })
             for r in scale_raw.get("rules") or []
         ]
+        for rule in rules:
+            if rule.type not in RULE_TYPES:
+                raise ComponentError(
+                    f"app {raw['app_id']}: unknown scale rule type "
+                    f"{rule.type!r} (known: {', '.join(RULE_TYPES)})")
         health = parse_health(raw.get("health", {}))
         grants = raw.get("grants")
         if grants is not None:

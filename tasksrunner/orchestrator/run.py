@@ -397,14 +397,16 @@ class Orchestrator:
         return len(self.replicas.get(app_id, []))
 
     def _replica_info(self, app_id: str) -> list[dict]:
-        """Live {pid, app_port, host} per replica — the measurement
-        inventory for the http/cpu/memory scale rules."""
+        """Live {pid, app_port, sidecar_port, host} per replica — the
+        measurement inventory for the http/cpu/memory scale rules and
+        the sidecar-metadata sweep behind target-p99/loop-lag."""
         out = []
         for r in self.replicas.get(app_id, []):
             running = r.proc is not None and r.proc.returncode is None
             out.append({
                 "pid": r.proc.pid if running else None,
                 "app_port": r.ports[0] if r.ports else None,
+                "sidecar_port": r.ports[1] if r.ports else None,
                 "host": r.app.host,
             })
         return out

@@ -256,6 +256,16 @@ class TargetPolicy:
                             f"call to {self.target!r} exceeded "
                             f"{self.timeout}s timeout") from exc
                     raise
+                # honor a server-sent Retry-After (429 shed / 503
+                # breaker): hammering a replica that just said "stay
+                # away" defeats the shed. Clamped to the policy's
+                # max_interval, and the total-budget check below still
+                # wins — the hint stretches a delay, never the budget.
+                hint = getattr(exc, "retry_after", None)
+                if hint:
+                    delay = max(delay, float(hint))
+                    if self.retry is not None:
+                        delay = min(delay, self.retry.max_interval)
                 if deadline is not None and \
                         time.monotonic() + delay >= deadline:
                     # sleeping through the backoff would blow the

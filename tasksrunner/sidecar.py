@@ -28,6 +28,7 @@ from aiohttp import web
 
 from tasksrunner.errors import TasksRunnerError, ValidationError
 from tasksrunner.invoke.headers import inward_headers, outward_headers
+from tasksrunner.observability.admission import AdmissionController
 from tasksrunner.observability.metrics import metrics, render_prometheus
 from tasksrunner.observability.probes import EventLoopLagProbe
 from tasksrunner.observability.tracing import (
@@ -60,8 +61,23 @@ from tasksrunner.security import (  # noqa: E402 (re-export)
 )
 
 
+def shed_response(admission) -> web.Response:
+    """The 429 a saturated replica answers instead of queueing work.
+
+    ``Retry-After`` scales with the saturation score, so clients back
+    off harder the deeper the overload; resiliency policies honor it
+    (resiliency/policy.py) and well-behaved external callers should
+    too.
+    """
+    return web.json_response(
+        {"error": "replica saturated; retry later"},
+        status=429,
+        headers={"Retry-After": str(admission.retry_after_seconds())})
+
+
 def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
-                      peer_tokens: set[str] | None = None) -> web.Application:
+                      peer_tokens: set[str] | None = None,
+                      admission=None) -> web.Application:
     if api_token is None:
         api_token = os.environ.get(TOKEN_ENV) or None
     if peer_tokens is None:
@@ -72,7 +88,8 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
 
     routes = web.RouteTableDef()
 
-    def _traced(handler=None, *, allow_peer: bool = False):
+    def _traced(handler=None, *, allow_peer: bool = False,
+                exempt: bool = False):
         # app↔sidecar API token (≙ Dapr's dapr-api-token / the
         # reference's identity posture, SURVEY.md §5.10): when a token
         # is configured, every building-block call must carry it —
@@ -88,6 +105,9 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
             # observations are a closure call, no label resolution
             record_latency = metrics.recorder(
                 "sidecar_request_latency_seconds", route=route_label)
+            # admission is None when TASKSRUNNER_ADMISSION is off, so
+            # the disabled path pays exactly one bool test per request
+            sheddable = admission is not None and not exempt
 
             async def wrapped(request: web.Request):
                 if api_token is not None:
@@ -98,6 +118,10 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
                     if supplied != api_token and not peer_ok:
                         return web.json_response(
                             {"error": "missing or bad api token"}, status=401)
+                # after auth — saturation state is not for anonymous eyes
+                if sheddable and admission.shedding:
+                    metrics.inc("admission_shed_total", route=route_label)
+                    return shed_response(admission)
                 ctx = ensure_trace(request.headers.get(TRACEPARENT_HEADER))
                 started = time.perf_counter()
                 with trace_scope(ctx):
@@ -234,10 +258,14 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
         return web.Response(status=204)
 
     @routes.get("/v1.0/metadata")
-    @_traced
+    @_traced(exempt=True)
     async def metadata(request: web.Request):
         # token-gated like every building-block route: the component
-        # inventory and metrics are exactly what the token protects
+        # inventory and metrics are exactly what the token protects.
+        # Admission-exempt: the autoscaler reads its scale signals from
+        # here — shedding it would blind the control loop exactly when
+        # it needs to scale out (healthz and /metrics bypass _traced
+        # entirely and are exempt the same way).
         return web.json_response(runtime.metadata())
 
     @routes.get("/metrics")
@@ -267,12 +295,17 @@ class Sidecar:
     (invoke/mesh.py) is the sidecar↔sidecar lane peers prefer — both
     dispatch into the same Runtime under the same token policy."""
 
-    def __init__(self, runtime: Runtime, *, host: str = "127.0.0.1", port: int = 3500):
+    def __init__(self, runtime: Runtime, *, host: str = "127.0.0.1", port: int = 3500,
+                 admission: AdmissionController | None = None):
         self.runtime = runtime
         self.host = host
         self.port = port
         self.mesh_port: int | None = None
-        self._http = build_sidecar_app(runtime)
+        # AppHost passes its shared controller (wired to App.inflight);
+        # a standalone sidecar builds its own from the environment
+        self.admission = (admission if admission is not None
+                          else AdmissionController.from_env())
+        self._http = build_sidecar_app(runtime, admission=self.admission)
         self._runner: web.AppRunner | None = None
         self._mesh = None
         self._lag_probe = EventLoopLagProbe()
@@ -295,10 +328,14 @@ class Sidecar:
             self.mesh_port = self._mesh.port
         await self.runtime.start()
         self._lag_probe.start()
+        if self.admission is not None:
+            self.admission.start()
         logger.info("sidecar for %s listening on %s:%d (mesh :%s)",
                     self.runtime.app_id, self.host, self.port, self.mesh_port)
 
     async def stop(self) -> None:
+        if self.admission is not None:
+            await self.admission.stop()
         await self._lag_probe.stop()
         await self.runtime.stop()
         if self._mesh is not None:

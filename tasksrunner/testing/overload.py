@@ -1,0 +1,288 @@
+"""The chaos-seeded overload drill: the closed loop, end to end.
+
+This module is the shared harness behind ``tests/test_overload_drill.py``
+(which asserts the trajectory) and ``bench.py --overload-bench`` /
+``make bench-overload`` (which prints it): drive a real orchestrator —
+subprocess replicas, sqlite state store slowed by a deterministic
+``kind: Chaos`` latency fault — through sustained overload and record
+what the control loop does about it.
+
+The trajectory the loop must produce:
+
+1. **shed** — admission control (``TASKSRUNNER_ADMISSION=1``, tight
+   in-flight line) answers the flood's excess with 429 + Retry-After
+   instead of queueing into collapse;
+2. **scale out** — the ``target-p99`` rule reads the replicas' merged
+   latency histograms through sidecar ``/v1.0/metadata`` (exempt from
+   shedding) and adds replicas;
+3. **recover** — the flood stops, the windowed p99 clears, and after
+   the cooldown the fleet returns to ``min_replicas``;
+4. **no lost acks** — every key a client got a 2xx for is durably in
+   the store afterwards; shed requests failed loudly, acked requests
+   never silently vanished.
+
+``make_app`` is the replica entrypoint
+(``tasksrunner.testing.overload:make_app``): one POST route that
+writes a state key per request, so overload pressure lands on the
+chaos-slowed store and the drill's loss check is exact.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import pathlib
+import sqlite3
+
+from tasksrunner.app import App
+
+#: module path the orchestrator spawns replicas from
+APP_MODULE = "tasksrunner.testing.overload:make_app"
+APP_ID = "overload-target"
+STORE = "statestore"
+
+
+def make_app() -> App:
+    app = App(APP_ID)
+
+    @app.post("/api/work")
+    async def work(req):
+        body = req.json() or {}
+        key = str(body.get("key", "k"))
+        await app.client.save_state(STORE, key, {"n": body.get("n", 0)})
+        return {"stored": key}
+
+    return app
+
+
+def _write_resources(resources: pathlib.Path, db_path: pathlib.Path,
+                     latency_ms: int) -> None:
+    resources.mkdir(parents=True, exist_ok=True)
+    (resources / f"{STORE}.yaml").write_text(json.dumps({
+        "componentType": "state.sqlite",
+        "metadata": [{"name": "databasePath", "value": str(db_path)}],
+    }))
+    # deterministic fault: every store call gets latency_ms extra —
+    # the overload that makes a modest flood saturate one replica
+    (resources / "chaos.yaml").write_text(f"""\
+apiVersion: tasksrunner/v1alpha1
+kind: Chaos
+metadata:
+  name: overload-drill
+spec:
+  seed: 7
+  faults:
+    slowStore:
+      latency:
+        duration: {latency_ms}ms
+        jitter: {latency_ms // 2}ms
+  targets:
+    components:
+      {STORE}:
+        outbound: [slowStore]
+""")
+
+
+def stored_keys(db_path: pathlib.Path) -> set[str]:
+    """User-visible keys durably in the store file (prefix stripped)."""
+    from tasksrunner.state.keyprefix import SEPARATOR
+
+    if not db_path.exists():
+        return set()
+    conn = sqlite3.connect(db_path)
+    try:
+        rows = conn.execute("SELECT key FROM state").fetchall()
+    finally:
+        conn.close()
+    return {row[0].split(SEPARATOR, 1)[-1] for row in rows}
+
+
+def _parse_prometheus(text: str, name: str) -> float:
+    """Sum of every sample of ``name`` in a text exposition."""
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        if line.startswith(name) and line[len(name):len(name) + 1] in ("{", " "):
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except (IndexError, ValueError):
+                continue
+    return total
+
+
+async def run_overload_drill(
+    base_dir: pathlib.Path,
+    *,
+    flood_seconds: float = 3.5,
+    concurrency: int = 16,
+    max_replicas: int = 2,
+    max_inflight: int = 4,
+    latency_ms: int = 120,
+    cooldown_seconds: float = 1.0,
+    settle_timeout: float = 30.0,
+) -> dict:
+    """Run the drill; return the measured trajectory (no assertions —
+    callers decide what passing means)."""
+    import aiohttp
+
+    from tasksrunner.observability.metrics import metrics
+    from tasksrunner.orchestrator.config import (
+        AppSpec,
+        RunConfig,
+        ScaleRule,
+        ScaleSpec,
+    )
+    from tasksrunner.orchestrator.run import Orchestrator
+
+    base_dir = pathlib.Path(base_dir)
+    db_path = base_dir / "overload-state.db"
+    resources = base_dir / "resources"
+    _write_resources(resources, db_path, latency_ms)
+
+    config = RunConfig(
+        apps=[AppSpec(
+            app_id=APP_ID, module=APP_MODULE,
+            env={
+                "TASKSRUNNER_CHAOS": "1",
+                "TASKSRUNNER_ADMISSION": "1",
+                "TASKSRUNNER_ADMISSION_MAX_INFLIGHT": str(max_inflight),
+                "TASKSRUNNER_ACCESS_LOG": "0",
+            },
+            scale=ScaleSpec(
+                min_replicas=1, max_replicas=max_replicas,
+                cooldown_seconds=cooldown_seconds,
+                rules=[
+                    ScaleRule(type="target-p99", metadata={
+                        "metric": "state_op_latency_seconds",
+                        # far below the injected latency: sustained
+                        # traffic through the slowed store must argue
+                        # for the whole allowed fleet
+                        "targetSeconds": str(latency_ms / 1000.0 / 4),
+                        "minSamples": "8",
+                    }),
+                    ScaleRule(type="loop-lag",
+                              metadata={"maxLagSeconds": "0.5"}),
+                ],
+            ),
+        )],
+        resources_path=str(resources),
+        registry_file=str(base_dir / "apps.json"),
+        base_dir=base_dir,
+    )
+
+    loop = asyncio.get_running_loop()
+    orch = Orchestrator(config)
+    acked: set[str] = set()
+    result = {
+        "acked": 0, "shed": 0, "shed_without_retry_after": 0,
+        "unexpected_statuses": {}, "connection_errors": 0,
+        "retry_after_min": None, "retry_after_max": None,
+        "max_replicas_seen": 1, "desired_gauge_peak": 0.0,
+        "final_replicas": None, "recovered_to_min": False,
+        "shed_metric_total": 0.0, "admission_state_after": None,
+        "lost_acked_keys": [],
+    }
+    try:
+        await orch.start()
+        replica = orch.replicas[APP_ID][0]
+        await asyncio.wait_for(replica.ready.wait(), timeout=30)
+        app_port, sidecar_port = replica.ports
+
+        stop_flood = asyncio.Event()
+
+        async def flood_worker(session: "aiohttp.ClientSession", w: int):
+            i = 0
+            while not stop_flood.is_set():
+                key = f"w{w}-{i}"
+                i += 1
+                try:
+                    async with session.post(
+                            f"http://127.0.0.1:{app_port}/api/work",
+                            json={"key": key, "n": i}) as resp:
+                        await resp.read()
+                        if 200 <= resp.status < 300:
+                            acked.add(key)
+                        elif resp.status == 429:
+                            result["shed"] += 1
+                            ra = resp.headers.get("Retry-After")
+                            if ra is None:
+                                result["shed_without_retry_after"] += 1
+                            else:
+                                v = float(ra)
+                                for bound, fn in (("retry_after_min", min),
+                                                  ("retry_after_max", max)):
+                                    cur = result[bound]
+                                    result[bound] = v if cur is None else fn(cur, v)
+                                # honor the hint, capped so the drill
+                                # keeps producing pressure
+                                await asyncio.sleep(min(v, 0.2))
+                        else:
+                            k = str(resp.status)
+                            result["unexpected_statuses"][k] = (
+                                result["unexpected_statuses"].get(k, 0) + 1)
+                except (OSError, aiohttp.ClientError):
+                    # connection collapse — exactly what shedding exists
+                    # to prevent; callers assert this stays 0
+                    result["connection_errors"] += 1
+                    await asyncio.sleep(0.05)
+
+        async def watch_fleet():
+            while not stop_flood.is_set():
+                result["max_replicas_seen"] = max(
+                    result["max_replicas_seen"], orch.replica_count(APP_ID))
+                result["desired_gauge_peak"] = max(
+                    result["desired_gauge_peak"],
+                    metrics.get("autoscale_desired_replicas", app=APP_ID))
+                await asyncio.sleep(0.1)
+
+        async with aiohttp.ClientSession() as session:
+            tasks = [asyncio.create_task(flood_worker(session, w))
+                     for w in range(concurrency)]
+            tasks.append(asyncio.create_task(watch_fleet()))
+            await asyncio.sleep(flood_seconds)
+            stop_flood.set()
+            await asyncio.gather(*tasks, return_exceptions=True)
+
+            # recovery: windowed p99 clears, cooldown elapses, the
+            # fleet returns to min
+            deadline = loop.time() + settle_timeout
+            while loop.time() < deadline:
+                count = orch.replica_count(APP_ID)
+                result["max_replicas_seen"] = max(
+                    result["max_replicas_seen"], count)
+                result["desired_gauge_peak"] = max(
+                    result["desired_gauge_peak"],
+                    metrics.get("autoscale_desired_replicas", app=APP_ID))
+                if (count <= config.apps[0].scale.min_replicas
+                        and result["max_replicas_seen"] > 1):
+                    result["recovered_to_min"] = True
+                    break
+                await asyncio.sleep(0.2)
+            result["final_replicas"] = orch.replica_count(APP_ID)
+
+            # the trajectory must be visible from the outside: scrape
+            # replica 0's /metrics exposition
+            deadline = loop.time() + 10
+            while loop.time() < deadline:
+                try:
+                    async with session.get(
+                            f"http://127.0.0.1:{sidecar_port}/metrics") as resp:
+                        text = await resp.text()
+                except (OSError, aiohttp.ClientError):
+                    await asyncio.sleep(0.2)
+                    continue
+                result["shed_metric_total"] = _parse_prometheus(
+                    text, "admission_shed_total")
+                result["admission_state_after"] = _parse_prometheus(
+                    text, "admission_state")
+                if result["admission_state_after"] == 0.0:
+                    break  # hysteresis exited; trajectory complete
+                await asyncio.sleep(0.2)
+    finally:
+        await orch.stop()
+
+    result["acked"] = len(acked)
+    durable = stored_keys(db_path)
+    result["lost_acked_keys"] = sorted(acked - durable)
+    return result

@@ -1,0 +1,316 @@
+"""Admission control: saturation scoring, hysteresis, shed surface,
+and the Retry-After contract through client + resiliency.
+
+The overload drill (tests/test_overload_drill.py) proves the closed
+loop end to end; this file pins the pieces in isolation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+
+from tasksrunner.app import App
+from tasksrunner.errors import SaturatedError
+from tasksrunner.observability.admission import AdmissionController
+from tasksrunner.observability.metrics import MetricsRegistry, metrics
+from tasksrunner.resiliency.policy import RetrySpec, TargetPolicy
+
+
+# -- scoring + hysteresis ------------------------------------------------
+
+def test_score_is_max_over_signals():
+    reg = MetricsRegistry()
+    reg.set_gauge("event_loop_lag_seconds", 0.05)
+    reg.set_gauge("state_write_queue_depth", 256, store="s")
+    box = {"inflight": 16}
+    c = AdmissionController(
+        max_lag_seconds=0.25, max_queue_depth=512, max_inflight=64,
+        inflight=lambda: box["inflight"], registry=reg)
+    # lag 0.2, queue 0.5, inflight 0.25 -> worst resource wins
+    assert c.sample() == pytest.approx(0.5)
+    assert not c.shedding
+
+
+def test_queue_depth_uses_worst_series():
+    reg = MetricsRegistry()
+    reg.set_gauge("state_write_queue_depth", 10, store="s", shard="0")
+    reg.set_gauge("broker_publish_queue_depth", 600, pubsub="bus")
+    c = AdmissionController(
+        max_lag_seconds=0, max_inflight=0, max_queue_depth=512, registry=reg)
+    assert c.sample() > 1.0
+    assert c.shedding
+
+
+def test_zero_threshold_disables_signal():
+    reg = MetricsRegistry()
+    reg.set_gauge("event_loop_lag_seconds", 99.0)
+    c = AdmissionController(
+        max_lag_seconds=0, max_queue_depth=0, max_inflight=0, registry=reg)
+    assert c.sample() == 0.0
+    assert not c.shedding
+
+
+def test_hysteresis_enter_at_one_exit_below_ratio():
+    reg = MetricsRegistry()
+    box = {"inflight": 0}
+    c = AdmissionController(
+        max_inflight=10, max_lag_seconds=0, max_queue_depth=0,
+        inflight=lambda: box["inflight"], registry=reg)
+    assert not c.shedding
+    box["inflight"] = 10          # score 1.0: trip
+    c.sample()
+    assert c.shedding
+    assert reg.get("admission_state") == 1.0
+    box["inflight"] = 8           # 0.8 — inside the band: keep shedding
+    c.sample()
+    assert c.shedding, "exiting above exit_ratio would flap"
+    box["inflight"] = 7           # 0.7 < 0.75: exit
+    c.sample()
+    assert not c.shedding
+    assert reg.get("admission_state") == 0.0
+    assert reg.get("admission_saturation") == pytest.approx(0.7)
+
+
+def test_retry_after_tracks_score_with_clamps():
+    reg = MetricsRegistry()
+    c = AdmissionController(registry=reg)
+    c.score = 0.0
+    assert c.retry_after_seconds() == 1
+    c.score = 3.2
+    assert c.retry_after_seconds() == 4
+    c.score = 1e6
+    assert c.retry_after_seconds() == 30
+
+
+def test_from_env_gate_and_thresholds(monkeypatch):
+    monkeypatch.delenv("TASKSRUNNER_ADMISSION", raising=False)
+    assert AdmissionController.from_env() is None
+    monkeypatch.setenv("TASKSRUNNER_ADMISSION", "0")
+    assert AdmissionController.from_env() is None
+    monkeypatch.setenv("TASKSRUNNER_ADMISSION", "1")
+    monkeypatch.setenv("TASKSRUNNER_ADMISSION_MAX_INFLIGHT", "7")
+    monkeypatch.setenv("TASKSRUNNER_ADMISSION_MAX_LAG_SECONDS", "0.5")
+    monkeypatch.setenv("TASKSRUNNER_ADMISSION_MAX_QUEUE_DEPTH", "100")
+    c = AdmissionController.from_env(registry=MetricsRegistry())
+    assert c is not None
+    assert c.max_inflight == 7
+    assert c.max_lag_seconds == 0.5
+    assert c.max_queue_depth == 100
+
+
+# -- shed surface: app server + sidecar ----------------------------------
+
+@pytest.mark.asyncio
+async def test_apphost_sheds_non_exempt_routes(tmp_path, monkeypatch):
+    import aiohttp
+
+    from tasksrunner.hosting import AppHost
+
+    monkeypatch.setenv("TASKSRUNNER_ADMISSION", "1")
+    app = App("admit-app")
+
+    @app.post("/api/echo")
+    async def echo(req):
+        return {"ok": True}
+
+    host = AppHost(app, specs=[], registry_file=str(tmp_path / "apps.json"))
+    await host.start()
+    try:
+        assert host.admission is not None
+        assert host.sidecar.admission is host.admission, \
+            "app server and sidecar must shed on the same state"
+        base_app = f"http://127.0.0.1:{host.app_port}"
+        base_sc = f"http://127.0.0.1:{host.sidecar_port}"
+        async with aiohttp.ClientSession() as s:
+            # not saturated: everything flows
+            async with s.post(f"{base_app}/api/echo", json={}) as r:
+                assert r.status == 200
+
+            host.admission.shedding = True
+            host.admission.score = 3.0
+
+            # app ingress shed with the Retry-After contract
+            async with s.post(f"{base_app}/api/echo", json={}) as r:
+                assert r.status == 429
+                assert r.headers.get("Retry-After") == "3"
+            # sidecar building-block route shed too
+            async with s.get(f"{base_sc}/v1.0/state/foo/bar") as r:
+                assert r.status == 429
+                assert "Retry-After" in r.headers
+
+            # exempt surfaces stay open while shedding: liveness,
+            # scaler stats, sidecar health, the autoscaler's metadata
+            # view, and the metrics scrape
+            async with s.get(f"{base_app}/healthz") as r:
+                assert r.status == 204
+            async with s.get(f"{base_app}/tasksrunner/stats") as r:
+                assert r.status == 200
+            async with s.get(f"{base_sc}/v1.0/healthz") as r:
+                assert r.status == 204
+            async with s.get(f"{base_sc}/v1.0/metadata") as r:
+                assert r.status == 200
+            async with s.get(f"{base_sc}/metrics") as r:
+                assert r.status == 200
+                assert "admission_shed_total" in await r.text()
+            assert metrics.get("admission_shed_total", route="app") >= 1
+
+            # hysteresis exit: traffic flows again
+            host.admission.shedding = False
+            async with s.post(f"{base_app}/api/echo", json={}) as r:
+                assert r.status == 200
+    finally:
+        await host.stop()
+
+
+@pytest.mark.asyncio
+async def test_apphost_gate_off_means_no_controller(tmp_path, monkeypatch):
+    monkeypatch.delenv("TASKSRUNNER_ADMISSION", raising=False)
+    from tasksrunner.hosting import AppHost
+
+    app = App("no-admit-app")
+    host = AppHost(app, specs=[], registry_file=str(tmp_path / "apps.json"))
+    await host.start()
+    try:
+        assert host.admission is None
+        assert host.sidecar.admission is None
+    finally:
+        await host.stop()
+
+
+@pytest.mark.asyncio
+async def test_sampler_task_trips_on_live_inflight(monkeypatch):
+    """The controller's own loop (not a manual sample()) observes the
+    in-flight callable and trips."""
+    reg = MetricsRegistry()
+    box = {"inflight": 0}
+    c = AdmissionController(
+        max_inflight=2, max_lag_seconds=0, max_queue_depth=0,
+        inflight=lambda: box["inflight"], interval=0.02, registry=reg)
+    c.start()
+    try:
+        box["inflight"] = 5
+        deadline = time.monotonic() + 2
+        while not c.shedding:
+            assert time.monotonic() < deadline, "sampler never tripped"
+            await asyncio.sleep(0.01)
+        box["inflight"] = 0
+        deadline = time.monotonic() + 2
+        while c.shedding:
+            assert time.monotonic() < deadline, "sampler never recovered"
+            await asyncio.sleep(0.01)
+    finally:
+        await c.stop()
+
+
+# -- Retry-After through the client and the retry loop -------------------
+
+def test_client_maps_429_to_saturated_with_retry_after():
+    from tasksrunner.client import _HTTPTransport
+
+    with pytest.raises(SaturatedError) as ei:
+        _HTTPTransport._raise(
+            429, b'{"error": "replica saturated; retry later"}',
+            context="save state s", headers={"retry-after": "7"})
+    assert ei.value.http_status == 429
+    assert ei.value.retry_after == 7.0
+
+
+def test_client_attaches_retry_after_on_503_only_when_present():
+    from tasksrunner.client import _HTTPTransport
+    from tasksrunner.errors import TasksRunnerError
+
+    with pytest.raises(TasksRunnerError) as ei:
+        _HTTPTransport._raise(503, b"{}", context="publish p/t",
+                              headers={"retry-after": "2.5"})
+    assert ei.value.retry_after == 2.5
+    with pytest.raises(TasksRunnerError) as ei:
+        _HTTPTransport._raise(503, b"{}", context="publish p/t", headers={})
+    assert getattr(ei.value, "retry_after", None) is None
+    # a 400 never picks up the hint, even if a proxy added the header
+    with pytest.raises(TasksRunnerError) as ei:
+        _HTTPTransport._raise(400, b"{}", context="save state s",
+                              headers={"retry-after": "9"})
+    assert getattr(ei.value, "retry_after", None) is None
+
+
+def test_invocation_response_carries_retry_after():
+    from tasksrunner.client import InvocationResponse
+    from tasksrunner.errors import InvocationStatusError
+
+    resp = InvocationResponse(429, {"retry-after": "3"}, b"busy")
+    with pytest.raises(InvocationStatusError) as ei:
+        resp.raise_for_status()
+    assert ei.value.status == 429
+    assert ei.value.retry_after == 3.0
+
+
+def test_retry_after_ignores_http_date_form():
+    from tasksrunner.client import _retry_after_seconds
+
+    assert _retry_after_seconds(
+        {"retry-after": "Wed, 21 Oct 2026 07:28:00 GMT"}) is None
+    assert _retry_after_seconds({"Retry-After": "4"}) == 4.0
+    assert _retry_after_seconds({}) is None
+    assert _retry_after_seconds(None) is None
+
+
+@pytest.mark.asyncio
+async def test_retry_loop_honors_retry_after_hint():
+    policy = TargetPolicy(
+        target="t", retry=RetrySpec(duration=0.001, max_retries=3))
+    calls = []
+
+    async def shed_then_ok():
+        calls.append(time.monotonic())
+        if len(calls) == 1:
+            exc = SaturatedError("shed")
+            exc.retry_after = 0.25
+            raise exc
+        return "ok"
+
+    assert await policy.execute(
+        shed_then_ok, retriable=(SaturatedError,)) == "ok"
+    # the 0.001s schedule was stretched to honor the 0.25s hint
+    assert calls[1] - calls[0] >= 0.25
+
+
+@pytest.mark.asyncio
+async def test_retry_after_hint_clamped_to_max_interval():
+    policy = TargetPolicy(
+        target="t",
+        retry=RetrySpec(duration=0.001, max_retries=3, max_interval=0.05))
+    calls = []
+
+    async def shed_then_ok():
+        calls.append(time.monotonic())
+        if len(calls) == 1:
+            exc = SaturatedError("shed")
+            exc.retry_after = 30.0  # a pathological hint must not park us
+            raise exc
+        return "ok"
+
+    t0 = time.monotonic()
+    assert await policy.execute(
+        shed_then_ok, retriable=(SaturatedError,)) == "ok"
+    assert time.monotonic() - t0 < 5.0
+
+
+@pytest.mark.asyncio
+async def test_retry_after_hint_still_bounded_by_total_budget():
+    policy = TargetPolicy(
+        target="t", timeout=0.1, timeout_policy="total",
+        retry=RetrySpec(duration=0.001, max_retries=10, max_interval=60))
+
+    async def always_shed():
+        exc = SaturatedError("shed")
+        exc.retry_after = 30.0
+        raise exc
+
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="total budget"):
+        await policy.execute(always_shed, retriable=(SaturatedError,))
+    # surfaced immediately instead of sleeping 30s through the budget
+    assert time.monotonic() - t0 < 2.0

@@ -698,3 +698,140 @@ async def test_memory_rule_does_not_ratchet_with_replica_count(tmp_path):
     assert scaler.desired_replicas() == desired_one, (
         "same per-replica RSS must not ask for more replicas "
         "just because more replicas exist")
+
+
+def _telemetry_scaler(tmp_path, rules, *, max_replicas=10,
+                      cooldown_seconds=5.0, calls=None):
+    app = AppSpec(
+        app_id="w", module="x:y",
+        scale=ScaleSpec(min_replicas=1, max_replicas=max_replicas,
+                        cooldown_seconds=cooldown_seconds, rules=rules))
+    return AutoscaleController(
+        app, [], (calls.append if calls is not None else lambda n: None),
+        base_dir=tmp_path)
+
+
+def _p99_doc(counts, *, bounds=(0.1, 0.5, 1.0),
+             metric="state_op_latency_seconds"):
+    """A fake sidecar /v1.0/metadata doc with one histogram series."""
+    return {"histograms": {metric: {
+        "bounds": list(bounds),
+        "series": [{"labels": {}, "counts": list(counts),
+                    "sum": 0.0, "count": sum(counts)}],
+    }}}
+
+
+@pytest.mark.asyncio
+async def test_target_p99_rule_windows_deltas(tmp_path):
+    """target-p99 sizes the fleet from the p99 of the WINDOW between
+    evaluations, not all-time cumulative counts — otherwise one past
+    overload would argue for a big fleet forever."""
+    rule = ScaleRule(type="target-p99", metadata={
+        "metric": "state_op_latency_seconds",
+        "targetSeconds": "0.25", "minSamples": "5"})
+    scaler = _telemetry_scaler(tmp_path, [rule])
+
+    # 20 observations in the (0.5, 1.0] bucket: p99 ~= 0.995, nearly
+    # 4x the 0.25s target, 1 live replica -> ceil(1 * p99/0.25) = 4
+    docs = [_p99_doc([0, 0, 20, 0])]
+    scaler._replica_metadata = lambda: docs
+    assert scaler._rule_desired(rule) == 4
+
+    # same cumulative counts next evaluation: the window is empty,
+    # under minSamples -> no verdict, the overload is NOT remembered
+    assert scaler._rule_desired(rule) == 0
+
+    # fresh fast traffic: 30 new observations under 0.1s -> p99 under
+    # target -> no pressure
+    docs = [_p99_doc([30, 0, 20, 0])]
+    assert scaler._rule_desired(rule) == 0
+
+    # replica restart shrinks the cumulative counts; negative deltas
+    # clamp to 0 instead of poisoning the window
+    docs = [_p99_doc([1, 0, 0, 0])]
+    assert scaler._rule_desired(rule) == 0
+
+    # metric gone entirely (no traffic yet on a fresh fleet): silence
+    # is not pressure
+    docs = [{"histograms": {}}]
+    assert scaler._rule_desired(rule) == 0
+
+
+@pytest.mark.asyncio
+async def test_loop_lag_rule_adds_one_while_any_loop_lags(tmp_path):
+    rule = ScaleRule(type="loop-lag", metadata={"maxLagSeconds": "0.5"})
+    scaler = _telemetry_scaler(tmp_path, [rule])
+
+    # worst lag across replicas and label sets decides — one healthy
+    # replica must not mask a saturated one
+    docs = [
+        {"metrics": {"event_loop_lag_seconds": 0.05}},
+        {"metrics": {'event_loop_lag_seconds{replica="1"}': 0.8,
+                     "other_metric": 99.0}},
+    ]
+    scaler._replica_metadata = lambda: docs
+    assert scaler._rule_desired(rule) == scaler.current + 1
+
+    # incremental, not proportional: from a bigger fleet it still asks
+    # for just one more
+    scaler.current = 3
+    assert scaler._rule_desired(rule) == 4
+
+    docs = [{"metrics": {"event_loop_lag_seconds": 0.1}}]
+    assert scaler._rule_desired(rule) == 0
+
+
+@pytest.mark.asyncio
+async def test_rule_failure_isolation_and_desired_gauge(tmp_path):
+    """One broken rule is logged + skipped, the healthy rule's verdict
+    still drives scaling, and the decision lands in the
+    autoscale_desired_replicas gauge; only an all-rules blackout holds
+    the current count."""
+    from tasksrunner.observability.metrics import metrics
+
+    bad = ScaleRule(type="pubsub-backlog", metadata={
+        "component": "no-such-broker", "topic": "t"})  # raises
+    lag = ScaleRule(type="loop-lag", metadata={"maxLagSeconds": "0.5"})
+    scaler = _telemetry_scaler(tmp_path, [bad, lag], max_replicas=5)
+    scaler._replica_metadata = lambda: [
+        {"metrics": {"event_loop_lag_seconds": 2.0}}]
+
+    # bad rule raises ComponentError; lag rule still argues 1 -> 2
+    assert scaler.desired_replicas() == 2
+    assert metrics.get("autoscale_desired_replicas", app="w") == 2.0
+
+    # every rule failing = telemetry blackout: hold, don't scale in
+    scaler.app.scale.rules = [bad]
+    scaler.current = 3
+    assert scaler.desired_replicas() == 3
+    assert metrics.get("autoscale_desired_replicas", app="w") == 3.0
+
+
+@pytest.mark.asyncio
+async def test_autoscale_cooldown_resets_when_load_returns(tmp_path):
+    """Scale-out is immediate; scale-in needs the backlog low for the
+    WHOLE cooldown — load returning mid-cooldown resets the clock, so
+    a sawtooth load never causes a scale-in at its trough."""
+    calls = []
+    scaler = _telemetry_scaler(tmp_path, [ScaleRule(type="loop-lag")],
+                               cooldown_seconds=0.3, calls=calls)
+    box = {"n": 1}
+    scaler.desired_replicas = lambda: box["n"]
+
+    box["n"] = 3
+    assert await scaler.step() == 3 and calls == [3]  # out: immediate
+
+    box["n"] = 1
+    assert await scaler.step() == 3      # low observed, clock starts
+    await asyncio.sleep(0.2)
+    box["n"] = 3
+    assert await scaler.step() == 3      # load is back: clock must reset
+    box["n"] = 1
+    assert await scaler.step() == 3      # clock restarts here
+    await asyncio.sleep(0.2)
+    # 0.4s since the FIRST low sample but only 0.2s since the reset:
+    # a non-reset clock would (wrongly) scale in now
+    assert await scaler.step() == 3
+    await asyncio.sleep(0.15)
+    assert await scaler.step() == 1      # full quiet cooldown elapsed
+    assert calls == [3, 1]

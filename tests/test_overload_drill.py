@@ -1,0 +1,63 @@
+"""The chaos overload drill: shed → scale out → recover, with zero
+lost acked writes. See tasksrunner/testing/overload.py for the
+harness; ``make bench-overload`` prints the same trajectory.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from tasksrunner.testing.overload import run_overload_drill
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _assert_trajectory(result: dict, *, max_replicas: int) -> None:
+    # 1. shed, never collapse: the flood's excess got clean 429s with
+    # the Retry-After contract — not refused connections, not timeouts
+    assert result["shed"] > 0, f"admission never shed: {result}"
+    assert result["shed_without_retry_after"] == 0
+    assert result["connection_errors"] == 0, \
+        f"connection collapse is what shedding exists to prevent: {result}"
+    assert not result["unexpected_statuses"], result["unexpected_statuses"]
+    assert result["retry_after_min"] >= 1
+    assert result["retry_after_max"] <= 30
+
+    # 2. scale out: the target-p99 rule saw the chaos-slowed store and
+    # argued for more replicas, visibly (gauge) and actually (fleet)
+    assert result["desired_gauge_peak"] >= 2, result
+    assert result["max_replicas_seen"] >= 2, result
+    assert result["max_replicas_seen"] <= max_replicas
+
+    # 3. recover: flood over, windowed p99 cleared, cooldown elapsed,
+    # fleet back at min; the replica stopped shedding
+    assert result["recovered_to_min"], result
+    assert result["final_replicas"] == 1, result
+    assert result["admission_state_after"] == 0.0, result
+
+    # 4. no lost acks: every 2xx the clients saw is durable
+    assert result["acked"] > 0, "drill made no progress at all"
+    assert result["lost_acked_keys"] == [], result["lost_acked_keys"]
+
+    # the trajectory is externally observable: the shed counter made it
+    # into the /metrics exposition
+    assert result["shed_metric_total"] > 0
+
+
+async def test_overload_drill_closed_loop(tmp_path, monkeypatch):
+    monkeypatch.setenv("PYTHONPATH", REPO)
+    result = await run_overload_drill(tmp_path)
+    _assert_trajectory(result, max_replicas=2)
+
+
+@pytest.mark.slow
+async def test_overload_drill_soak(tmp_path, monkeypatch):
+    """Longer flood, wider fleet: the loop holds under sustained
+    pressure, not just a burst."""
+    monkeypatch.setenv("PYTHONPATH", REPO)
+    result = await run_overload_drill(
+        tmp_path, flood_seconds=8.0, concurrency=24, max_replicas=3,
+        settle_timeout=60.0)
+    _assert_trajectory(result, max_replicas=3)
