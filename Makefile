@@ -1,6 +1,6 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test lint lint-metrics soak bench bench-state bench-shard bench-hist bench-overload chaos sweep-flash run validate docs-serve docs-build clean
+.PHONY: test lint lint-metrics soak bench bench-state bench-shard bench-hist bench-overload bench-actors chaos sweep-flash run validate docs-serve docs-build clean
 
 test: lint
 	python -m pytest tests/ -q
@@ -46,6 +46,14 @@ bench-hist:
 bench-overload:
 	python -m pytest tests/test_overload_drill.py -q -m "not slow"
 	python bench.py --overload-bench
+
+# virtual actors: the test suite (fencing, reminders, the seeded
+# crashEveryN failover drill), then the bench section — turn
+# throughput, failover time, zero lost acked turns, and the gate-off
+# sidecar ingress overhead (<1% when TASKSRUNNER_ACTORS is unset)
+bench-actors:
+	python -m pytest tests/test_actors.py -q -m "not slow"
+	python bench.py --actor-bench
 
 # chaos verification: the deterministic fault-injection harness, the
 # faulty-broker convergence soak, and the proof that the disabled gate

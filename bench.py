@@ -1077,6 +1077,246 @@ async def run_overload_drill_bench() -> dict:
     return await run_overload_drill(pathlib.Path(tmp))
 
 
+async def run_actor_bench(n_turns: int = 1600, *, concurrency: int = 32,
+                          ingress_ops: int = 3000,
+                          ingress_concurrency: int = 32,
+                          rounds: int = 5) -> dict:
+    """``actor_bench``: the virtual-actor subsystem's three numbers.
+
+    * **turn throughput** — acked turns/s through the full path
+      (placement lookup → per-actor lock → app handler → etag-guarded
+      state commit → ack) over 64 actors, plus one actor alone (turns
+      on a single id are serialized by design, so this is the per-actor
+      ceiling, not a defect);
+    * **failover drill** — two replicas over one store; the owner takes
+      acked turns and holds a periodic reminder, then crashes WITHOUT
+      releasing its lease (the hard case). Reported: time until the
+      survivor completes a turn on the same actor (bounded by the lease
+      TTL), time until the reminder fires again on the survivor, and
+      the lost-acked-turns count (must be 0 — the next turn's counter
+      value proves every pre-crash ack survived);
+    * **gate-off ingress overhead** — the sidecar with
+      ``TASKSRUNNER_ACTORS`` unset has a route table with NO actor
+      routes (asserted structurally — byte-identical dispatch to the
+      pre-actors sidecar), and the healthz flood measures that as a
+      number vs an independently built baseline server; ``enabled`` is
+      the route-table cost of the five actor routes on non-actor
+      traffic. Order rotates each round; overhead is the median of
+      PAIRED per-round ratios (the chaos bench's methodology).
+    """
+    import aiohttp
+    from aiohttp import web
+
+    from tasksrunner.app import App
+    from tasksrunner.component.registry import ComponentRegistry
+    from tasksrunner.component.spec import ComponentSpec
+    from tasksrunner.errors import TasksRunnerError
+    from tasksrunner.runtime import InProcAppChannel, Runtime
+    from tasksrunner.sidecar import build_sidecar_app
+    from tasksrunner.state.memory import InMemoryStateStore
+
+    saved = {k: os.environ.get(k) for k in (
+        "TASKSRUNNER_ACTORS", "TASKSRUNNER_ACTOR_LEASE_SECONDS",
+        "TASKSRUNNER_ACTOR_REMINDER_POLL_SECONDS")}
+
+    def build_app() -> App:
+        app = App("bench-actors")
+
+        @app.actor("Counter")
+        async def counter(turn):
+            if turn.is_reminder:
+                turn.state["reminded"] = turn.state.get("reminded", 0) + 1
+                return None
+            turn.state["n"] = turn.state.get("n", 0) + 1
+            return turn.state["n"]
+
+        return app
+
+    def make_runtime(shared) -> Runtime:
+        spec = ComponentSpec(name="statestore", type="state.in-memory")
+        reg = ComponentRegistry([spec], app_id="bench-actors")
+        reg._instances["statestore"] = shared
+        return Runtime("bench-actors", reg,
+                       app_channel=InProcAppChannel(build_app()))
+
+    out: dict = {}
+    lease_seconds = 0.4
+    os.environ["TASKSRUNNER_ACTORS"] = "1"
+    os.environ["TASKSRUNNER_ACTOR_LEASE_SECONDS"] = str(lease_seconds)
+    os.environ["TASKSRUNNER_ACTOR_REMINDER_POLL_SECONDS"] = "0.05"
+    try:
+        # -- turn throughput ---------------------------------------------
+        rt = make_runtime(InMemoryStateStore("statestore"))
+        await rt.start()
+        assert rt.actors is not None
+        ids = [f"a{i}" for i in range(64)]
+        per_worker = n_turns // concurrency
+
+        async def turn_worker(w: int) -> None:
+            for i in range(per_worker):
+                await rt.invoke_actor(
+                    "Counter", ids[(w + i) % len(ids)], "bump")
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(turn_worker(w) for w in range(concurrency)))
+        many = (per_worker * concurrency) / (time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        for _ in range(200):
+            await rt.invoke_actor("Counter", "serial", "bump")
+        serial = 200 / (time.perf_counter() - t0)
+        await rt.stop()
+        out["turns"] = {
+            "turns_per_sec_64_actors": round(many, 1),
+            "turns_per_sec_single_actor": round(serial, 1),
+            "concurrency": concurrency,
+            "note": "in-memory store; single-actor turns are serialized "
+                    "by the turn-based concurrency contract, so that "
+                    "figure is the per-actor ceiling",
+        }
+
+        # -- failover drill ----------------------------------------------
+        shared = InMemoryStateStore("statestore")
+        r1, r2 = make_runtime(shared), make_runtime(shared)
+        await r1.start()
+        await r2.start()
+        acked = 0
+        for _ in range(25):
+            acked = await r1.invoke_actor("Counter", "fo", "bump")
+        await r1.register_actor_reminder(
+            "Counter", "fo", "tick", due_seconds=0.0, period_seconds=0.15)
+        await r1.actors.sweep()  # the reminder fires once pre-crash
+        r1.actors.simulate_crash()
+        t0 = time.perf_counter()
+        while True:
+            try:
+                v = await r2.invoke_actor("Counter", "fo", "bump")
+                break
+            except TasksRunnerError:
+                await asyncio.sleep(0.02)
+        failover_ms = (time.perf_counter() - t0) * 1000.0
+        refire_ms = None
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 5.0:
+            doc = await r2.get_actor_state("Counter", "fo")
+            if doc["data"].get("reminded", 0) >= 2:
+                refire_ms = round((time.perf_counter() - t0) * 1000.0, 1)
+                break
+            await asyncio.sleep(0.02)
+        await r2.stop()
+        r1.actors = None  # crashed replica: nothing to release
+        await r1.stop()
+        out["failover"] = {
+            "acked_turns_before_crash": acked,
+            "lost_acked_turns": (acked + 1) - v,
+            "failover_ms": round(failover_ms, 1),
+            "lease_seconds": lease_seconds,
+            "reminder_refire_ms": refire_ms,
+            "note": "crash WITHOUT lease release — failover is bounded "
+                    "by the lease TTL; the survivor's first turn "
+                    "returning acked+1 proves zero lost acked turns",
+        }
+    finally:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+
+    # -- gate-off ingress overhead ---------------------------------------
+    def make_sidecar(flag_on: bool) -> web.Application:
+        prev = os.environ.pop("TASKSRUNNER_ACTORS", None)
+        if flag_on:
+            os.environ["TASKSRUNNER_ACTORS"] = "1"
+        try:
+            return build_sidecar_app(
+                make_runtime(InMemoryStateStore("statestore")),
+                api_token=None, peer_tokens=set())
+        finally:
+            if prev is None:
+                os.environ.pop("TASKSRUNNER_ACTORS", None)
+            else:
+                os.environ["TASKSRUNNER_ACTORS"] = prev
+
+    def has_actor_routes(webapp: web.Application) -> bool:
+        return any("/v1.0/actors" in str(r.resource.canonical)
+                   for r in webapp.router.routes()
+                   if r.resource is not None)
+
+    configs = [("baseline", make_sidecar(False)),
+               ("gate_off", make_sidecar(False)),
+               ("enabled", make_sidecar(True))]
+    by_name = dict(configs)
+    assert not has_actor_routes(by_name["gate_off"]), \
+        "gate-off sidecar must not register actor routes"
+    assert has_actor_routes(by_name["enabled"])
+
+    runners, ports = [], {}
+    rates: dict[str, list[float]] = {name: [] for name, _ in configs}
+    try:
+        for name, server in configs:
+            runner = web.AppRunner(server)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            runners.append(runner)
+            ports[name] = runner.addresses[0][1]
+
+        per_worker = ingress_ops // ingress_concurrency
+        async with aiohttp.ClientSession() as session:
+
+            async def rate(name: str, n_per_worker: int) -> float:
+                url = f"http://127.0.0.1:{ports[name]}/v1.0/healthz"
+
+                async def worker() -> None:
+                    for _ in range(n_per_worker):
+                        async with session.get(url) as resp:
+                            await resp.read()
+                            assert resp.status == 204
+
+                t0 = time.perf_counter()
+                await asyncio.gather(
+                    *(worker() for _ in range(ingress_concurrency)))
+                return ((n_per_worker * ingress_concurrency)
+                        / (time.perf_counter() - t0))
+
+            for name, _ in configs:  # warmup round, discarded
+                await rate(name, max(2, per_worker // 4))
+            for r in range(rounds):
+                order = configs[r % len(configs):] + configs[:r % len(configs)]
+                for name, _ in order:
+                    rates[name].append(await rate(name, per_worker))
+    finally:
+        for runner in runners:
+            await runner.cleanup()
+
+    med = {name: statistics.median(rs) for name, rs in rates.items()}
+
+    def overhead_pct(name: str) -> float:
+        per_round = [1.0 - rates[name][r] / rates["baseline"][r]
+                     for r in range(len(rates[name]))]
+        return round(statistics.median(per_round) * 100.0, 2)
+
+    out["ingress"] = {
+        "baseline_req_per_sec": round(med["baseline"], 1),
+        "gate_off_req_per_sec": round(med["gate_off"], 1),
+        "gate_off_overhead_pct": overhead_pct("gate_off"),
+        "gate_off_route_table_has_actor_routes": False,
+        "enabled_req_per_sec": round(med["enabled"], 1),
+        "enabled_overhead_pct": overhead_pct("enabled"),
+        "concurrency": ingress_concurrency,
+        "note": "sidecar healthz flood (real aiohttp server, "
+                "localhost). gate_off is the production default "
+                "(TASKSRUNNER_ACTORS unset -> the actor routes are "
+                "never registered, asserted structurally), so its "
+                "delta vs baseline is pure host noise — the "
+                "acceptance bar is <1% net of that noise. enabled "
+                "measures the five extra routes' dispatch cost on "
+                "non-actor traffic",
+    }
+    return out
+
+
 # ---------------------------------------------------------------------------
 # optional: ML-extension step time on the real chip (EXTENSION ONLY)
 # ---------------------------------------------------------------------------
@@ -1321,6 +1561,12 @@ def main() -> None:
                              "overhead on the ingress path (<1%% bar "
                              "when off) plus the chaos overload drill's "
                              "shed/scale/recover trajectory")
+    parser.add_argument("--actor-bench", action="store_true",
+                        help="run ONLY the virtual-actor section "
+                             "(`make bench-actors`): turn throughput, "
+                             "the crash-failover drill (zero lost acked "
+                             "turns, reminder refire), and the gate-off "
+                             "sidecar ingress overhead (<1%% bar)")
     args = parser.parse_args()
 
     if args.tpu_bench:
@@ -1390,6 +1636,22 @@ def main() -> None:
                           "overload_drill": drill}))
         return
 
+    if args.actor_bench:
+        _log("virtual actors: turns, crash failover, gate-off ingress ...")
+        actor_bench = asyncio.run(run_actor_bench())
+        t, f, i = actor_bench["turns"], actor_bench["failover"], \
+            actor_bench["ingress"]
+        _log(f"  -> {t['turns_per_sec_64_actors']} turns/s over 64 actors "
+             f"({t['turns_per_sec_single_actor']} on one), failover "
+             f"{f['failover_ms']:.0f} ms (lease {f['lease_seconds']}s), "
+             f"lost acked turns {f['lost_acked_turns']}, reminder refire "
+             f"{f['reminder_refire_ms']} ms")
+        _log(f"  -> ingress gate-off {i['gate_off_overhead_pct']:+.2f}% vs "
+             f"baseline {i['baseline_req_per_sec']} req/s (bar <1%), "
+             f"enabled {i['enabled_overhead_pct']:+.2f}%")
+        print(json.dumps({"actor_bench": actor_bench}))
+        return
+
     if args.worker:
         profile_dir = os.environ.get("BENCH_PROFILE_DIR")
         if profile_dir:
@@ -1411,7 +1673,7 @@ def main() -> None:
     # the chip section runs FIRST: it is the scarcest measurement (the
     # tunnel has documented multi-hour outages) and must not queue
     # behind minutes of CPU benches that could overlap an outage window
-    _log("bench 1/10: ML-extension train step on the attached chip ...")
+    _log("bench 1/11: ML-extension train step on the attached chip ...")
     # belt over braces: the section is internally fault-tolerant, but
     # it also runs FIRST now — nothing it could raise may be allowed
     # to cost the CPU sections their numbers
@@ -1430,7 +1692,7 @@ def main() -> None:
     # the component the e2e write path bottlenecks on, measured alone —
     # and the seed write path measured in the SAME run, so the group-
     # commit speedup is a same-host apples-to-apples figure
-    _log("bench 2/10: state-store ops/s (group-commit write queue) ...")
+    _log("bench 2/11: state-store ops/s (group-commit write queue) ...")
     state_ops = asyncio.run(run_state_bench())
     _log(f"  -> write-heavy {state_ops['write_heavy']['ops_per_sec']} ops/s "
          f"({state_ops['write_heavy']['speedup']}x vs pre-change), "
@@ -1439,7 +1701,7 @@ def main() -> None:
 
     # the sharded state plane's scaling claim: N writer shards ≈ N
     # independent group-commit engines (docs/modules/04 quotes this)
-    _log("bench 3/10: state shard-scaling sweep (write-heavy mix) ...")
+    _log("bench 3/11: state shard-scaling sweep (write-heavy mix) ...")
     shard_scaling = asyncio.run(run_shard_scaling_bench())
     _log("  -> " + ", ".join(
         f"shards={n}: {lane['ops_per_sec']} ops/s "
@@ -1448,7 +1710,7 @@ def main() -> None:
 
     # the chaos gate's "free when off" claim, measured on the same
     # write-heavy path (docs/modules/16-chaos.md quotes this number)
-    _log("bench 4/10: chaos-gate overhead on the write-heavy state path ...")
+    _log("bench 4/11: chaos-gate overhead on the write-heavy state path ...")
     chaos_overhead = asyncio.run(run_chaos_overhead_bench())
     _log(f"  -> gate-off {chaos_overhead['gate_off_overhead_pct']:+.2f}% vs "
          f"baseline {chaos_overhead['baseline_ops_per_sec']} ops/s, "
@@ -1456,7 +1718,7 @@ def main() -> None:
 
     # the latency-histogram instrumentation's "free when off, cheap when
     # on" claim on the same two hot paths (docs/modules/08 quotes this)
-    _log("bench 5/10: histogram overhead (state write + publish/deliver) ...")
+    _log("bench 5/11: histogram overhead (state write + publish/deliver) ...")
     hist_overhead = asyncio.run(run_histogram_overhead_bench())
     _hs = hist_overhead["state_write"]
     _hp = hist_overhead["publish_deliver"]
@@ -1466,7 +1728,7 @@ def main() -> None:
     # the overload-protection loop's two numbers: the admission gate is
     # free when off (<1% bar, docs module 09 quotes this) and the full
     # shed -> scale out -> recover trajectory holds end to end
-    _log("bench 6/10: admission-gate overhead + chaos overload drill ...")
+    _log("bench 6/11: admission-gate overhead + chaos overload drill ...")
     admission_overhead = asyncio.run(run_admission_overhead_bench())
     _log(f"  -> gate-off {admission_overhead['gate_off_overhead_pct']:+.2f}% "
          f"vs baseline {admission_overhead['baseline_req_per_sec']} req/s, "
@@ -1478,7 +1740,19 @@ def main() -> None:
          f"{overload_drill['recovered_to_min']}, lost acked keys "
          f"{len(overload_drill['lost_acked_keys'])}")
 
-    _log("bench 7/10: cross-process write path (faithful [PB] topology) ...")
+    # the virtual-actor runtime's three numbers: turn throughput, the
+    # crash-failover drill (zero lost acked turns + reminder refire),
+    # and the gate-off sidecar ingress overhead (docs module 18 / the
+    # acceptance bar: <1% when TASKSRUNNER_ACTORS is unset)
+    _log("bench 7/11: virtual actors (turns, failover, gate-off ingress) ...")
+    actor_bench = asyncio.run(run_actor_bench())
+    _log(f"  -> {actor_bench['turns']['turns_per_sec_64_actors']} turns/s, "
+         f"failover {actor_bench['failover']['failover_ms']:.0f} ms, "
+         f"lost acked turns {actor_bench['failover']['lost_acked_turns']}, "
+         f"ingress gate-off "
+         f"{actor_bench['ingress']['gate_off_overhead_pct']:+.2f}% (bar <1%)")
+
+    _log("bench 8/11: cross-process write path (faithful [PB] topology) ...")
     xproc = asyncio.run(run_xproc(latency_probe=True, rounds=5))
     _log(f"  -> {xproc['throughput']} tasks/s, "
          f"p50 {xproc['p50_ms']} ms, p99 {xproc['p99_ms']} ms (conc=8)")
@@ -1487,7 +1761,7 @@ def main() -> None:
     # workload certs, every peer hop on the authenticated mesh lane —
     # module 15 quotes this delta instead of recommending an unmeasured
     # configuration
-    _log("bench 8/10: cross-process write path under mesh mTLS ...")
+    _log("bench 9/11: cross-process write path under mesh mTLS ...")
     # same rounds as the plaintext headline — an asymmetric pair would
     # bake an ordering/averaging confound into the published delta
     mtls = asyncio.run(run_xproc(latency_probe=True, rounds=5,
@@ -1510,7 +1784,7 @@ def main() -> None:
     # reference processor's SendGrid call) consumers are the
     # bottleneck; 5 competing replicas vs 1 shows the KEDA-style
     # scale-out actually scaling (SURVEY.md §5.8)
-    _log("bench 9/10: competing-consumer scale-out (20 ms work/message) ...")
+    _log("bench 10/11: competing-consumer scale-out (20 ms work/message) ...")
     one = asyncio.run(run_xproc(n_tasks=300, n_processors=1, rounds=2,
                                 work_ms=20.0))
     five = asyncio.run(run_xproc(n_tasks=300, n_processors=5, rounds=2,
@@ -1519,7 +1793,7 @@ def main() -> None:
     _log(f"  -> 1 replica: {one['throughput']} tasks/s; "
          f"5 replicas: {five['throughput']} tasks/s ({speedup}x)")
 
-    _log("bench 10/10: in-process cluster (round-1 continuity) ...")
+    _log("bench 11/11: in-process cluster (round-1 continuity) ...")
     inproc = asyncio.run(run_inproc())
     _log(f"  -> {inproc} tasks/s")
 
@@ -1580,6 +1854,7 @@ def main() -> None:
             "histogram_overhead": hist_overhead,
             "admission_overhead": admission_overhead,
             "overload_drill": overload_drill,
+            "actor_bench": actor_bench,
             "ml_extension_tpu": tpu,
             **({} if tpu else {"ml_extension_note":
                 "chip bench skipped (no TPU reachable within the "

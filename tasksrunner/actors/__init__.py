@@ -1,0 +1,15 @@
+"""Virtual actors: single-owner placement, turns, durable reminders.
+
+The missing Dapr building block (ROADMAP open item 2): an *actor* is a
+named unit of state + behavior (``("Counter", "user-7")``) that the
+runtime materializes on exactly one replica at a time. Apps register a
+turn handler per actor type with ``@app.actor("Counter")``; clients
+call ``client.invoke_actor(...)`` and never learn (or care) where the
+actor lives. See docs/modules/10-actors.md for the model, guarantees,
+and failure semantics; gated by ``TASKSRUNNER_ACTORS`` (off).
+"""
+
+from tasksrunner.actors.turn import ActorTurn
+from tasksrunner.actors.runtime import ActorRuntime
+
+__all__ = ["ActorRuntime", "ActorTurn"]

@@ -1,0 +1,774 @@
+"""The per-replica virtual-actor runtime: placement, turns, reminders.
+
+Three durable records per actor, all in the app's actor state store
+(single-key etag-guarded writes, so they stay atomic on the sharded
+state plane — a record never spans shards):
+
+* ``actor-rec||{type}||{id}`` — ``{"epoch", "data", "reminders"}``.
+  The actor's state AND its reminder table in one record: a turn's
+  state writes and its reminder changes commit in one etag-guarded
+  ``set``, atomically with the turn.
+* ``actor-place||{type}||{id}`` — the placement entry: owner identity
+  (replica token, pid, host, sidecar port, registration time), the
+  fencing epoch, and the lease expiry. Exactly one owner per actor id;
+  everyone else forwards.
+* ``actor-index||{type}`` — the id directory the failover sweep scans.
+
+**Fencing.** Every ownership acquisition bumps the epoch with an
+etag-guarded write to the actor record, which invalidates the previous
+owner's cached etag. A zombie — a replica that lost its lease mid-turn
+or crashed without releasing it — therefore fails its next commit with
+``EtagMismatch``, surfaced as :class:`ActorFencedError`; the turn was
+never acked, so the caller retries against the new owner. Acks happen
+strictly after the commit resolves: a 2xx-acked turn is durable, full
+stop. Ownership races (two replicas acquiring concurrently) are
+likewise resolved by the etag chain — at most one of any two
+conflicting commits can land.
+
+**Liveness.** An owner is considered dead when its lease expired OR
+``NameResolver.local_pid_dead`` says so — the ``/proc`` starttime
+check closes the pid-recycling window, so a recycled pid cannot
+impersonate a dead owner, and a live-but-wedged owner is still fenced
+out once its lease lapses. No ghost passes both tests.
+
+**Reminders.** Durable, re-armed on ownership acquisition: the sweep
+loop (``TASKSRUNNER_ACTOR_REMINDER_POLL_SECONDS``) renews leases for
+owned actors, fires due reminders (the due-time update commits in the
+same record write as the handler's state changes — exactly-once per
+schedule at the state level), and adopts actors with reminders whose
+owner died, which is what makes failover automatic rather than
+operator-driven.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import itertools
+import json
+import logging
+import os
+import time
+from typing import Any
+
+from tasksrunner.errors import (
+    ActorError,
+    ActorFencedError,
+    ActorNotRegistered,
+    EtagMismatch,
+    TasksRunnerError,
+)
+from tasksrunner.invoke.resolver import NameResolver
+from tasksrunner.observability.metrics import metrics
+from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER
+
+logger = logging.getLogger(__name__)
+
+#: in-process forwarding table (replica token → ActorRuntime): an
+#: InProcCluster's replicas route turns to each other through here;
+#: hosted replicas advertise a sidecar address in the placement record
+#: instead. A crashed runtime removes itself — exactly like a dead
+#: process stops answering its port.
+_LOCAL_REPLICAS: dict[str, "ActorRuntime"] = {}
+
+_REPLICA_SEQ = itertools.count()
+
+DEFAULT_LEASE_SECONDS = 30.0
+DEFAULT_POLL_SECONDS = 2.0
+DEFAULT_TURN_TIMEOUT = 30.0
+
+
+def _env_seconds(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r; using %s", name, raw, default)
+        return default
+
+
+def record_key(actor_type: str, actor_id: str) -> str:
+    return f"actor-rec||{actor_type}||{actor_id}"
+
+
+def place_key(actor_type: str, actor_id: str) -> str:
+    return f"actor-place||{actor_type}||{actor_id}"
+
+
+def index_key(actor_type: str) -> str:
+    return f"actor-index||{actor_type}"
+
+
+class _Activation:
+    """One locally-owned actor: its turn lock and cached etags."""
+
+    __slots__ = ("lock", "etag", "place_etag", "epoch", "data",
+                 "reminders", "lease_expires")
+
+    def __init__(self, *, etag: str, place_etag: str, epoch: int,
+                 data: dict, reminders: dict, lease_expires: float):
+        self.lock = asyncio.Lock()  # turns run one-at-a-time per actor
+        self.etag = etag
+        self.place_etag = place_etag
+        self.epoch = epoch
+        self.data = data
+        self.reminders = reminders
+        self.lease_expires = lease_expires
+
+
+class ActorRuntime:
+    """Everything actor-shaped on one replica. Built by
+    ``Runtime.start()`` when ``TASKSRUNNER_ACTORS`` is on and the app
+    registered at least one ``@app.actor`` handler; absent otherwise,
+    so the gate-off path carries no per-request cost."""
+
+    def __init__(self, runtime: Any, actor_types: list[str], *,
+                 store_name: str | None = None,
+                 crash_on_chaos: bool = False):
+        self.runtime = runtime
+        self.types = sorted(actor_types)
+        self.store = store_name or self._pick_store()
+        self.lease_seconds = _env_seconds(
+            "TASKSRUNNER_ACTOR_LEASE_SECONDS", DEFAULT_LEASE_SECONDS)
+        self.poll_seconds = _env_seconds(
+            "TASKSRUNNER_ACTOR_REMINDER_POLL_SECONDS", DEFAULT_POLL_SECONDS)
+        self.turn_timeout = _env_seconds(
+            "TASKSRUNNER_ACTOR_TURN_TIMEOUT_SECONDS", DEFAULT_TURN_TIMEOUT)
+        #: drill switch: a chaos fault injected into a turn also kills
+        #: this runtime (stops renewals, leaves leases dangling) so a
+        #: seeded crashEveryN rule exercises real crash-failover —
+        #: see the chaos drill in tests/test_actors.py and module 16
+        self.crash_on_chaos = crash_on_chaos
+        self.crashed = False
+        self.replica_id = (f"{runtime.app_id or 'app'}"
+                           f"@{os.getpid()}.{next(_REPLICA_SEQ)}")
+        self._registered_at = time.time()
+        self._activations: dict[tuple[str, str], _Activation] = {}
+        self._sweep_task: asyncio.Task | None = None
+        self._session = None  # outbound forwards to peer sidecars
+        self._rec_turn: dict[str, Any] = {}
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def start(self) -> None:
+        _LOCAL_REPLICAS[self.replica_id] = self
+        self._sweep_task = asyncio.create_task(self._sweep_loop())
+        logger.info("actor runtime %s hosting %s (lease %.1fs, poll %.1fs)",
+                    self.replica_id, self.types, self.lease_seconds,
+                    self.poll_seconds)
+
+    async def stop(self) -> None:
+        """Graceful shutdown: release every lease (keeping the epoch,
+        so the next owner still fences above us) — failover after a
+        clean stop is immediate, not lease-expiry-bounded."""
+        if self._sweep_task is not None:
+            self._sweep_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError):
+                await self._sweep_task
+            self._sweep_task = None
+        _LOCAL_REPLICAS.pop(self.replica_id, None)
+        now = time.time()
+        for (atype, aid), act in list(self._activations.items()):
+            release = {"owner": self._identity(), "epoch": act.epoch,
+                       "lease_expires": 0.0, "granted_at": now,
+                       "released": True}
+            try:
+                await self.runtime.save_state_item(
+                    self.store, place_key(atype, aid), release,
+                    etag=act.place_etag)
+            except TasksRunnerError:
+                pass  # already re-placed — nothing to release
+        self._activations.clear()
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    def simulate_crash(self) -> None:
+        """Test/drill hook: die the way SIGKILL dies — stop sweeping,
+        stop answering, release NOTHING. Leases dangle until expiry;
+        in-flight turns keep running and hit the fence at commit (the
+        zombie scenario the epoch exists for)."""
+        self.crashed = True
+        _LOCAL_REPLICAS.pop(self.replica_id, None)
+        if self._sweep_task is not None:
+            self._sweep_task.cancel()
+            self._sweep_task = None
+
+    # -- store / identity ------------------------------------------------
+
+    def _pick_store(self) -> str:
+        """The Dapr convention: the state component marked with
+        metadata ``actorStateStore: "true"`` holds actor state; fall
+        back to a component named ``statestore``, then to the only
+        state component if there is exactly one."""
+        names = list(self.runtime.registry.names(block="state"))
+        for name in names:
+            raw = self.runtime.registry.spec(name).metadata.get("actorStateStore")
+            if str(raw).lower() == "true":
+                return name
+        if "statestore" in names:
+            return "statestore"
+        if len(names) == 1:
+            return names[0]
+        raise ActorError(
+            "no actor state store: mark one state component with "
+            'metadata actorStateStore: "true" '
+            f"(state components: {names or 'none'})")
+
+    def _identity(self) -> dict:
+        addr = getattr(self.runtime, "actor_address", None)
+        return {
+            "replica": self.replica_id,
+            "app_id": self.runtime.app_id,
+            "host": addr[0] if addr else "127.0.0.1",
+            "sidecar_port": addr[1] if addr else None,
+            "pid": os.getpid(),
+            "registered_at": self._registered_at,
+        }
+
+    @staticmethod
+    def owner_dead(place_doc: dict, now: float | None = None) -> bool:
+        """The takeover predicate: lease expired, or the owner's pid is
+        provably gone. ``local_pid_dead`` includes the /proc starttime
+        pid-recycling check, so a recycled pid cannot keep a dead
+        owner's lease alive — and a live owner inside its lease is
+        never preempted, however wedged it looks."""
+        now = time.time() if now is None else now
+        if float(place_doc.get("lease_expires", 0.0)) <= now:
+            return True
+        owner = place_doc.get("owner") or {}
+        return NameResolver.local_pid_dead(
+            owner.get("host"), owner.get("pid"), owner.get("registered_at"))
+
+    # -- public operations -----------------------------------------------
+
+    async def invoke_turn(self, actor_type: str, actor_id: str, method: str,
+                          data: Any = None, *, forwarded: bool = False) -> Any:
+        """Run one turn; returns the handler's result AFTER the turn's
+        state commit resolved (the ack-after-commit contract)."""
+        act_or_route = await self._resolve_owner(actor_type, actor_id,
+                                                 forwarded=forwarded)
+        if not isinstance(act_or_route, _Activation):
+            return await self._forward_turn(act_or_route, actor_type,
+                                            actor_id, method, data)
+        return await self._execute_turn(
+            act_or_route, actor_type, actor_id, method=method, data=data,
+            kind="turn", reminder_name=None)
+
+    async def register_reminder(self, actor_type: str, actor_id: str,
+                                name: str, *, due_seconds: float,
+                                period_seconds: float | None = None,
+                                data: Any = None,
+                                forwarded: bool = False) -> None:
+        """Persist a reminder beside the actor's state (same record,
+        same etag-guarded commit). Re-registering replaces."""
+        target = await self._resolve_owner(actor_type, actor_id,
+                                           forwarded=forwarded)
+        if not isinstance(target, _Activation):
+            body = {"dueSeconds": due_seconds,
+                    "periodSeconds": period_seconds, "data": data}
+            await self._forward_reminder(target, actor_type, actor_id, name,
+                                         "POST", body)
+            return
+        act = target
+        async with act.lock:
+            reminders = dict(act.reminders)
+            reminders[name] = {"due": time.time() + max(0.0, due_seconds),
+                               "period": period_seconds, "data": data}
+            await self._commit(act, actor_type, actor_id,
+                               new_data=act.data, new_reminders=reminders)
+
+    async def unregister_reminder(self, actor_type: str, actor_id: str,
+                                  name: str, *, forwarded: bool = False) -> None:
+        target = await self._resolve_owner(actor_type, actor_id,
+                                           forwarded=forwarded)
+        if not isinstance(target, _Activation):
+            await self._forward_reminder(target, actor_type, actor_id, name,
+                                         "DELETE", None)
+            return
+        act = target
+        async with act.lock:
+            if name not in act.reminders:
+                return
+            reminders = dict(act.reminders)
+            reminders.pop(name)
+            await self._commit(act, actor_type, actor_id,
+                               new_data=act.data, new_reminders=reminders)
+
+    async def read_state(self, actor_type: str, actor_id: str) -> dict:
+        """Diagnostic read of the durable record (any replica may
+        serve it — it is a plain state read, not a turn)."""
+        item = await self.runtime.get_state(
+            self.store, record_key(actor_type, actor_id))
+        if item is None:
+            return {"epoch": 0, "data": {}, "reminders": {}}
+        return item.value
+
+    # -- ownership resolution --------------------------------------------
+
+    async def _resolve_owner(self, actor_type: str, actor_id: str, *,
+                             forwarded: bool):
+        if self.crashed:
+            raise ActorError(
+                f"actor runtime {self.replica_id} is down (crashed)")
+        if actor_type not in self.types:
+            raise ActorNotRegistered(
+                f"no actor type {actor_type!r} on app "
+                f"{self.runtime.app_id!r} (hosted: {self.types})")
+        act = self._activations.get((actor_type, actor_id))
+        if act is not None:
+            if act.lease_expires > time.time():
+                return act
+            # our lease lapsed (a stalled sweep, a paused process):
+            # drop the activation and re-walk placement — if nobody
+            # took over we re-acquire (bumping OUR own epoch, which is
+            # harmless); if somebody did, we forward
+            self._deactivate(actor_type, actor_id)
+        return await self._activate(actor_type, actor_id, forwarded=forwarded)
+
+    async def _activate(self, actor_type: str, actor_id: str, *,
+                        forwarded: bool):
+        """Walk the placement table: forward to a live owner, or take
+        (or retake) ownership — bumping the fencing epoch — when the
+        record is free, released, or its owner is dead."""
+        for _ in range(4):
+            now = time.time()
+            place = await self.runtime.get_state(
+                self.store, place_key(actor_type, actor_id))
+            takeover = False
+            if place is not None:
+                doc = place.value
+                owner = doc.get("owner") or {}
+                if owner.get("replica") != self.replica_id:
+                    if not self.owner_dead(doc, now):
+                        if forwarded:
+                            # hop guard: a forwarded call never forwards
+                            # again — placement moved mid-flight, the
+                            # origin retries against the fresh table
+                            raise ActorError(
+                                f"actor {actor_type}/{actor_id} moved "
+                                "while forwarding; retry")
+                        return doc
+                    takeover = not doc.get("released", False)
+                epoch = int(doc.get("epoch", 0)) + 1
+                place_etag = place.etag
+            else:
+                epoch = 1
+                place_etag = None
+            lease_expires = now + self.lease_seconds
+            new_place = {"owner": self._identity(), "epoch": epoch,
+                         "lease_expires": lease_expires, "granted_at": now}
+            try:
+                new_place_etag = await self.runtime.save_state_item(
+                    self.store, place_key(actor_type, actor_id), new_place,
+                    etag=place_etag)
+            except EtagMismatch:
+                continue  # lost the race — re-read and re-decide
+            if place_etag is None:
+                # first-activation create is unguarded (no etag to CAS
+                # on), so two replicas can both "win" the write. Read
+                # back: the store's last write is the truth.
+                check = await self.runtime.get_state(
+                    self.store, place_key(actor_type, actor_id))
+                if check is None or (check.value.get("owner") or {}).get(
+                        "replica") != self.replica_id:
+                    continue
+                new_place_etag = check.etag
+            act = await self._fence_record(actor_type, actor_id, epoch,
+                                           new_place_etag, lease_expires)
+            if act is None:
+                continue
+            await self._index_add(actor_type, actor_id)
+            self._activations[(actor_type, actor_id)] = act
+            if takeover:
+                metrics.inc("actor_failover_total", type=actor_type)
+                logger.warning("actor %s/%s failed over to %s (epoch %d)",
+                               actor_type, actor_id, self.replica_id, epoch)
+            return act
+        raise ActorError(
+            f"could not place actor {actor_type}/{actor_id}: placement "
+            "contention; retry")
+
+    async def _fence_record(self, actor_type: str, actor_id: str, epoch: int,
+                            place_etag: str, lease_expires: float):
+        """Write the new epoch into the actor record BEFORE serving any
+        turn. This is the fence: it rotates the record's etag, so every
+        etag the previous owner cached is now stale and its in-flight
+        commit lands in :class:`ActorFencedError` instead of state."""
+        rec = await self.runtime.get_state(
+            self.store, record_key(actor_type, actor_id))
+        for _ in range(4):
+            if rec is None:
+                value = {"epoch": epoch, "data": {}, "reminders": {}}
+                etag = None
+            else:
+                value = dict(rec.value)
+                value["epoch"] = epoch
+                etag = rec.etag
+            try:
+                new_etag = await self.runtime.save_state_item(
+                    self.store, record_key(actor_type, actor_id), value,
+                    etag=etag)
+            except EtagMismatch:
+                # a zombie's last commit slipped in between our read
+                # and our bump — legitimate (it still held the etag
+                # chain); absorb its write and fence on top of it
+                rec = await self.runtime.get_state(
+                    self.store, record_key(actor_type, actor_id))
+                continue
+            return _Activation(
+                etag=new_etag, place_etag=place_etag, epoch=epoch,
+                data=value.get("data") or {},
+                reminders=value.get("reminders") or {},
+                lease_expires=lease_expires)
+        return None
+
+    async def _index_add(self, actor_type: str, actor_id: str) -> None:
+        key = index_key(actor_type)
+        for _ in range(8):
+            item = await self.runtime.get_state(self.store, key)
+            ids = list((item.value.get("ids") or [])) if item is not None else []
+            if actor_id in ids:
+                return
+            doc = {"ids": sorted({*ids, actor_id})}
+            try:
+                await self.runtime.save_state_item(
+                    self.store, key, doc,
+                    etag=item.etag if item is not None else None)
+            except EtagMismatch:
+                continue
+            if item is not None:
+                return
+            # unguarded create: verify a concurrent creator didn't
+            # overwrite us, else loop and merge into their record
+            check = await self.runtime.get_state(self.store, key)
+            if check is not None and actor_id in (check.value.get("ids") or []):
+                return
+        logger.warning("actor index %s: gave up adding %s under contention",
+                       actor_type, actor_id)
+
+    async def _index_ids(self, actor_type: str) -> list[str]:
+        item = await self.runtime.get_state(self.store, index_key(actor_type))
+        if item is None:
+            return []
+        return list(item.value.get("ids") or [])
+
+    def _deactivate(self, actor_type: str, actor_id: str) -> None:
+        self._activations.pop((actor_type, actor_id), None)
+
+    # -- turn execution --------------------------------------------------
+
+    def _chaos_policy(self, actor_type: str):
+        chaos = getattr(self.runtime, "chaos", None)
+        if chaos is None:
+            return None
+        return chaos.for_actor(actor_type)
+
+    async def _execute_turn(self, act: _Activation, actor_type: str,
+                            actor_id: str, *, method: str, data: Any,
+                            kind: str, reminder_name: str | None) -> Any:
+        rec_latency = self._rec_turn.get(actor_type)
+        if rec_latency is None:
+            rec_latency = self._rec_turn[actor_type] = metrics.recorder(
+                "actor_turn_latency_seconds", type=actor_type)
+        async with act.lock:
+            started = time.perf_counter()
+            policy = self._chaos_policy(actor_type)
+            if policy is not None:
+                # the fault fires HERE, on the owning replica, inside
+                # the turn — which is what lets a crashEveryN rule
+                # target "whoever currently owns this actor type"
+                try:
+                    status = await policy.before_call()
+                except BaseException:
+                    if self.crash_on_chaos:
+                        self.simulate_crash()
+                    metrics.inc("actor_turns_total", type=actor_type,
+                                status="chaos")
+                    raise
+                if status is not None:
+                    policy.raise_for_status(status)
+            payload = json.dumps({
+                "data": data, "state": act.data, "kind": kind,
+                "reminder": reminder_name,
+            }).encode()
+            try:
+                status, _, body = await asyncio.wait_for(
+                    self.runtime.app_channel.request(
+                        "PUT",
+                        f"/tasksrunner/actors/{actor_type}/{actor_id}/{method}",
+                        headers={"content-type": "application/json"},
+                        body=payload),
+                    timeout=self.turn_timeout)
+            except asyncio.TimeoutError:
+                metrics.inc("actor_turns_total", type=actor_type,
+                            status="timeout")
+                raise ActorError(
+                    f"actor {actor_type}/{actor_id}.{method} exceeded the "
+                    f"{self.turn_timeout}s turn timeout "
+                    "(TASKSRUNNER_ACTOR_TURN_TIMEOUT_SECONDS)") from None
+            if status >= 300:
+                metrics.inc("actor_turns_total", type=actor_type,
+                            status="error")
+                detail = body[:200].decode("utf-8", "replace")
+                raise ActorError(
+                    f"actor {actor_type}/{actor_id}.{method} failed "
+                    f"({status}): {detail}")
+            doc = json.loads(body) if body else {}
+            new_state = doc.get("state")
+            if not isinstance(new_state, dict):
+                new_state = {}
+            reminders = dict(act.reminders)
+            if kind == "reminder" and reminder_name is not None:
+                rem = reminders.get(reminder_name)
+                if rem is not None:
+                    if rem.get("period"):
+                        rem = dict(rem)
+                        rem["due"] = time.time() + float(rem["period"])
+                        reminders[reminder_name] = rem
+                    else:
+                        reminders.pop(reminder_name)
+            await self._commit(act, actor_type, actor_id,
+                               new_data=new_state, new_reminders=reminders)
+            rec_latency(time.perf_counter() - started)
+            metrics.inc("actor_turns_total", type=actor_type, status="ok")
+            if kind == "reminder":
+                metrics.inc("actor_reminder_fired_total", type=actor_type)
+            return doc.get("result")
+
+    async def _commit(self, act: _Activation, actor_type: str,
+                      actor_id: str, *, new_data: dict,
+                      new_reminders: dict) -> None:
+        """The only writer of the actor record — etag-guarded, called
+        with the turn lock held. Success is the precondition for the
+        ack; EtagMismatch means we were fenced."""
+        record = {"epoch": act.epoch, "data": new_data,
+                  "reminders": new_reminders}
+        try:
+            act.etag = await self.runtime.save_state_item(
+                self.store, record_key(actor_type, actor_id), record,
+                etag=act.etag)
+        except EtagMismatch as exc:
+            self._deactivate(actor_type, actor_id)
+            metrics.inc("actor_fenced_total", type=actor_type)
+            metrics.inc("actor_turns_total", type=actor_type, status="fenced")
+            raise ActorFencedError(
+                f"actor {actor_type}/{actor_id}: commit fenced — a newer "
+                f"owner bumped past epoch {act.epoch}; this turn was NOT "
+                "applied (retry against the new owner)") from exc
+        act.data = new_data
+        act.reminders = new_reminders
+
+    # -- forwarding ------------------------------------------------------
+
+    async def _forward_turn(self, owner: dict, actor_type: str,
+                            actor_id: str, method: str, data: Any) -> Any:
+        peer = _LOCAL_REPLICAS.get((owner.get("owner") or {}).get("replica"))
+        odoc = owner.get("owner") or {}
+        if peer is not None:
+            return await peer.invoke_turn(actor_type, actor_id, method, data,
+                                          forwarded=True)
+        if odoc.get("sidecar_port"):
+            path = (f"/v1.0/actors/{actor_type}/{actor_id}"
+                    f"/method/{method}")
+            status, body = await self._http_forward(
+                odoc, "PUT", path, None if data is None else data)
+            if status == 409:
+                raise ActorFencedError(
+                    f"actor {actor_type}/{actor_id}: owner fenced the "
+                    "forwarded turn; retry")
+            if status >= 300:
+                raise ActorError(
+                    f"forwarded turn to {odoc.get('replica')} failed "
+                    f"({status}): {body[:200].decode('utf-8', 'replace')}")
+            doc = json.loads(body) if body else {}
+            return doc.get("result")
+        raise ActorError(
+            f"actor {actor_type}/{actor_id} is owned by "
+            f"{odoc.get('replica')!r} which is unreachable from here; "
+            "retry (ownership moves when its lease expires)")
+
+    async def _forward_reminder(self, owner: dict, actor_type: str,
+                                actor_id: str, name: str, http_method: str,
+                                body: Any) -> None:
+        odoc = owner.get("owner") or {}
+        peer = _LOCAL_REPLICAS.get(odoc.get("replica"))
+        if peer is not None:
+            if http_method == "POST":
+                await peer.register_reminder(
+                    actor_type, actor_id, name,
+                    due_seconds=body["dueSeconds"],
+                    period_seconds=body.get("periodSeconds"),
+                    data=body.get("data"), forwarded=True)
+            else:
+                await peer.unregister_reminder(actor_type, actor_id, name,
+                                               forwarded=True)
+            return
+        if odoc.get("sidecar_port"):
+            path = f"/v1.0/actors/{actor_type}/{actor_id}/reminders/{name}"
+            status, resp = await self._http_forward(odoc, http_method, path, body)
+            if status >= 300:
+                raise ActorError(
+                    f"forwarded reminder op to {odoc.get('replica')} failed "
+                    f"({status}): {resp[:200].decode('utf-8', 'replace')}")
+            return
+        raise ActorError(
+            f"actor {actor_type}/{actor_id} is owned by "
+            f"{odoc.get('replica')!r} which is unreachable from here; retry")
+
+    async def _http_forward(self, owner: dict, http_method: str, path: str,
+                            body: Any) -> tuple[int, bytes]:
+        if self._session is None:
+            import aiohttp
+            self._session = aiohttp.ClientSession()
+        headers = {"content-type": "application/json",
+                   "x-tasksrunner-actor-forward": "1"}
+        token = os.environ.get(TOKEN_ENV)
+        if token:
+            headers[TOKEN_HEADER] = token
+        url = (f"http://{owner.get('host')}:{owner.get('sidecar_port')}{path}")
+        try:
+            async with self._session.request(
+                    http_method, url, headers=headers,
+                    data=None if body is None else json.dumps(body)) as resp:
+                return resp.status, await resp.read()
+        except OSError as exc:
+            raise ActorError(
+                f"owner sidecar unreachable at {url}: {exc} "
+                "(retry; ownership moves when its lease expires)") from exc
+
+    # -- sweep: lease renewal, reminders, failover -----------------------
+
+    async def _sweep_loop(self) -> None:
+        while not self.crashed:
+            await asyncio.sleep(self.poll_seconds)
+            try:
+                await self.sweep()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # tasklint: disable=error-taxonomy (sweep)
+                logger.exception("actor sweep failed on %s", self.replica_id)
+
+    async def sweep(self) -> dict:
+        """One control-loop pass. Exposed for tests and the drill, so
+        they can step the loop deterministically instead of sleeping."""
+        stats = {"renewed": 0, "fired": 0, "adopted": 0}
+        now = time.time()
+        # 1. renew leases on everything we own; losing the CAS means a
+        # new owner fenced us while we slept — drop the activation
+        for (atype, aid), act in list(self._activations.items()):
+            if self.crashed:
+                return stats
+            renewal = {"owner": self._identity(), "epoch": act.epoch,
+                       "lease_expires": now + self.lease_seconds,
+                       "granted_at": now}
+            try:
+                act.place_etag = await self.runtime.save_state_item(
+                    self.store, place_key(atype, aid), renewal,
+                    etag=act.place_etag)
+                act.lease_expires = now + self.lease_seconds
+                stats["renewed"] += 1
+            except EtagMismatch:
+                self._deactivate(atype, aid)
+        # 2. fire due reminders on owned actors
+        for (atype, aid), act in list(self._activations.items()):
+            if self.crashed:
+                return stats
+            stats["fired"] += await self._fire_due(atype, aid, act)
+        # 3. adopt actors with reminders whose owner is dead — the
+        # automatic-failover half of the durability story (actors
+        # without reminders re-place lazily, on their next invoke)
+        for atype in self.types:
+            for aid in await self._index_ids(atype):
+                if self.crashed:
+                    return stats
+                if (atype, aid) in self._activations:
+                    continue
+                place = await self.runtime.get_state(
+                    self.store, place_key(atype, aid))
+                if place is None or not self.owner_dead(place.value):
+                    continue
+                rec = await self.runtime.get_state(
+                    self.store, record_key(atype, aid))
+                if rec is None or not rec.value.get("reminders"):
+                    continue
+                try:
+                    adopted = await self._activate(atype, aid, forwarded=False)
+                except TasksRunnerError as exc:
+                    logger.warning("adopting %s/%s failed: %s", atype, aid, exc)
+                    continue
+                if isinstance(adopted, _Activation):
+                    stats["adopted"] += 1
+                    stats["fired"] += await self._fire_due(atype, aid, adopted)
+        counts: dict[str, int] = {}
+        for (atype, _aid) in self._activations:
+            counts[atype] = counts.get(atype, 0) + 1
+        for atype in self.types:
+            metrics.set_gauge("actor_owned", counts.get(atype, 0), type=atype)
+        return stats
+
+    async def _fire_due(self, actor_type: str, actor_id: str,
+                        act: _Activation) -> int:
+        fired = 0
+        now = time.time()
+        for name, rem in sorted(act.reminders.items()):
+            if float(rem.get("due", 0.0)) > now:
+                continue
+            try:
+                await self._execute_turn(
+                    act, actor_type, actor_id, method=name,
+                    data=rem.get("data"), kind="reminder",
+                    reminder_name=name)
+                fired += 1
+            except ActorFencedError:
+                return fired  # lost the actor mid-sweep; the new owner fires
+            except TasksRunnerError as exc:
+                # a failing handler must not wedge the sweep; the due
+                # time is unchanged, so it retries next pass
+                logger.warning("reminder %s on %s/%s failed: %s",
+                               name, actor_type, actor_id, exc)
+        return fired
+
+    # -- introspection ---------------------------------------------------
+
+    def summary(self) -> dict:
+        """Cheap local view for ``/v1.0/metadata`` and ``ps``."""
+        owned: dict[str, int] = {}
+        for (atype, _aid) in self._activations:
+            owned[atype] = owned.get(atype, 0) + 1
+        return {"types": self.types, "replica": self.replica_id,
+                "owned": owned, "crashed": self.crashed,
+                "lease_seconds": self.lease_seconds}
+
+    async def placement_table(self) -> list[dict]:
+        """The global placement table, rendered from the shared store
+        (any replica computes the same view). One row per actor id."""
+        rows: list[dict] = []
+        now = time.time()
+        for atype in self.types:
+            for aid in await self._index_ids(atype):
+                place = await self.runtime.get_state(
+                    self.store, place_key(atype, aid))
+                if place is None:
+                    continue
+                doc = place.value
+                owner = doc.get("owner") or {}
+                rows.append({
+                    "type": atype,
+                    "id": aid,
+                    "owner": owner.get("replica"),
+                    "owner_app": owner.get("app_id"),
+                    "host": owner.get("host"),
+                    "sidecar_port": owner.get("sidecar_port"),
+                    "pid": owner.get("pid"),
+                    "epoch": doc.get("epoch"),
+                    "lease_age": round(
+                        max(0.0, now - float(doc.get("granted_at", now))), 3),
+                    "lease_expires_in": round(
+                        float(doc.get("lease_expires", 0.0)) - now, 3),
+                    "alive": not self.owner_dead(doc, now),
+                    "owned_here": (atype, aid) in self._activations,
+                })
+        return rows

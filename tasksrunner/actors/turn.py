@@ -1,0 +1,35 @@
+"""The object an actor turn handler receives.
+
+Kept in its own module so ``tasksrunner.app`` can build turns without
+importing the actor runtime (which would cycle back through the
+runtime core).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class ActorTurn:
+    """One turn: the handler mutates ``state`` in place (or replaces
+    it) and returns a JSON-serializable result for the caller. The
+    owning replica commits ``state`` with an etag-guarded write AFTER
+    the handler returns — the turn is acked only once that commit
+    resolves, which is what makes an ack durable across a crash."""
+
+    actor_type: str
+    actor_id: str
+    #: invoked method name; for reminder turns this is the reminder name
+    method: str
+    data: Any = None
+    state: dict = field(default_factory=dict)
+    #: "turn" for client invocations, "reminder" for scheduled firings
+    kind: str = "turn"
+    #: reminder name when kind == "reminder"
+    reminder: str | None = None
+
+    @property
+    def is_reminder(self) -> bool:
+        return self.kind == "reminder"

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 from tasksrunner.analysis.rules import (  # noqa: F401
+    actors,
     blocking,
     coroutines,
     envflags,

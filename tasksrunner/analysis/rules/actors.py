@@ -1,0 +1,79 @@
+"""actor-turn-discipline — actor handlers stay async and hands-off.
+
+The actor runtime's zero-lost-acked-turns guarantee
+(``actors/runtime.py``) rests on two properties of the handler:
+
+* **turns are awaitable** — the runtime serializes turns per actor id
+  under an asyncio lock and bounds each with
+  ``TASKSRUNNER_ACTOR_TURN_TIMEOUT_SECONDS``. A synchronous handler
+  can't be timed out or interleaved; ``App.actor`` rejects it at
+  registration, and this rule rejects it at lint time so the mistake
+  never reaches a running host.
+* **state goes through the turn** — the handler mutates ``turn.state``
+  and the runtime commits it atomically with the turn under the
+  fencing etag. A handler that calls the state APIs directly
+  (``save_state`` / ``get_state`` / ...) writes OUTSIDE the fence:
+  a zombie replica replaying that turn would not get the
+  ``ActorFencedError`` the design depends on, and the write survives
+  even when the turn's own commit is rejected.
+
+Blocking calls inside handlers are already covered by
+``blocking-call-in-async`` once the handler is async; this rule makes
+sure it *is* async, and adds the store-API check on top.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from tasksrunner.analysis.core import FileContext, Finding, Rule, register
+
+#: Runtime/AppClient state-surface methods a turn handler must not call
+#: directly — state changes ride the turn commit or they break fencing.
+STATE_API_ATTRS = {
+    "save_state", "save_state_item", "get_state", "delete_state",
+    "get_bulk_state",
+}
+
+
+def _is_actor_decorator(dec: ast.expr) -> bool:
+    """``@app.actor("Type")`` — a call of an attribute named ``actor``."""
+    return (isinstance(dec, ast.Call)
+            and isinstance(dec.func, ast.Attribute)
+            and dec.func.attr == "actor")
+
+
+@register
+class ActorTurnDiscipline(Rule):
+    id = "actor-turn-discipline"
+    doc = ("actor turn handlers must be 'async def' and must not call "
+           "state APIs directly (mutate turn.state; the runtime commits "
+           "it under the fencing etag)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in self.walk(ctx):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not any(_is_actor_decorator(d) for d in node.decorator_list):
+                continue
+            if isinstance(node, ast.FunctionDef):
+                yield ctx.finding(
+                    self.id, node,
+                    f"actor turn handler {node.name!r} must be 'async def' "
+                    "— the runtime serializes and times out turns, which "
+                    "needs an awaitable")
+            yield from self._scan_body(ctx, node)
+
+    def _scan_body(self, ctx: FileContext,
+                   fn: ast.AST) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in STATE_API_ATTRS):
+                yield ctx.finding(
+                    self.id, node,
+                    f".{node.func.attr}() inside an actor turn handler "
+                    "writes outside the fencing etag — mutate turn.state "
+                    "instead; the runtime commits it atomically with the "
+                    "turn")

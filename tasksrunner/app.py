@@ -148,6 +148,8 @@ class App:
         self._static_mounts: list[tuple[str, Any]] = []
         self.subscriptions: list[SubscriptionEntry] = []
         self.binding_routes: list[BindingEntry] = []
+        #: actor type → turn handler, registered with @app.actor(...)
+        self.actors: dict[str, Handler] = {}
         self._startup_hooks: list[Callable[[], Awaitable[None]]] = []
         self._shutdown_hooks: list[Callable[[], Awaitable[None]]] = []
         #: set by the serving harness; the app's handle to its sidecar
@@ -281,6 +283,33 @@ class App:
 
         return register
 
+    def actor(self, actor_type: str):
+        """Register the turn handler for one actor type (≙ a Dapr actor
+        class). The handler receives an ``ActorTurn`` and runs with the
+        one-at-a-time guarantee: never two concurrent turns for the
+        same actor id, cluster-wide. It must be ``async def`` — a sync
+        handler would block the owning replica's event loop for every
+        actor it hosts (see the actor-turn-discipline lint rule)::
+
+            @app.actor("Counter")
+            async def counter(turn):
+                turn.state["n"] = turn.state.get("n", 0) + 1
+                return turn.state["n"]
+        """
+        def register(handler: Handler) -> Handler:
+            if not inspect.iscoroutinefunction(handler):
+                raise ValidationError(
+                    f"actor turn handlers must be 'async def' "
+                    f"({actor_type}: {getattr(handler, '__name__', handler)!r} "
+                    "is synchronous)")
+            if actor_type in self.actors:
+                raise ValidationError(
+                    f"actor type {actor_type!r} is already registered")
+            self.actors[actor_type] = handler
+            return handler
+
+        return register
+
     def on_startup(self, fn: Callable[[], Awaitable[None]]):
         self._startup_hooks.append(fn)
         return fn
@@ -354,6 +383,50 @@ class App:
         finally:
             self.inflight -= 1
 
+    async def _handle_actor(self, method: str, clean_path: str,
+                            body: bytes) -> Response:
+        """The sidecar-facing actor channel (reserved, like
+        /tasksrunner/subscribe): GET /tasksrunner/actors advertises the
+        hosted types; PUT /tasksrunner/actors/{type}/{id}/{method} runs
+        one turn. Only the OWNING replica's runtime calls the PUT — the
+        one-at-a-time lock is held there, not here."""
+        from tasksrunner.actors.turn import ActorTurn
+
+        if method.upper() == "GET" and clean_path == "/tasksrunner/actors":
+            return Response(body=sorted(self.actors))
+        parts = [p for p in clean_path.split("/") if p != ""]
+        # ["tasksrunner", "actors", type, id, method] — ids keep case
+        if method.upper() != "PUT" or len(parts) != 5:
+            return Response(status=404, body={
+                "error": f"no actor route for {method} {clean_path}"})
+        actor_type, actor_id, turn_method = parts[2], parts[3], parts[4]
+        handler = self.actors.get(actor_type)
+        if handler is None:
+            return Response(status=404, body={
+                "error": f"app {self.app_id!r} hosts no actor type "
+                         f"{actor_type!r}"})
+        doc = json.loads(body) if body else {}
+        turn = ActorTurn(
+            actor_type=actor_type, actor_id=actor_id, method=turn_method,
+            data=doc.get("data"), state=doc.get("state") or {},
+            kind=doc.get("kind") or "turn", reminder=doc.get("reminder"),
+        )
+        started = time.time()
+        try:
+            result = await handler(turn)
+            resp = Response(body={"state": turn.state, "result": result})
+        except TasksRunnerError as exc:
+            resp = Response(status=exc.http_status, body={"error": str(exc)})
+        except Exception:
+            logger.exception("actor turn %s/%s.%s failed",
+                             actor_type, actor_id, turn_method)
+            resp = Response(status=500, body={"error": "internal error"})
+        record_span(
+            kind="server", name=f"ACTOR {actor_type}/{actor_id}.{turn_method}",
+            status=resp.status, start=started, duration=time.time() - started,
+        )
+        return resp
+
     async def _handle(self, method: str, path: str, *, query: str = "",
                       headers: dict[str, str] | None = None,
                       body: bytes = b"") -> Response:
@@ -375,6 +448,8 @@ class App:
             return Response(status=204)
         if method.upper() == "GET" and clean_path == "/openapi.json":
             return Response(body=self.openapi())
+        if clean_path.startswith("/tasksrunner/actors"):
+            return await self._handle_actor(method, clean_path, body)
 
         if method.upper() in ("GET", "HEAD"):
             for mount_prefix, read_file in self._static_mounts:

@@ -163,6 +163,14 @@ class ChaosPolicies:
         """Faults applied to component operations on ``name``."""
         return self._resolve("components", name, direction)
 
+    def for_actor(self, actor_type: str) -> ChaosPolicy | None:
+        """Faults applied to actor turns of ``actor_type``. The actor
+        runtime consults this inside the OWNER's turn execution, so a
+        crashEveryN rule here deterministically fells whichever replica
+        currently owns the actor — placement-following by construction,
+        no replica targeting needed."""
+        return self._resolve("actors", actor_type, "turn")
+
     def _resolve(self, kind: str, name: str, direction: str) -> ChaosPolicy | None:
         cache_key = (kind, name, direction)
         if cache_key in self._cache:
@@ -171,6 +179,8 @@ class ChaosPolicies:
         for spec in self.specs:
             if kind == "apps":
                 refs = spec.app_targets.get(name)
+            elif kind == "actors":
+                refs = spec.actor_targets.get(name)
             else:
                 refs = (spec.component_targets.get(name) or {}).get(direction)
             if not refs:
@@ -202,6 +212,10 @@ class ChaosPolicies:
                     f"components/{comp}/{direction}"
                     for comp, dirs in spec.component_targets.items()
                     for direction, refs in dirs.items()
+                    if rule.name in refs
+                ] + [
+                    f"actors/{atype}/turn"
+                    for atype, refs in spec.actor_targets.items()
                     if rule.name in refs
                 ]
                 out.append({

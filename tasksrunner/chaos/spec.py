@@ -33,6 +33,8 @@ are inert unless the host runs with ``TASKSRUNNER_CHAOS=1``.
             outbound: [slowStore, flakyStore]
           taskspubsub:
             inbound: [poison]
+        actors:
+          Counter: [poison]
 
 Each named fault carries exactly one fault kind:
 
@@ -142,6 +144,11 @@ class ChaosSpec:
     #: component → direction → rule names
     component_targets: dict[str, dict[str, tuple[str, ...]]] = field(
         default_factory=dict)
+    #: actor type → rule names, injected inside the owning replica's
+    #: turn execution — by construction the fault always hits the
+    #: CURRENT owner, wherever placement moved it (the failover drill's
+    #: crash-the-owner primitive)
+    actor_targets: dict[str, tuple[str, ...]] = field(default_factory=dict)
 
     def in_scope(self, app_id: str | None) -> bool:
         if not self.scopes or app_id is None:
@@ -251,6 +258,10 @@ def parse_chaos(doc: Mapping[str, Any], *, source: str | None = None) -> ChaosSp
                 f"{where}: component target {comp!r} needs an 'outbound' "
                 "or 'inbound' direction")
         component_targets[str(comp)] = directions
+    actor_targets = {
+        str(atype): _parse_rule_refs(raw, where=where, target=str(atype))
+        for atype, raw in (targets.get("actors") or {}).items()
+    }
 
     scopes = doc.get("scopes") or []
     if not isinstance(scopes, list) or not all(isinstance(s, str) for s in scopes):
@@ -258,7 +269,7 @@ def parse_chaos(doc: Mapping[str, Any], *, source: str | None = None) -> ChaosSp
 
     # dangling rule references fail at load time, like the Resiliency
     # loader: a typo must fail startup, not silently inject nothing
-    all_refs = list(app_targets.items()) + [
+    all_refs = list(app_targets.items()) + list(actor_targets.items()) + [
         (comp, ref)
         for comp, dirs in component_targets.items()
         for ref in dirs.values()
@@ -277,6 +288,7 @@ def parse_chaos(doc: Mapping[str, Any], *, source: str | None = None) -> ChaosSp
         rules=rules,
         app_targets=app_targets,
         component_targets=component_targets,
+        actor_targets=actor_targets,
     )
 
 

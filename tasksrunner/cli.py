@@ -385,7 +385,8 @@ def _cmd_ps(args) -> None:
                 return [{"app_id": app_id, "pid": None, "app_port": None,
                          "sidecar_port": None, "host": None,
                          "up_seconds": None, "health": "gone",
-                         "components": None, "subscriptions": None}]
+                         "components": None, "subscriptions": None,
+                         "actors": None}]
             return await asyncio.gather(
                 *(probe(s, app_id, addr, idx, len(replicas))
                   for idx, addr in enumerate(replicas)))
@@ -406,6 +407,7 @@ def _cmd_ps(args) -> None:
                 "health": "down",
                 "components": None,
                 "subscriptions": None,
+                "actors": None,
             }
             # a dead LOCAL pid is stale registry debris (SIGKILL leaves
             # entries behind) — report it as such instead of probing
@@ -439,6 +441,12 @@ def _cmd_ps(args) -> None:
                         row["components"] = len(meta.get("components") or [])
                         row["subscriptions"] = len(
                             meta.get("subscriptions") or [])
+                        # activations this replica owns ("-" when the
+                        # actor gate is off or the app hosts no types)
+                        actors = meta.get("actors")
+                        if actors is not None:
+                            row["actors"] = sum(
+                                (actors.get("owned") or {}).values())
                     elif r.status == 401:
                         row["components"] = "auth"
                         row["subscriptions"] = "auth"
@@ -472,16 +480,79 @@ def _cmd_ps(args) -> None:
 
     width = max(6, max(len(tag(r)) for r in rows))
     print(f"{'APP-ID':<{width}}  {'PID':>7}  {'APP':>5}  {'SIDECAR':>7}  "
-          f"{'HEALTH':<9}  {'COMPS':>5}  {'SUBS':>4}  UP")
+          f"{'HEALTH':<9}  {'COMPS':>5}  {'SUBS':>4}  {'ACTORS':>6}  UP")
     for r in rows:
         print(f"{tag(r):<{width}}  {r['pid'] or '-':>7}  "
               f"{r['app_port'] or '-':>5}  {r['sidecar_port'] or '-':>7}  "
               f"{r['health']:<9}  "
               f"{'-' if r['components'] is None else r['components']:>5}  "
               f"{'-' if r['subscriptions'] is None else r['subscriptions']:>4}  "
+              f"{'-' if r.get('actors') is None else r['actors']:>6}  "
               f"{fmt_up(r['up_seconds'])}")
     if any_down:
         raise SystemExit(2)
+
+
+def _cmd_actors(args) -> None:
+    """The cluster's actor placement table, read from ``--app-id``'s
+    sidecar (every replica computes the same table from the shared
+    store). Default view aggregates per type: id count, owner replicas,
+    lease age and fencing epoch ranges; ``--ids`` lists each actor id;
+    ``--json`` dumps the raw document."""
+    import json as json_mod
+
+    addr, headers = _resolve_sidecar(args)
+
+    async def go():
+        import aiohttp
+
+        url = f"{addr.base_url}/v1.0/actors"
+        timeout = aiohttp.ClientTimeout(total=10.0)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            async with s.get(url, headers=headers) as r:
+                return r.status, await r.read()
+
+    status, raw = asyncio.run(go())
+    if status == 404:
+        raise SystemExit(
+            "actor API not found — is TASKSRUNNER_ACTORS=1 set on the app?")
+    if status >= 400:
+        raise SystemExit(f"HTTP {status}: {raw.decode('utf-8', 'replace')}")
+    doc = json_mod.loads(raw)
+    if args.json:
+        print(json_mod.dumps(doc, indent=2))
+        return
+    placement = doc.get("placement") or []
+    if not placement:
+        summary = doc.get("replica") or {}
+        types = ", ".join(summary.get("types") or []) or "(none)"
+        print(f"no actors placed yet (hosted types: {types})")
+        return
+    if args.ids:
+        width = max(5, max(len(f"{r['type']}/{r['id']}") for r in placement))
+        print(f"{'ACTOR':<{width}}  {'OWNER':<28}  {'EPOCH':>5}  "
+              f"{'LEASE-AGE':>9}  ALIVE")
+        for r in placement:
+            print(f"{r['type'] + '/' + r['id']:<{width}}  "
+                  f"{r.get('owner') or '-':<28}  {r.get('epoch') or 0:>5}  "
+                  f"{r.get('lease_age', 0):>8.1f}s  "
+                  f"{'yes' if r.get('alive') else 'NO'}")
+        return
+    by_type: dict[str, list[dict]] = {}
+    for r in placement:
+        by_type.setdefault(r["type"], []).append(r)
+    width = max(4, max(len(t) for t in by_type))
+    print(f"{'TYPE':<{width}}  {'IDS':>4}  {'OWNERS':>6}  {'EPOCH':>8}  "
+          f"{'LEASE-AGE':>12}  DEAD")
+    for atype, rows in sorted(by_type.items()):
+        owners = {r.get("owner") for r in rows if r.get("owner")}
+        epochs = [int(r.get("epoch") or 0) for r in rows]
+        ages = [float(r.get("lease_age") or 0.0) for r in rows]
+        dead = sum(1 for r in rows if not r.get("alive"))
+        print(f"{atype:<{width}}  {len(rows):>4}  {len(owners):>6}  "
+              f"{min(epochs)}-{max(epochs):<4}  "
+              f"{min(ages):>5.1f}-{max(ages):<5.1f}  "
+              f"{dead or '-'}")
 
 
 def _cmd_lint(args) -> None:
@@ -1306,6 +1377,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--app-id", required=True)
     p.add_argument("--registry-file", **registry_arg)
     p.set_defaults(fn=_cmd_secret)
+
+    p = sub.add_parser(
+        "actors", help="the virtual-actor placement table "
+                       "(type → ids → owner → lease/epoch)")
+    p.add_argument("--app-id", required=True,
+                   help="any actor-hosting app; every replica serves the "
+                        "same table")
+    p.add_argument("--ids", action="store_true",
+                   help="one row per actor id instead of the per-type "
+                        "aggregate")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--registry-file", **registry_arg)
+    p.set_defaults(fn=_cmd_actors)
 
     p = sub.add_parser("stop", help="SIGTERM a registered app host")
     p.add_argument("app_id")

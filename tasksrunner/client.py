@@ -26,6 +26,7 @@ from typing import Any
 
 from tasksrunner.bindings.base import BindingResponse
 from tasksrunner.errors import (
+    ActorFencedError,
     EtagMismatch,
     InvocationError,
     InvocationStatusError,
@@ -118,6 +119,15 @@ class _Transport(abc.ABC):
     async def get_secret(self, store, key) -> dict[str, str]: ...
     @abc.abstractmethod
     async def bulk_secrets(self, store) -> dict[str, str]: ...
+    @abc.abstractmethod
+    async def invoke_actor(self, actor_type, actor_id, method, data) -> Any: ...
+    @abc.abstractmethod
+    async def register_actor_reminder(self, actor_type, actor_id, name,
+                                      due_seconds, period_seconds, data): ...
+    @abc.abstractmethod
+    async def unregister_actor_reminder(self, actor_type, actor_id, name): ...
+    @abc.abstractmethod
+    async def get_actor_state(self, actor_type, actor_id) -> dict: ...
     async def close(self): ...
 
 
@@ -159,6 +169,22 @@ class _DirectTransport(_Transport):
 
     async def bulk_secrets(self, store):
         return self.runtime.bulk_secrets(store)
+
+    async def invoke_actor(self, actor_type, actor_id, method, data):
+        return await self.runtime.invoke_actor(actor_type, actor_id,
+                                               method, data)
+
+    async def register_actor_reminder(self, actor_type, actor_id, name,
+                                      due_seconds, period_seconds, data):
+        await self.runtime.register_actor_reminder(
+            actor_type, actor_id, name, due_seconds=due_seconds,
+            period_seconds=period_seconds, data=data)
+
+    async def unregister_actor_reminder(self, actor_type, actor_id, name):
+        await self.runtime.unregister_actor_reminder(actor_type, actor_id, name)
+
+    async def get_actor_state(self, actor_type, actor_id):
+        return await self.runtime.get_actor_state(actor_type, actor_id)
 
 
 class _HTTPTransport(_Transport):
@@ -208,7 +234,9 @@ class _HTTPTransport(_Transport):
         except (ValueError, AttributeError):
             message = body[:200].decode("utf-8", "replace")
         exc_type: type[TasksRunnerError]
-        if status == 409:
+        if status == 409 and "actor" in context:
+            exc_type = ActorFencedError
+        elif status == 409:
             exc_type = EtagMismatch
         elif status == 429:
             exc_type = SaturatedError
@@ -303,6 +331,45 @@ class _HTTPTransport(_Transport):
             self._raise(status, body, context=f"secret {store}", headers=headers)
         return json.loads(body)
 
+    async def invoke_actor(self, actor_type, actor_id, method, data):
+        status, headers, body = await self._request(
+            "PUT", f"/v1.0/actors/{actor_type}/{actor_id}/method/{method}",
+            json_body=data)
+        if status >= 300:
+            self._raise(status, body,
+                        context=f"actor {actor_type}/{actor_id}.{method}",
+                        headers=headers)
+        return json.loads(body).get("result") if body else None
+
+    async def register_actor_reminder(self, actor_type, actor_id, name,
+                                      due_seconds, period_seconds, data):
+        payload = {"dueSeconds": due_seconds, "periodSeconds": period_seconds,
+                   "data": data}
+        status, headers, body = await self._request(
+            "POST", f"/v1.0/actors/{actor_type}/{actor_id}/reminders/{name}",
+            json_body=payload)
+        if status >= 300:
+            self._raise(status, body,
+                        context=f"actor reminder {actor_type}/{actor_id}",
+                        headers=headers)
+
+    async def unregister_actor_reminder(self, actor_type, actor_id, name):
+        status, headers, body = await self._request(
+            "DELETE", f"/v1.0/actors/{actor_type}/{actor_id}/reminders/{name}")
+        if status >= 300:
+            self._raise(status, body,
+                        context=f"actor reminder {actor_type}/{actor_id}",
+                        headers=headers)
+
+    async def get_actor_state(self, actor_type, actor_id):
+        status, headers, body = await self._request(
+            "GET", f"/v1.0/actors/{actor_type}/{actor_id}/state")
+        if status >= 300:
+            self._raise(status, body,
+                        context=f"actor state {actor_type}/{actor_id}",
+                        headers=headers)
+        return json.loads(body)
+
     async def close(self):
         if self._session is not None:
             await self._session.close()
@@ -395,6 +462,37 @@ class AppClient:
         resp = await self.invoke_method(
             app_id, method_path, http_method=http_method, data=data, query=query)
         return resp.raise_for_status().json()
+
+    # -- actors ----------------------------------------------------------
+
+    async def invoke_actor(self, actor_type: str, actor_id: str, method: str,
+                           data: Any = None) -> Any:
+        """Run one turn on a virtual actor and return its result. The
+        runtime routes to the current owner wherever it lives; a 2xx
+        means the turn's state changes are durably committed. Raises
+        :class:`ActorFencedError` if ownership moved mid-turn — the
+        turn was NOT applied; simply retry."""
+        return await self._t.invoke_actor(actor_type, actor_id, method, data)
+
+    async def register_actor_reminder(
+            self, actor_type: str, actor_id: str, name: str, *,
+            due_seconds: float, period_seconds: float | None = None,
+            data: Any = None) -> None:
+        """Schedule a durable reminder: fires as a turn (``kind ==
+        "reminder"``, method = reminder name) after ``due_seconds``,
+        then every ``period_seconds`` if periodic. Survives replica
+        crashes — whichever replica owns the actor fires it."""
+        await self._t.register_actor_reminder(
+            actor_type, actor_id, name, due_seconds, period_seconds, data)
+
+    async def unregister_actor_reminder(self, actor_type: str, actor_id: str,
+                                        name: str) -> None:
+        await self._t.unregister_actor_reminder(actor_type, actor_id, name)
+
+    async def get_actor_state(self, actor_type: str, actor_id: str) -> dict:
+        """Diagnostic read of the actor's durable record
+        (``{"epoch", "data", "reminders"}``) — not a turn."""
+        return await self._t.get_actor_state(actor_type, actor_id)
 
     # -- secrets ---------------------------------------------------------
 

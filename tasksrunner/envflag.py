@@ -42,6 +42,14 @@ def _f(name: str, kind: str, default: str, doc: str) -> tuple[str, Flag]:
 FLAGS: dict[str, Flag] = dict([
     _f("TASKSRUNNER_ACCESS_LOG", "bool", "on",
        "per-request access-log lines from app servers and sidecars"),
+    _f("TASKSRUNNER_ACTORS", "bool", "off",
+       "virtual-actor runtime (placement, turns, reminders, failover)"),
+    _f("TASKSRUNNER_ACTOR_LEASE_SECONDS", "float", "30",
+       "placement lease duration; expiry lets survivors take ownership"),
+    _f("TASKSRUNNER_ACTOR_REMINDER_POLL_SECONDS", "float", "2",
+       "reminder/lease sweep interval on every actor-hosting replica"),
+    _f("TASKSRUNNER_ACTOR_TURN_TIMEOUT_SECONDS", "float", "30",
+       "per-turn actor handler deadline before the turn fails"),
     _f("TASKSRUNNER_ACT_F32", "bool", "off",
        "keep ML activations in float32 instead of the platform default"),
     _f("TASKSRUNNER_ADMISSION", "bool", "off",

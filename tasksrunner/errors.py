@@ -160,6 +160,31 @@ class SaturatedError(TasksRunnerError):
     retry_after: float | None = None
 
 
+class ActorError(TasksRunnerError):
+    """A virtual-actor operation failed (tasksrunner/actors/)."""
+
+    http_status = 500
+
+
+class ActorNotRegistered(ActorError):
+    """The app hosts no handler for the requested actor type."""
+
+    http_status = 404
+
+
+class ActorFencedError(ActorError):
+    """A turn's commit was rejected by epoch fencing.
+
+    Every ownership acquisition bumps the actor record's epoch with an
+    etag-guarded write, so a zombie owner — one that lost its lease
+    mid-turn, or a crashed-but-still-scheduled replica — commits with
+    a stale etag and lands here instead of corrupting state. The turn
+    was NOT applied and was never acked; callers retry against the new
+    owner. Maps to 409 like the underlying :class:`EtagMismatch`."""
+
+    http_status = 409
+
+
 class CircuitOpenError(TasksRunnerError):
     """A resiliency circuit breaker is open — the call was rejected
     without being attempted (fail-fast). Maps to 503 so callers can

@@ -34,6 +34,10 @@ COUNTERS: dict[str, str] = {
     "chaos_injected_total": "faults injected by the chaos engine",
     "resiliency_retry_total": "resiliency-policy retry attempts",
     "resiliency_retry_exhausted_total": "retry budgets exhausted",
+    "actor_turns_total": "actor turns executed, by type and status",
+    "actor_reminder_fired_total": "durable reminders fired, by actor type",
+    "actor_fenced_total": "zombie-owner commits rejected by epoch fencing",
+    "actor_failover_total": "ownership acquisitions from a dead or expired owner",
 }
 
 #: point-in-time levels (the saturation probes live here)
@@ -48,6 +52,7 @@ GAUGES: dict[str, str] = {
     "broker_publish_queue_depth": "pending publishes in the broker write queue",
     "broker_dlq_depth": "dead-lettered messages per topic/group",
     "span_buffer_depth": "spans buffered in the recorder awaiting flush",
+    "actor_owned": "actor activations this replica currently owns, per type",
 }
 
 #: latency distributions (seconds); exposed as _bucket/_sum/_count
@@ -61,6 +66,7 @@ HISTOGRAMS: dict[str, str] = {
     "delivery_latency_seconds": "pub/sub delivery to the app, per route",
     "binding_latency_seconds": "output-binding invocation, per binding and op",
     "binding_delivery_latency_seconds": "input-binding delivery, per binding",
+    "actor_turn_latency_seconds": "actor turn execution, per actor type",
 }
 
 ALL: dict[str, str] = {**COUNTERS, **GAUGES, **HISTOGRAMS}

@@ -70,6 +70,7 @@ class AdminServer:
         app.router.add_post("/admin/apps/{app_id}/env", self._env)
         app.router.add_post("/admin/apps/{app_id}/scale", self._scale)
         app.router.add_get("/admin/apps/{app_id}/metrics", self._metrics)
+        app.router.add_get("/admin/actors", self._actors)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         self._site = web.TCPSite(self._runner, self.host, self.port)
@@ -215,6 +216,44 @@ class AdminServer:
             "percentiles": summarize_histograms(merged_hist),
             "histograms": merged_hist,
         })
+
+    async def _actors(self, request):
+        """Cluster actor view: the placement table (type → id → owner →
+        lease age / fencing epoch) plus each replica's local summary.
+        Every replica computes the same table from the shared store, so
+        the first reachable sidecar per app supplies it; the per-replica
+        summaries still come from every replica we can reach."""
+        import aiohttp
+        from aiohttp import web
+
+        token = os.environ.get(TOKEN_ENV)
+        headers = {TOKEN_HEADER: token} if token else {}
+        placement: list[dict] = []
+        replicas: list[dict] = []
+        async with aiohttp.ClientSession() as session:
+            for app_id, app_replicas in sorted(self.orch.replicas.items()):
+                have_table = False
+                for replica in app_replicas:
+                    if not replica.ports:
+                        continue
+                    url = f"http://127.0.0.1:{replica.ports[1]}/v1.0/actors"
+                    try:
+                        async with session.get(
+                                url, headers=headers,
+                                timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                            if resp.status != 200:
+                                continue
+                            doc = await resp.json()
+                    except (aiohttp.ClientError, asyncio.TimeoutError):
+                        continue  # a dead replica must not fail the view
+                    if doc.get("replica"):
+                        replicas.append({"app_id": app_id, **doc["replica"]})
+                    if not have_table and doc.get("placement"):
+                        placement.extend(doc["placement"])
+                        have_table = True
+        placement.sort(key=lambda r: (r.get("type") or "", r.get("id") or ""))
+        return web.json_response(
+            {"placement": placement, "replicas": replicas})
 
     async def _scale(self, request):
         from aiohttp import web

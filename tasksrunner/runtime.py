@@ -36,6 +36,7 @@ from tasksrunner.bindings.base import BindingEvent, InputBinding, OutputBinding
 from tasksrunner.component.registry import ComponentRegistry
 from tasksrunner.component.spec import ComponentSpec
 from tasksrunner.errors import (
+    ActorError,
     AppNotFound,
     BindingError,
     ComponentNotFound,
@@ -173,6 +174,16 @@ class Runtime:
         from tasksrunner.envflag import env_flag
         self._mesh_enabled = env_flag("TASKSRUNNER_MESH")
         self._started = False
+        #: ActorRuntime when TASKSRUNNER_ACTORS is on AND the app
+        #: registered @app.actor handlers; None otherwise — the
+        #: gate-off path pays one attribute check, nothing more
+        self.actors = None
+        #: (host, sidecar_port) advertised in actor placement records
+        #: so peer replicas can forward turns here; set by
+        #: Sidecar.start() before it calls runtime.start()
+        self.actor_address: tuple[str, int] | None = None
+        #: drill switch forwarded to ActorRuntime (chaos failover test)
+        self._actor_crash_on_chaos = False
         # cached metrics.recorder() closures for the per-request latency
         # histograms, keyed by the one label that varies per call — a
         # recorder observation is a float compare + list append, so the
@@ -248,6 +259,29 @@ class Runtime:
         rec(time.perf_counter() - started)
         return item
 
+    async def save_state_item(self, store_name: str, key: str, value: Any, *,
+                              etag: str | None = None) -> str:
+        """Single-item save that RETURNS the store's new etag.
+
+        ``save_state`` discards etags (the Dapr bulk API has nowhere to
+        put them), but the actor runtime's commit chain needs each
+        write's resulting etag to guard the next one — re-reading after
+        the write would race a newer owner and adopt *their* record.
+        Same grants/resiliency/metrics treatment as ``save_state``."""
+        self._authorize(store_name, "write")
+        store, prefixer = self._state_store(store_name)
+        started = time.perf_counter()
+        new_etag = await self._guarded(
+            store_name,
+            lambda: store.set(prefixer.apply(key), value, etag=etag))
+        metrics.inc("state_save", store=store_name)
+        rec = self._rec_state_save.get(store_name)
+        if rec is None:
+            rec = self._rec_state_save[store_name] = metrics.recorder(
+                "state_op_latency_seconds", store=store_name, op="save")
+        rec(time.perf_counter() - started)
+        return new_etag
+
     async def delete_state(self, store_name: str, key: str, *, etag=None) -> bool:
         self._authorize(store_name, "write")
         store, prefixer = self._state_store(store_name)
@@ -311,6 +345,37 @@ class Runtime:
             rec = self._rec_state_transact[store_name] = metrics.recorder(
                 "state_op_latency_seconds", store=store_name, op="transact")
         rec(time.perf_counter() - started)
+
+    # -- actors ----------------------------------------------------------
+
+    def _actor_runtime(self):
+        if self.actors is None:
+            raise ActorError(
+                "virtual actors are disabled: set TASKSRUNNER_ACTORS=1 and "
+                "register at least one @app.actor handler")
+        return self.actors
+
+    async def invoke_actor(self, actor_type: str, actor_id: str, method: str,
+                           data: Any = None, *, forwarded: bool = False) -> Any:
+        return await self._actor_runtime().invoke_turn(
+            actor_type, actor_id, method, data, forwarded=forwarded)
+
+    async def register_actor_reminder(
+            self, actor_type: str, actor_id: str, name: str, *,
+            due_seconds: float, period_seconds: float | None = None,
+            data: Any = None, forwarded: bool = False) -> None:
+        await self._actor_runtime().register_reminder(
+            actor_type, actor_id, name, due_seconds=due_seconds,
+            period_seconds=period_seconds, data=data, forwarded=forwarded)
+
+    async def unregister_actor_reminder(self, actor_type: str, actor_id: str,
+                                        name: str, *,
+                                        forwarded: bool = False) -> None:
+        await self._actor_runtime().unregister_reminder(
+            actor_type, actor_id, name, forwarded=forwarded)
+
+    async def get_actor_state(self, actor_type: str, actor_id: str) -> dict:
+        return await self._actor_runtime().read_state(actor_type, actor_id)
 
     # -- secrets ---------------------------------------------------------
 
@@ -643,7 +708,26 @@ class Runtime:
                 instance.running = True
                 self._input_bindings.append(instance)
                 logger.info("input binding %s -> %s", name, instance.route)
+
+        # 3. virtual actors (gated; the off path costs one env read)
+        from tasksrunner.envflag import env_flag
+        if env_flag("TASKSRUNNER_ACTORS", default=False):
+            await self._start_actors()
         self._started = True
+
+    async def _start_actors(self) -> None:
+        """Ask the app which actor types it hosts (≙ the Dapr sidecar's
+        GET /dapr/config actor-type discovery) and boot the actor
+        runtime when there are any."""
+        status, _, body = await self.app_channel.request(
+            "GET", "/tasksrunner/actors")
+        types = json.loads(body) if status == 200 and body else []
+        if not types:
+            return
+        from tasksrunner.actors import ActorRuntime
+        self.actors = ActorRuntime(self, types,
+                                   crash_on_chaos=self._actor_crash_on_chaos)
+        await self.actors.start()
 
     def _inbound_policy(self, component_name: str):
         """The component's inbound resiliency policy (if any) — applied
@@ -735,7 +819,7 @@ class Runtime:
     # -- metadata / teardown ---------------------------------------------
 
     def metadata(self) -> dict:
-        return {
+        out = {
             "id": self.app_id,
             "components": [
                 {"name": n, "type": self.registry.spec(n).type}
@@ -748,8 +832,14 @@ class Runtime:
             "histograms": metrics.snapshot_histograms(),
             "metric_kinds": metrics.snapshot_kinds(),
         }
+        if self.actors is not None:
+            out["actors"] = self.actors.summary()
+        return out
 
     async def stop(self) -> None:
+        if self.actors is not None:
+            await self.actors.stop()
+            self.actors = None
         for sub in self._subscriptions:
             await sub.cancel()
         self._subscriptions.clear()

@@ -251,6 +251,80 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
         return web.Response(status=status, body=resp_body,
                             headers=outward_headers(headers))
 
+    # -- actors ----------------------------------------------------------
+
+    # Routes registered only when the gate is on: with TASKSRUNNER_ACTORS
+    # unset the sidecar's route table is byte-identical to before this
+    # subsystem existed, so the off path adds zero routing or dispatch
+    # cost (the <1% overhead budget measured by bench.py --actor-bench).
+    from tasksrunner.envflag import env_flag
+    if env_flag("TASKSRUNNER_ACTORS", default=False):
+
+        @routes.route("*", "/v1.0/actors/{atype}/{aid}/method/{m}")
+        @_traced(allow_peer=True)
+        async def invoke_actor(request: web.Request):
+            # allow_peer: a peer replica forwarding a turn to the owner
+            # authenticates with its own app token, like /v1.0/invoke
+            body = await request.read()
+            data = json.loads(body) if body else None
+            forwarded = request.headers.get(
+                "x-tasksrunner-actor-forward") == "1"
+            result = await runtime.invoke_actor(
+                request.match_info["atype"], request.match_info["aid"],
+                request.match_info["m"], data, forwarded=forwarded)
+            return web.json_response({"result": result})
+
+        @routes.post("/v1.0/actors/{atype}/{aid}/reminders/{name}")
+        @_traced(allow_peer=True)
+        async def register_actor_reminder(request: web.Request):
+            body = await request.json()
+            if not isinstance(body, dict) or "dueSeconds" not in body:
+                raise ValidationError(
+                    'reminder body must be {"dueSeconds": n, '
+                    '"periodSeconds"?: n, "data"?: ...}')
+            forwarded = request.headers.get(
+                "x-tasksrunner-actor-forward") == "1"
+            await runtime.register_actor_reminder(
+                request.match_info["atype"], request.match_info["aid"],
+                request.match_info["name"],
+                due_seconds=float(body["dueSeconds"]),
+                period_seconds=(float(body["periodSeconds"])
+                                if body.get("periodSeconds") is not None
+                                else None),
+                data=body.get("data"), forwarded=forwarded)
+            return web.Response(status=204)
+
+        @routes.delete("/v1.0/actors/{atype}/{aid}/reminders/{name}")
+        @_traced(allow_peer=True)
+        async def unregister_actor_reminder(request: web.Request):
+            forwarded = request.headers.get(
+                "x-tasksrunner-actor-forward") == "1"
+            await runtime.unregister_actor_reminder(
+                request.match_info["atype"], request.match_info["aid"],
+                request.match_info["name"], forwarded=forwarded)
+            return web.Response(status=204)
+
+        @routes.get("/v1.0/actors/{atype}/{aid}/state")
+        @_traced
+        async def get_actor_state(request: web.Request):
+            doc = await runtime.get_actor_state(
+                request.match_info["atype"], request.match_info["aid"])
+            return web.json_response(doc)
+
+        @routes.get("/v1.0/actors")
+        @_traced(exempt=True)
+        async def actor_placement(request: web.Request):
+            # admin/ps surface: this replica's summary + the global
+            # placement table computed from the shared store.
+            # Admission-exempt like /v1.0/metadata — it is an operator
+            # observability read, most needed during overload/failover.
+            if runtime.actors is None:
+                return web.json_response({"replica": None, "placement": []})
+            return web.json_response({
+                "replica": runtime.actors.summary(),
+                "placement": await runtime.actors.placement_table(),
+            })
+
     # -- meta ------------------------------------------------------------
 
     @routes.get("/v1.0/healthz")
@@ -322,6 +396,10 @@ class Sidecar:
         await _bind_or_explain(site, "sidecar", self.host, self.port)
         if self.port == 0:  # pick the real ephemeral port
             self.port = self._runner.addresses[0][1]
+        # advertised in actor placement records so peers can forward
+        # turns to this replica; must be set before runtime.start()
+        # boots the actor runtime
+        self.runtime.actor_address = (self.host, self.port)
         if env_flag("TASKSRUNNER_MESH"):
             self._mesh = MeshServer(self.runtime, host=self.host)
             await self._mesh.start()

@@ -1,0 +1,609 @@
+"""Virtual actors: placement, turn discipline, fencing, durable
+reminders, and crash failover (docs module 18).
+
+The multi-replica tests build several ``Runtime`` objects by hand
+sharing ONE in-memory state store (the registry lets tests inject a
+live instance), which models N replicas of the same app against one
+durable store without OS processes. Failover is driven by
+``simulate_crash()`` — die like SIGKILL: no lease release, activations
+kept hot so the dead replica acts as a zombie if resurrected — plus
+short leases, so every scenario is deterministic and fast.
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+import yaml
+
+from tasksrunner.app import App
+from tasksrunner.chaos.engine import ChaosPolicies
+from tasksrunner.chaos.spec import parse_chaos
+from tasksrunner.component.registry import ComponentRegistry
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.errors import (
+    ActorError,
+    ActorFencedError,
+    ActorNotRegistered,
+    ComponentError,
+    TasksRunnerError,
+    ValidationError,
+)
+from tasksrunner.runtime import InProcAppChannel, Runtime
+from tasksrunner.state.memory import InMemoryStateStore
+
+LEASE = 0.25  # tests shorten per-runtime after start(); see make_runtime
+
+
+@pytest.fixture
+def actor_env(monkeypatch):
+    monkeypatch.setenv("TASKSRUNNER_ACTORS", "1")
+    # long defaults: tests that need failover shorten lease_seconds on
+    # the built runtime; the background sweep is effectively disabled
+    # (poll 30s) so every sweep in a test is an explicit, deterministic
+    # sweep() call
+    monkeypatch.setenv("TASKSRUNNER_ACTOR_LEASE_SECONDS", "5")
+    monkeypatch.setenv("TASKSRUNNER_ACTOR_REMINDER_POLL_SECONDS", "30")
+
+
+def build_app(app_id="svc", events=None):
+    app = App(app_id)
+
+    @app.actor("Counter")
+    async def counter(turn):
+        if turn.is_reminder:
+            turn.state["reminded"] = turn.state.get("reminded", 0) + 1
+            turn.state.setdefault("fired_as", []).append(turn.method)
+            return None
+        turn.state["n"] = turn.state.get("n", 0) + 1
+        return turn.state["n"]
+
+    @app.actor("Slow")
+    async def slow(turn):
+        if events is not None:
+            events.append(("start", turn.data))
+        await asyncio.sleep(0.03)
+        if events is not None:
+            events.append(("end", turn.data))
+        return None
+
+    return app
+
+
+def make_runtime(shared, *, app_id="svc", chaos=None, crash_on_chaos=False,
+                 lease=None, events=None):
+    spec = ComponentSpec(name="statestore", type="state.in-memory")
+    reg = ComponentRegistry([spec], app_id=app_id)
+    reg._instances["statestore"] = shared
+    rt = Runtime(app_id, reg,
+                 app_channel=InProcAppChannel(build_app(app_id, events)),
+                 chaos=chaos)
+    if crash_on_chaos:
+        rt._actor_crash_on_chaos = True
+    rt._test_lease = lease
+    return rt
+
+
+async def start_all(*rts):
+    for rt in rts:
+        await rt.start()
+        assert rt.actors is not None
+        if rt._test_lease is not None:
+            rt.actors.lease_seconds = rt._test_lease
+
+
+async def shutdown(*rts):
+    # stop every actor runtime while the shared store is still open,
+    # THEN stop the runtimes (the first Runtime.stop closes the store)
+    for rt in rts:
+        if rt.actors is not None:
+            await rt.actors.stop()
+            rt.actors = None
+    for rt in rts:
+        await rt.stop()
+
+
+async def retry_turn(rt, actor_id, *, deadline=5.0):
+    """Drive one turn, retrying while placement moves (lease expiry)."""
+    end = time.time() + deadline
+    while True:
+        try:
+            return await rt.invoke_actor("Counter", actor_id, "bump")
+        except TasksRunnerError:
+            if time.time() > end:
+                raise
+            await asyncio.sleep(0.02)
+
+
+# -- registration ----------------------------------------------------------
+
+
+def test_actor_decorator_rejects_sync_handlers():
+    app = App("svc")
+    with pytest.raises(ValidationError):
+        @app.actor("Bad")
+        def bad(turn):  # noqa: ARG001 - shape under test
+            return None
+
+
+def test_actor_decorator_rejects_duplicate_type():
+    app = App("svc")
+
+    @app.actor("Dup")
+    async def one(turn):
+        return None
+
+    with pytest.raises(ValidationError):
+        @app.actor("Dup")
+        async def two(turn):
+            return None
+
+
+# -- gate ------------------------------------------------------------------
+
+
+async def test_gate_off_no_actor_runtime(monkeypatch):
+    monkeypatch.delenv("TASKSRUNNER_ACTORS", raising=False)
+    rt = make_runtime(InMemoryStateStore("statestore"))
+    await rt.start()
+    try:
+        assert rt.actors is None
+        assert "actors" not in rt.metadata()
+        with pytest.raises(ActorError):
+            await rt.invoke_actor("Counter", "x", "bump")
+    finally:
+        await rt.stop()
+
+
+async def test_gate_on_but_no_handlers(actor_env):
+    spec = ComponentSpec(name="statestore", type="state.in-memory")
+    reg = ComponentRegistry([spec], app_id="plain")
+    reg._instances["statestore"] = InMemoryStateStore("statestore")
+    rt = Runtime("plain", reg, app_channel=InProcAppChannel(App("plain")))
+    await rt.start()
+    try:
+        assert rt.actors is None  # handshake returned no actor types
+    finally:
+        await rt.stop()
+
+
+# -- turns -----------------------------------------------------------------
+
+
+async def test_turns_and_state_persistence(actor_env):
+    rt = make_runtime(InMemoryStateStore("statestore"))
+    await start_all(rt)
+    try:
+        assert await rt.invoke_actor("Counter", "c1", "bump") == 1
+        assert await rt.invoke_actor("Counter", "c1", "bump") == 2
+        assert await rt.invoke_actor("Counter", "other", "bump") == 1
+        doc = await rt.get_actor_state("Counter", "c1")
+        assert doc["data"] == {"n": 2}
+        assert doc["epoch"] == 1
+        with pytest.raises(ActorNotRegistered):
+            await rt.invoke_actor("Nope", "c1", "bump")
+        assert rt.metadata()["actors"]["owned"] == {"Counter": 2}
+    finally:
+        await shutdown(rt)
+
+
+async def test_turns_serialize_per_actor(actor_env):
+    events = []
+    rt = make_runtime(InMemoryStateStore("statestore"), events=events)
+    await start_all(rt)
+    try:
+        await asyncio.gather(
+            rt.invoke_actor("Slow", "s1", "go", 1),
+            rt.invoke_actor("Slow", "s1", "go", 2),
+            rt.invoke_actor("Slow", "s1", "go", 3),
+        )
+        # one turn at a time: every start is immediately followed by
+        # its own end — no interleaving on a single actor id
+        assert len(events) == 6
+        for i in range(0, 6, 2):
+            assert events[i][0] == "start"
+            assert events[i + 1] == ("end", events[i][1])
+    finally:
+        await shutdown(rt)
+
+
+async def test_forwarding_to_live_owner(actor_env):
+    shared = InMemoryStateStore("statestore")
+    r1, r2 = make_runtime(shared), make_runtime(shared)
+    await start_all(r1, r2)
+    try:
+        assert await r1.invoke_actor("Counter", "f1", "bump") == 1
+        # r2 does not own f1: the turn forwards to r1 in-process and
+        # the single counter keeps incrementing — one owner, one state
+        assert await r2.invoke_actor("Counter", "f1", "bump") == 2
+        assert await r1.invoke_actor("Counter", "f1", "bump") == 3
+        assert ("Counter", "f1") in r1.actors._activations
+        assert ("Counter", "f1") not in r2.actors._activations
+    finally:
+        await shutdown(r1, r2)
+
+
+# -- reminders -------------------------------------------------------------
+
+
+async def test_reminder_fires_exactly_once_per_schedule(actor_env):
+    rt = make_runtime(InMemoryStateStore("statestore"))
+    await start_all(rt)
+    try:
+        await rt.invoke_actor("Counter", "r1", "bump")
+        # one-shot: fires once, then deletes itself
+        await rt.register_actor_reminder("Counter", "r1", "once",
+                                         due_seconds=0.0)
+        stats = await rt.actors.sweep()
+        assert stats["fired"] == 1
+        assert (await rt.actors.sweep())["fired"] == 0
+        doc = await rt.get_actor_state("Counter", "r1")
+        assert doc["data"]["reminded"] == 1
+        assert "once" not in doc["reminders"]
+        # periodic: fires, re-arms, fires again after the period —
+        # and never twice inside one period
+        await rt.register_actor_reminder("Counter", "r1", "tick",
+                                         due_seconds=0.0,
+                                         period_seconds=0.15)
+        assert (await rt.actors.sweep())["fired"] == 1
+        assert (await rt.actors.sweep())["fired"] == 0
+        await asyncio.sleep(0.2)
+        assert (await rt.actors.sweep())["fired"] == 1
+        await rt.unregister_actor_reminder("Counter", "r1", "tick")
+        await asyncio.sleep(0.2)
+        assert (await rt.actors.sweep())["fired"] == 0
+        doc = await rt.get_actor_state("Counter", "r1")
+        assert doc["data"]["reminded"] == 3
+        assert doc["reminders"] == {}
+    finally:
+        await shutdown(rt)
+
+
+async def test_reminders_survive_replica_restart(actor_env):
+    shared = InMemoryStateStore("statestore")
+    r1 = make_runtime(shared)
+    await start_all(r1)
+    await r1.invoke_actor("Counter", "d1", "bump")
+    await r1.register_actor_reminder("Counter", "d1", "tick",
+                                     due_seconds=0.0, period_seconds=0.1)
+    # the replica goes away cleanly (released lease, reminder durable)
+    await r1.actors.stop()
+    r1.actors = None
+    r2 = make_runtime(shared)
+    await start_all(r2)
+    try:
+        # the sweep ADOPTS the released reminder-holding actor and
+        # fires the due reminder — automatic failover, nobody invoked
+        stats = await r2.actors.sweep()
+        assert stats["adopted"] == 1
+        assert stats["fired"] == 1
+        doc = await r2.get_actor_state("Counter", "d1")
+        assert doc["data"]["reminded"] == 1
+        assert doc["epoch"] == 2  # adoption bumped the fencing epoch
+    finally:
+        await shutdown(r2, r1)
+
+
+# -- crash failover & fencing ----------------------------------------------
+
+
+async def test_crash_failover_zero_lost_acked_turns(actor_env):
+    shared = InMemoryStateStore("statestore")
+    r1 = make_runtime(shared, lease=LEASE)
+    r2 = make_runtime(shared, lease=LEASE)
+    await start_all(r1, r2)
+    try:
+        acked = 0
+        for _ in range(5):
+            acked = await r1.invoke_actor("Counter", "c2", "bump")
+        r1.actors.simulate_crash()
+        t0 = time.time()
+        v = await retry_turn(r2, "c2")
+        took = time.time() - t0
+        # every acked turn survived: the survivor's first turn sees
+        # exactly the acked count
+        assert v == acked + 1
+        # bounded failover: one lease TTL plus scheduling slack
+        assert took < LEASE + 2.0
+        doc = await r2.get_actor_state("Counter", "c2")
+        assert doc["epoch"] == 2
+    finally:
+        await shutdown(r2, r1)
+
+
+async def test_zombie_commit_is_fenced(actor_env):
+    shared = InMemoryStateStore("statestore")
+    r1 = make_runtime(shared, lease=LEASE)
+    r2 = make_runtime(shared, lease=LEASE)
+    await start_all(r1, r2)
+    try:
+        await r1.invoke_actor("Counter", "z1", "bump")
+        r1.actors.simulate_crash()
+        await retry_turn(r2, "z1")  # r2 takes over, epoch 2
+        # resurrect the zombie: it still holds its activation (cached
+        # etag, epoch 1) and believes its lease is alive
+        r1.actors.crashed = False
+        act = r1.actors._activations[("Counter", "z1")]
+        act.lease_expires = time.time() + 99
+        with pytest.raises(ActorFencedError) as exc:
+            await r1.invoke_actor("Counter", "z1", "bump")
+        assert "NOT applied" in str(exc.value)
+        # the fenced turn changed nothing; the zombie dropped the actor
+        doc = await r2.get_actor_state("Counter", "z1")
+        assert doc["data"]["n"] == 2
+        assert doc["epoch"] == 2
+        assert ("Counter", "z1") not in r1.actors._activations
+    finally:
+        await shutdown(r2, r1)
+
+
+async def test_double_failover_epochs_monotonic(actor_env):
+    shared = InMemoryStateStore("statestore")
+    rts = [make_runtime(shared, lease=LEASE) for _ in range(3)]
+    await start_all(*rts)
+    r1, r2, r3 = rts
+    try:
+        for _ in range(3):
+            await r1.invoke_actor("Counter", "m1", "bump")
+        r1.actors.simulate_crash()
+        assert await retry_turn(r2, "m1") == 4
+        assert (await r2.get_actor_state("Counter", "m1"))["epoch"] == 2
+        r2.actors.simulate_crash()
+        assert await retry_turn(r3, "m1") == 5
+        doc = await r3.get_actor_state("Counter", "m1")
+        assert doc["epoch"] == 3
+        assert doc["data"]["n"] == 5
+    finally:
+        await shutdown(r3, r2, r1)
+
+
+# -- pid recycling (satellite: lease expiry vs /proc starttime) ------------
+
+
+def _place_doc(pid, registered_at, *, lease_expires):
+    return {"owner": {"replica": "ghost@x.y", "app_id": "svc",
+                      "host": "127.0.0.1", "pid": pid,
+                      "registered_at": registered_at},
+            "epoch": 7, "lease_expires": lease_expires,
+            "granted_at": registered_at}
+
+
+async def test_owner_dead_predicate_no_ghost_passes_both(actor_env,
+                                                         monkeypatch):
+    from tasksrunner.actors.runtime import ActorRuntime
+
+    child = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)"])
+    try:
+        registered_at = time.time()
+        live = _place_doc(child.pid, registered_at,
+                          lease_expires=time.time() + 60)
+        # live pid, honest starttime, valid lease -> alive
+        assert not ActorRuntime.owner_dead(live)
+        # expired lease -> dead, however alive the pid looks (the
+        # wedged-owner case: fencing, not pid checks, protects state)
+        stale = _place_doc(child.pid, registered_at,
+                           lease_expires=time.time() - 1)
+        assert ActorRuntime.owner_dead(stale)
+        # recycled pid: the number is in use, but its holder was born
+        # AFTER the owner registered -> the owner is gone, lease or not
+        monkeypatch.setattr("tasksrunner.invoke.resolver._pid_started_at",
+                            lambda pid: registered_at + 100.0)
+        assert ActorRuntime.owner_dead(live)
+    finally:
+        child.kill()
+        child.wait()
+
+
+async def test_pid_recycled_owner_is_preempted(actor_env, monkeypatch):
+    from tasksrunner.actors.runtime import place_key
+
+    child = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)"])
+    shared = InMemoryStateStore("statestore")
+    rt = make_runtime(shared)
+    await start_all(rt)
+    try:
+        registered_at = time.time()
+        await rt.save_state_item(
+            "statestore", place_key("Counter", "p1"),
+            _place_doc(child.pid, registered_at,
+                       lease_expires=time.time() + 60))
+        # the ghost's lease is valid and its pid exists: unreachable,
+        # NOT preemptable — the caller is told to retry, state is safe
+        with pytest.raises(ActorError, match="unreachable"):
+            await rt.invoke_actor("Counter", "p1", "bump")
+        # now the pid turns out to be recycled (current holder born
+        # after the registration): provably dead -> immediate takeover
+        # fencing ABOVE the ghost's epoch, no lease wait
+        monkeypatch.setattr("tasksrunner.invoke.resolver._pid_started_at",
+                            lambda pid: registered_at + 100.0)
+        assert await rt.invoke_actor("Counter", "p1", "bump") == 1
+        doc = await rt.get_actor_state("Counter", "p1")
+        assert doc["epoch"] == 8  # ghost claimed 7; the fence went above
+    finally:
+        child.kill()
+        child.wait()
+        await shutdown(rt)
+
+
+# -- the chaos drill (satellite: crashEveryN follows placement) ------------
+
+CHAOS_YAML = """
+apiVersion: tasksrunner/v1alpha1
+kind: Chaos
+metadata: {name: actor-drill}
+spec:
+  seed: 7
+  faults:
+    poison:
+      crashEveryN: {n: 5, raise: OSError}
+  targets:
+    actors:
+      Counter: [poison]
+"""
+
+
+def test_chaos_actor_targets_parse_and_resolve():
+    spec = parse_chaos(yaml.safe_load(CHAOS_YAML))
+    assert spec.actor_targets == {"Counter": ("poison",)}
+    pol = ChaosPolicies([spec], app_id="svc")
+    assert pol.for_actor("Counter") is not None
+    assert pol.for_actor("Other") is None
+    assert any("actors/Counter/turn" in d["targets"] for d in pol.describe())
+
+
+def test_chaos_actor_target_dangling_ref_fails_at_load():
+    doc = yaml.safe_load(CHAOS_YAML)
+    doc["spec"]["targets"]["actors"]["Counter"] = ["typo"]
+    with pytest.raises(ComponentError, match="unknown fault rule"):
+        parse_chaos(doc)
+
+
+async def test_seeded_crash_every_n_failover_drill(actor_env):
+    """The tentpole proof: a seeded crashEveryN rule fells whichever
+    replica CURRENTLY owns the actor (the fault injects inside the
+    owner's turn), survivors take over with monotonically increasing
+    epochs, zero acked turns are lost, and the durable reminder
+    resumes on the final owner. Deterministic: crashEveryN is
+    call-counted per replica, so the schedule is fixed — replica 1
+    dies on its 5th turn, replica 2 on its 5th, replica 3 survives."""
+    shared = InMemoryStateStore("statestore")
+    spec = parse_chaos(yaml.safe_load(CHAOS_YAML))
+    rts = [make_runtime(shared, lease=LEASE,
+                        chaos=ChaosPolicies([spec], app_id="svc"),
+                        crash_on_chaos=True)
+           for _ in range(3)]
+    await start_all(*rts)
+    try:
+        # a durable reminder registered up front must ride through
+        # every failover (registration is not a turn: no chaos)
+        await rts[0].register_actor_reminder(
+            "Counter", "d1", "tick", due_seconds=0.0, period_seconds=0.2)
+
+        acked = 0
+        crashes = 0
+        deadline = time.time() + 30
+        while acked < 11:
+            assert time.time() < deadline, \
+                f"drill stalled at {acked} acked turns"
+            alive = next(rt for rt in rts
+                         if rt.actors is not None and not rt.actors.crashed)
+            try:
+                v = await alive.invoke_actor("Counter", "d1", "bump")
+            except (TasksRunnerError, OSError):
+                # OSError is the configured fault class: the owner fell
+                # mid-turn and the turn is UNacked; TasksRunnerError is
+                # the takeover window (lease not yet expired) — retry
+                crashes = sum(1 for rt in rts
+                              if rt.actors is not None and rt.actors.crashed)
+                await asyncio.sleep(0.02)
+                continue
+            acked += 1
+            assert v == acked  # each ack sees every prior acked turn
+
+        assert crashes == 2  # replicas 1 and 2 each died on turn 5
+        survivor = rts[2]
+        assert not survivor.actors.crashed
+        doc = await survivor.get_actor_state("Counter", "d1")
+        assert doc["data"]["n"] == 11   # zero lost acked turns
+        assert doc["epoch"] == 3        # one fence bump per failover
+        assert "tick" in doc["reminders"]
+
+        # the reminder, long overdue, fires on the final owner (the
+        # reminder turn is the survivor's 4th call — under the crash
+        # schedule, not at a crash point)
+        stats = await survivor.actors.sweep()
+        assert stats["fired"] == 1
+        doc = await survivor.get_actor_state("Counter", "d1")
+        assert doc["data"]["reminded"] == 1
+    finally:
+        await shutdown(*rts)
+
+
+# -- surfacing: sidecar routes, placement table, CLI -----------------------
+
+
+async def test_sidecar_actor_routes_gated_off(monkeypatch):
+    monkeypatch.delenv("TASKSRUNNER_ACTORS", raising=False)
+    from tasksrunner.sidecar import build_sidecar_app
+
+    app = build_sidecar_app(make_runtime(InMemoryStateStore("statestore")),
+                            api_token=None, peer_tokens=set())
+    assert not any("/v1.0/actors" in str(r.resource.canonical)
+                   for r in app.router.routes() if r.resource is not None)
+
+
+async def test_sidecar_actor_api_end_to_end(actor_env):
+    import aiohttp
+
+    from tasksrunner.sidecar import Sidecar
+
+    rt = make_runtime(InMemoryStateStore("statestore"))
+    sc = Sidecar(rt, port=0)
+    await sc.start()
+    try:
+        base = f"http://127.0.0.1:{sc.port}"
+        async with aiohttp.ClientSession() as session:
+            resp = await session.put(
+                f"{base}/v1.0/actors/Counter/web1/method/bump", json=None)
+            assert resp.status == 200
+            assert (await resp.json())["result"] == 1
+            resp = await session.post(
+                f"{base}/v1.0/actors/Counter/web1/reminders/tick",
+                json={"dueSeconds": 0.0, "periodSeconds": 5})
+            assert resp.status == 204
+            assert (await rt.actors.sweep())["fired"] == 1
+            resp = await session.get(
+                f"{base}/v1.0/actors/Counter/web1/state")
+            doc = await resp.json()
+            assert doc["data"] == {"n": 1, "reminded": 1,
+                                   "fired_as": ["tick"]}
+            resp = await session.delete(
+                f"{base}/v1.0/actors/Counter/web1/reminders/tick")
+            assert resp.status == 204
+            resp = await session.get(f"{base}/v1.0/actors")
+            view = await resp.json()
+            assert view["replica"]["owned"] == {"Counter": 1}
+            assert view["placement"][0]["id"] == "web1"
+            assert view["placement"][0]["alive"] is True
+            resp = await session.put(
+                f"{base}/v1.0/actors/Nope/x/method/m", json=None)
+            assert resp.status == 404
+    finally:
+        await sc.stop()
+
+
+async def test_placement_table_rows(actor_env):
+    shared = InMemoryStateStore("statestore")
+    r1, r2 = make_runtime(shared), make_runtime(shared)
+    await start_all(r1, r2)
+    try:
+        await r1.invoke_actor("Counter", "t1", "bump")
+        await r2.invoke_actor("Counter", "t2", "bump")
+        # both replicas render the SAME table from the shared store
+        t_from_r1 = await r1.actors.placement_table()
+        t_from_r2 = await r2.actors.placement_table()
+        owners = {row["id"]: row["owner"] for row in t_from_r1}
+        assert owners == {row["id"]: row["owner"] for row in t_from_r2}
+        assert owners["t1"] == r1.actors.replica_id
+        assert owners["t2"] == r2.actors.replica_id
+        by_id = {row["id"]: row for row in t_from_r1}
+        assert by_id["t1"]["owned_here"] is True
+        assert by_id["t2"]["owned_here"] is False
+        assert all(row["alive"] for row in t_from_r1)
+        assert all(row["epoch"] == 1 for row in t_from_r1)
+    finally:
+        await shutdown(r1, r2)
+
+
+def test_cli_has_actors_surface():
+    from tasksrunner.cli import _cmd_actors, build_parser
+
+    args = build_parser().parse_args(["actors", "--app-id", "svc", "--ids"])
+    assert args.fn is _cmd_actors
+    assert args.app_id == "svc"
+    assert args.ids is True
