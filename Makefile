@@ -1,8 +1,8 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test lint lint-metrics soak bench bench-state bench-shard bench-hist bench-overload bench-actors chaos sweep-flash run validate docs-serve docs-build clean
+.PHONY: test lint lint-program lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-overload bench-actors chaos sweep-flash run validate docs-serve docs-build clean
 
-test: lint
+test: lint lint-program
 	python -m pytest tests/ -q
 
 # tasklint: AST enforcement of the runtime's invariants — no blocking
@@ -11,6 +11,17 @@ test: lint
 # (docs/modules/17-static-analysis.md)
 lint:
 	python -m tasksrunner.analysis
+
+# whole-program phase only: call-graph, lock-graph, thread-boundary,
+# and route-conformance rules over the full package (tree-digest
+# cached, so warm runs are near-free)
+lint-program:
+	python -m tasksrunner.analysis --rules transitive-blocking,lock-order-cycle,held-lock-across-await,thread-shared-state,route-conformance
+
+# fast pre-commit loop: per-file phase on the git delta vs main; the
+# program phase still covers the whole tree
+lint-changed:
+	python -m tasksrunner.analysis --changed
 
 # back-compat alias: the metric-name check is now the tasklint
 # `metric-names` rule

@@ -1,13 +1,25 @@
-"""Per-file result cache.
+"""Per-file and whole-program result caches.
 
-Linting the whole package parses ~80 modules; editors and `make test`
+Linting the whole package parses ~100 modules; editors and `make test`
 run it repeatedly, so unchanged files must be free. The cache maps
-absolute path → (mtime, size, ruleset signature, findings). The
-signature hashes the *source of the analysis package itself* plus the
-selected rule ids, so editing any rule — or selecting a different
-subset — invalidates every entry without a manual version bump.
+absolute path → (mtime, size, content sha1, ruleset signature,
+findings). The *content hash is the authoritative key*: mtime+size
+alone miss same-size edits (editors that pad, ``touch -r`` restoring
+an old mtime after a change), so ``get`` always re-hashes the file —
+mtime and size are kept as debugging metadata only. Hashing ~100 small
+files costs single-digit milliseconds, far below one AST parse.
 
-Suppression comments live in the linted file, so cached findings are
+The ruleset signature hashes the *source of the analysis package
+itself* plus the selected rule ids, so editing any rule — or selecting
+a different subset — invalidates every entry without a manual version
+bump.
+
+The whole-program phase stores one extra entry under ``__program__``
+keyed on a digest of the sorted (path, mtime, size, content-hash) set:
+any file appearing, vanishing, or changing rebuilds the graph; an
+untouched tree makes warm program-phase runs free.
+
+Suppression comments live in the linted files, so cached findings are
 post-suppression; the baseline is applied after the cache by the
 engine (the baseline file can change independently of the sources).
 """
@@ -17,10 +29,47 @@ from __future__ import annotations
 import hashlib
 import json
 import pathlib
+from typing import Iterable
 
 from tasksrunner.analysis.core import Finding
 
 _PKG = pathlib.Path(__file__).resolve().parent
+
+#: reserved table key for the whole-program phase entry — not a path
+PROGRAM_KEY = "__program__"
+
+#: (path, mtime_ns, size) → sha1, memoised per process. The proxy key
+#: is safe *within* one run (nothing restores mtimes mid-lint); the
+#: cross-run lie is exactly what the persisted sha1 guards against.
+_digest_memo: dict[tuple[str, int, int], str] = {}
+
+
+def file_digest(path: pathlib.Path) -> str | None:
+    """Content sha1, or None when the file cannot be read."""
+    try:
+        stat = path.stat()
+        key = (str(path), stat.st_mtime_ns, stat.st_size)
+        hit = _digest_memo.get(key)
+        if hit is not None:
+            return hit
+        digest = hashlib.sha1(path.read_bytes()).hexdigest()[:16]
+    except OSError:
+        return None
+    _digest_memo[key] = digest
+    return digest
+
+
+def tree_digest(files: Iterable[pathlib.Path]) -> str:
+    """Identity of a file *set* for the program-phase cache."""
+    h = hashlib.sha1()
+    for path in sorted(files):
+        try:
+            stat = path.stat()
+        except OSError:
+            continue
+        h.update(f"{path}|{stat.st_mtime_ns}|{stat.st_size}"
+                 f"|{file_digest(path)}\n".encode())
+    return h.hexdigest()[:16]
 
 
 def ruleset_signature(rule_ids: tuple[str, ...]) -> str:
@@ -49,19 +98,42 @@ class ResultCache:
         entry = self._table.get(str(path))
         if entry is None or entry.get("sig") != self.signature:
             return None
-        stat = path.stat()
-        if entry.get("mtime") != stat.st_mtime_ns or \
-                entry.get("size") != stat.st_size:
+        digest = file_digest(path)
+        if digest is None or entry.get("sha1") != digest:
             return None
         self.hits += 1
         return [Finding.from_json(d) for d in entry.get("findings", [])]
 
     def put(self, path: pathlib.Path, findings: list[Finding]) -> None:
-        stat = path.stat()
+        try:
+            stat = path.stat()
+        except OSError:
+            return
         self._table[str(path)] = {
             "sig": self.signature,
             "mtime": stat.st_mtime_ns,
             "size": stat.st_size,
+            "sha1": file_digest(path),
+            "findings": [f.to_json() for f in findings],
+        }
+        self._dirty = True
+
+    def get_program(self, tree_hash: str,
+                    ) -> tuple[list[Finding], int] | None:
+        entry = self._table.get(PROGRAM_KEY)
+        if entry is None or entry.get("sig") != self.signature or \
+                entry.get("tree") != tree_hash:
+            return None
+        self.hits += 1
+        return ([Finding.from_json(d) for d in entry.get("findings", [])],
+                int(entry.get("suppressed", 0)))
+
+    def put_program(self, tree_hash: str, findings: list[Finding],
+                    suppressed: int) -> None:
+        self._table[PROGRAM_KEY] = {
+            "sig": self.signature,
+            "tree": tree_hash,
+            "suppressed": suppressed,
             "findings": [f.to_json() for f in findings],
         }
         self._dirty = True

@@ -30,22 +30,33 @@ OFF_LOOP_RE = re.compile(r"#\s*tasklint:\s*off-loop\b")
 
 @dataclasses.dataclass(frozen=True, order=True)
 class Finding:
-    """One rule violation at one source location."""
+    """One rule violation at one source location.
+
+    Interprocedural rules additionally carry ``chain`` — the full call
+    path as ``file:line`` frames, first frame = the entry site the
+    finding is reported at, last frame = the offending leaf. Editors
+    render it as a navigable path; ``--json`` emits it verbatim.
+    """
 
     path: str  # repo-relative posix path
     line: int
     col: int  # 1-based, for editors
     rule: str
     message: str
+    chain: tuple[str, ...] = ()
 
     def format(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+        text = f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+        if self.chain:
+            text += "\n    chain: " + " -> ".join(self.chain)
+        return text
 
     def fingerprint(self) -> str:
         """Baseline identity. Deliberately excludes the line number so
         unrelated edits above a grandfathered finding don't churn the
         baseline file; two identical findings in one file share a
-        fingerprint and are matched by count."""
+        fingerprint and are matched by count. The chain is excluded for
+        the same reason — its frames are line numbers."""
         raw = f"{self.rule}|{self.path}|{self.message}"
         return hashlib.sha1(raw.encode()).hexdigest()[:16]
 
@@ -56,13 +67,15 @@ class Finding:
             "line": self.line,
             "col": self.col,
             "message": self.message,
+            "chain": list(self.chain),
             "fingerprint": self.fingerprint(),
         }
 
     @classmethod
     def from_json(cls, doc: dict) -> "Finding":
         return cls(path=doc["path"], line=doc["line"], col=doc["col"],
-                   rule=doc["rule"], message=doc["message"])
+                   rule=doc["rule"], message=doc["message"],
+                   chain=tuple(doc.get("chain") or ()))
 
 
 class FileContext:
@@ -179,15 +192,44 @@ class Rule:
         yield from ast.walk(ctx.tree)
 
 
+class ProgramRule:
+    """Base class for whole-program rules: ``check`` sees the
+    :class:`~tasksrunner.analysis.program.ProgramGraph` built over the
+    whole lint target, not one file. Findings still flow through the
+    same suppression / baseline / JSON machinery as per-file rules."""
+
+    id: str = ""
+    doc: str = ""
+
+    def check(self, graph) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
 #: rule id → singleton instance; populated at import of ``.rules``
 RULES: dict[str, Rule] = {}
 
+#: whole-program rule id → singleton; shares the id namespace with
+#: RULES (the suppression validator and ``--rules`` see one table)
+PROGRAM_RULES: dict[str, ProgramRule] = {}
+
+
+def known_rule_ids() -> set[str]:
+    return set(RULES) | set(PROGRAM_RULES)
+
+
+def _register_into(table: dict, inst) -> None:
+    if not inst.id:
+        raise ValueError(f"{type(inst).__name__} has no rule id")
+    if inst.id in RULES or inst.id in PROGRAM_RULES:
+        raise ValueError(f"duplicate rule id {inst.id!r}")
+    table[inst.id] = inst
+
 
 def register(cls: type[Rule]) -> type[Rule]:
-    inst = cls()
-    if not inst.id:
-        raise ValueError(f"{cls.__name__} has no rule id")
-    if inst.id in RULES:
-        raise ValueError(f"duplicate rule id {inst.id!r}")
-    RULES[inst.id] = inst
+    _register_into(RULES, cls())
+    return cls
+
+
+def register_program(cls: type[ProgramRule]) -> type[ProgramRule]:
+    _register_into(PROGRAM_RULES, cls())
     return cls

@@ -1,11 +1,21 @@
 """File walking, suppression handling, baseline plumbing, and the CLI.
 
-Exit codes: 0 clean, 1 findings (or stale-baseline when ``--strict``),
-2 usage error. ``--json`` emits one machine-readable document::
+Two phases per run. The **per-file phase** parses each target file and
+runs the ``RULES`` table against its AST, exactly as in PR 4. The
+**whole-program phase** then builds one
+:class:`~tasksrunner.analysis.program.ProgramGraph` over the full lint
+target and runs the ``PROGRAM_RULES`` table against it — call-graph,
+lock-graph, and thread-boundary rules that no single file can express.
+Program findings flow through the same suppression, baseline, and
+``--json`` machinery; their extra ``chain`` field lists the call path
+as ``file:line`` frames.
 
-    {"version": 1,
+Exit codes: 0 clean, 1 findings, 2 usage error. ``--json`` emits one
+machine-readable document::
+
+    {"version": 2,
      "findings": [{"rule", "path", "line", "col", "message",
-                   "fingerprint"}, ...],
+                   "chain", "fingerprint"}, ...],
      "files": N, "suppressed": N, "baselined": N,
      "stale_baseline": [...]}
 """
@@ -16,13 +26,25 @@ import argparse
 import ast
 import json
 import pathlib
+import subprocess
 import sys
 from typing import Iterable, TextIO
 
 from tasksrunner.analysis import baseline as baseline_mod
-from tasksrunner.analysis import rules  # noqa: F401 - populates RULES
-from tasksrunner.analysis.cache import ResultCache, ruleset_signature
-from tasksrunner.analysis.core import RULES, Finding, SUPPRESS_RE
+from tasksrunner.analysis import rules  # noqa: F401 - populates the tables
+from tasksrunner.analysis.cache import (
+    ResultCache,
+    ruleset_signature,
+    tree_digest,
+)
+from tasksrunner.analysis.core import (
+    PROGRAM_RULES,
+    RULES,
+    SUPPRESS_RE,
+    Finding,
+    known_rule_ids,
+)
+from tasksrunner.analysis.program import ProgramGraph
 
 #: repo root = parent of the tasksrunner package
 REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
@@ -30,7 +52,7 @@ DEFAULT_TARGET = REPO_ROOT / "tasksrunner"
 DEFAULT_BASELINE = REPO_ROOT / "tasklint-baseline.json"
 DEFAULT_CACHE = REPO_ROOT / ".tasksrunner" / "tasklint-cache.json"
 
-JSON_VERSION = 1
+JSON_VERSION = 2
 
 
 def relpath(path: pathlib.Path) -> str:
@@ -54,6 +76,7 @@ def iter_py_files(paths: Iterable[pathlib.Path]) -> list[pathlib.Path]:
 def _suppressions(source: str) -> tuple[dict[int, set[str]], set[str],
                                         list[tuple[int, str]]]:
     """(per-line rule sets, whole-file rule set, unknown-rule sites)."""
+    known = known_rule_ids()
     per_line: dict[int, set[str]] = {}
     whole_file: set[str] = set()
     unknown: list[tuple[int, str]] = []
@@ -63,7 +86,7 @@ def _suppressions(source: str) -> tuple[dict[int, set[str]], set[str],
             for rid in (r.strip() for r in raw.split(",")):
                 if not rid:
                     continue
-                if rid not in RULES:
+                if rid not in known:
                     unknown.append((lineno, rid))
                 elif scope == "disable-file":
                     whole_file.add(rid)
@@ -108,7 +131,39 @@ def lint_file(path: pathlib.Path, rule_ids: tuple[str, ...],
         findings.append(Finding(
             path=rel, line=lineno, col=1, rule="bad-suppression",
             message=f"unknown rule id {rid!r} in tasklint suppression "
-                    f"(known: {', '.join(sorted(RULES))})"))
+                    f"(known: {', '.join(sorted(known_rule_ids()))})"))
+    return sorted(findings), suppressed
+
+
+def _program_suppressed(graph: ProgramGraph, finding: Finding) -> bool:
+    """A program finding spans locations: honouring a suppression
+    comment on the reported line *or on any chain frame* lets either
+    the async entry or the offending leaf opt out."""
+    if graph.suppressed(finding.path, finding.line, finding.rule):
+        return True
+    for frame in finding.chain:
+        rel, _, line = frame.rpartition(":")
+        if rel and line.isdigit() and \
+                graph.suppressed(rel, int(line), finding.rule):
+            return True
+    return False
+
+
+def lint_program(files: list[pathlib.Path], rule_ids: tuple[str, ...],
+                 ) -> tuple[list[Finding], int]:
+    """Build the ProgramGraph over ``files`` and run the
+    whole-program rules. Returns (findings, suppressed-count)."""
+    graph = ProgramGraph.build([(p, relpath(p)) for p in files])
+    raw: list[Finding] = []
+    for rid in rule_ids:
+        raw.extend(PROGRAM_RULES[rid].check(graph))
+    findings: list[Finding] = []
+    suppressed = 0
+    for f in raw:
+        if _program_suppressed(graph, f):
+            suppressed += 1
+        else:
+            findings.append(f)
     return sorted(findings), suppressed
 
 
@@ -117,8 +172,17 @@ def run(paths: list[pathlib.Path], rule_ids: tuple[str, ...], *,
         update_baseline: bool = False,
         cache_path: pathlib.Path | None = None,
         json_out: bool = False,
-        out: TextIO = sys.stdout) -> int:
+        program_paths: list[pathlib.Path] | None = None,
+        out: TextIO | None = None) -> int:
+    """``paths`` feeds the per-file phase; ``program_paths`` (default:
+    the same) feeds the whole-program graph — ``--changed`` narrows the
+    former but never the latter, since interprocedural rules are only
+    sound over the full tree."""
+    if out is None:  # resolved at call time so redirection works
+        out = sys.stdout
     files = iter_py_files(paths)
+    file_rules = tuple(r for r in rule_ids if r in RULES)
+    program_rules = tuple(r for r in rule_ids if r in PROGRAM_RULES)
     cache = ResultCache(cache_path, ruleset_signature(rule_ids))
     all_findings: list[Finding] = []
     suppressed = 0
@@ -127,10 +191,24 @@ def run(paths: list[pathlib.Path], rule_ids: tuple[str, ...], *,
         if cached is not None:
             all_findings.extend(cached)
             continue
-        findings, nsup = lint_file(path, rule_ids)
+        findings, nsup = lint_file(path, file_rules)
         suppressed += nsup
         cache.put(path, findings)
         all_findings.extend(findings)
+
+    if program_rules:
+        pfiles = iter_py_files(program_paths) if program_paths is not None \
+            else files
+        tree_hash = tree_digest(pfiles)
+        cached_prog = cache.get_program(tree_hash)
+        if cached_prog is not None:
+            pfindings, psup = cached_prog
+        else:
+            pfindings, psup = lint_program(pfiles, program_rules)
+            cache.put_program(tree_hash, pfindings, psup)
+        all_findings.extend(pfindings)
+        suppressed += psup
+
     cache.save()
     all_findings.sort()
 
@@ -177,11 +255,52 @@ def run(paths: list[pathlib.Path], rule_ids: tuple[str, ...], *,
     return 1 if fresh else 0
 
 
+def _git(args: list[str]) -> subprocess.CompletedProcess | None:
+    try:
+        return subprocess.run(["git", "-C", str(REPO_ROOT)] + args,
+                              capture_output=True, text=True, timeout=15)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+
+
+def changed_paths(scope: list[pathlib.Path]) -> list[pathlib.Path] | None:
+    """Python files changed vs the merge-base with the main branch
+    (committed, staged, unstaged, and untracked), restricted to
+    ``scope``. None = git unavailable; caller falls back to a full
+    lint."""
+    base = None
+    for ref in ("origin/main", "main"):
+        proc = _git(["merge-base", "HEAD", ref])
+        if proc is not None and proc.returncode == 0:
+            base = proc.stdout.strip()
+            break
+    diff_ref = base or "HEAD"
+    proc = _git(["diff", "--name-only", diff_ref, "--"])
+    if proc is None or proc.returncode != 0:
+        return None
+    names = {line for line in proc.stdout.splitlines() if line}
+    others = _git(["ls-files", "--others", "--exclude-standard"])
+    if others is not None and others.returncode == 0:
+        names |= {line for line in others.stdout.splitlines() if line}
+    roots = [p.resolve() for p in scope]
+    out: list[pathlib.Path] = []
+    for name in sorted(names):
+        if not name.endswith(".py"):
+            continue
+        path = (REPO_ROOT / name).resolve()
+        if not path.is_file():
+            continue  # deleted since the merge-base
+        if any(path == root or root in path.parents for root in roots):
+            out.append(path)
+    return out
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="tasksrunner lint",
-        description="tasklint: AST checks for the runtime's concurrency, "
-                    "env-flag, metric-name, and error-taxonomy invariants.")
+        description="tasklint: per-file AST checks plus whole-program "
+                    "call-graph, lock-graph, and thread-boundary rules "
+                    "for the runtime's concurrency invariants.")
     parser.add_argument("paths", nargs="*", type=pathlib.Path,
                         help="files or directories (default: the "
                              "tasksrunner package)")
@@ -190,6 +309,11 @@ def build_parser() -> argparse.ArgumentParser:
                              "(default: all)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule table and exit")
+    parser.add_argument("--changed", action="store_true",
+                        help="per-file phase only lints files changed vs "
+                             "the git merge-base with main; the "
+                             "whole-program phase still covers the full "
+                             "target (cached, so warm runs are cheap)")
     parser.add_argument("--json", action="store_true", dest="json_out",
                         help="machine-readable findings on stdout")
     parser.add_argument("--baseline", type=pathlib.Path,
@@ -209,31 +333,45 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    known = known_rule_ids()
     if args.list_rules:
-        width = max(len(r) for r in RULES)
-        for rid in sorted(RULES):
-            print(f"{rid:<{width}}  {RULES[rid].doc}")
+        table = dict(RULES)
+        table.update(PROGRAM_RULES)
+        width = max(len(r) for r in table)
+        for rid in sorted(table):
+            kind = "program" if rid in PROGRAM_RULES else "file"
+            print(f"{rid:<{width}}  [{kind}] {table[rid].doc}")
         return 0
     if args.rules:
         rule_ids = tuple(r.strip() for r in args.rules.split(",") if r.strip())
-        unknown = [r for r in rule_ids if r not in RULES]
+        unknown = [r for r in rule_ids if r not in known]
         if unknown:
             print(f"tasklint: unknown rule(s): {', '.join(unknown)} "
-                  f"(known: {', '.join(sorted(RULES))})", file=sys.stderr)
+                  f"(known: {', '.join(sorted(known))})", file=sys.stderr)
             return 2
     else:
-        rule_ids = tuple(sorted(RULES))
+        rule_ids = tuple(sorted(known))
     paths = args.paths or [DEFAULT_TARGET]
     missing = [p for p in paths if not p.exists()]
     if missing:
         print("tasklint: no such path: "
               + ", ".join(str(p) for p in missing), file=sys.stderr)
         return 2
+    program_paths = None
+    if args.changed:
+        narrowed = changed_paths(paths)
+        if narrowed is None:
+            print("tasklint: --changed: git unavailable, linting "
+                  "everything", file=sys.stderr)
+        else:
+            program_paths = paths  # program phase stays whole-tree
+            paths = narrowed
     return run(paths, rule_ids,
                baseline_path=args.baseline,
                update_baseline=args.update_baseline,
                cache_path=None if args.no_cache else args.cache,
-               json_out=args.json_out)
+               json_out=args.json_out,
+               program_paths=program_paths)
 
 
 if __name__ == "__main__":  # pragma: no cover

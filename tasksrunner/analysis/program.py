@@ -1,0 +1,701 @@
+"""ProgramGraph — the whole-program IR behind tasklint's
+interprocedural rules.
+
+PR 4's rules are deliberately per-file: each sees one AST. That shape
+cannot catch the bugs that actually bite this codebase — a sync helper
+that blocks three calls deep under an async hot path, a lock-order
+cycle split across two modules, or an attribute mutated both on the
+event loop and inside a writer thread. The ProgramGraph is built once
+per lint run over every target file and gives the program-phase rules
+(:mod:`.rules.transitive`, :mod:`.rules.lockgraph`,
+:mod:`.rules.threadshared`, :mod:`.rules.routes`) four cross-cutting
+views:
+
+* **symbol table** — every module, class, and function (including
+  nested defs), keyed ``relpath::Class.method``;
+* **call graph** — conservative, name-based edges: plain names through
+  the module's import table, ``self.``/``cls.`` method edges (base
+  classes resolved within the package), ``Class.method`` and
+  ``module.func`` attribute edges. Dispatch sites
+  (``asyncio.to_thread``, ``run_in_executor``, ``executor.submit``,
+  ``threading.Thread(target=...)``, ``threading.Timer(...)``) become
+  *dispatch* edges — the callee runs on another thread;
+* **execution contexts** — every function classified ``loop`` (async
+  bodies and their transitive sync callees), ``thread`` (dispatch
+  targets, ``# tasklint: off-loop`` marked helpers and the
+  OFF_LOOP_ENTRYPOINTS allowlist, plus their transitive callees), or
+  both. Propagation runs to a fixpoint over non-dispatch edges and
+  stops at declared off-loop helpers;
+* **lock graph** — which declared ``threading`` locks each function
+  acquires (``with self._lock:`` / module-level locks), in what nesting
+  order, which locks are held at each call site and each attribute
+  write, and whether an ``await`` occurs while a lock is held.
+
+Everything is resolved by name within the lint target — no imports are
+executed. Unresolvable calls (dynamic dispatch, foreign libraries)
+simply produce no edge: the graph under-approximates reachability, so
+interprocedural findings are conservative (a reported chain is a real
+syntactic path; absence of a finding is not a proof).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+from typing import Iterator
+
+from tasksrunner.analysis.core import OFF_LOOP_RE, SUPPRESS_RE, import_table
+from tasksrunner.analysis.rules.blocking import (
+    BLOCKING_ATTRS,
+    BLOCKING_CALLS,
+    BLOCKING_NAMES,
+    OFF_LOOP_ENTRYPOINTS,
+)
+
+_LOCK_FACTORIES = {"threading.Lock", "threading.RLock",
+                   "threading.Condition", "threading.Semaphore",
+                   "threading.BoundedSemaphore"}
+
+#: dispatch call shapes: (canonical dotted target or attr name) → index
+#: of the argument that names the function run on another thread
+_THREAD_ARG = {"asyncio.to_thread": 0}
+_THREAD_KW = {"threading.Thread": "target", "threading.Timer": "function"}
+#: threading.Timer(interval, function) — positional form
+_TIMER_POS = 1
+
+
+@dataclasses.dataclass
+class CallEdge:
+    """One resolved call site: ``caller`` invokes ``callee``."""
+
+    callee: str          # FunctionInfo key
+    lineno: int
+    dispatch: bool       # True = callee runs on another thread
+    held_locks: tuple[str, ...]  # lock ids held at the call site
+
+
+@dataclasses.dataclass
+class LockSite:
+    """One ``with <lock>:`` acquisition inside a function."""
+
+    lock: str            # canonical lock id
+    lineno: int
+    awaits_inside: bool  # an await executes while this lock is held
+    await_lineno: int | None
+    inner: tuple[str, ...]  # locks acquired (directly) while this is held
+
+
+@dataclasses.dataclass
+class AttrWrite:
+    """One ``self.<attr>`` store (plain, augmented, or subscript)."""
+
+    attr: str
+    lineno: int
+    held_locks: frozenset
+
+
+@dataclasses.dataclass
+class BlockingOp:
+    """A direct blocking call inside a function body."""
+
+    lineno: int
+    target: str          # "time.sleep", ".execute", "open", ...
+    message: str
+
+
+class FunctionInfo:
+    __slots__ = ("key", "relpath", "name", "qualname", "lineno", "node",
+                 "is_async", "off_loop", "cls_key", "edges", "lock_sites",
+                 "writes", "blocking", "contexts", "context_origin")
+
+    def __init__(self, key: str, relpath: str, qualname: str, node: ast.AST,
+                 *, is_async: bool, off_loop: bool, cls_key: str | None):
+        self.key = key
+        self.relpath = relpath
+        self.name = qualname.rsplit(".", 1)[-1]
+        self.qualname = qualname
+        self.lineno = node.lineno
+        self.node = node
+        self.is_async = is_async
+        self.off_loop = off_loop
+        self.cls_key = cls_key
+        self.edges: list[CallEdge] = []
+        self.lock_sites: list[LockSite] = []
+        self.writes: list[AttrWrite] = []
+        self.blocking: list[BlockingOp] = []
+        #: "loop" / "thread" after propagation
+        self.contexts: set[str] = set()
+        #: context → human-readable provenance, for messages
+        self.context_origin: dict[str, str] = {}
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<fn {self.key} ctx={sorted(self.contexts)}>"
+
+
+class ClassInfo:
+    __slots__ = ("key", "name", "relpath", "node", "base_names", "methods",
+                 "lock_attrs", "attr_types")
+
+    def __init__(self, key: str, name: str, relpath: str, node: ast.ClassDef):
+        self.key = key
+        self.name = name
+        self.relpath = relpath
+        self.node = node
+        self.base_names: list[str] = []
+        self.methods: dict[str, FunctionInfo] = {}
+        #: attribute names assigned a threading.Lock()/RLock()/... —
+        #: identity of a lock is (class key, attr)
+        self.lock_attrs: set[str] = set()
+        #: attr → class key, from ``self.x = SomeClass(...)`` and
+        #: annotations; lets ``self.x.m()`` resolve to SomeClass.m
+        self.attr_types: dict[str, str] = {}
+
+
+class ModuleInfo:
+    __slots__ = ("relpath", "modname", "tree", "source", "lines", "imports",
+                 "functions", "classes", "module_locks", "global_types",
+                 "suppress_line", "suppress_file")
+
+    def __init__(self, relpath: str, modname: str, source: str,
+                 tree: ast.Module):
+        self.relpath = relpath
+        self.modname = modname
+        self.tree = tree
+        self.source = source
+        self.lines = source.splitlines()
+        self.imports = import_table(tree)
+        self.functions: dict[str, FunctionInfo] = {}   # module-level defs
+        self.classes: dict[str, ClassInfo] = {}
+        self.module_locks: set[str] = set()
+        #: module-global name → class key, from ``X = SomeClass(...)``
+        #: and ``X: SomeClass | None = None`` annotations
+        self.global_types: dict[str, str] = {}
+        self.suppress_line: dict[int, set[str]] = {}
+        self.suppress_file: set[str] = set()
+
+    def marked_off_loop(self, node: ast.AST) -> bool:
+        first = min(getattr(node, "lineno", 1),
+                    *[d.lineno for d in getattr(node, "decorator_list", [])]
+                    or [getattr(node, "lineno", 1)])
+        for lineno in range(first, getattr(node, "lineno", first) + 1):
+            if 0 < lineno <= len(self.lines) and \
+                    OFF_LOOP_RE.search(self.lines[lineno - 1]):
+                return True
+        return False
+
+
+def _modname(relpath: str) -> str:
+    parts = relpath[:-3].split("/") if relpath.endswith(".py") else \
+        relpath.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or relpath
+
+
+class ProgramGraph:
+    """The whole-program view. Build with :meth:`build`; rules query
+    ``functions`` / ``classes`` / ``modules`` and the helpers below."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}       # relpath → module
+        self.by_modname: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}   # key → fn
+        self.classes: dict[str, ClassInfo] = {}        # key → class
+        #: class name → class keys (for base-class resolution by name)
+        self._class_by_name: dict[str, list[str]] = {}
+        self.parse_errors: list[tuple[str, str]] = []
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def build(cls, files: list[tuple[pathlib.Path, str]]) -> "ProgramGraph":
+        """``files`` is (absolute path, repo-relative posix path)."""
+        graph = cls()
+        for path, relpath in files:
+            try:
+                source = path.read_text()
+                tree = ast.parse(source, filename=str(path))
+            except (OSError, UnicodeDecodeError, SyntaxError) as exc:
+                graph.parse_errors.append((relpath, str(exc)))
+                continue
+            graph._index_module(relpath, source, tree)
+        for mod in graph.modules.values():
+            graph._infer_types(mod)
+        for mod in graph.modules.values():
+            graph._scan_module(mod)
+        graph._propagate_contexts()
+        return graph
+
+    def _infer_types(self, mod: ModuleInfo) -> None:
+        """Nominal typing, one level deep: a name (module global, class
+        attribute, or — handled in the body scan — function local) bound
+        to ``SomeClass(...)`` or annotated with an in-package class gets
+        that class, so method calls through it resolve. Runs after every
+        module is indexed, since annotations cross module boundaries."""
+        for node in mod.tree.body:
+            name, cinfo = None, None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                cinfo = self._class_of_call(mod, node.value)
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name):
+                name = node.target.id
+                cinfo = self._annotation_class(mod, node.annotation) or \
+                    self._class_of_call(mod, node.value)
+            if name and cinfo is not None:
+                mod.global_types.setdefault(name, cinfo.key)
+        for cls in mod.classes.values():
+            for node in ast.walk(cls.node):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    attr = _self_attr(node.targets[0])
+                    hit = self._class_of_call(mod, node.value)
+                elif isinstance(node, ast.AnnAssign):
+                    attr = (node.target.id
+                            if isinstance(node.target, ast.Name)
+                            else _self_attr(node.target))
+                    hit = self._annotation_class(mod, node.annotation) or \
+                        self._class_of_call(mod, node.value)
+                else:
+                    continue
+                if attr and hit is not None:
+                    cls.attr_types.setdefault(attr, hit.key)
+
+    def _class_of_call(self, mod: ModuleInfo,
+                       value: ast.AST | None) -> ClassInfo | None:
+        """``SomeClass(...)`` → the in-package class it constructs."""
+        if not isinstance(value, ast.Call):
+            return None
+        if isinstance(value.func, ast.Name):
+            return self._class_of_name(mod, value.func.id)
+        fq = _resolve_dotted(mod.imports, value.func)
+        return self._class_fq(fq) if fq else None
+
+    def _class_fq(self, fq: str) -> ClassInfo | None:
+        parts = fq.split(".")
+        if len(parts) < 2:
+            return None
+        owner = self.by_modname.get(".".join(parts[:-1]))
+        return owner.classes.get(parts[-1]) if owner is not None else None
+
+    def _annotation_class(self, mod: ModuleInfo,
+                          node: ast.AST | None) -> ClassInfo | None:
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+            return self._annotation_class(mod, node.left) or \
+                self._annotation_class(mod, node.right)
+        if isinstance(node, ast.Subscript):  # Optional[X] / list[X]: inner
+            return self._annotation_class(mod, node.slice)
+        if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+                and node.value.isidentifier():
+            return self._class_of_name(mod, node.value)
+        if isinstance(node, ast.Name):
+            return self._class_of_name(mod, node.id)
+        if isinstance(node, ast.Attribute):
+            fq = _resolve_dotted(mod.imports, node)
+            return self._class_fq(fq) if fq else None
+        return None
+
+    def _index_module(self, relpath: str, source: str,
+                      tree: ast.Module) -> None:
+        mod = ModuleInfo(relpath, _modname(relpath), source, tree)
+        self.modules[relpath] = mod
+        self.by_modname[mod.modname] = mod
+        for lineno, line in enumerate(source.splitlines(), start=1):
+            for match in SUPPRESS_RE.finditer(line):
+                scope, raw = match.group(1), match.group(2)
+                ids = {r.strip() for r in raw.split(",") if r.strip()}
+                if scope == "disable-file":
+                    mod.suppress_file.update(ids)
+                else:
+                    mod.suppress_line.setdefault(lineno, set()).update(ids)
+        allow = OFF_LOOP_ENTRYPOINTS.get(relpath, frozenset())
+
+        def index_fn(node, qualname: str, cls: ClassInfo | None) -> None:
+            key = f"{relpath}::{qualname}"
+            off = (node.name in allow and cls is None) or \
+                (node.name in allow and cls is not None) or \
+                mod.marked_off_loop(node)
+            fn = FunctionInfo(key, relpath, qualname, node,
+                              is_async=isinstance(node, ast.AsyncFunctionDef),
+                              off_loop=off,
+                              cls_key=cls.key if cls is not None else None)
+            self.functions[key] = fn
+            if cls is not None and "." not in qualname.removeprefix(
+                    cls.name + "."):
+                cls.methods[node.name] = fn
+            elif cls is None and "." not in qualname:
+                mod.functions[node.name] = fn
+            walk_body(node, qualname, cls)
+
+        def walk_body(parent, prefix: str, cls: ClassInfo | None) -> None:
+            for child in ast.iter_child_nodes(parent):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    index_fn(child, f"{prefix}.{child.name}" if prefix
+                             else child.name, cls)
+                elif isinstance(child, ast.ClassDef):
+                    ckey = f"{relpath}::{child.name}"
+                    cinfo = ClassInfo(ckey, child.name, relpath, child)
+                    self.classes[ckey] = cinfo
+                    self._class_by_name.setdefault(child.name, []).append(ckey)
+                    if not prefix:
+                        mod.classes[child.name] = cinfo
+                    for base in child.bases:
+                        if isinstance(base, ast.Name):
+                            cinfo.base_names.append(base.id)
+                        elif isinstance(base, ast.Attribute):
+                            cinfo.base_names.append(base.attr)
+                    for item in child.body:
+                        if isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                            index_fn(item, f"{child.name}.{item.name}", cinfo)
+                        elif isinstance(item, ast.ClassDef):
+                            walk_body(child, child.name, None)
+                            break
+                else:
+                    walk_body(child, prefix, cls)
+
+        walk_body(tree, "", None)
+        # module-level locks: X = threading.Lock()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                target = _resolve_dotted(mod.imports, node.value.func)
+                if target in _LOCK_FACTORIES:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            mod.module_locks.add(tgt.id)
+        # class lock attributes: self.x = threading.Lock() anywhere in class
+        for cinfo in mod.classes.values():
+            for node in ast.walk(cinfo.node):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    target = _resolve_dotted(mod.imports, node.value.func)
+                    if target in _LOCK_FACTORIES:
+                        for tgt in node.targets:
+                            attr = _self_attr(tgt)
+                            if attr:
+                                cinfo.lock_attrs.add(attr)
+
+    # -- symbol resolution -------------------------------------------------
+
+    def _resolve_fq(self, fq: str) -> FunctionInfo | None:
+        """"tasksrunner.state.sqlite.SqliteStateStore.close" → fn, by
+        longest-module-prefix match, then class-method or module-fn."""
+        parts = fq.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = self.by_modname.get(".".join(parts[:cut]))
+            if mod is None:
+                continue
+            rest = parts[cut:]
+            if len(rest) == 1:
+                return mod.functions.get(rest[0])
+            if len(rest) == 2:
+                cinfo = mod.classes.get(rest[0])
+                if cinfo is not None:
+                    return self._method(cinfo, rest[1])
+            return None
+        return None
+
+    def _method(self, cinfo: ClassInfo, name: str,
+                _seen: frozenset = frozenset()) -> FunctionInfo | None:
+        """Method lookup walking base classes by name (package-only)."""
+        if cinfo.key in _seen:
+            return None
+        fn = cinfo.methods.get(name)
+        if fn is not None:
+            return fn
+        for base_name in cinfo.base_names:
+            for bkey in self._class_by_name.get(base_name, ()):
+                found = self._method(self.classes[bkey], name,
+                                     _seen | {cinfo.key})
+                if found is not None:
+                    return found
+        return None
+
+    def _class_of_name(self, mod: ModuleInfo, name: str) -> ClassInfo | None:
+        cinfo = mod.classes.get(name)
+        if cinfo is not None:
+            return cinfo
+        fq = mod.imports.get(name)
+        if fq is None:
+            return None
+        parts = fq.split(".")
+        if len(parts) < 2:
+            return None
+        owner = self.by_modname.get(".".join(parts[:-1]))
+        return owner.classes.get(parts[-1]) if owner is not None else None
+
+    def _attr_type(self, cinfo: ClassInfo, attr: str,
+                   _seen: frozenset = frozenset()) -> str | None:
+        if cinfo.key in _seen:
+            return None
+        hit = cinfo.attr_types.get(attr)
+        if hit is not None:
+            return hit
+        for base_name in cinfo.base_names:
+            for bkey in self._class_by_name.get(base_name, ()):
+                hit = self._attr_type(self.classes[bkey], attr,
+                                      _seen | {cinfo.key})
+                if hit is not None:
+                    return hit
+        return None
+
+    def _resolve_callee(self, mod: ModuleInfo, fn: FunctionInfo,
+                        func_expr: ast.AST, local_defs: dict[str, str],
+                        local_types: dict[str, str]) -> FunctionInfo | None:
+        if isinstance(func_expr, ast.Name):
+            nested = local_defs.get(func_expr.id)
+            if nested is not None:
+                return self.functions.get(nested)
+            local = mod.functions.get(func_expr.id)
+            if local is not None:
+                return local
+            fq = mod.imports.get(func_expr.id)
+            return self._resolve_fq(fq) if fq else None
+        if isinstance(func_expr, ast.Attribute):
+            value, attr = func_expr.value, func_expr.attr
+            if isinstance(value, ast.Name):
+                if value.id in ("self", "cls") and fn.cls_key is not None:
+                    return self._method(self.classes[fn.cls_key], attr)
+                cinfo = self._class_of_name(mod, value.id)
+                if cinfo is not None:
+                    return self._method(cinfo, attr)
+                # instance variables: local first, then module global
+                ckey = local_types.get(value.id) or \
+                    mod.global_types.get(value.id)
+                if ckey is not None:
+                    return self._method(self.classes[ckey], attr)
+            inner = _self_attr(value)  # self.x.m() via inferred attr type
+            if inner is not None and fn.cls_key is not None:
+                ckey = self._attr_type(self.classes[fn.cls_key], inner)
+                if ckey is not None:
+                    return self._method(self.classes[ckey], attr)
+            fq = _resolve_dotted(mod.imports, func_expr)
+            return self._resolve_fq(fq) if fq else None
+        return None
+
+    # -- body scan: edges, locks, writes, blocking ------------------------
+
+    def _scan_module(self, mod: ModuleInfo) -> None:
+        for fn in self.functions.values():
+            if fn.relpath == mod.relpath:
+                self._scan_function(mod, fn)
+
+    def _lock_id(self, mod: ModuleInfo, fn: FunctionInfo,
+                 expr: ast.AST) -> str | None:
+        attr = _self_attr(expr)
+        if attr is not None and fn.cls_key is not None:
+            cinfo = self.classes[fn.cls_key]
+            if attr in self._all_lock_attrs(cinfo):
+                return f"{cinfo.key}.{attr}"
+            return None
+        if isinstance(expr, ast.Name) and expr.id in mod.module_locks:
+            return f"{mod.relpath}::{expr.id}"
+        return None
+
+    def _all_lock_attrs(self, cinfo: ClassInfo,
+                        _seen: frozenset = frozenset()) -> set[str]:
+        if cinfo.key in _seen:
+            return set()
+        attrs = set(cinfo.lock_attrs)
+        for base_name in cinfo.base_names:
+            for bkey in self._class_by_name.get(base_name, ()):
+                attrs |= self._all_lock_attrs(self.classes[bkey],
+                                              _seen | {cinfo.key})
+        return attrs
+
+    def _scan_function(self, mod: ModuleInfo, fn: FunctionInfo) -> None:
+        #: nested ``def``s visible to calls inside this function
+        local_defs = {
+            child.name: f"{fn.relpath}::{fn.qualname}.{child.name}"
+            for child in ast.walk(fn.node)
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and child is not fn.node}
+        #: function-local ``x = SomeClass(...)`` so ``x.m()`` resolves
+        local_types: dict[str, str] = {}
+        for child in ast.walk(fn.node):
+            if isinstance(child, ast.Assign) and len(child.targets) == 1 \
+                    and isinstance(child.targets[0], ast.Name):
+                hit = self._class_of_call(mod, child.value)
+                if hit is not None:
+                    local_types.setdefault(child.targets[0].id, hit.key)
+        open_sites: list[LockSite] = []  # stack of held locks
+
+        def visit(node: ast.AST, awaited: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn.node:
+                return  # nested defs are their own FunctionInfo
+            if isinstance(node, ast.Lambda):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired: list[LockSite] = []
+                for item in node.items:
+                    lock = self._lock_id(mod, fn, item.context_expr)
+                    if lock is not None:
+                        site = LockSite(lock=lock, lineno=node.lineno,
+                                        awaits_inside=False,
+                                        await_lineno=None, inner=())
+                        for outer in open_sites:
+                            if outer.lock != lock:
+                                outer.inner = outer.inner + (lock,)
+                        open_sites.append(site)
+                        acquired.append(site)
+                        fn.lock_sites.append(site)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, awaited)
+                for site in acquired:
+                    open_sites.remove(site)
+                return
+            if isinstance(node, (ast.Await, ast.AsyncFor)):
+                for site in open_sites:
+                    if not site.awaits_inside:
+                        site.awaits_inside = True
+                        site.await_lineno = node.lineno
+                for child in ast.iter_child_nodes(node):
+                    visit(child, isinstance(node, ast.Await))
+                return
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                held = frozenset(s.lock for s in open_sites)
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    attr = _self_attr(tgt)
+                    if attr is None and isinstance(tgt, ast.Subscript):
+                        attr = _self_attr(tgt.value)
+                    if attr is not None:
+                        fn.writes.append(AttrWrite(attr=attr,
+                                                   lineno=node.lineno,
+                                                   held_locks=held))
+            if isinstance(node, ast.Call):
+                self._scan_call(mod, fn, node, local_defs, local_types,
+                                tuple(s.lock for s in open_sites), awaited)
+            for child in ast.iter_child_nodes(node):
+                visit(child, False)
+
+        for child in ast.iter_child_nodes(fn.node):
+            visit(child, False)
+
+    def _scan_call(self, mod: ModuleInfo, fn: FunctionInfo, call: ast.Call,
+                   local_defs: dict[str, str], local_types: dict[str, str],
+                   held: tuple[str, ...], awaited: bool) -> None:
+        target = _resolve_dotted(mod.imports, call.func)
+        # dispatch sites: the *argument* function runs on a thread
+        dispatched: list[ast.AST] = []
+        if target in _THREAD_ARG and len(call.args) > _THREAD_ARG[target]:
+            dispatched.append(call.args[_THREAD_ARG[target]])
+        if target in _THREAD_KW:
+            dispatched.extend(kw.value for kw in call.keywords
+                              if kw.arg == _THREAD_KW[target])
+            if target == "threading.Timer" and len(call.args) > _TIMER_POS:
+                dispatched.append(call.args[_TIMER_POS])
+        attr_name = call.func.attr if isinstance(call.func, ast.Attribute) \
+            else ""
+        if attr_name == "submit" and call.args:
+            dispatched.append(call.args[0])
+        elif attr_name == "run_in_executor" and len(call.args) >= 2:
+            dispatched.append(call.args[1])
+        for cand in dispatched:
+            callee = self._resolve_callee(mod, fn, cand, local_defs,
+                                         local_types)
+            if callee is not None:
+                fn.edges.append(CallEdge(callee=callee.key,
+                                         lineno=call.lineno, dispatch=True,
+                                         held_locks=held))
+                callee.contexts.add("thread")
+                callee.context_origin.setdefault(
+                    "thread", f"dispatched at {fn.relpath}:{call.lineno}")
+        if dispatched:
+            return
+        # direct blocking leaf?
+        if not awaited:
+            if target in BLOCKING_CALLS:
+                fn.blocking.append(BlockingOp(
+                    lineno=call.lineno, target=target,
+                    message=BLOCKING_CALLS[target]))
+            elif isinstance(call.func, ast.Name) and \
+                    call.func.id in BLOCKING_NAMES:
+                fn.blocking.append(BlockingOp(
+                    lineno=call.lineno, target=call.func.id,
+                    message=BLOCKING_NAMES[call.func.id]))
+            elif attr_name in BLOCKING_ATTRS:
+                fn.blocking.append(BlockingOp(
+                    lineno=call.lineno, target=f".{attr_name}",
+                    message=BLOCKING_ATTRS[attr_name]))
+        # plain call edge
+        callee = self._resolve_callee(mod, fn, call.func, local_defs,
+                                     local_types)
+        if callee is not None and callee.key != fn.key:
+            fn.edges.append(CallEdge(callee=callee.key, lineno=call.lineno,
+                                     dispatch=False, held_locks=held))
+
+    # -- context propagation ----------------------------------------------
+
+    def _propagate_contexts(self) -> None:
+        work: list[FunctionInfo] = []
+        for fn in self.functions.values():
+            if fn.is_async:
+                fn.contexts.add("loop")
+                fn.context_origin.setdefault("loop", "async def")
+            if fn.off_loop:
+                fn.contexts.add("thread")
+                fn.context_origin.setdefault(
+                    "thread", "declared off-loop")
+            if fn.contexts:
+                work.append(fn)
+        while work:
+            fn = work.pop()
+            for edge in fn.edges:
+                if edge.dispatch:
+                    continue
+                callee = self.functions.get(edge.callee)
+                if callee is None:
+                    continue
+                for ctx in fn.contexts:
+                    if ctx in callee.contexts:
+                        continue
+                    if callee.is_async:
+                        continue  # async callees are their own loop entry
+                    if ctx == "loop" and callee.off_loop:
+                        continue  # declared thread-only: trust the marker
+                    callee.contexts.add(ctx)
+                    callee.context_origin.setdefault(
+                        ctx, f"called from {fn.qualname} "
+                             f"({fn.relpath}:{edge.lineno})")
+                    work.append(callee)
+
+    # -- queries -----------------------------------------------------------
+
+    def iter_functions(self) -> Iterator[FunctionInfo]:
+        return iter(self.functions.values())
+
+    def suppressed(self, relpath: str, lineno: int, rule: str) -> bool:
+        mod = self.modules.get(relpath)
+        if mod is None:
+            return False
+        return rule in mod.suppress_file or \
+            rule in mod.suppress_line.get(lineno, ())
+
+    def frame(self, fn: FunctionInfo, lineno: int) -> str:
+        return f"{fn.relpath}:{lineno}"
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _resolve_dotted(imports: dict[str, str], func: ast.AST) -> str | None:
+    parts: list[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    dotted = ".".join(reversed(parts))
+    head, _, rest = dotted.partition(".")
+    base = imports.get(head, head)
+    return f"{base}.{rest}" if rest else base

@@ -1,4 +1,10 @@
-"""Rule modules — importing this package populates ``core.RULES``."""
+"""Rule modules — importing this package populates ``core.RULES``
+and ``core.PROGRAM_RULES``.
+
+Import order note: the whole-program modules (transitive, lockgraph,
+threadshared, routes) import :mod:`tasksrunner.analysis.program`,
+which reuses the blocking-call tables from :mod:`.blocking`.
+"""
 
 from __future__ import annotations
 
@@ -7,7 +13,11 @@ from tasksrunner.analysis.rules import (  # noqa: F401
     blocking,
     coroutines,
     envflags,
+    lockgraph,
     locks,
     metricnames,
+    routes,
     taxonomy,
+    threadshared,
+    transitive,
 )

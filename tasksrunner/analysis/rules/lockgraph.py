@@ -1,0 +1,158 @@
+"""Whole-program lock discipline: ordering cycles and await-while-held.
+
+``lock-order-cycle`` builds a global acquired-before relation over
+every declared ``threading`` lock (class attributes and module-level
+locks): ``A → B`` when some function acquires B while holding A —
+either via a nested ``with`` in one body, or interprocedurally when a
+function holding A calls (transitively) into code that acquires B. A
+cycle in that relation is a deadlock waiting for the right
+interleaving, and the two halves are usually in different files, which
+is exactly why the per-file rule from PR 4 cannot see it.
+
+``held-lock-across-await`` flags a ``with <threading lock>:`` block in
+an async function whose body awaits. While the coroutine is suspended
+the lock stays held; any other task (or thread) that touches the same
+lock then blocks — and if that contender runs on the event loop, the
+loop wedges entirely.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from tasksrunner.analysis.core import Finding, ProgramRule, register_program
+from tasksrunner.analysis.program import FunctionInfo, ProgramGraph
+
+
+def _short(lock: str) -> str:
+    """Display name: drop the ``relpath::`` qualifier."""
+    return lock.rsplit("::", 1)[-1]
+
+
+@register_program
+class HeldLockAcrossAwait(ProgramRule):
+    id = "held-lock-across-await"
+    doc = "threading lock held across an await suspends every contender"
+
+    def check(self, graph: ProgramGraph) -> Iterable[Finding]:
+        for fn in sorted(graph.functions.values(),
+                         key=lambda f: (f.relpath, f.lineno)):
+            if not fn.is_async:
+                continue
+            for site in fn.lock_sites:
+                if not site.awaits_inside:
+                    continue
+                chain = (graph.frame(fn, site.lineno),
+                         graph.frame(fn, site.await_lineno or site.lineno))
+                yield Finding(
+                    path=fn.relpath, line=site.lineno, col=1, rule=self.id,
+                    message=f"threading lock {_short(site.lock)} is held "
+                            f"across an await in {fn.qualname}; the loop "
+                            "cannot run other tasks while a contender "
+                            "blocks on it",
+                    chain=chain)
+
+
+@register_program
+class LockOrderCycle(ProgramRule):
+    id = "lock-order-cycle"
+    doc = "global acquired-before relation over declared locks has a cycle"
+
+    def check(self, graph: ProgramGraph) -> Iterable[Finding]:
+        # acquired-before edges with one witness per ordered pair:
+        # (outer, inner) → (fn, lineno, description)
+        edges: dict[tuple[str, str], tuple[FunctionInfo, int, str]] = {}
+        memo: dict[str, frozenset] = {}
+        for fn in graph.functions.values():
+            for site in fn.lock_sites:
+                for inner in site.inner:
+                    edges.setdefault((site.lock, inner), (
+                        fn, site.lineno,
+                        f"{fn.qualname} acquires {_short(inner)} while "
+                        f"holding {_short(site.lock)}"))
+            for edge in fn.edges:
+                if edge.dispatch or not edge.held_locks:
+                    continue
+                callee = graph.functions.get(edge.callee)
+                if callee is None:
+                    continue
+                for inner in sorted(self._acquires(graph, callee, memo,
+                                                   frozenset())):
+                    for outer in edge.held_locks:
+                        if outer == inner:
+                            continue
+                        edges.setdefault((outer, inner), (
+                            fn, edge.lineno,
+                            f"{fn.qualname} calls {callee.qualname} "
+                            f"(acquires {_short(inner)}) while holding "
+                            f"{_short(outer)}"))
+        adj: dict[str, set[str]] = {}
+        for outer, inner in edges:
+            adj.setdefault(outer, set()).add(inner)
+
+        reported: set[frozenset] = set()
+        for (outer, inner), (fn, lineno, _) in sorted(
+                edges.items(), key=lambda kv: (kv[1][0].relpath, kv[1][1])):
+            back = self._path(adj, inner, outer)
+            if back is None:
+                continue
+            cycle = [outer] + back  # [outer, inner, ..., outer]
+            key = frozenset(cycle)
+            if key in reported:
+                continue
+            reported.add(key)
+            frames, notes = [], []
+            for a, b in zip(cycle, cycle[1:]):
+                wfn, wline, wdesc = edges[(a, b)]
+                frames.append(graph.frame(wfn, wline))
+                notes.append(wdesc)
+            yield Finding(
+                path=fn.relpath, line=lineno, col=1, rule=self.id,
+                message="lock order cycle "
+                        + " -> ".join(_short(n) for n in cycle)
+                        + ": " + "; ".join(notes),
+                chain=tuple(frames))
+
+    def _acquires(self, graph: ProgramGraph, fn: FunctionInfo,
+                  memo: dict[str, frozenset],
+                  stack: frozenset) -> frozenset:
+        """Locks ``fn`` may acquire, directly or via non-dispatch
+        callees. Memoised; recursion through cycles contributes the
+        partial set, which only under-approximates."""
+        if fn.key in memo:
+            return memo[fn.key]
+        if fn.key in stack:
+            return frozenset()
+        acq = {site.lock for site in fn.lock_sites}
+        for edge in fn.edges:
+            if edge.dispatch:
+                continue
+            callee = graph.functions.get(edge.callee)
+            if callee is not None:
+                acq |= self._acquires(graph, callee, memo,
+                                      stack | {fn.key})
+        result = frozenset(acq)
+        memo[fn.key] = result
+        return result
+
+    @staticmethod
+    def _path(adj: dict[str, set[str]], src: str,
+              dst: str) -> list[str] | None:
+        """Shortest src→…→dst node list (starting at src), else None."""
+        if src == dst:
+            return [src]
+        prev: dict[str, str] = {src: ""}
+        queue = [src]
+        while queue:
+            node = queue.pop(0)
+            for nxt in sorted(adj.get(node, ())):
+                if nxt in prev:
+                    continue
+                prev[nxt] = node
+                if nxt == dst:
+                    path = [nxt]
+                    while path[-1] != src:
+                        path.append(prev[path[-1]])
+                    return list(reversed(path))
+                queue.append(nxt)
+        return None

@@ -1,0 +1,279 @@
+"""route-conformance: HTTP request sites vs the declared route tables.
+
+The sidecar declares its surface with aiohttp ``RouteTableDef``
+decorators (``@routes.post("/v1.0/state/{store}")``,
+``routes.route("*", ...)``); the orchestrator admin plane registers
+via ``app.router.add_get(...)``. The SDK (``client.py``), the CLI's
+sidecar/admin helpers, and the actor runtime's peer-forwarding all
+*construct* paths against those tables by hand — nothing checks them
+against each other, so a renamed segment or a dropped parameter only
+surfaces as a 404 at runtime. Same cross-artifact shape as the
+metric-names and flag-inventory rules, one level up the stack.
+
+Request paths are flattened conservatively: f-string interpolations
+become ``{*}`` (matches any single segment), string concatenation
+tails become ``{**}`` (matches any remaining segments), so only
+*literal* drift is flagged — a site that is entirely dynamic can match
+anything and never fires. Matching is site → route only: every
+request site must match some declared route; unused routes are fine.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable
+
+from tasksrunner.analysis.core import Finding, ProgramRule, register_program
+from tasksrunner.analysis.program import ModuleInfo, ProgramGraph
+
+_VERBS = {"get", "post", "put", "delete", "patch", "head", "options"}
+
+#: request-helper call shapes: callable name → (method-arg index,
+#: path-arg index, implicit path prefix the helper prepends)
+_HELPERS = {
+    "_request": (0, 1, ""),
+    "_sidecar_request": (1, 2, "/v1.0/"),
+    "_admin_request": (1, 2, ""),
+    "_http_forward": (1, 2, ""),
+}
+
+#: only paths under these anchors are checkable — everything else
+#: (external URLs, arbitrary strings) is out of scope
+_ANCHORS = ("/v1.0/", "/admin/")
+
+
+@dataclasses.dataclass(frozen=True)
+class _Route:
+    method: str          # upper-case verb or "*"
+    path: str
+    relpath: str
+    lineno: int
+
+    @property
+    def segments(self) -> tuple[str, ...]:
+        return tuple(s for s in self.path.split("/") if s)
+
+
+@dataclasses.dataclass(frozen=True)
+class _Site:
+    method: str          # upper-case verb or "*"
+    path: str            # flattened: literals, {*}, {**}
+    relpath: str
+    lineno: int
+
+    @property
+    def segments(self) -> tuple[str, ...]:
+        return tuple(s for s in self.path.split("/") if s)
+
+
+def _flatten(node: ast.AST) -> str | None:
+    """Conservative string shape of a path expression; None = fully
+    dynamic (nothing checkable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(str(piece.value))
+            else:
+                parts.append("{*}")
+        return "".join(parts)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _flatten(node.left)
+        return f"{left}{{**}}" if left is not None else None
+    return None
+
+
+def _is_rest(route_seg: str) -> bool:
+    """aiohttp rest parameter: ``{name:.*}`` swallows the remainder."""
+    return route_seg.startswith("{") and ":" in route_seg \
+        and route_seg.endswith("}")
+
+
+def _is_param(route_seg: str) -> bool:
+    return route_seg.startswith("{") and route_seg.endswith("}")
+
+
+def _seg_match(site_seg: str, route_seg: str) -> bool:
+    if site_seg == "{*}" or site_seg == "{**}":
+        return True
+    if _is_param(route_seg):
+        return True
+    if "{*" in site_seg:
+        # mixed segment like "logs{*}": the dynamic tail may be empty
+        # (a query string, an optional suffix) — match on the literal
+        # prefix only
+        prefix = site_seg.split("{", 1)[0]
+        return route_seg.startswith(prefix)
+    return site_seg == route_seg
+
+
+def _segments_match(site: tuple[str, ...], route: tuple[str, ...]) -> bool:
+    def walk(i: int, j: int) -> bool:
+        if j < len(route) and _is_rest(route[j]):
+            return True  # rest param matches ≥0 remaining segments
+        if i < len(site) and site[i] == "{**}":
+            return True  # unknown site tail matches ≥0 remaining route
+        if i == len(site) or j == len(route):
+            return i == len(site) and j == len(route)
+        return _seg_match(site[i], route[j]) and walk(i + 1, j + 1)
+
+    return walk(0, 0)
+
+
+def _match(site: _Site, route: _Route) -> bool:
+    if site.method != "*" and route.method != "*" \
+            and site.method != route.method:
+        return False
+    return _segments_match(site.segments, route.segments)
+
+
+def _closest(site: _Site, routes: list[_Route]) -> _Route | None:
+    def score(route: _Route) -> int:
+        pts = sum(2 for a, b in zip(site.segments, route.segments)
+                  if a == b) \
+            + sum(1 for a, b in zip(site.segments, route.segments)
+                  if a != b and _seg_match(a, b))
+        if len(site.segments) == len(route.segments):
+            pts += 1
+        if site.method in ("*", route.method) or route.method == "*":
+            pts += 1
+        return pts
+
+    return max(routes, key=score, default=None)
+
+
+@register_program
+class RouteConformance(ProgramRule):
+    id = "route-conformance"
+    doc = ("hand-built request path drifted from the declared "
+           "sidecar/admin route tables")
+
+    def check(self, graph: ProgramGraph) -> Iterable[Finding]:
+        routes = self._routes(graph)
+        if not routes:
+            return
+        for site in self._sites(graph):
+            if any(_match(site, r) for r in routes):
+                continue
+            near = _closest(site, routes)
+            hint = f" (closest route: {near.method} {near.path}, " \
+                   f"{near.relpath}:{near.lineno})" if near else ""
+            yield Finding(
+                path=site.relpath, line=site.lineno, col=1, rule=self.id,
+                message=f"request {site.method} {site.path} matches no "
+                        f"declared route{hint}",
+                chain=(f"{site.relpath}:{site.lineno}",)
+                + ((f"{near.relpath}:{near.lineno}",) if near else ()))
+
+    # -- route tables ------------------------------------------------------
+
+    def _routes(self, graph: ProgramGraph) -> list[_Route]:
+        routes: list[_Route] = []
+        for mod in graph.modules.values():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        routes.extend(self._route_from_call(mod, dec))
+                elif isinstance(node, ast.Call):
+                    routes.extend(self._router_add(mod, node))
+        return routes
+
+    def _route_from_call(self, mod: ModuleInfo,
+                         call: ast.AST) -> list[_Route]:
+        if not (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)):
+            return []
+        verb = call.func.attr
+        if verb in _VERBS and call.args:
+            path = _flatten(call.args[0])
+            if path is not None and path.startswith("/"):
+                return [_Route(verb.upper(), path, mod.relpath, call.lineno)]
+        if verb == "route" and len(call.args) >= 2:
+            method = _flatten(call.args[0])
+            path = _flatten(call.args[1])
+            if method and path is not None and path.startswith("/"):
+                return [_Route(method.upper(), path, mod.relpath,
+                               call.lineno)]
+        return []
+
+    def _router_add(self, mod: ModuleInfo, call: ast.Call) -> list[_Route]:
+        if not isinstance(call.func, ast.Attribute):
+            return []
+        name = call.func.attr
+        if name.startswith("add_") and name[4:] in _VERBS and call.args:
+            path = _flatten(call.args[0])
+            if path is not None and path.startswith("/"):
+                return [_Route(name[4:].upper(), path, mod.relpath,
+                               call.lineno)]
+        if name == "add_route" and len(call.args) >= 2:
+            method = _flatten(call.args[0])
+            path = _flatten(call.args[1])
+            if method and path is not None and path.startswith("/"):
+                return [_Route(method.upper(), path, mod.relpath,
+                               call.lineno)]
+        return []
+
+    # -- request sites -----------------------------------------------------
+
+    def _sites(self, graph: ProgramGraph) -> list[_Site]:
+        sites: list[_Site] = []
+        seen: set[tuple[str, int, str]] = set()
+        consumed: set[int] = set()
+
+        def add(mod: ModuleInfo, lineno: int, method: str,
+                flat: str) -> None:
+            anchor = min((flat.find(a) for a in _ANCHORS
+                          if flat.find(a) >= 0), default=-1)
+            if anchor < 0:
+                return
+            path = flat[anchor:]
+            key = (mod.relpath, lineno, path)
+            if key not in seen:
+                seen.add(key)
+                sites.append(_Site(method, path, mod.relpath, lineno))
+
+        def walk(mod: ModuleInfo, node: ast.AST, infn: str) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                infn = node.name
+            if isinstance(node, ast.Call):
+                fname = node.func.attr \
+                    if isinstance(node.func, ast.Attribute) else (
+                        node.func.id if isinstance(node.func, ast.Name)
+                        else "")
+                # inside a helper's own body every path is dynamic by
+                # construction — the callers are the checkable sites
+                if fname in _HELPERS and infn not in _HELPERS:
+                    mi, pi, prefix = _HELPERS[fname]
+                    if len(node.args) > pi:
+                        consumed.add(id(node.args[pi]))
+                        method = _flatten(node.args[mi]) or "*"
+                        flat = _flatten(node.args[pi])
+                        if flat is not None:
+                            add(mod, node.lineno,
+                                method.upper() if method != "*" else "*",
+                                prefix + flat if not flat.startswith("/")
+                                else flat)
+                elif fname in _VERBS | {"request"} and infn not in _HELPERS:
+                    for arg in node.args:
+                        if id(arg) in consumed:
+                            continue
+                        flat = _flatten(arg)
+                        if flat is not None:
+                            method = fname.upper() \
+                                if fname in _VERBS else "*"
+                            add(mod, node.lineno, method, flat)
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, (ast.JoinedStr, ast.BinOp)) \
+                    and infn not in _HELPERS:
+                flat = _flatten(node.value)
+                if flat is not None:
+                    add(mod, node.lineno, "*", flat)
+            for child in ast.iter_child_nodes(node):
+                walk(mod, child, infn)
+
+        for mod in graph.modules.values():
+            walk(mod, mod.tree, "")
+        return sites

@@ -1,0 +1,81 @@
+"""thread-shared-state: a lockset race detector over class attributes.
+
+Classic Eraser, scaled down to what the graph knows statically: for
+every class, collect all ``self.<attr>`` writes outside ``__init__``,
+note each write's execution context (from the ProgramGraph's
+propagation) and the set of declared locks held at the write. The
+boundary of interest is *dispatched-thread code vs everything else*:
+a function reachable only from a dispatch site (``Thread(target=)``,
+``to_thread``, a timer, an executor) runs on its own thread, while a
+function with loop context — or with no inferred context at all — runs
+on whichever thread calls it (the event loop, the CLI main thread, an
+``atexit`` hook). If an attribute is written on both sides of that
+boundary and the intersection of held-lock sets over those writes is
+empty, no single lock orders the accesses — the interleaving is a data
+race.
+
+``__init__`` writes are exempt (the object is not yet shared), as are
+the lock attributes themselves. A write site whose function carries
+*both* contexts counts on both sides: the same method called from the
+loop and from a worker thread is precisely the hazard.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from tasksrunner.analysis.core import Finding, ProgramRule, register_program
+from tasksrunner.analysis.program import ProgramGraph
+
+_EXEMPT_METHODS = {"__init__", "__new__", "__post_init__", "__init_subclass__"}
+
+
+@register_program
+class ThreadSharedState(ProgramRule):
+    id = "thread-shared-state"
+    doc = ("attribute written both from dispatched-thread context and "
+           "from loop/caller context with no common lock")
+
+    def check(self, graph: ProgramGraph) -> Iterable[Finding]:
+        for ckey in sorted(graph.classes):
+            cinfo = graph.classes[ckey]
+            lock_attrs = graph._all_lock_attrs(cinfo)
+            # attr → [(fn, write)] over every function of the class
+            writes: dict[str, list] = {}
+            for fn in sorted(graph.functions.values(),
+                             key=lambda f: (f.relpath, f.lineno)):
+                if fn.cls_key != ckey or fn.name in _EXEMPT_METHODS:
+                    continue
+                for w in fn.writes:
+                    if w.attr in lock_attrs:
+                        continue
+                    writes.setdefault(w.attr, []).append((fn, w))
+            for attr in sorted(writes):
+                sites = writes[attr]
+                thread_sites = [(f, w) for f, w in sites
+                                if "thread" in f.contexts]
+                # "other side": may run on the loop or on whatever
+                # thread calls it — anything not proven thread-only
+                other_sites = [(f, w) for f, w in sites
+                               if f.contexts != {"thread"}]
+                if not thread_sites or not other_sites:
+                    continue
+                boundary = thread_sites + other_sites
+                common = frozenset.intersection(
+                    *(w.held_locks for _, w in boundary))
+                if common:
+                    continue
+                tfn, tw = thread_sites[0]
+                ofn, ow = other_sites[0]
+                thread_why = tfn.context_origin.get("thread", "off-loop")
+                other_why = ("event-loop context"
+                             if "loop" in ofn.contexts
+                             else "caller context")
+                yield Finding(
+                    path=tfn.relpath, line=tw.lineno, col=1, rule=self.id,
+                    message=f"{cinfo.name}.{attr} is written from thread "
+                            f"context in {tfn.qualname} ({thread_why}) and "
+                            f"from {other_why} in {ofn.qualname} with no "
+                            "common lock",
+                    chain=(f"{tfn.relpath}:{tw.lineno}",
+                           f"{ofn.relpath}:{ow.lineno}"))

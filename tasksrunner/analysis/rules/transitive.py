@@ -1,0 +1,81 @@
+"""transitive-blocking: blocking leaves reachable from async context.
+
+The per-file ``async-blocking-call`` rule only sees blocking calls
+written directly inside an ``async def``. The real offenders hide one
+or more calls deep: an async handler calls a sync helper which calls
+another helper which does ``time.sleep`` / ``sqlite3`` / ``pathlib``
+I/O. This rule walks the ProgramGraph's call graph from every async
+function through sync callees — stopping at dispatch sites
+(``to_thread`` / ``run_in_executor`` / ``Thread(target=...)``) and at
+functions declared ``# tasklint: off-loop`` — and reports the first
+path that ends at a direct blocking operation. The finding carries the
+full chain as ``file:line`` frames: entry call site first, blocking
+leaf last.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from tasksrunner.analysis.core import Finding, ProgramRule, register_program
+from tasksrunner.analysis.program import (
+    BlockingOp,
+    FunctionInfo,
+    ProgramGraph,
+)
+
+
+@register_program
+class TransitiveBlocking(ProgramRule):
+    id = "transitive-blocking"
+    doc = ("sync call chain from an async function reaches a blocking "
+           "operation with no off-loop dispatch on the path")
+
+    def check(self, graph: ProgramGraph) -> Iterable[Finding]:
+        reported: set[tuple[str, str]] = set()
+        for fn in sorted(graph.functions.values(),
+                         key=lambda f: (f.relpath, f.lineno)):
+            if not fn.is_async:
+                continue
+            for edge in sorted(fn.edges, key=lambda e: e.lineno):
+                if edge.dispatch:
+                    continue
+                callee = graph.functions.get(edge.callee)
+                if callee is None or callee.is_async or callee.off_loop:
+                    continue
+                hit = self._dfs(graph, callee, frozenset({fn.key, callee.key}))
+                if hit is None:
+                    continue
+                frames, op, leaf = hit
+                if (fn.key, leaf.key) in reported:
+                    continue
+                reported.add((fn.key, leaf.key))
+                chain = (graph.frame(fn, edge.lineno),) + frames
+                yield Finding(
+                    path=fn.relpath, line=edge.lineno, col=1, rule=self.id,
+                    message=f"async {fn.qualname} reaches blocking "
+                            f"{op.target} in {leaf.qualname} with no "
+                            f"off-loop dispatch on the path ({op.message})",
+                    chain=chain)
+
+    def _dfs(self, graph: ProgramGraph, fn: FunctionInfo,
+             seen: frozenset,
+             ) -> tuple[tuple[str, ...], BlockingOp, FunctionInfo] | None:
+        """First (frames, blocking op, leaf fn) reachable from ``fn``
+        over sync, non-dispatch, non-off-loop edges. ``fn`` itself is
+        already at least one call away from the async entry."""
+        if fn.blocking:
+            op = min(fn.blocking, key=lambda b: b.lineno)
+            return (graph.frame(fn, op.lineno),), op, fn
+        for edge in sorted(fn.edges, key=lambda e: e.lineno):
+            if edge.dispatch:
+                continue
+            callee = graph.functions.get(edge.callee)
+            if callee is None or callee.is_async or callee.off_loop \
+                    or callee.key in seen:
+                continue
+            hit = self._dfs(graph, callee, seen | {callee.key})
+            if hit is not None:
+                frames, op, leaf = hit
+                return (graph.frame(fn, edge.lineno),) + frames, op, leaf
+        return None

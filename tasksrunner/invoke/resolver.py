@@ -43,13 +43,17 @@ def _pid_started_at(pid: int) -> float | None:
     /proc (Linux). None when undeterminable — non-Linux hosts, the
     process exiting mid-read, malformed stat — in which case callers
     must fall back to plain pid-exists liveness."""
+    # Reached from async actor/runtime paths, but /proc is procfs —
+    # RAM-backed, sub-microsecond, never touches disk. Dispatching two
+    # reads to a worker thread would cost more than it saves on the
+    # liveness hot path, so the transitive-blocking chain is allowlisted.
     try:
-        stat = pathlib.Path(f"/proc/{pid}/stat").read_bytes()
+        stat = pathlib.Path(f"/proc/{pid}/stat").read_bytes()  # tasklint: disable=transitive-blocking
         # fields after the last ')' (comm may embed spaces and parens):
         # the first is field 3 (state); starttime is field 22, so
         # index 19 here — clock ticks since boot
         ticks = int(stat[stat.rindex(b")") + 2:].split()[19])
-        for line in pathlib.Path("/proc/stat").read_text().splitlines():
+        for line in pathlib.Path("/proc/stat").read_text().splitlines():  # tasklint: disable=transitive-blocking
             if line.startswith("btime "):
                 boot = int(line.split()[1])
                 return boot + ticks / os.sysconf("SC_CLK_TCK")

@@ -182,9 +182,14 @@ class SpanRecorder:
         if self._timer is not None:
             self._timer.cancel()
         self.flush()
-        if self._conn is not None:
-            self._conn.close()
-            self._conn = None
+        # Timer.cancel() does not interrupt a tick already running, so a
+        # flush on the timer thread may still hold _io_lock and be using
+        # _conn; tear it down under the same lock or that flush dies with
+        # "Cannot operate on a closed database".
+        with self._io_lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
 
 
 #: process-global recorder; None = tracing disabled

@@ -307,7 +307,9 @@ class Orchestrator:
         if self.config.per_app_tokens and not self.config.app_tokens:
             self._issue_app_tokens()
         if self.config.mesh_tls and not self.config.mesh_certs:
-            self._issue_mesh_certs()
+            # key generation + PEM writes are real disk work — keep the
+            # loop responsive during startup
+            await asyncio.to_thread(self._issue_mesh_certs)
         for app in self.config.apps:
             self.replicas[app.app_id] = []
             self._record_revision(app.app_id, "initial deploy")

@@ -138,7 +138,7 @@ async def run_overload_drill(
     base_dir = pathlib.Path(base_dir)
     db_path = base_dir / "overload-state.db"
     resources = base_dir / "resources"
-    _write_resources(resources, db_path, latency_ms)
+    await asyncio.to_thread(_write_resources, resources, db_path, latency_ms)
 
     config = RunConfig(
         apps=[AppSpec(
@@ -283,6 +283,6 @@ async def run_overload_drill(
         await orch.stop()
 
     result["acked"] = len(acked)
-    durable = stored_keys(db_path)
+    durable = await asyncio.to_thread(stored_keys, db_path)
     result["lost_acked_keys"] = sorted(acked - durable)
     return result

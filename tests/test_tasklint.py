@@ -20,12 +20,15 @@ import pytest
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 from tasksrunner.analysis.cache import ResultCache, ruleset_signature
-from tasksrunner.analysis.core import RULES
+from tasksrunner.analysis.core import RULES, known_rule_ids
 from tasksrunner.analysis.engine import (
     DEFAULT_BASELINE, DEFAULT_TARGET, lint_file, run,
 )
 
+#: per-file rules only — what lint_file accepts; the program rules are
+#: exercised in test_tasklint_program.py
 ALL_RULES = tuple(sorted(RULES))
+EVERY_RULE = tuple(sorted(known_rule_ids()))
 
 
 def _lint_source(tmp_path, source, rules=ALL_RULES, name="fixture.py"):
@@ -340,7 +343,7 @@ def test_json_output_schema(tmp_path):
     rc = run([target], ALL_RULES, json_out=True, out=out)
     assert rc == 1
     doc = json.loads(out.getvalue())
-    assert doc["version"] == 1
+    assert doc["version"] == 2
     assert doc["files"] == 1
     assert isinstance(doc["suppressed"], int)
     assert isinstance(doc["baselined"], int)
@@ -351,6 +354,7 @@ def test_json_output_schema(tmp_path):
     assert finding["line"] == 4 and finding["col"] >= 1
     assert "time.sleep" in finding["message"]
     assert finding["fingerprint"]
+    assert finding["chain"] == []  # per-file findings carry no chain
 
 
 def test_cache_roundtrip_and_invalidation(tmp_path):
@@ -370,7 +374,8 @@ def test_cache_roundtrip_and_invalidation(tmp_path):
     assert cache2.get(target) == findings
     assert cache2.hits == 1
 
-    # content change invalidates (mtime_ns + size)
+    # content change invalidates (the sha1 is authoritative; see
+    # test_tasklint_program.py for the same-size touch -r case)
     target.write_text(GOOD)
     assert ResultCache(cache_file, sig).get(target) is None
 
@@ -408,10 +413,10 @@ def test_rules_filter_limits_what_fires(tmp_path):
 
 def test_package_has_zero_nonbaselined_findings():
     """Green-by-construction: the shipped baseline is EMPTY and the
-    whole package passes every rule. Any new finding fails this test
-    even if `make lint` is skipped."""
+    whole package passes every rule — per-file AND whole-program. Any
+    new finding fails this test even if `make lint` is skipped."""
     out = io.StringIO()
-    rc = run([DEFAULT_TARGET], ALL_RULES,
+    rc = run([DEFAULT_TARGET], EVERY_RULE,
              baseline_path=DEFAULT_BASELINE, cache_path=None, out=out)
     assert rc == 0, out.getvalue()
     baseline = json.loads(DEFAULT_BASELINE.read_text())

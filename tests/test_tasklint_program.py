@@ -1,0 +1,574 @@
+"""Whole-program tasklint: ProgramGraph rules + engine mechanics.
+
+Same two-layer shape as test_tasklint.py: seeded-bad-code fixtures
+prove each interprocedural rule fires (and stays quiet on the healthy
+variant), and the mechanics tests pin the program-phase contracts —
+chain-aware suppression, the tree-digest cache, ``--changed`` keeping
+the program phase whole-tree, the v2 JSON schema, and the wall-time
+budget that keeps `make lint` usable as a pre-commit step.
+"""
+
+import io
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import textwrap
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tasksrunner.analysis import engine
+from tasksrunner.analysis.cache import (
+    ResultCache, _digest_memo, ruleset_signature,
+)
+from tasksrunner.analysis.core import PROGRAM_RULES, known_rule_ids
+from tasksrunner.analysis.engine import (
+    DEFAULT_TARGET, _program_suppressed, changed_paths, run,
+)
+from tasksrunner.analysis.program import ProgramGraph
+
+ALL_RULES = tuple(sorted(known_rule_ids()))
+PROGRAM_ONLY = tuple(sorted(PROGRAM_RULES))
+
+
+def _program(tmp_path, sources, rules=PROGRAM_ONLY):
+    """Build a ProgramGraph over ``sources`` ({relpath: code}) with
+    controlled relpaths (so cross-module imports resolve) and run the
+    program rules through the real suppression filter."""
+    files = []
+    for name, src in sources.items():
+        path = tmp_path / name
+        path.write_text(textwrap.dedent(src))
+        files.append((path, name))
+    graph = ProgramGraph.build(files)
+    raw = []
+    for rid in rules:
+        raw.extend(PROGRAM_RULES[rid].check(graph))
+    findings = sorted(f for f in raw if not _program_suppressed(graph, f))
+    return findings, len(raw) - len(findings)
+
+
+# -- transitive-blocking ------------------------------------------------
+
+
+ENTRY = """\
+from b import helper
+
+
+async def entry():
+    helper()
+"""
+
+HELPERS = """\
+import time
+
+
+def helper():
+    deeper()
+
+
+def deeper():
+    time.sleep(1)
+"""
+
+
+def test_transitive_blocking_reports_cross_module_chain(tmp_path):
+    findings, _ = _program(tmp_path, {"a.py": ENTRY, "b.py": HELPERS},
+                           rules=("transitive-blocking",))
+    (f,) = findings
+    assert f.rule == "transitive-blocking"
+    assert (f.path, f.line) == ("a.py", 5)  # the entry call site
+    assert "entry" in f.message and "deeper" in f.message
+    assert "time.sleep" in f.message and "off-loop dispatch" in f.message
+    # full path: entry call -> helper's call -> the blocking leaf
+    assert [frame.split(":")[0] for frame in f.chain] == \
+        ["a.py", "b.py", "b.py"]
+    assert f.chain == ("a.py:5", "b.py:5", "b.py:9")
+
+
+def test_transitive_blocking_stops_at_dispatch_and_off_loop(tmp_path):
+    dispatched = """\
+        import asyncio
+
+        from b import helper
+
+
+        async def entry():
+            await asyncio.to_thread(helper)
+        """
+    findings, _ = _program(tmp_path, {"a.py": dispatched, "b.py": HELPERS},
+                           rules=("transitive-blocking",))
+    assert findings == []
+
+    declared = HELPERS.replace("def helper():",
+                               "def helper():  # tasklint: off-loop")
+    findings, _ = _program(tmp_path, {"a.py": ENTRY, "b.py": declared},
+                           rules=("transitive-blocking",))
+    assert findings == []
+
+
+def test_transitive_suppressable_at_entry_or_leaf(tmp_path):
+    at_entry = ENTRY.replace(
+        "    helper()",
+        "    helper()  # tasklint: disable=transitive-blocking")
+    findings, suppressed = _program(
+        tmp_path, {"a.py": at_entry, "b.py": HELPERS},
+        rules=("transitive-blocking",))
+    assert (findings, suppressed) == ([], 1)
+
+    at_leaf = HELPERS.replace(
+        "    time.sleep(1)",
+        "    time.sleep(1)  # tasklint: disable=transitive-blocking")
+    findings, suppressed = _program(
+        tmp_path, {"a.py": ENTRY, "b.py": at_leaf},
+        rules=("transitive-blocking",))
+    assert (findings, suppressed) == ([], 1)
+
+
+# -- held-lock-across-await ---------------------------------------------
+
+
+def test_held_lock_across_await_fires_with_chain(tmp_path):
+    findings, _ = _program(tmp_path, {"m.py": """\
+        import asyncio
+        import threading
+
+        L = threading.Lock()
+
+
+        async def bad():
+            with L:
+                await asyncio.sleep(0)
+        """}, rules=("held-lock-across-await",))
+    (f,) = findings
+    assert f.line == 8
+    assert "L is held" in f.message and "await" in f.message
+    assert f.chain == ("m.py:8", "m.py:9")  # acquire, then the await
+
+
+def test_held_lock_not_spanning_await_is_clean(tmp_path):
+    findings, _ = _program(tmp_path, {"m.py": """\
+        import asyncio
+        import threading
+
+        L = threading.Lock()
+        A = asyncio.Lock()  # not a threading lock: fine across awaits
+
+
+        async def ok():
+            with L:
+                x = 1
+            await asyncio.sleep(0)
+            async with A:
+                await asyncio.sleep(0)
+        """}, rules=("held-lock-across-await",))
+    assert findings == []
+
+
+def test_held_lock_suppressable_on_acquire_line(tmp_path):
+    findings, suppressed = _program(tmp_path, {"m.py": """\
+        import asyncio
+        import threading
+
+        L = threading.Lock()
+
+
+        async def bad():
+            with L:  # tasklint: disable=held-lock-across-await
+                await asyncio.sleep(0)
+        """}, rules=("held-lock-across-await",))
+    assert (findings, suppressed) == ([], 1)
+
+
+# -- lock-order-cycle ---------------------------------------------------
+
+
+def test_lock_order_cycle_nested_with(tmp_path):
+    findings, _ = _program(tmp_path, {"m.py": """\
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+
+        def one():
+            with A:
+                with B:
+                    pass
+
+
+        def two():
+            with B:
+                with A:
+                    pass
+        """}, rules=("lock-order-cycle",))
+    (f,) = findings  # the mirror-image cycle is deduplicated
+    assert "lock order cycle" in f.message
+    assert "A -> B -> A" in f.message or "B -> A -> B" in f.message
+    assert len(f.chain) == 2  # one witness frame per edge
+
+
+def test_lock_order_cycle_interprocedural(tmp_path):
+    findings, _ = _program(tmp_path, {"m.py": """\
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+
+        def one():
+            with A:
+                grab()
+
+
+        def grab():
+            with B:
+                pass
+
+
+        def two():
+            with B:
+                with A:
+                    pass
+        """}, rules=("lock-order-cycle",))
+    (f,) = findings
+    assert "calls grab" in f.message  # the A→B edge goes through a call
+
+
+def test_lock_order_consistent_is_clean(tmp_path):
+    findings, _ = _program(tmp_path, {"m.py": """\
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+
+        def one():
+            with A:
+                with B:
+                    pass
+
+
+        def two():
+            with A:
+                with B:
+                    pass
+        """}, rules=("lock-order-cycle",))
+    assert findings == []
+
+
+def test_lock_order_cycle_suppressable_on_witness_frame(tmp_path):
+    """The finding spans two witness sites; a disable on either chain
+    frame (here: one()'s outer acquisition) silences it."""
+    findings, suppressed = _program(tmp_path, {"m.py": """\
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+
+        def one():
+            with A:  # tasklint: disable=lock-order-cycle
+                with B:
+                    pass
+
+
+        def two():
+            with B:
+                with A:
+                    pass
+        """}, rules=("lock-order-cycle",))
+    assert (findings, suppressed) == ([], 1)
+
+
+# -- thread-shared-state ------------------------------------------------
+
+
+RACY = """\
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        threading.Thread(target=self._work).start()
+
+    def _work(self):
+        self.count = 1
+
+    async def bump(self):
+        self.count = 2
+"""
+
+
+def test_thread_shared_state_fires_across_boundary(tmp_path):
+    findings, _ = _program(tmp_path, {"m.py": RACY},
+                           rules=("thread-shared-state",))
+    (f,) = findings
+    assert "Box.count" in f.message and "no common lock" in f.message
+    assert f.line == 11  # the thread-side write
+    assert len(f.chain) == 2  # thread-side frame, loop-side frame
+
+
+def test_thread_shared_state_common_lock_is_clean(tmp_path):
+    guarded = RACY.replace(
+        "        self.count = 1",
+        "        with self._lock:\n            self.count = 1").replace(
+        "        self.count = 2",
+        "        with self._lock:\n            self.count = 2")
+    findings, _ = _program(tmp_path, {"m.py": guarded},
+                           rules=("thread-shared-state",))
+    assert findings == []
+
+
+def test_thread_shared_state_init_only_writes_are_clean(tmp_path):
+    findings, _ = _program(tmp_path, {"m.py": """\
+        import threading
+
+
+        class Box:
+            def __init__(self):
+                self.count = 0
+                threading.Thread(target=self._work).start()
+
+            def _work(self):
+                self.count = 1
+        """}, rules=("thread-shared-state",))
+    assert findings == []  # __init__ runs before the object is shared
+
+
+def test_thread_shared_state_suppression_on_write_line(tmp_path):
+    quiet = RACY.replace(
+        "        self.count = 1",
+        "        self.count = 1  # tasklint: disable=thread-shared-state")
+    findings, suppressed = _program(tmp_path, {"m.py": quiet},
+                                    rules=("thread-shared-state",))
+    assert (findings, suppressed) == ([], 1)
+
+
+# -- route-conformance --------------------------------------------------
+
+
+ROUTE_TABLE = """\
+@routes.get("/v1.0/state/{store}/{key}")
+async def get_state(request):
+    pass
+
+
+@routes.post("/v1.0/state/{store}")
+async def save_state(request):
+    pass
+
+
+def register(app):
+    app.router.add_get("/admin/apps", list_apps)
+"""
+
+
+def test_route_conformance_flags_drifted_path(tmp_path):
+    findings, _ = _program(tmp_path, {"app.py": ROUTE_TABLE,
+                                      "client.py": """\
+        async def drifted(session, store):
+            await session.get(f"/v1.0/states/{store}/x")
+        """}, rules=("route-conformance",))
+    (f,) = findings
+    assert (f.path, f.line) == ("client.py", 2)
+    assert "matches no declared route" in f.message
+    assert "closest route: GET /v1.0/state/{store}/{key}" in f.message
+    assert len(f.chain) == 2  # the site, then the closest route
+
+
+def test_route_conformance_flags_method_mismatch(tmp_path):
+    findings, _ = _program(tmp_path, {"app.py": ROUTE_TABLE,
+                                      "client.py": """\
+        async def wrong_verb(session):
+            await session.post("/admin/apps")
+        """}, rules=("route-conformance",))
+    (f,) = findings
+    assert "POST /admin/apps" in f.message
+
+
+def test_route_conformance_matching_sites_are_clean(tmp_path):
+    findings, _ = _program(tmp_path, {"app.py": ROUTE_TABLE,
+                                      "client.py": """\
+        async def fetch(session, store, key):
+            await session.get(f"/v1.0/state/{store}/{key}")
+
+
+        async def save(sidecar, store):
+            await _sidecar_request(sidecar, "POST", f"state/{store}")
+
+
+        async def external(session):
+            await session.get("http://example.com/metrics")
+        """}, rules=("route-conformance",))
+    assert findings == []
+
+
+def test_route_conformance_suppressable_on_site_line(tmp_path):
+    findings, suppressed = _program(tmp_path, {"app.py": ROUTE_TABLE,
+                                               "client.py": """\
+        async def legacy(session, store):
+            # the old spelling, kept for a deprecated peer
+            await session.get(f"/v1.0/states/{store}/x")  # tasklint: disable=route-conformance
+        """}, rules=("route-conformance",))
+    assert (findings, suppressed) == ([], 1)
+
+
+# -- engine mechanics: program phase ------------------------------------
+
+
+PROG_BAD = """\
+import time
+
+
+async def entry():
+    helper()
+
+
+def helper():
+    deeper()
+
+
+def deeper():
+    time.sleep(1)
+"""
+
+GOOD = """\
+import asyncio
+
+
+async def handler():
+    await asyncio.sleep(0.1)
+"""
+
+
+def test_run_emits_program_findings_with_chain_in_json(tmp_path):
+    target = tmp_path / "mod.py"
+    target.write_text(PROG_BAD)
+    out = io.StringIO()
+    rc = run([target], ("transitive-blocking",), json_out=True, out=out)
+    assert rc == 1
+    doc = json.loads(out.getvalue())
+    assert doc["version"] == 2
+    (finding,) = doc["findings"]
+    assert finding["rule"] == "transitive-blocking"
+    assert len(finding["chain"]) == 3
+    assert all(frame.rsplit(":", 1)[1].isdigit()
+               for frame in finding["chain"])
+
+
+def test_program_phase_uses_tree_digest_cache(tmp_path):
+    target = tmp_path / "mod.py"
+    target.write_text(PROG_BAD)
+    cache_file = tmp_path / "cache.json"
+
+    def _run():
+        out = io.StringIO()
+        rc = run([target], ALL_RULES, cache_path=cache_file, out=out)
+        return rc, out.getvalue()
+
+    rc1, text1 = _run()
+    rc2, text2 = _run()
+    assert (rc1, rc2) == (1, 1)
+    assert "cached" not in text1
+    assert "2 cached" in text2  # one per-file hit + the program entry
+
+    # any content change invalidates the tree digest
+    target.write_text(PROG_BAD + "# trailing comment\n")
+    rc3, text3 = _run()
+    assert rc3 == 1 and "cached" not in text3
+
+
+def test_bad_suppression_fires_for_unknown_id_on_chain_line(tmp_path):
+    target = tmp_path / "mod.py"
+    target.write_text(PROG_BAD.replace(
+        "    helper()",
+        "    helper()  # tasklint: disable=transitive-blocked"))  # typo
+    out = io.StringIO()
+    rc = run([target], ALL_RULES, out=out)
+    assert rc == 1
+    text = out.getvalue()
+    # the typo is reported AND the chain it meant to silence still fires
+    assert "bad-suppression" in text and "transitive-blocked" in text
+    assert "transitive-blocking" in text.replace("transitive-blocked", "")
+
+
+def test_content_hash_invalidates_same_size_touch_r(tmp_path):
+    """``touch -r`` style edits: same byte count, restored mtime. The
+    mtime+size proxy is blind to this; the persisted sha1 is not."""
+    bad1 = "import time\n\nasync def handler():\n    time.sleep(1)\n"
+    bad2 = "import time\n\nasync def handler():\n    time.sleep(2)\n"
+    assert len(bad1) == len(bad2)
+    target = tmp_path / "mod.py"
+    target.write_text(bad1)
+    stat = target.stat()
+
+    sig = ruleset_signature(("blocking-call-in-async",))
+    cache_file = tmp_path / "cache.json"
+    cache = ResultCache(cache_file, sig)
+    findings, _ = engine.lint_file(target, ("blocking-call-in-async",))
+    cache.put(target, findings)
+    cache.save()
+    assert ResultCache(cache_file, sig).get(target) == findings
+
+    target.write_text(bad2)
+    os.utime(target, ns=(stat.st_atime_ns, stat.st_mtime_ns))
+    _digest_memo.clear()  # a fresh process has no per-run memo
+    assert ResultCache(cache_file, sig).get(target) is None
+
+
+def test_changed_narrows_files_but_program_phase_stays_whole_tree(
+        tmp_path, monkeypatch, capfd):
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def git(*args):
+        subprocess.run(["git", "-C", str(repo), *args],
+                       check=True, capture_output=True)
+
+    git("init", "-q")
+    git("symbolic-ref", "HEAD", "refs/heads/main")
+    git("config", "user.email", "t@example.com")
+    git("config", "user.name", "t")
+    (repo / "legacy.py").write_text(PROG_BAD)
+    (repo / "notes.txt").write_text("not python\n")
+    git("add", ".")
+    git("commit", "-qm", "seed")
+    (repo / "fresh.py").write_text(GOOD)  # untracked
+
+    monkeypatch.setattr(engine, "REPO_ROOT", repo)
+    changed = changed_paths([repo])
+    assert changed == [(repo / "fresh.py").resolve()]
+
+    rc = engine.main(["--changed", "--no-cache",
+                      "--baseline", str(tmp_path / "baseline.json"),
+                      str(repo)])
+    text = capfd.readouterr().out
+    assert rc == 1
+    assert "1 file(s)" in text  # per-file phase: fresh.py only
+    # legacy.py was skipped per-file (its direct-blocking finding is
+    # absent) but the whole-tree program phase still walked its chain
+    assert "transitive-blocking" in text
+    assert "blocking-call-in-async" not in text
+
+
+def test_whole_tree_wall_time_budget(tmp_path):
+    """`make lint` must stay usable interactively: cold under 20s,
+    warm (tree digest unchanged) under 3s for the whole package."""
+    cache_file = tmp_path / "cache.json"
+    t0 = time.perf_counter()
+    rc = run([DEFAULT_TARGET], ALL_RULES, cache_path=cache_file,
+             out=io.StringIO())
+    cold = time.perf_counter() - t0
+    assert rc == 0
+    t0 = time.perf_counter()
+    rc = run([DEFAULT_TARGET], ALL_RULES, cache_path=cache_file,
+             out=io.StringIO())
+    warm = time.perf_counter() - t0
+    assert rc == 0
+    assert cold < 20.0, f"cold whole-tree lint took {cold:.1f}s"
+    assert warm < 3.0, f"warm whole-tree lint took {warm:.1f}s"
