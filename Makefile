@@ -1,6 +1,6 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test lint lint-program lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-overload bench-actors chaos sweep-flash run validate docs-serve docs-build clean
+.PHONY: test lint lint-program lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-overload bench-actors bench-repl chaos sweep-flash run validate docs-serve docs-build clean
 
 test: lint lint-program
 	python -m pytest tests/ -q
@@ -65,6 +65,14 @@ bench-overload:
 bench-actors:
 	python -m pytest tests/test_actors.py -q -m "not slow"
 	python bench.py --actor-bench
+
+# replicated state plane: the replication test matrix (record stream,
+# fencing, resync, mesh transport, kill -9 drill), then the RF {1,2,3}
+# write-overhead sweep + leader-crash failover drill (zero lost acked
+# writes at RF 2)
+bench-repl:
+	python -m pytest tests/test_replication.py -q -m "not slow"
+	python bench.py --replication-bench
 
 # chaos verification: the deterministic fault-injection harness, the
 # faulty-broker convergence soak, and the proof that the disabled gate

@@ -1526,6 +1526,95 @@ def run_tpu_section() -> dict | None:
     return None
 
 
+async def run_replication_bench(n_ops: int = 3000, *, concurrency: int = 64,
+                                n_keys: int = 1024, rounds: int = 3) -> dict:
+    """``replication_bench``: the replicated state plane's two numbers.
+
+    * **write overhead vs RF** — the same write-heavy mix as
+      ``state_ops_per_sec`` swept over replication factor {1, 2, 3}.
+      RF 1 is the exact unreplicated code path (build_replicated_store
+      returns a plain SqliteStateStore), so its lane doubles as the
+      no-regression control. Followers are in-process members on the
+      same disk, so the ratio isolates the record-stream + quorum-ack
+      machinery itself, not network or extra spindles.
+    * **failover drill** — RF 2, ack quorum 2 (every acked write is on
+      both members before the caller sees the ack). A writer banks
+      acked keys; the leader crashes WITHOUT releasing its lease (the
+      hard case) and the crashed member rejoins a beat later, as a
+      restarted process would. Reported: time from the crash to the
+      next successful write (bounded by the lease TTL + quorum
+      re-forming) and ``lost_acked_keys`` — must be empty: zero lost
+      acked writes is the acceptance bar, not a statistic.
+    """
+    from tasksrunner.state.replication import build_replicated_store
+
+    tmp = tempfile.mkdtemp(prefix="tasksrunner-bench-repl-")
+    keys = [f"task-{i}" for i in range(n_keys)]
+
+    lanes: dict[int, float] = {}
+    for rf in (1, 2, 3):
+        store = build_replicated_store(
+            f"bench-repl{rf}", f"{tmp}/rf{rf}/state.db", replicas=rf)
+        try:
+            await _state_op_rate(store, "write", max(200, n_ops // 4),
+                                 concurrency, keys)  # warmup, discarded
+            rates = []
+            for _ in range(rounds):
+                rates.append(await _state_op_rate(
+                    store, "write", n_ops, concurrency, keys))
+            lanes[rf] = statistics.median(rates)
+        finally:
+            await store.aclose()
+
+    base = lanes[1]
+    sweep = {
+        str(rf): {
+            "ops_per_sec": round(rate, 1),
+            "write_overhead_ratio": (round(base / rate, 2) if rate else None),
+        }
+        for rf, rate in lanes.items()
+    }
+
+    lease_s = 0.5
+    store = build_replicated_store(
+        "bench-repl-failover", f"{tmp}/failover/state.db", replicas=2,
+        ack_quorum=2, lease_seconds=lease_s, ack_timeout=5.0)
+    acked: list[str] = []
+    try:
+        for i in range(50):
+            await store.set(f"pre-{i}", {"v": i})
+            acked.append(f"pre-{i}")
+        victim = next(n for n in store.nodes
+                      if n.node_id == store.leader_member())
+        victim.crash()
+        t0 = time.perf_counter()
+        # the killed host's process restarts and rejoins as a follower
+        # while the survivor is still waiting out the zombie's lease
+        asyncio.get_running_loop().call_later(0.1, victim.revive)
+        await store.set("post-failover", {"v": -1})
+        acked.append("post-failover")
+        failover_ms = round((time.perf_counter() - t0) * 1000.0, 1)
+        for i in range(20):
+            await store.set(f"post-{i}", {"v": i})
+            acked.append(f"post-{i}")
+        lost = [key for key in acked if await store.get(key) is None]
+        new_leader = store.leader_member()
+    finally:
+        await store.aclose()
+
+    return {
+        "rf_sweep": sweep,
+        "failover": {
+            "failover_ms": failover_ms,
+            "lease_seconds": lease_s,
+            "ack_quorum": 2,
+            "new_leader": new_leader,
+            "acked_writes": len(acked),
+            "lost_acked_keys": lost,
+        },
+    }
+
+
 # ---------------------------------------------------------------------------
 # entry point
 # ---------------------------------------------------------------------------
@@ -1567,6 +1656,12 @@ def main() -> None:
                              "the crash-failover drill (zero lost acked "
                              "turns, reminder refire), and the gate-off "
                              "sidecar ingress overhead (<1%% bar)")
+    parser.add_argument("--replication-bench", action="store_true",
+                        help="run ONLY the replicated-state section "
+                             "(`make bench-repl`): write-overhead "
+                             "ratios for RF {1,2,3} and the leader-"
+                             "crash failover drill (zero lost acked "
+                             "writes at RF 2, failover time)")
     args = parser.parse_args()
 
     if args.tpu_bench:
@@ -1652,6 +1747,20 @@ def main() -> None:
         print(json.dumps({"actor_bench": actor_bench}))
         return
 
+    if args.replication_bench:
+        _log("replicated state plane: RF sweep + leader-crash failover ...")
+        replication_bench = asyncio.run(run_replication_bench())
+        for rf, lane in replication_bench["rf_sweep"].items():
+            _log(f"  -> RF {rf}: {lane['ops_per_sec']} ops/s "
+                 f"(x{lane['write_overhead_ratio']} vs RF 1)")
+        fo = replication_bench["failover"]
+        _log(f"  -> failover {fo['failover_ms']:.0f} ms (lease "
+             f"{fo['lease_seconds']}s, quorum {fo['ack_quorum']}), new "
+             f"leader {fo['new_leader']}, lost acked keys "
+             f"{len(fo['lost_acked_keys'])} of {fo['acked_writes']}")
+        print(json.dumps({"replication_bench": replication_bench}))
+        return
+
     if args.worker:
         profile_dir = os.environ.get("BENCH_PROFILE_DIR")
         if profile_dir:
@@ -1673,7 +1782,7 @@ def main() -> None:
     # the chip section runs FIRST: it is the scarcest measurement (the
     # tunnel has documented multi-hour outages) and must not queue
     # behind minutes of CPU benches that could overlap an outage window
-    _log("bench 1/11: ML-extension train step on the attached chip ...")
+    _log("bench 1/12: ML-extension train step on the attached chip ...")
     # belt over braces: the section is internally fault-tolerant, but
     # it also runs FIRST now — nothing it could raise may be allowed
     # to cost the CPU sections their numbers
@@ -1692,7 +1801,7 @@ def main() -> None:
     # the component the e2e write path bottlenecks on, measured alone —
     # and the seed write path measured in the SAME run, so the group-
     # commit speedup is a same-host apples-to-apples figure
-    _log("bench 2/11: state-store ops/s (group-commit write queue) ...")
+    _log("bench 2/12: state-store ops/s (group-commit write queue) ...")
     state_ops = asyncio.run(run_state_bench())
     _log(f"  -> write-heavy {state_ops['write_heavy']['ops_per_sec']} ops/s "
          f"({state_ops['write_heavy']['speedup']}x vs pre-change), "
@@ -1701,7 +1810,7 @@ def main() -> None:
 
     # the sharded state plane's scaling claim: N writer shards ≈ N
     # independent group-commit engines (docs/modules/04 quotes this)
-    _log("bench 3/11: state shard-scaling sweep (write-heavy mix) ...")
+    _log("bench 3/12: state shard-scaling sweep (write-heavy mix) ...")
     shard_scaling = asyncio.run(run_shard_scaling_bench())
     _log("  -> " + ", ".join(
         f"shards={n}: {lane['ops_per_sec']} ops/s "
@@ -1710,7 +1819,7 @@ def main() -> None:
 
     # the chaos gate's "free when off" claim, measured on the same
     # write-heavy path (docs/modules/16-chaos.md quotes this number)
-    _log("bench 4/11: chaos-gate overhead on the write-heavy state path ...")
+    _log("bench 4/12: chaos-gate overhead on the write-heavy state path ...")
     chaos_overhead = asyncio.run(run_chaos_overhead_bench())
     _log(f"  -> gate-off {chaos_overhead['gate_off_overhead_pct']:+.2f}% vs "
          f"baseline {chaos_overhead['baseline_ops_per_sec']} ops/s, "
@@ -1718,7 +1827,7 @@ def main() -> None:
 
     # the latency-histogram instrumentation's "free when off, cheap when
     # on" claim on the same two hot paths (docs/modules/08 quotes this)
-    _log("bench 5/11: histogram overhead (state write + publish/deliver) ...")
+    _log("bench 5/12: histogram overhead (state write + publish/deliver) ...")
     hist_overhead = asyncio.run(run_histogram_overhead_bench())
     _hs = hist_overhead["state_write"]
     _hp = hist_overhead["publish_deliver"]
@@ -1728,7 +1837,7 @@ def main() -> None:
     # the overload-protection loop's two numbers: the admission gate is
     # free when off (<1% bar, docs module 09 quotes this) and the full
     # shed -> scale out -> recover trajectory holds end to end
-    _log("bench 6/11: admission-gate overhead + chaos overload drill ...")
+    _log("bench 6/12: admission-gate overhead + chaos overload drill ...")
     admission_overhead = asyncio.run(run_admission_overhead_bench())
     _log(f"  -> gate-off {admission_overhead['gate_off_overhead_pct']:+.2f}% "
          f"vs baseline {admission_overhead['baseline_req_per_sec']} req/s, "
@@ -1744,7 +1853,7 @@ def main() -> None:
     # crash-failover drill (zero lost acked turns + reminder refire),
     # and the gate-off sidecar ingress overhead (docs module 18 / the
     # acceptance bar: <1% when TASKSRUNNER_ACTORS is unset)
-    _log("bench 7/11: virtual actors (turns, failover, gate-off ingress) ...")
+    _log("bench 7/12: virtual actors (turns, failover, gate-off ingress) ...")
     actor_bench = asyncio.run(run_actor_bench())
     _log(f"  -> {actor_bench['turns']['turns_per_sec_64_actors']} turns/s, "
          f"failover {actor_bench['failover']['failover_ms']:.0f} ms, "
@@ -1752,7 +1861,21 @@ def main() -> None:
          f"ingress gate-off "
          f"{actor_bench['ingress']['gate_off_overhead_pct']:+.2f}% (bar <1%)")
 
-    _log("bench 8/11: cross-process write path (faithful [PB] topology) ...")
+    # the replicated state plane's two numbers: what RF {2,3} costs the
+    # write path, and the leader-crash failover drill at RF 2 with its
+    # zero-lost-acked-writes proof (docs module 19 quotes both)
+    _log("bench 8/12: replicated state plane (RF sweep + failover) ...")
+    replication_bench = asyncio.run(run_replication_bench())
+    _log("  -> " + ", ".join(
+        f"RF {rf}: {lane['ops_per_sec']} ops/s "
+        f"(x{lane['write_overhead_ratio']})"
+        for rf, lane in replication_bench["rf_sweep"].items()))
+    _fo = replication_bench["failover"]
+    _log(f"  -> failover {_fo['failover_ms']:.0f} ms (lease "
+         f"{_fo['lease_seconds']}s), lost acked keys "
+         f"{len(_fo['lost_acked_keys'])} of {_fo['acked_writes']}")
+
+    _log("bench 9/12: cross-process write path (faithful [PB] topology) ...")
     xproc = asyncio.run(run_xproc(latency_probe=True, rounds=5))
     _log(f"  -> {xproc['throughput']} tasks/s, "
          f"p50 {xproc['p50_ms']} ms, p99 {xproc['p99_ms']} ms (conc=8)")
@@ -1761,7 +1884,7 @@ def main() -> None:
     # workload certs, every peer hop on the authenticated mesh lane —
     # module 15 quotes this delta instead of recommending an unmeasured
     # configuration
-    _log("bench 9/11: cross-process write path under mesh mTLS ...")
+    _log("bench 10/12: cross-process write path under mesh mTLS ...")
     # same rounds as the plaintext headline — an asymmetric pair would
     # bake an ordering/averaging confound into the published delta
     mtls = asyncio.run(run_xproc(latency_probe=True, rounds=5,
@@ -1784,7 +1907,7 @@ def main() -> None:
     # reference processor's SendGrid call) consumers are the
     # bottleneck; 5 competing replicas vs 1 shows the KEDA-style
     # scale-out actually scaling (SURVEY.md §5.8)
-    _log("bench 10/11: competing-consumer scale-out (20 ms work/message) ...")
+    _log("bench 11/12: competing-consumer scale-out (20 ms work/message) ...")
     one = asyncio.run(run_xproc(n_tasks=300, n_processors=1, rounds=2,
                                 work_ms=20.0))
     five = asyncio.run(run_xproc(n_tasks=300, n_processors=5, rounds=2,
@@ -1793,7 +1916,7 @@ def main() -> None:
     _log(f"  -> 1 replica: {one['throughput']} tasks/s; "
          f"5 replicas: {five['throughput']} tasks/s ({speedup}x)")
 
-    _log("bench 11/11: in-process cluster (round-1 continuity) ...")
+    _log("bench 12/12: in-process cluster (round-1 continuity) ...")
     inproc = asyncio.run(run_inproc())
     _log(f"  -> {inproc} tasks/s")
 
@@ -1855,6 +1978,7 @@ def main() -> None:
             "admission_overhead": admission_overhead,
             "overload_drill": overload_drill,
             "actor_bench": actor_bench,
+            "replication_bench": replication_bench,
             "ml_extension_tpu": tpu,
             **({} if tpu else {"ml_extension_note":
                 "chip bench skipped (no TPU reachable within the "

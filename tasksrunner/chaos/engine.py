@@ -171,6 +171,18 @@ class ChaosPolicies:
         no replica targeting needed."""
         return self._resolve("actors", actor_type, "turn")
 
+    def for_replication(self, store: str, shard: int,
+                        member: str) -> ChaosPolicy | None:
+        """Faults applied to the record stream from ``store``'s shard
+        leader toward follower ``member``. Resolution is most-specific
+        first — ``store/shard/member`` beats ``store/shard`` beats
+        ``store`` — so a drill can sever exactly one lane."""
+        for key in (f"{store}/{shard}/{member}", f"{store}/{shard}", store):
+            policy = self._resolve("replication", key, "stream")
+            if policy is not None:
+                return policy
+        return None
+
     def _resolve(self, kind: str, name: str, direction: str) -> ChaosPolicy | None:
         cache_key = (kind, name, direction)
         if cache_key in self._cache:
@@ -181,6 +193,8 @@ class ChaosPolicies:
                 refs = spec.app_targets.get(name)
             elif kind == "actors":
                 refs = spec.actor_targets.get(name)
+            elif kind == "replication":
+                refs = spec.replication_targets.get(name)
             else:
                 refs = (spec.component_targets.get(name) or {}).get(direction)
             if not refs:
@@ -216,6 +230,10 @@ class ChaosPolicies:
                 ] + [
                     f"actors/{atype}/turn"
                     for atype, refs in spec.actor_targets.items()
+                    if rule.name in refs
+                ] + [
+                    f"replication/{lane}/stream"
+                    for lane, refs in spec.replication_targets.items()
                     if rule.name in refs
                 ]
                 out.append({

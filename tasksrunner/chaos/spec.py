@@ -35,6 +35,15 @@ are inert unless the host runs with ``TASKSRUNNER_CHAOS=1``.
             inbound: [poison]
         actors:
           Counter: [poison]
+        replication:
+          statestore/0/r1: [deadPeer]          # one leader→follower lane
+          statestore: [slowStore]              # every lane of the store
+
+Replication targets address the record stream between a shard's leader
+and a follower (state/replication.py): the key is ``<store>``,
+``<store>/<shard>``, or ``<store>/<shard>/<member>`` — most specific
+wins at resolution time, so a drill can blackhole exactly one
+leader→follower lane while the rest of the set replicates normally.
 
 Each named fault carries exactly one fault kind:
 
@@ -149,6 +158,11 @@ class ChaosSpec:
     #: CURRENT owner, wherever placement moved it (the failover drill's
     #: crash-the-owner primitive)
     actor_targets: dict[str, tuple[str, ...]] = field(default_factory=dict)
+    #: replication-lane key → rule names, injected on the leader's
+    #: record shipment toward a follower. Keys are ``store``,
+    #: ``store/shard`` or ``store/shard/member`` (most specific wins).
+    replication_targets: dict[str, tuple[str, ...]] = field(
+        default_factory=dict)
 
     def in_scope(self, app_id: str | None) -> bool:
         if not self.scopes or app_id is None:
@@ -262,6 +276,10 @@ def parse_chaos(doc: Mapping[str, Any], *, source: str | None = None) -> ChaosSp
         str(atype): _parse_rule_refs(raw, where=where, target=str(atype))
         for atype, raw in (targets.get("actors") or {}).items()
     }
+    replication_targets = {
+        str(lane): _parse_rule_refs(raw, where=where, target=str(lane))
+        for lane, raw in (targets.get("replication") or {}).items()
+    }
 
     scopes = doc.get("scopes") or []
     if not isinstance(scopes, list) or not all(isinstance(s, str) for s in scopes):
@@ -269,7 +287,8 @@ def parse_chaos(doc: Mapping[str, Any], *, source: str | None = None) -> ChaosSp
 
     # dangling rule references fail at load time, like the Resiliency
     # loader: a typo must fail startup, not silently inject nothing
-    all_refs = list(app_targets.items()) + list(actor_targets.items()) + [
+    all_refs = (list(app_targets.items()) + list(actor_targets.items())
+                + list(replication_targets.items())) + [
         (comp, ref)
         for comp, dirs in component_targets.items()
         for ref in dirs.values()
@@ -289,6 +308,7 @@ def parse_chaos(doc: Mapping[str, Any], *, source: str | None = None) -> ChaosSp
         app_targets=app_targets,
         component_targets=component_targets,
         actor_targets=actor_targets,
+        replication_targets=replication_targets,
     )
 
 

@@ -204,6 +204,17 @@ def wrap_component(instance: Any, spec: ComponentSpec,
         return instance
     block = spec.block
     if block == "state":
+        # replication-lane faults bind to the member links themselves
+        # (leader→follower record stream), independent of — and
+        # composable with — the outbound per-operation rules below
+        attach = getattr(instance, "attach_chaos", None)
+        if attach is not None:
+            attach(chaos)
+        else:
+            for child in getattr(instance, "_shards", []):
+                child_attach = getattr(child, "attach_chaos", None)
+                if child_attach is not None:
+                    child_attach(chaos)
         policy = chaos.for_component(spec.name, "outbound")
         if policy is not None and isinstance(instance, StateStore):
             return ChaosStateStore(instance, policy)

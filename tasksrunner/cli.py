@@ -181,6 +181,10 @@ def _cmd_run(args) -> None:
     configure_logging("orchestrator",
                       level=getattr(logging, args.log_level.upper()))
     config = load_run_config(args.config)
+    if args.standby:
+        config.standby = True
+    if args.no_adopt:
+        config.adopt = False
     _run_until_interrupt(run_from_config(config))
 
 
@@ -992,6 +996,82 @@ def _cmd_chaos(args) -> None:
         raise SystemExit(3)
 
 
+def _cmd_repl(args) -> None:
+    """Replication status straight from the on-disk databases — works
+    with or without a live runtime (the sqlite files ARE the truth):
+    the shared meta db holds each shard's leadership lease, and every
+    member file's repl_meta row names its applied position."""
+    import json as json_mod
+    import pathlib
+    import sqlite3
+    import time as time_mod
+
+    from tasksrunner.state.replication import (
+        MAX_REPLICAS,
+        _member_path,
+        _meta_path,
+    )
+
+    base = args.database
+    meta = _meta_path(base)
+    if meta == ":memory:" or not pathlib.Path(meta).is_file():
+        raise SystemExit(
+            f"no replication meta database next to {base} (expected "
+            f"{meta}) — is the store configured with replicas > 1?")
+    con = sqlite3.connect(meta)
+    try:
+        leases = con.execute(
+            "SELECT key, value FROM state WHERE key LIKE 'repl-lease||%'"
+        ).fetchall()
+    finally:
+        con.close()
+    if not leases:
+        raise SystemExit(f"{meta} holds no shard leases yet — no leader "
+                         "has started")
+    now = time_mod.time()
+    shard_count = 1 + max(int(key.split("||")[2]) for key, _ in leases)
+    out = []
+    for key, raw in sorted(leases):
+        _, name, shard_str = key.split("||")
+        shard = int(shard_str)
+        rec = json_mod.loads(raw)
+        members = []
+        for m in range(MAX_REPLICAS):
+            mpath = _member_path(base, shard, m, shard_count)
+            if not pathlib.Path(mpath).is_file():
+                continue
+            mcon = sqlite3.connect(mpath)
+            try:
+                row = mcon.execute(
+                    "SELECT hwm, epoch FROM repl_meta WHERE id = 1"
+                ).fetchone()
+            except sqlite3.OperationalError:
+                row = None  # member file predates replication tables
+            finally:
+                mcon.close()
+            if row is not None:
+                members.append(
+                    {"member": f"r{m}", "hwm": row[0], "epoch": row[1]})
+        out.append({
+            "store": name, "shard": shard,
+            "leader": rec.get("owner"), "epoch": rec.get("epoch"),
+            "pid": rec.get("pid"),
+            "lease_seconds_left": round(rec.get("expires", 0.0) - now, 2),
+            "members": members,
+        })
+    if args.json:
+        print(json_mod.dumps({"replication": out}, indent=2))
+        return
+    for entry in out:
+        left = entry["lease_seconds_left"]
+        state = "EXPIRED" if left <= 0 else f"{left:.1f}s left"
+        print(f"{entry['store']} shard {entry['shard']}: leader "
+              f"{entry['leader']} (epoch {entry['epoch']}, pid "
+              f"{entry['pid']}, lease {state})")
+        for m in entry["members"]:
+            print(f"  {m['member']}: hwm {m['hwm']} epoch {m['epoch']}")
+
+
 def _admin_request(registry_file: str, method: str, path: str,
                    body: dict | None = None) -> dict:
     """Talk to the orchestrator's control plane (the `az containerapp`
@@ -1010,8 +1090,21 @@ def _admin_request(registry_file: str, method: str, path: str,
         raise SystemExit(
             f"no orchestrator control file at {info_file} — is "
             "`tasksrunner run` running with this registry_file?")
-    info = json_mod.loads(info_file.read_text())
-    url = info["admin_url"] + path
+    try:
+        info = json_mod.loads(info_file.read_text())
+        url = info["admin_url"] + path
+    except (ValueError, KeyError, TypeError):
+        # a torn/garbage control file can only be crash debris (writes
+        # are atomic rename); heal by removing it so the next
+        # orchestrator start or CLI call sees a clean slate
+        try:
+            info_file.unlink()
+        except OSError:
+            pass
+        raise SystemExit(
+            f"orchestrator control file {info_file} was unreadable "
+            "(crash debris?) — removed it; if `tasksrunner run` is "
+            "live, retry in a moment, else restart it")
     headers = {"content-type": "application/json"}
     token = os.environ.get(TOKEN_ENV)
     if token:
@@ -1288,7 +1381,23 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("run", help="run a multi-app config (orchestrator)")
     p.add_argument("config")
+    p.add_argument("--standby", action="store_true",
+                   help="wait for the control-plane lease and take over "
+                        "when the current orchestrator dies")
+    p.add_argument("--no-adopt", action="store_true",
+                   help="respawn replicas instead of re-adopting live "
+                        "ones a previous orchestrator left running")
     p.set_defaults(fn=_cmd_run)
+
+    p = sub.add_parser(
+        "repl",
+        help="replication status of a replicated store (leases, per-"
+             "member positions) straight from its sqlite files")
+    p.add_argument("database",
+                   help="base sqlite path of the store (e.g. data/tasks.db)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.set_defaults(fn=_cmd_repl)
 
     p = sub.add_parser(
         "deploy",

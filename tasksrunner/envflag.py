@@ -96,6 +96,14 @@ FLAGS: dict[str, Flag] = dict([
        "opt-in performance assertions in the test suite"),
     _f("TASKSRUNNER_REPLICA", "int", "0",
        "replica index injected by the orchestrator"),
+    _f("TASKSRUNNER_REPL_ACK_TIMEOUT_SECONDS", "float", "10",
+       "deadline for a write to reach its ack quorum before failing 503"),
+    _f("TASKSRUNNER_REPL_LEASE_SECONDS", "float", "5",
+       "shard-leadership lease duration; expiry lets a follower promote"),
+    _f("TASKSRUNNER_REPL_LOG_RETAIN", "int", "4096",
+       "replication records kept per member; gaps beyond resync via snapshot"),
+    _f("TASKSRUNNER_REPL_MAX_LAG_RECORDS", "int", "256",
+       "follower lag bound for stale-tolerant reads (followerReads)"),
     _f("TASKSRUNNER_SLOW_THRESHOLD_SECONDS", "float", "0.25",
        "latency above which histogram observations capture trace exemplars"),
     _f("TASKSRUNNER_SOAK", "bool", "off",

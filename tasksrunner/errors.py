@@ -95,6 +95,67 @@ class CrossShardAtomicityError(StateError):
     http_status = 500
 
 
+class ReplicationError(StateError):
+    """A replicated-state-plane operation failed (state/replication.py)."""
+
+    http_status = 500
+
+
+class NotLeaderError(ReplicationError):
+    """The write landed on a replica that is not the shard's current
+    lease holder. Carries no data loss — nothing was attempted; the
+    caller re-resolves the leader and retries (the facade does this
+    once automatically). Maps to 409 like the other ownership
+    conflicts."""
+
+    http_status = 409
+
+
+class ReplicaFencedError(ReplicationError):
+    """A leader's commit was rejected by epoch fencing.
+
+    A follower that promoted itself bumped the shard epoch, so the old
+    leader's late records carry a stale epoch and every follower
+    refuses them — the write can no longer reach its ack quorum and
+    was NEVER acked. Same contract as :class:`ActorFencedError`, one
+    layer down: zombies fail closed."""
+
+    http_status = 409
+
+
+class ReplicationQuorumError(ReplicationError):
+    """An acked-after-replication write could not reach its configured
+    ``ackQuorum`` within the ack timeout. The record is committed on
+    the leader's copy but its durability on followers is UNKNOWN — the
+    caller must treat the write as not acked (retry is safe: records
+    are idempotent by sequence number). Maps to 503: the replica set
+    is degraded, not the request malformed."""
+
+    http_status = 503
+
+
+class ReplicationGapError(ReplicationError):
+    """Protocol signal from a follower: the appended record does not
+    extend its log (``seq`` beyond ``hwm + 1``, or a diverged suffix
+    from a fenced ex-leader). The leader answers with a log catch-up
+    from ``hwm``, or a full snapshot when ``diverged`` (or the log was
+    pruned past the gap). Never surfaces to state-API callers."""
+
+    def __init__(self, message: str, *, hwm: int, diverged: bool = False):
+        super().__init__(message)
+        self.hwm = hwm
+        self.diverged = diverged
+
+
+class StaleReadError(ReplicationError):
+    """A follower read was refused because the replica's lag exceeded
+    the configured bound (``maxLagRecords``). The facade redirects to
+    the leader instead of surfacing this; it reaches callers only when
+    they address a follower directly."""
+
+    http_status = 503
+
+
 class QueryError(StateError):
     """Malformed state query or store without query support.
 

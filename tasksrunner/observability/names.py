@@ -38,6 +38,9 @@ COUNTERS: dict[str, str] = {
     "actor_reminder_fired_total": "durable reminders fired, by actor type",
     "actor_fenced_total": "zombie-owner commits rejected by epoch fencing",
     "actor_failover_total": "ownership acquisitions from a dead or expired owner",
+    "repl_records_total": "replication records shipped to followers, per member",
+    "repl_fenced_total": "shard-leader sessions fenced by an epoch bump",
+    "repl_failover_total": "shard leadership takeovers (epoch > 1 acquisitions)",
 }
 
 #: point-in-time levels (the saturation probes live here)
@@ -53,6 +56,8 @@ GAUGES: dict[str, str] = {
     "broker_dlq_depth": "dead-lettered messages per topic/group",
     "span_buffer_depth": "spans buffered in the recorder awaiting flush",
     "actor_owned": "actor activations this replica currently owns, per type",
+    "repl_epoch": "current shard leadership epoch, per store and shard",
+    "repl_follower_lag_records": "records a follower trails the leader by",
 }
 
 #: latency distributions (seconds); exposed as _bucket/_sum/_count

@@ -85,8 +85,25 @@ class AdminServer:
         })
 
         def write_info() -> None:  # tasklint: off-loop
+            import tempfile
+
             self._info_file.parent.mkdir(parents=True, exist_ok=True)
-            self._info_file.write_text(info)
+            # write-then-rename: a reader (CLI, standby orchestrator)
+            # racing this write must see the old document or the new
+            # one, never a torn half — same discipline as the name
+            # registry's _mutate
+            fd, tmp = tempfile.mkstemp(
+                dir=self._info_file.parent, prefix=".orchestrator-")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    f.write(info)
+                os.replace(tmp, self._info_file)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
 
         # startup disk write off-loop: the supervisor loop is already
         # scheduling replica starts at this point
@@ -100,6 +117,15 @@ class AdminServer:
             except OSError:
                 pass
             self._info_file = None
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def abandon(self) -> None:
+        """Release the listener but leave ``orchestrator.json`` behind
+        — the on-disk state a kill -9'd orchestrator leaves (it never
+        gets to unlink). The takeover orchestrator overwrites it."""
+        self._info_file = None
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None

@@ -142,6 +142,14 @@ class RunConfig:
     #: filled by the orchestrator at start when mesh_tls is on
     #: (app_id → {ca, cert, key} PEM paths); not read from YAML
     mesh_certs: dict[str, dict[str, str]] = field(default_factory=dict)
+    #: re-adopt live replicas a previous orchestrator left registered
+    #: (crash/kill -9 of the control plane) instead of respawning them —
+    #: a control-plane restart must not bounce a healthy data plane
+    adopt: bool = True
+    #: wait for the control-plane lease instead of exiting when another
+    #: orchestrator already holds it; on the holder's death this
+    #: process takes over (and, with adopt, inherits its replicas)
+    standby: bool = False
 
 
 def parse_health(health_raw: object) -> HealthSpec:
@@ -239,4 +247,6 @@ def load_run_config(path: str | pathlib.Path) -> RunConfig:
         require_api_token=bool(doc.get("require_api_token", False)),
         per_app_tokens=bool(doc.get("per_app_tokens", False)),
         mesh_tls=bool(doc.get("mesh_tls", False)),
+        adopt=bool(doc.get("adopt", True)),
+        standby=bool(doc.get("standby", False)),
     )

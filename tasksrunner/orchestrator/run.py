@@ -25,6 +25,7 @@ import re
 import signal
 import sys
 import time
+import uuid
 
 from tasksrunner.orchestrator.autoscale import AutoscaleController
 from tasksrunner.orchestrator.config import AppSpec, RunConfig
@@ -39,6 +40,47 @@ RESTART_BACKOFF = [0.2, 0.5, 1.0, 2.0, 5.0]
 #: parsed here so the orchestrator learns ephemeral replica ports
 _READY_RE = re.compile(
     r"ready app=\S+ app_port=(\d+) sidecar_port=(\d+)")
+
+
+class _AdoptedProc:
+    """The supervisor-facing slice of an asyncio subprocess Process,
+    duck-typed around a replica process a PREVIOUS orchestrator
+    spawned. A restarted (or standby-takeover) control plane cannot
+    ``waitpid`` a process it never forked, so liveness comes from the
+    registry's one predicate (``NameResolver.local_pid_dead``, with
+    its pid-recycling guard) and ``wait()`` polls it. The exact exit
+    code of a non-child is unknowable; a detected death reports -9."""
+
+    def __init__(self, pid: int, registered_at: float | None):
+        self.pid = pid
+        self._registered_at = registered_at
+        self._code: int | None = None
+
+    @property
+    def returncode(self) -> int | None:
+        if self._code is None:
+            from tasksrunner.invoke.resolver import NameResolver
+            if NameResolver.local_pid_dead(
+                    "127.0.0.1", self.pid, self._registered_at):
+                self._code = -9
+        return self._code
+
+    async def wait(self) -> int:
+        while self.returncode is None:
+            await asyncio.sleep(0.2)
+        return self._code
+
+    def terminate(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            self._code = -15
+
+    def kill(self) -> None:
+        try:
+            os.kill(self.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            self._code = -9
 
 
 class Replica:
@@ -143,6 +185,23 @@ class Replica:
                 self._prober.cancel()
             self._prober = asyncio.create_task(self._probe_liveness())
         logger.info("started replica %s (pid %d)", self.tag, self.proc.pid)
+
+    def adopt(self, addr) -> None:
+        """Wire this Replica around an ALREADY RUNNING host process a
+        previous orchestrator registered, instead of spawning one. No
+        log pump (its stdout pipe belongs to the dead parent) — but
+        readiness, ports, liveness probing, and supervise() all work;
+        when the adopted process eventually dies, supervise() respawns
+        a normal child in its place."""
+        self.proc = _AdoptedProc(addr.pid, addr.registered_at)
+        self.ports = (addr.app_port or 0, addr.sidecar_port)
+        self.ready.set()
+        self.started_at = addr.registered_at or time.time()
+        self.log_buffer.append(
+            f"(adopted running pid {addr.pid}; earlier output went to "
+            "the previous orchestrator)")
+        if self.app.health.enabled:
+            self._prober = asyncio.create_task(self._probe_liveness())
 
     async def _pump_logs(self) -> None:
         assert self.proc is not None and self.proc.stdout is not None
@@ -276,6 +335,17 @@ class Orchestrator:
         #: newest is the active one — single-revision mode, SURVEY §5.3)
         self.revisions: dict[str, list[dict]] = {}
         self._admin = None
+        #: control-plane lease: at most one live orchestrator per
+        #: registry dir; a standby waits on it and takes over (reusing
+        #: the shard-leadership Lease — same fencing, same liveness)
+        self._cp_store = None
+        self._cp_lease = None
+        # pid alone is not unique enough: a standby in the SAME process
+        # (tests, embedded control planes) must not alias the holder's
+        # identity, or its acquire would read as the holder renewing
+        self._cp_owner = f"orchestrator-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        self._cp_epoch: int | None = None
+        self._cp_renewer: asyncio.Task | None = None
 
     def _record_revision(self, app_id: str, reason: str, **details) -> dict:
         history = self.revisions.setdefault(app_id, [])
@@ -287,6 +357,9 @@ class Orchestrator:
         return entry
 
     async def start(self) -> None:
+        # control plane first: two orchestrators adopting/spawning over
+        # one registry would fight for ports and registry entries
+        await self._acquire_control_plane()
         # sweep entries a previous SIGKILLed topology left behind —
         # without this, the new replicas share ports with ghost entries
         # that `ps` then reports healthy (the live process answers the
@@ -310,10 +383,26 @@ class Orchestrator:
             # key generation + PEM writes are real disk work — keep the
             # loop responsive during startup
             await asyncio.to_thread(self._issue_mesh_certs)
+        adopted: dict[str, list] = {}
+        if self.config.adopt:
+            # registry reads busy-wait on the lock file — off-loop
+            adopted = await asyncio.to_thread(self._find_adoptable)
         for app in self.config.apps:
             self.replicas[app.app_id] = []
-            self._record_revision(app.app_id, "initial deploy")
-            for i in range(app.scale.min_replicas):
+            survivors = adopted.get(app.app_id, [])
+            for addr in survivors[:app.scale.max_replicas]:
+                self._adopt_replica(app, addr)
+            if survivors:
+                # a control-plane restart re-adopts the healthy data
+                # plane instead of bouncing it: no respawn, no dropped
+                # in-flight work, same pids
+                self._record_revision(
+                    app.app_id,
+                    f"adopted {len(self.replicas[app.app_id])} running "
+                    "replica(s) from a previous orchestrator")
+            else:
+                self._record_revision(app.app_id, "initial deploy")
+            while len(self.replicas[app.app_id]) < app.scale.min_replicas:
                 await self._add_replica(app)
             if app.scale.rules:
                 scaler = AutoscaleController(
@@ -332,6 +421,97 @@ class Orchestrator:
         from tasksrunner.orchestrator.admin import AdminServer
         self._admin = AdminServer(self, port=self.config.admin_port)
         await self._admin.start()
+
+    async def _acquire_control_plane(self) -> None:
+        """Acquire (or, in standby mode, wait for) the per-registry-dir
+        orchestrator lease. Epoch-fenced exactly like shard leadership:
+        the record names owner/pid/expiry, takeover needs the holder
+        dead or expired, and every acquisition bumps the epoch."""
+        from tasksrunner.state.replication import Lease, lease_seconds_default
+        from tasksrunner.state.sqlite import SqliteStateStore
+
+        registry_dir = self.config.registry_path.parent
+        await asyncio.to_thread(
+            lambda: registry_dir.mkdir(parents=True, exist_ok=True))
+        self._cp_store = SqliteStateStore(
+            "orchestrator.control-plane", registry_dir / "control-plane.db")
+        self._cp_lease = Lease(self._cp_store, "control-plane")
+        lease_s = lease_seconds_default()
+        announced = False
+        while True:
+            epoch = await self._cp_lease.acquire(self._cp_owner)
+            if epoch is not None:
+                self._cp_epoch = epoch
+                break
+            holder = await self._cp_lease.peek() or {}
+            if not self.config.standby:
+                await self._cp_store.aclose()
+                self._cp_store = self._cp_lease = None
+                raise SystemExit(
+                    f"another orchestrator (pid {holder.get('pid')}) holds "
+                    f"the control plane for {registry_dir} — stop it, or "
+                    "start this one with --standby to take over when it "
+                    "dies")
+            if not announced:
+                logger.info(
+                    "standby: control plane held by pid %s; waiting for "
+                    "the lease (epoch %s)",
+                    holder.get("pid"), holder.get("epoch"))
+                announced = True
+            await asyncio.sleep(max(lease_s / 3.0, 0.05))
+        self._cp_renewer = asyncio.create_task(self._renew_control_plane())
+        logger.info("control-plane lease acquired (owner %s, epoch %d)",
+                    self._cp_owner, self._cp_epoch)
+
+    async def _renew_control_plane(self) -> None:
+        from tasksrunner.state.replication import lease_seconds_default
+
+        interval = max(lease_seconds_default() / 3.0, 0.05)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                renewed = await self._cp_lease.renew(self._cp_owner)
+            except Exception:
+                logger.debug("control-plane renew failed", exc_info=True)
+                continue
+            if not renewed:
+                # a standby fenced us out — it now owns the registry
+                # and the replicas; mutating anything past this point
+                # would be the zombie-orchestrator bug
+                holder = await self._cp_lease.peek() or {}
+                logger.critical(
+                    "control-plane lease lost to pid %s — this "
+                    "orchestrator is fenced; stop it", holder.get("pid"))
+                return
+
+    def _find_adoptable(self) -> dict[str, list]:
+        """Live local registry entries for the configured apps — what a
+        previous orchestrator's data plane left running."""
+        from tasksrunner.invoke.resolver import NameResolver
+
+        registry = self.config.registry_path
+        if not registry.is_file():
+            return {}
+        resolver = NameResolver(registry_file=registry)
+        out: dict[str, list] = {}
+        for app in self.config.apps:
+            live = [
+                addr for addr in resolver.resolve_all(app.app_id)
+                if addr.pid is not None and not NameResolver.local_pid_dead(
+                    addr.host, addr.pid, addr.registered_at)
+            ]
+            if live:
+                out[app.app_id] = sorted(live, key=lambda a: a.registered_at)
+        return out
+
+    def _adopt_replica(self, app: AppSpec, addr) -> None:
+        replica = Replica(app, len(self.replicas[app.app_id]), self.config)
+        replica.adopt(addr)
+        self.replicas[app.app_id].append(replica)
+        self._supervisors.append(asyncio.create_task(replica.supervise()))
+        logger.info("adopted running replica %s (pid %d, app_port %s, "
+                    "sidecar_port %s)", replica.tag, addr.pid,
+                    addr.app_port, addr.sidecar_port)
 
     def _issue_mesh_certs(self) -> None:
         """Generate the environment CA + one workload certificate per
@@ -581,6 +761,69 @@ class Orchestrator:
             except asyncio.CancelledError:
                 pass
         self._supervisors.clear()
+        await self._release_control_plane()
+
+    async def _release_control_plane(self) -> None:
+        if self._cp_renewer is not None:
+            self._cp_renewer.cancel()
+            try:
+                await self._cp_renewer
+            except asyncio.CancelledError:
+                pass
+            self._cp_renewer = None
+        if self._cp_lease is not None:
+            try:
+                await self._cp_lease.release(self._cp_owner)
+            except Exception:  # pragma: no cover - store already gone
+                logger.debug("control-plane release failed", exc_info=True)
+            self._cp_lease = None
+        if self._cp_store is not None:
+            await self._cp_store.aclose()
+            self._cp_store = None
+
+    async def abandon(self) -> None:
+        """Walk away from everything WITHOUT stopping it — the test
+        double for ``kill -9`` of the orchestrator process. Replicas
+        keep running and stay registered; the control-plane lease
+        record and ``orchestrator.json`` stay on disk exactly as a
+        dead process would leave them (no release, no unlink); only
+        this process's tasks and sockets are torn down. A successor
+        with ``adopt`` then takes the lease on expiry and re-adopts
+        the data plane."""
+        if self._admin is not None:
+            await self._admin.abandon()
+            self._admin = None
+        for scaler in self._scalers:
+            await scaler.stop()
+        self._scalers.clear()
+        doomed: list[asyncio.Task] = list(self._supervisors)
+        self._supervisors.clear()
+        for task in doomed:
+            # a supervisor blocks in proc.wait() — and the proc, by
+            # design, keeps running; cancel rather than wait it out
+            task.cancel()
+        for group in self.replicas.values():
+            for replica in group:
+                replica.stopping = True  # a dead parent restarts nothing
+                for task in (replica._pump, replica._prober):
+                    if task is not None:
+                        task.cancel()
+                        doomed.append(task)
+                replica._pump = replica._prober = None
+        if self._cp_renewer is not None:
+            self._cp_renewer.cancel()
+            doomed.append(self._cp_renewer)
+            self._cp_renewer = None
+        for task in doomed:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._cp_lease = None
+        if self._cp_store is not None:
+            # close the handle only — the lease record stays unreleased
+            await self._cp_store.aclose()
+            self._cp_store = None
 
 
 async def run_from_config(config: RunConfig) -> None:

@@ -1,0 +1,950 @@
+"""Replicated state plane: per-shard replica sets with leased
+leadership, epoch fencing, and ack-after-replication.
+
+Each shard of a state component becomes a replica set of N members.
+Exactly one member at a time holds the shard's **lease** (an
+etag-guarded record in a shared meta store — the PR 7 actor-placement
+fencing model applied one layer down) and is the shard's leader: its
+group-commit flusher appends every batch to a logical write-ahead
+record stream (``repl_log``, monotonic per-shard sequence numbers,
+state/sqlite.py) and ships the stream to the followers, which apply
+records in order and ack their high-water mark.
+
+The durability contract is **ack-after-replication**: with
+``ackQuorum`` > 1, a caller's write future resolves only once the
+record is durable on that many members (leader included). A leader
+that loses its lease is **fenced** — a follower's promotion bumps the
+epoch, every member refuses lower-epoch records, and the zombie's late
+commits fail :class:`~tasksrunner.errors.ReplicaFencedError` without
+ever having been acked. Zero lost acked writes is therefore structural,
+not probabilistic; the chaos drill in tests/test_replication.py proves
+it under ``kill -9`` and blackhole.
+
+Roles are dynamic: every member runs a small role loop (renew the
+lease when leader; watch for expiry and promote when follower). A
+promoted follower first appends a **leadership barrier** — an empty
+record at its new epoch, Raft's no-op commit — then resyncs peers from
+its log (or a full snapshot when a peer's log diverged or the bounded
+log was pruned past the gap).
+
+Follower reads are the optional stale-tolerant path: with
+``followerReads: true`` the facade serves reads from a follower whose
+lag (leader hwm − follower hwm) is within ``maxLagRecords``,
+redirecting to the leader beyond the bound; a *direct* follower read
+past the bound raises :class:`~tasksrunner.errors.StaleReadError`.
+
+The in-process member/link classes here are the unit the mesh-framed
+transport (state/replmesh.py) wraps for cross-process replica sets;
+the protocol — ``append`` / ``install`` / ``position`` plus the gap
+and fencing errors — is identical on both.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+import os
+import pathlib
+import time
+from typing import Any, Callable
+
+from tasksrunner.errors import (
+    ComponentError, EtagMismatch, NotLeaderError, ReplicaFencedError,
+    ReplicationGapError, ReplicationQuorumError, StaleReadError, StateError,
+)
+from tasksrunner.observability.metrics import metrics
+from tasksrunner.state.base import (
+    QueryResponse, StateItem, StateStore, TransactionOp,
+)
+from tasksrunner.state.sqlite import SqliteStateStore, _shard_path
+
+logger = logging.getLogger(__name__)
+
+#: hard ceiling on replication factor — each member is a full engine
+#: (file + threads + connections); past RF 5 the write amplification
+#: costs more availability than it buys
+MAX_REPLICAS = 5
+
+DEFAULT_LEASE_SECONDS = 5.0
+DEFAULT_ACK_TIMEOUT_SECONDS = 10.0
+DEFAULT_MAX_LAG_RECORDS = 256
+DEFAULT_LOG_RETAIN = 4096
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def lease_seconds_default() -> float:
+    return _env_float("TASKSRUNNER_REPL_LEASE_SECONDS", DEFAULT_LEASE_SECONDS)
+
+
+def ack_timeout_default() -> float:
+    return _env_float("TASKSRUNNER_REPL_ACK_TIMEOUT_SECONDS",
+                      DEFAULT_ACK_TIMEOUT_SECONDS)
+
+
+def max_lag_default() -> int:
+    return _env_int("TASKSRUNNER_REPL_MAX_LAG_RECORDS",
+                    DEFAULT_MAX_LAG_RECORDS)
+
+
+def log_retain_default() -> int:
+    return _env_int("TASKSRUNNER_REPL_LOG_RETAIN", DEFAULT_LOG_RETAIN)
+
+
+class Lease:
+    """An epoch-fenced lease over ONE record in a state store.
+
+    The record — ``{owner, epoch, expires, host, pid, registered_at}``
+    — is only ever replaced with an etag-guarded write, so two
+    contenders can never both win a takeover: the loser's write fails
+    :class:`EtagMismatch`. Every change of ownership bumps ``epoch``;
+    holders embed their epoch in everything they emit, and consumers
+    refuse lower epochs — the fencing contract shared with the actor
+    placement table (PR 7) and now the shard record stream.
+
+    Liveness is lease expiry OR a dead local pid: the record carries
+    the holder's host/pid/registration time, and
+    ``NameResolver.local_pid_dead`` (the ONE liveness predicate in this
+    codebase) detects SIGKILL debris without waiting out the lease.
+    """
+
+    def __init__(self, store: StateStore, key: str, *,
+                 lease_seconds: float | None = None):
+        self._store = store
+        self.key = key
+        self.lease_seconds = (float(lease_seconds) if lease_seconds
+                              else lease_seconds_default())
+
+    def _record(self, owner: str, epoch: int) -> dict:
+        return {
+            "owner": owner,
+            "epoch": int(epoch),
+            "expires": time.time() + self.lease_seconds,
+            "host": "127.0.0.1",
+            "pid": os.getpid(),
+            "registered_at": time.time(),
+        }
+
+    @staticmethod
+    def holder_gone(rec: dict) -> bool:
+        """Expired, or registered by a local pid that no longer exists."""
+        if float(rec.get("expires", 0.0)) <= time.time():
+            return True
+        from tasksrunner.invoke.resolver import NameResolver
+        return NameResolver.local_pid_dead(
+            rec.get("host"), rec.get("pid"), rec.get("registered_at"))
+
+    async def peek(self) -> dict | None:
+        item = await self._store.get(self.key)
+        return None if item is None else item.value
+
+    async def acquire(self, owner: str) -> int | None:
+        """Take or renew the lease. Returns the (possibly bumped) epoch
+        on success, None if another live holder has it or we lost the
+        takeover race."""
+        item = await self._store.get(self.key)
+        if item is None:
+            # creation race: write, then verify we are the one who won
+            # (last write wins the upsert; exactly one owner survives)
+            await self._store.set(self.key, self._record(owner, 1))
+            check = await self._store.get(self.key)
+            if check is not None and check.value.get("owner") == owner:
+                return 1
+            return None
+        rec = item.value
+        epoch = int(rec.get("epoch", 0))
+        if rec.get("owner") == owner:
+            try:
+                await self._store.set(self.key, self._record(owner, epoch),
+                                      etag=item.etag)
+                return epoch
+            except EtagMismatch:
+                return None
+        if not self.holder_gone(rec):
+            return None
+        try:
+            await self._store.set(self.key, self._record(owner, epoch + 1),
+                                  etag=item.etag)
+            return epoch + 1
+        except EtagMismatch:
+            return None
+
+    async def renew(self, owner: str) -> bool:
+        item = await self._store.get(self.key)
+        if item is None or item.value.get("owner") != owner:
+            return False
+        try:
+            await self._store.set(
+                self.key,
+                self._record(owner, int(item.value.get("epoch", 0))),
+                etag=item.etag)
+            return True
+        except EtagMismatch:
+            return False
+
+    async def release(self, owner: str) -> None:
+        """Expire our own lease in place (epoch preserved, so the next
+        acquisition still bumps it); a no-op if we don't hold it."""
+        item = await self._store.get(self.key)
+        if item is None or item.value.get("owner") != owner:
+            return
+        rec = dict(item.value)
+        rec["expires"] = 0.0
+        try:
+            await self._store.set(self.key, rec, etag=item.etag)
+        except EtagMismatch:
+            pass
+
+
+class LocalLink:
+    """Leader's handle on one in-process follower member.
+
+    The protocol surface — ``append(records) -> hwm``,
+    ``install(snapshot)``, ``position() -> (hwm, epoch)`` — is exactly
+    what the mesh link (state/replmesh.py) implements over TCP, so the
+    replicator is transport-agnostic. A chaos policy attached to the
+    lane (``kind:Chaos`` ``targets.replication``) injects before every
+    shipment, which is how blackhole/latency failover drills sever one
+    specific leader→follower stream."""
+
+    def __init__(self, node: "ReplicationNode"):
+        self._node = node
+        self.member = node.node_id
+        self.chaos = None  # ChaosPolicy | None, set via attach_chaos
+
+    async def _chaos_gate(self) -> None:
+        if self.chaos is not None:
+            status = await self.chaos.before_call()
+            if status is not None:
+                self.chaos.raise_for_status(status)
+
+    async def append(self, records: list[dict]) -> int:
+        await self._chaos_gate()
+        return await self._node.apply_records(records)
+
+    async def install(self, snapshot: dict) -> None:
+        await self._chaos_gate()
+        await self._node.install_snapshot(snapshot)
+
+    async def position(self) -> tuple[int, int]:
+        return self._node.position()
+
+
+class _Pending:
+    """One committed-on-leader record awaiting its ack quorum."""
+
+    __slots__ = ("record", "resolve", "fail", "acks", "deadline")
+
+    def __init__(self, record: dict, resolve: Callable[[], None],
+                 fail: Callable[[BaseException], None], first_ack: str,
+                 deadline: float):
+        self.record = record
+        self.resolve = resolve
+        self.fail = fail
+        self.acks = {first_ack}
+        self.deadline = deadline
+
+
+class ShardReplicator:
+    """The leader-side replication session for one shard.
+
+    Attached to the leader's :class:`SqliteStateStore` as ``_repl``:
+    the flusher calls :meth:`on_commit` (writer thread) after every
+    replicated batch, and the callers' futures resolve only when the
+    record reaches ``ack_quorum`` members — or fail with
+    :class:`ReplicationQuorumError` at the ack timeout, or
+    :class:`ReplicaFencedError` if leadership was lost meanwhile.
+
+    One shipper task per follower streams the log from that member's
+    acked position; a follower that answers with a gap gets a log
+    catch-up, a diverged or pruned-past follower gets a full snapshot.
+    A fencing signal from any follower (it saw a higher epoch) fences
+    this whole session: all pending and future writes fail closed.
+    """
+
+    def __init__(self, node: "ReplicationNode", *, epoch: int,
+                 ack_quorum: int, ack_timeout: float):
+        self._node = node
+        self._store = node.store
+        self._loop = asyncio.get_running_loop()
+        self.epoch = int(epoch)
+        self.ack_quorum = max(1, int(ack_quorum))
+        self.ack_timeout = float(ack_timeout)
+        self.fenced = False
+        self._closed = False
+        self._pending: "collections.OrderedDict[int, _Pending]" = \
+            collections.OrderedDict()
+        self._member_hwm: dict[str, int] = {}
+        self._wake: dict[str, asyncio.Event] = {}
+        self._tasks: list[asyncio.Task] = []
+
+    def start(self) -> None:
+        for member, link in self._node.links.items():
+            self._member_hwm[member] = 0
+            self._wake[member] = asyncio.Event()
+            self._wake[member].set()  # immediate catch-up pass
+            self._tasks.append(
+                asyncio.ensure_future(self._ship_loop(member, link)))
+        self._tasks.append(asyncio.ensure_future(self._timeout_loop()))
+
+    # -- flusher side (writer thread) -------------------------------------
+
+    def on_commit(self, record: dict, resolve: Callable[[], None],
+                  fail: Callable[[BaseException], None]) -> None:
+        """Called by the store after a replicated batch COMMITs locally.
+        ``resolve``/``fail`` complete the batch's caller futures (both
+        are thread-safe)."""
+        if self.fenced:
+            fail(ReplicaFencedError(
+                f"state store {self._store.name!r}: leadership lost "
+                "(epoch fenced); the write was not acked"))
+            return
+        if self.ack_quorum <= 1:
+            # leader-only durability: ack now, ship in the background
+            resolve()
+            resolve = None  # type: ignore[assignment]
+        try:
+            self._loop.call_soon_threadsafe(self._admit, record, resolve, fail)
+        except RuntimeError:  # loop closed (shutdown race)
+            if resolve is not None:
+                fail(StateError(
+                    f"state store {self._store.name!r}: replication "
+                    "session closed before the write could be acked"))
+
+    # -- loop side ---------------------------------------------------------
+
+    def _admit(self, record: dict, resolve: Callable[[], None] | None,
+               fail: Callable[[BaseException], None]) -> None:
+        if resolve is not None:
+            if self._closed or self.fenced:
+                fail(ReplicaFencedError(
+                    f"state store {self._store.name!r}: leadership lost "
+                    "(epoch fenced); the write was not acked")
+                    if self.fenced else
+                    StateError(f"state store {self._store.name!r}: "
+                               "replication session closed"))
+                return
+            self._pending[record["seq"]] = _Pending(
+                record, resolve, fail, self._node.node_id,
+                time.monotonic() + self.ack_timeout)
+        for evt in self._wake.values():
+            evt.set()
+
+    def _on_ack(self, member: str, hwm: int) -> None:
+        done: list[int] = []
+        for seq, p in self._pending.items():
+            if seq > hwm:
+                break
+            p.acks.add(member)
+            if len(p.acks) >= self.ack_quorum:
+                done.append(seq)
+        for seq in done:
+            self._pending.pop(seq).resolve()
+
+    async def _ship_loop(self, member: str, link) -> None:
+        labels = self._node.metric_labels
+        backoff = 0.05
+        primed = False
+        force_snapshot = False
+        while not self._closed and not self.fenced:
+            evt = self._wake[member]
+            evt.clear()
+            try:
+                if not primed:
+                    hwm, f_epoch = await link.position()
+                    self._member_hwm[member] = hwm
+                    # log-matching check (Raft §5.3): the follower's
+                    # log is a prefix of ours only if OUR entry at ITS
+                    # hwm carries the same epoch. A zombie ex-leader
+                    # that committed past our barrier fails this and
+                    # gets a snapshot, dropping its divergent suffix.
+                    if hwm > 0:
+                        ours = await self._run_store(
+                            self._store.read_repl_epoch_at, hwm)
+                        if ours != f_epoch:
+                            force_snapshot = True
+                    primed = True
+                leader_hwm, _ = self._store.repl_position()
+                sent = self._member_hwm[member]
+                metrics.set_gauge("repl_follower_lag_records",
+                                  max(0, leader_hwm - sent),
+                                  member=member, **labels)
+                if not force_snapshot and sent >= leader_hwm:
+                    await evt.wait()
+                    continue
+                records = (None if force_snapshot
+                           else await self._read_log(sent))
+                if records is None:
+                    # pruned past the gap, or the follower diverged:
+                    # reinstall from a full snapshot
+                    snap = await self._run_store(
+                        self._store.read_repl_snapshot)
+                    await link.install(snap)
+                    acked = int(snap["hwm"])
+                    force_snapshot = False
+                else:
+                    acked = await link.append(records)
+                    metrics.inc("repl_records_total", len(records),
+                                member=member, **labels)
+                self._member_hwm[member] = acked
+                self._on_ack(member, acked)
+                backoff = 0.05
+            except ReplicationGapError as exc:
+                if exc.diverged:
+                    force_snapshot = True
+                else:
+                    self._member_hwm[member] = exc.hwm
+            except ReplicaFencedError:
+                self._fence()
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # transport failure, chaos injection, follower down:
+                # back off and retry — the ack-timeout loop owns
+                # failing the pending writes if this never recovers
+                primed = False
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 0.5)
+
+    async def _timeout_loop(self) -> None:
+        interval = max(0.02, min(self.ack_timeout / 4, 1.0))
+        while not self._closed and not self.fenced:
+            await asyncio.sleep(interval)
+            now = time.monotonic()
+            expired = [seq for seq, p in self._pending.items()
+                       if p.deadline <= now]
+            for seq in expired:
+                p = self._pending.pop(seq)
+                p.fail(ReplicationQuorumError(
+                    f"state store {self._store.name!r}: record seq {seq} "
+                    f"did not reach ack quorum {self.ack_quorum} within "
+                    f"{self.ack_timeout}s — the replica set is degraded"))
+
+    async def _read_log(self, after_seq: int) -> list[dict] | None:
+        return await self._run_store(self._store.read_repl_log, after_seq)
+
+    async def _run_store(self, fn, *args):
+        return await self._loop.run_in_executor(
+            self._store._write_exec, fn, *args)
+
+    def _fence(self) -> None:
+        """Leadership is gone: fail everything pending, refuse
+        everything future. The store keeps this fenced session attached
+        so late flushes fail fast until a new leader resyncs us."""
+        if self.fenced:
+            return
+        self.fenced = True
+        metrics.inc("repl_fenced_total", **self._node.metric_labels)
+        pending, self._pending = self._pending, collections.OrderedDict()
+        err = ReplicaFencedError(
+            f"state store {self._store.name!r}: leadership lost "
+            "(epoch fenced); the write was not acked")
+        for p in pending.values():
+            p.fail(err)
+        self._node._on_fenced()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for t in self._tasks:
+            t.cancel()
+        for evt in self._wake.values():
+            evt.set()
+        pending, self._pending = self._pending, collections.OrderedDict()
+        err = StateError(
+            f"state store {self._store.name!r}: replication session closed")
+        for p in pending.values():
+            p.fail(err)
+
+
+class ReplicationNode:
+    """One member of a shard's replica set: a full SQLite engine plus
+    a role loop that renews the shard lease while leader and contends
+    for it while follower."""
+
+    def __init__(self, name: str, path: str | pathlib.Path, *,
+                 member: int, shard: int, meta_store: StateStore,
+                 lease_seconds: float | None = None,
+                 ack_quorum: int = 2, ack_timeout: float | None = None,
+                 log_retain: int | None = None,
+                 group_commit: bool = True, cache_size: int = 0,
+                 shard_label: int | None = None):
+        self.name = name
+        self.member = int(member)
+        self.node_id = f"r{member}"
+        self.shard = int(shard)
+        self.ack_quorum = int(ack_quorum)
+        self.ack_timeout = (float(ack_timeout) if ack_timeout
+                            else ack_timeout_default())
+        self.store = SqliteStateStore(
+            name, path, replication=True,
+            repl_log_retain=log_retain or log_retain_default(),
+            group_commit=group_commit, cache_size=cache_size,
+            shard=shard_label)
+        self.lease = Lease(meta_store, f"repl-lease||{name}||{shard}",
+                           lease_seconds=lease_seconds)
+        #: links to the OTHER members, wired by the builder
+        self.links: dict[str, LocalLink] = {}
+        self.replicator: ShardReplicator | None = None
+        #: simulated host death (tests/chaos): every inbound protocol
+        #: call raises OSError, the role loop goes inert
+        self.crashed = False
+        #: zombie drill switch: a "paused" leader stops renewing (as a
+        #: GC-stalled or partitioned process would) but keeps accepting
+        #: writes until fenced
+        self.renewal_paused = False
+        #: set when this member lost leadership with a possibly
+        #: divergent log suffix; it must NOT re-promote until the new
+        #: leader resynced it (snapshot or higher-epoch records), or
+        #: its unacked suffix could overwrite quorum-acked writes
+        self._needs_resync = False
+        self._running = False
+        self._task: asyncio.Task | None = None
+
+    @property
+    def metric_labels(self) -> dict:
+        return {"store": self.name, "shard": self.shard}
+
+    @property
+    def is_leader(self) -> bool:
+        return self.replicator is not None and not self.replicator.fenced
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._running = True
+            self._task = asyncio.ensure_future(self._role_loop())
+
+    async def stop(self) -> None:
+        self._running = False
+        if self._task is not None:
+            self._task.cancel()
+            await asyncio.gather(self._task, return_exceptions=True)
+            self._task = None
+        if self.is_leader and not self.crashed:
+            try:
+                await self.lease.release(self.node_id)
+            except Exception:  # meta store may already be gone
+                logger.debug("lease release failed for %s/%s %s",
+                             self.name, self.shard, self.node_id,
+                             exc_info=True)
+        if self.replicator is not None:
+            self.replicator.close()
+            self.replicator = None
+            self.store._repl = None
+
+    def crash(self) -> None:
+        """Simulate host loss: protocol calls fail, the role loop goes
+        inert, and the lease is left to expire — exactly what a real
+        ``kill -9`` leaves behind."""
+        self.crashed = True
+        if self.replicator is not None:
+            self.replicator.close()
+            self.replicator = None
+            self.store._repl = None
+            self._needs_resync = True
+
+    def revive(self) -> None:
+        self.crashed = False
+
+    # -- role loop ---------------------------------------------------------
+
+    async def _role_loop(self) -> None:
+        interval = self.lease.lease_seconds / 3.0
+        if self.member:
+            # cold-boot bias: member 0 contends first so the initial
+            # election is deterministic; irrelevant after any failover
+            await asyncio.sleep(min(interval, 0.03 * self.member))
+        while self._running:
+            try:
+                if not self.crashed:
+                    await self._tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.debug("replication %s/%s %s: role tick failed",
+                             self.name, self.shard, self.node_id,
+                             exc_info=True)
+            await asyncio.sleep(interval)
+
+    async def _tick(self) -> None:
+        if self.is_leader:
+            if self.renewal_paused:
+                return  # zombie drill: let the lease run out
+            if not await self.lease.renew(self.node_id):
+                # someone took the lease from us — fence locally NOW
+                # rather than waiting for a follower to refuse a record
+                if self.replicator is not None:
+                    self.replicator._fence()
+        else:
+            await self._maybe_promote()
+
+    async def _maybe_promote(self) -> None:
+        rec = await self.lease.peek()
+        if rec is not None and not Lease.holder_gone(rec):
+            return
+        if self._needs_resync:
+            return
+        # don't take leadership while a reachable peer is ahead of us:
+        # our stream would truncate its acked suffix. An unreachable
+        # peer can't object — it will be resynced when it returns.
+        my_hwm, _ = self.store.repl_position()
+        for link in self.links.values():
+            try:
+                hwm, _ = await link.position()
+            except Exception:
+                continue
+            if hwm > my_hwm:
+                return
+        epoch = await self.lease.acquire(self.node_id)
+        if epoch is not None:
+            await self._become_leader(epoch)
+
+    async def _become_leader(self, epoch: int) -> None:
+        if self.replicator is not None:
+            self.replicator.close()
+        loop = asyncio.get_running_loop()
+        # the leadership barrier: an empty record at the new epoch,
+        # durable before any data is accepted at this epoch
+        await loop.run_in_executor(
+            self.store._write_exec, self.store.append_repl_barrier, epoch)
+        self.replicator = ShardReplicator(
+            self, epoch=epoch, ack_quorum=self.ack_quorum,
+            ack_timeout=self.ack_timeout)
+        self.store._repl = self.replicator
+        self.replicator.start()
+        self._needs_resync = False
+        metrics.set_gauge("repl_epoch", epoch, **self.metric_labels)
+        if epoch > 1:
+            metrics.inc("repl_failover_total", **self.metric_labels)
+        logger.info("replication: %s shard %d: %s is leader (epoch %d)",
+                    self.name, self.shard, self.node_id, epoch)
+
+    def _on_fenced(self) -> None:
+        self._needs_resync = True
+
+    # -- follower protocol (called via links / mesh server) ----------------
+
+    async def apply_records(self, records: list[dict]) -> int:
+        if self.crashed:
+            raise OSError(f"replica member {self.node_id} is down")
+        loop = asyncio.get_running_loop()
+        _, prev_epoch = self.store.repl_position()
+        hwm = await loop.run_in_executor(
+            self.store._write_exec, self.store.apply_repl_records, records)
+        _, epoch = self.store.repl_position()
+        if epoch > prev_epoch:
+            # a new leader's records applied cleanly: our log is a
+            # prefix of its log — safe to contend for leadership again
+            self._accept_new_leader()
+        return hwm
+
+    async def install_snapshot(self, snapshot: dict) -> None:
+        if self.crashed:
+            raise OSError(f"replica member {self.node_id} is down")
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            self.store._write_exec, self.store.install_repl_snapshot,
+            snapshot)
+        self._accept_new_leader()
+
+    def position(self) -> tuple[int, int]:
+        if self.crashed:
+            raise OSError(f"replica member {self.node_id} is down")
+        return self.store.repl_position()
+
+    def _accept_new_leader(self) -> None:
+        if self.replicator is not None:
+            self.replicator.close()
+            self.replicator = None
+            self.store._repl = None
+        self._needs_resync = False
+
+
+class ReplicaSetStore(StateStore):
+    """One shard's replica set behind the plain ``StateStore`` API.
+
+    Writes route to whichever member currently holds the lease, with
+    one transparent retry after a fencing failure (the write was
+    provably not applied). Reads go to the leader, or — with
+    ``followerReads`` — to a follower whose lag is within the bound.
+    Members start lazily on first use because drivers construct
+    components without a running event loop."""
+
+    supports_query = True
+
+    def __init__(self, name: str, nodes: list[ReplicationNode], *,
+                 shard: int = 0, follower_reads: bool = False,
+                 max_lag: int | None = None,
+                 meta_store: StateStore | None = None,
+                 owns_meta: bool = False):
+        super().__init__(name)
+        self.nodes = nodes
+        self.shard = int(shard)
+        self.follower_reads = bool(follower_reads)
+        self.max_lag = int(max_lag) if max_lag else max_lag_default()
+        self._meta = meta_store
+        self._owns_meta = bool(owns_meta)
+        self._started = False
+        self._rr = 0
+
+    # -- membership --------------------------------------------------------
+
+    async def _ensure_started(self) -> None:
+        if not self._started:
+            self._started = True
+            for node in self.nodes:
+                await node.start()
+
+    async def _leader_node(self) -> ReplicationNode:
+        await self._ensure_started()
+        lease_s = self.nodes[0].lease.lease_seconds
+        deadline = time.monotonic() + 3.0 * lease_s + 1.0
+        while True:
+            for node in self.nodes:
+                if node.is_leader and not node.crashed:
+                    return node
+            if time.monotonic() > deadline:
+                raise NotLeaderError(
+                    f"state store {self.name!r} shard {self.shard}: no "
+                    "member holds the shard lease")
+            await asyncio.sleep(min(0.02, lease_s / 10.0))
+
+    def leader_member(self) -> str | None:
+        for node in self.nodes:
+            if node.is_leader:
+                return node.node_id
+        return None
+
+    def attach_chaos(self, policies) -> None:
+        """Bind ``kind:Chaos`` replication-lane faults to the member
+        links (called by chaos/wrappers.py at component build)."""
+        for node in self.nodes:
+            for member_id, link in node.links.items():
+                link.chaos = policies.for_replication(
+                    self.name, self.shard, member_id)
+
+    # -- writes ------------------------------------------------------------
+
+    async def _write(self, fn) -> Any:
+        await self._ensure_started()
+        last: BaseException | None = None
+        for attempt in (0, 1):
+            node = await self._leader_node()
+            try:
+                return await fn(node)
+            except (NotLeaderError, ReplicaFencedError) as exc:
+                # fenced means NOT applied and NOT acked: one
+                # re-resolve + retry against the new leader is safe
+                last = exc
+        raise last  # type: ignore[misc]
+
+    async def set(self, key: str, value: Any, *,
+                  etag: str | None = None) -> str:
+        return await self._write(lambda n: n.store.set(key, value, etag=etag))
+
+    async def delete(self, key: str, *, etag: str | None = None) -> bool:
+        return await self._write(lambda n: n.store.delete(key, etag=etag))
+
+    async def transact(self, ops: list[TransactionOp]) -> None:
+        return await self._write(lambda n: n.store.transact(ops))
+
+    async def stage_transact(self, ops: list[TransactionOp]):
+        """Two-phase hook for the sharded facade: stage on the current
+        leader (no retry — a staged transaction holds the commit slot)."""
+        node = await self._leader_node()
+        return await node.store.stage_transact(ops)
+
+    # -- reads -------------------------------------------------------------
+
+    async def _read_node(self) -> ReplicationNode:
+        await self._ensure_started()
+        leader = await self._leader_node()
+        if not self.follower_reads:
+            return leader
+        leader_hwm, _ = leader.store.repl_position()
+        n = len(self.nodes)
+        for i in range(n):
+            node = self.nodes[(self._rr + i) % n]
+            if node is leader or node.crashed:
+                continue
+            hwm, _ = node.store.repl_position()
+            if leader_hwm - hwm <= self.max_lag:
+                self._rr = (self._rr + i + 1) % n
+                return node
+        return leader  # every follower beyond the bound → redirect
+
+    async def get(self, key: str) -> StateItem | None:
+        node = await self._read_node()
+        return await node.store.get(key)
+
+    async def bulk_get(self, keys: list[str]) -> list[StateItem | None]:
+        node = await self._read_node()
+        return await node.store.bulk_get(keys)
+
+    async def query(self, query: dict, *, key_prefix: str = "") -> QueryResponse:
+        node = await self._read_node()
+        return await node.store.query(query, key_prefix=key_prefix)
+
+    async def keys(self, *, prefix: str = "") -> list[str]:
+        node = await self._read_node()
+        return await node.store.keys(prefix=prefix)
+
+    async def read_follower(self, key: str, *,
+                            member: str | None = None) -> StateItem | None:
+        """Read from a specific follower, enforcing the lag bound the
+        hard way: beyond ``maxLagRecords`` this raises
+        :class:`StaleReadError` instead of redirecting — the contract
+        for callers that addressed the member deliberately."""
+        await self._ensure_started()
+        leader = await self._leader_node()
+        leader_hwm, _ = leader.store.repl_position()
+        for node in self.nodes:
+            if node is leader:
+                continue
+            if member is not None and node.node_id != member:
+                continue
+            hwm, _ = node.store.repl_position()
+            if leader_hwm - hwm > self.max_lag:
+                raise StaleReadError(
+                    f"state store {self.name!r}: follower {node.node_id} "
+                    f"lags {leader_hwm - hwm} records "
+                    f"(> maxLagRecords {self.max_lag})")
+            return await node.store.get(key)
+        raise StaleReadError(
+            f"state store {self.name!r}: no follower matches {member!r}")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def aclose(self) -> None:
+        for node in self.nodes:
+            await node.stop()
+        for node in self.nodes:
+            node.store.close()
+        if self._owns_meta and self._meta is not None:
+            self._meta.close()
+
+    def close(self) -> None:
+        """Sync teardown is the crash-equivalent path: no lease
+        release (it expires on its own), just stop the machinery."""
+        for node in self.nodes:
+            node._running = False
+            if node._task is not None:
+                node._task.cancel()
+                node._task = None
+            if node.replicator is not None:
+                node.replicator.close()
+                node.replicator = None
+                node.store._repl = None
+        for node in self.nodes:
+            node.store.close()
+        if self._owns_meta and self._meta is not None:
+            self._meta.close()
+
+
+def _member_path(path: str, shard: int, member: int, shards: int) -> str:
+    """Member ``m`` of shard ``s``: member 0 keeps the unreplicated
+    layout's exact file (``tasks.db`` / ``tasks-shardN.db``) so
+    enabling replication on existing data promotes the existing file
+    to the seed copy; followers add an ``-rM`` suffix. ``":memory:"``
+    passes through — each member's connection gets a private database,
+    which is exactly one private replica."""
+    if path == ":memory:":
+        return path
+    base = path if shards == 1 else _shard_path(path, shard)
+    if member == 0:
+        return base
+    p = pathlib.Path(base)
+    return str(p.with_name(f"{p.stem}-r{member}{p.suffix}"))
+
+
+def _meta_path(path: str) -> str:
+    if path == ":memory:":
+        return ":memory:"
+    p = pathlib.Path(path)
+    return str(p.with_name(f"{p.stem}-repl-meta{p.suffix}"))
+
+
+def build_replicated_store(
+        name: str, path: str | pathlib.Path = ":memory:", *,
+        shards: int = 1, replicas: int, ack_quorum: int | None = None,
+        hash_seed: str = "", group_commit: bool = True, cache_size: int = 0,
+        follower_reads: bool = False, max_lag: int | None = None,
+        lease_seconds: float | None = None, ack_timeout: float | None = None,
+        log_retain: int | None = None) -> StateStore:
+    """Assemble the replicated state plane for one component: per
+    shard, a replica set of ``replicas`` members sharing one meta store
+    (the lease table); across shards, the PR 5 rendezvous facade over
+    the per-shard replica sets. ``ack_quorum`` defaults to a majority
+    (RF 2 → 2, RF 3 → 2): zero lost acked writes as long as any
+    majority survives."""
+    from tasksrunner.state.sharding import MAX_SHARDS, ShardedStateStore
+    if replicas < 1 or replicas > MAX_REPLICAS:
+        raise ComponentError(
+            f"state store {name!r}: replicas must be in 1..{MAX_REPLICAS}, "
+            f"not {replicas}")
+    if shards < 1 or shards > MAX_SHARDS:
+        raise ComponentError(
+            f"state store {name!r}: shards must be in 1..{MAX_SHARDS}, "
+            f"not {shards}")
+    if replicas == 1:
+        # RF 1 is exactly the unreplicated engine — the bench baseline
+        from tasksrunner.state.sqlite import build_sharded_store
+        if shards == 1:
+            return SqliteStateStore(name, path, group_commit=group_commit,
+                                    cache_size=cache_size)
+        return build_sharded_store(name, path, shards=shards,
+                                   hash_seed=hash_seed,
+                                   group_commit=group_commit,
+                                   cache_size=cache_size)
+    quorum = int(ack_quorum) if ack_quorum else replicas // 2 + 1
+    quorum = max(1, min(quorum, replicas))
+    per_cache = (max(1, cache_size // shards)
+                 if cache_size and shards > 1 else cache_size)
+    meta = SqliteStateStore(f"{name}.repl-meta", _meta_path(str(path)))
+    sets: list[ReplicaSetStore] = []
+    for s in range(shards):
+        nodes = [
+            ReplicationNode(
+                name, _member_path(str(path), s, m, shards),
+                member=m, shard=s, meta_store=meta,
+                lease_seconds=lease_seconds, ack_quorum=quorum,
+                ack_timeout=ack_timeout, log_retain=log_retain,
+                group_commit=group_commit, cache_size=per_cache,
+                shard_label=s if shards > 1 else None)
+            for m in range(replicas)
+        ]
+        for node in nodes:
+            node.links = {
+                other.node_id: LocalLink(other)
+                for other in nodes if other is not node
+            }
+        sets.append(ReplicaSetStore(
+            name, nodes, shard=s, follower_reads=follower_reads,
+            max_lag=max_lag, meta_store=meta,
+            owns_meta=(s == shards - 1)))
+    if shards == 1:
+        return sets[0]
+    return ShardedStateStore(name, sets, hash_seed=hash_seed)

@@ -1,0 +1,205 @@
+"""Mesh-framed transport for the replication record stream.
+
+The in-process replica sets (state/replication.py) wire members with
+direct :class:`~tasksrunner.state.replication.LocalLink` calls; this
+module carries the same three-verb protocol — ``append`` / ``install``
+/ ``position`` — across processes over the mesh lane's frame format
+(``[u32 frame_len][u32 header_len][header JSON][body]``,
+invoke/mesh.py), so a follower can live on another host and a
+``kill -9`` of the leader *process* is survivable, not just a leader
+*object* going away.
+
+Error mapping is explicit: a follower's
+:class:`~tasksrunner.errors.ReplicationGapError` and
+:class:`~tasksrunner.errors.ReplicaFencedError` are protocol signals
+the leader's shipper must see typed (gap → catch-up or snapshot,
+fenced → fence the session), so they travel as structured reply
+headers (``kind: gap|fenced``) and are re-raised as the same classes
+on the caller side. Everything else is an opaque transport failure
+(OSError) the shipper retries with backoff.
+
+Requests on one connection are strictly serial request/response — the
+shipper is a single loop per follower, so multiplexing would buy
+nothing here (unlike the invoke lane).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+from tasksrunner.errors import ReplicaFencedError, ReplicationGapError
+from tasksrunner.invoke.mesh import CONNECT_TIMEOUT, MAX_FRAME, _pack, _read_frame
+from tasksrunner.state.replication import ReplicationNode
+
+logger = logging.getLogger(__name__)
+
+#: per-request ceiling: a snapshot install on a slow disk is the worst
+#: legitimate case; far below the invoke lane's 300 s — a hung peer
+#: must fail the shipment (and eventually the ack quorum), not park it
+REPL_REQUEST_TIMEOUT = 30.0
+
+
+class ReplicationServer:
+    """Exposes local follower members to remote leaders.
+
+    One server per process; members register by ``(store, shard)``.
+    The handler loop is serial per connection, mirroring the client's
+    one-request-at-a-time shipper."""
+
+    def __init__(self, *, host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
+        self.host = host
+        self.port = port
+        self._ssl = ssl_context
+        self._nodes: dict[tuple[str, int], ReplicationNode] = {}
+        self._server: asyncio.AbstractServer | None = None
+
+    def register(self, node: ReplicationNode) -> None:
+        self._nodes[(node.name, node.shard)] = node
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port, ssl=self._ssl)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def aclose(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                header, body = await _read_frame(reader, max_body=MAX_FRAME)
+                resp_header, resp_body = await self._dispatch(header, body)
+                writer.write(_pack(resp_header, resp_body))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass  # peer went away; its shipper reconnects
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):  # pragma: no cover
+                pass
+
+    async def _dispatch(self, header: dict,
+                        body: bytes | None) -> tuple[dict, bytes]:
+        node = self._nodes.get(
+            (header.get("store"), int(header.get("shard", 0))))
+        if node is None:
+            return ({"ok": False, "kind": "error",
+                     "error": f"no replica member for "
+                              f"{header.get('store')!r} shard "
+                              f"{header.get('shard')}"}, b"")
+        op = header.get("op")
+        try:
+            if op == "append":
+                hwm = await node.apply_records(json.loads(body or b"[]"))
+                return {"ok": True}, json.dumps({"hwm": hwm}).encode()
+            if op == "install":
+                await node.install_snapshot(json.loads(body or b"{}"))
+                return {"ok": True}, b"{}"
+            if op == "position":
+                hwm, epoch = node.position()
+                return ({"ok": True},
+                        json.dumps({"hwm": hwm, "epoch": epoch}).encode())
+            return ({"ok": False, "kind": "error",
+                     "error": f"unknown replication op {op!r}"}, b"")
+        except ReplicationGapError as exc:
+            return ({"ok": False, "kind": "gap", "hwm": exc.hwm,
+                     "diverged": exc.diverged}, b"")
+        except ReplicaFencedError as exc:
+            return {"ok": False, "kind": "fenced", "error": str(exc)}, b""
+        except Exception as exc:
+            logger.debug("replication server op %s failed", op, exc_info=True)
+            return ({"ok": False, "kind": "error",
+                     "error": f"{type(exc).__name__}: {exc}"}, b"")
+
+
+class MeshFollowerLink:
+    """Leader-side handle on a REMOTE follower — the cross-process
+    drop-in for ``LocalLink`` (same verbs, same typed errors, same
+    optional chaos gate on the lane)."""
+
+    def __init__(self, store: str, shard: int, member: str,
+                 host: str, port: int, *, ssl_context=None,
+                 timeout: float = REPL_REQUEST_TIMEOUT):
+        self.store = store
+        self.shard = int(shard)
+        self.member = member
+        self.host = host
+        self.port = int(port)
+        self.timeout = float(timeout)
+        self.chaos = None  # ChaosPolicy | None
+        self._ssl = ssl_context
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._lock = asyncio.Lock()
+
+    async def _chaos_gate(self) -> None:
+        if self.chaos is not None:
+            status = await self.chaos.before_call()
+            if status is not None:
+                self.chaos.raise_for_status(status)
+
+    async def _teardown(self) -> None:
+        writer, self._reader, self._writer = self._writer, None, None
+        if writer is not None:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _request(self, op: str, payload) -> dict:
+        async with self._lock:
+            if self._writer is None:
+                self._reader, self._writer = await asyncio.wait_for(
+                    asyncio.open_connection(self.host, self.port,
+                                            ssl=self._ssl),
+                    CONNECT_TIMEOUT)
+            header = {"op": op, "store": self.store, "shard": self.shard}
+            body = (b"" if payload is None
+                    else json.dumps(payload, separators=(",", ":")).encode())
+            try:
+                self._writer.write(_pack(header, body))
+                await self._writer.drain()
+                resp, resp_body = await asyncio.wait_for(
+                    _read_frame(self._reader), self.timeout)
+            except (OSError, asyncio.IncompleteReadError, ConnectionError,
+                    asyncio.TimeoutError):
+                await self._teardown()
+                raise
+        if resp.get("ok"):
+            return json.loads(resp_body) if resp_body else {}
+        kind = resp.get("kind")
+        if kind == "gap":
+            raise ReplicationGapError(
+                f"follower {self.member} reports a log gap",
+                hwm=int(resp.get("hwm", 0)),
+                diverged=bool(resp.get("diverged", False)))
+        if kind == "fenced":
+            raise ReplicaFencedError(
+                resp.get("error") or f"follower {self.member}: fenced")
+        raise OSError(
+            f"replication peer {self.member} error: {resp.get('error')}")
+
+    async def append(self, records: list[dict]) -> int:
+        await self._chaos_gate()
+        return int((await self._request("append", records))["hwm"])
+
+    async def install(self, snapshot: dict) -> None:
+        await self._chaos_gate()
+        await self._request("install", snapshot)
+
+    async def position(self) -> tuple[int, int]:
+        reply = await self._request("position", None)
+        return int(reply["hwm"]), int(reply["epoch"])
+
+    async def aclose(self) -> None:
+        await self._teardown()

@@ -292,3 +292,17 @@ class ShardedStateStore(StateStore):
                     first = exc
         if first is not None:
             raise first
+
+    async def aclose(self) -> None:
+        """Prefer the children's async teardown: replicated children
+        (state/replication.py) release shard leases gracefully only on
+        the async path — sync ``close()`` is the crash-equivalent."""
+        first: BaseException | None = None
+        for child in self._shards:
+            try:
+                await child.aclose()
+            except Exception as exc:
+                if first is None:
+                    first = exc
+        if first is not None:
+            raise first

@@ -49,7 +49,8 @@ from typing import Any
 from tasksrunner.component.registry import driver
 from tasksrunner.component.spec import ComponentSpec, metadata_bool, metadata_int
 from tasksrunner.errors import (
-    ComponentError, EtagMismatch, QueryError, StateError,
+    ComponentError, EtagMismatch, QueryError, ReplicaFencedError,
+    ReplicationGapError, StateError,
 )
 from tasksrunner.observability.metrics import metrics
 from tasksrunner.state.base import QueryResponse, StateItem, StateStore, TransactionOp
@@ -66,6 +67,24 @@ CREATE TABLE IF NOT EXISTS etag_seq (
     n   INTEGER NOT NULL
 );
 INSERT OR IGNORE INTO etag_seq(id, n) VALUES (1, 0);
+"""
+
+#: created only on replicated members (``replication=True``) so a
+#: plain store's file layout stays bit-for-bit what it was: the
+#: logical write-ahead record stream (state/replication.py) plus the
+#: member's durable position (high-water mark + fencing epoch).
+_REPL_SCHEMA = """
+CREATE TABLE IF NOT EXISTS repl_log (
+    seq    INTEGER PRIMARY KEY,
+    epoch  INTEGER NOT NULL,
+    record TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS repl_meta (
+    id    INTEGER PRIMARY KEY CHECK (id = 1),
+    hwm   INTEGER NOT NULL,
+    epoch INTEGER NOT NULL
+);
+INSERT OR IGNORE INTO repl_meta(id, hwm, epoch) VALUES (1, 0, 0);
 """
 
 
@@ -299,9 +318,17 @@ class SqliteStateStore(StateStore):
 
     def __init__(self, name: str, path: str | pathlib.Path = ":memory:", *,
                  group_commit: bool = True, cache_size: int = 0,
-                 shard: int | None = None):
+                 shard: int | None = None, replication: bool = False,
+                 repl_log_retain: int = 4096):
         super().__init__(name)
         self.path = str(path)
+        #: True on replica-set members: every commit also appends a
+        #: logical record to ``repl_log`` (same transaction), and the
+        #: attached :attr:`_repl` session — when present — defers the
+        #: caller's ack until the record reached its quorum.
+        self.replication = bool(replication)
+        if self.replication:
+            group_commit = True  # the record stream IS the flusher's output
         #: shard index when this store is one partition of a sharded
         #: component (state/sharding.py); None = standalone. Only
         #: affects observability: the queue-depth gauge gains a
@@ -339,7 +366,23 @@ class SqliteStateStore(StateStore):
             # committing writer; the background thread PASSIVE-checkpoints.
             self._conn.execute("PRAGMA wal_autocheckpoint=0")
         self._conn.executescript(_SCHEMA)
+        if self.replication:
+            self._conn.executescript(_REPL_SCHEMA)
         self._conn.commit()
+
+        # Replication bookkeeping. _repl_hwm/_repl_epoch mirror
+        # repl_meta; they are mutated on the writer thread only, but
+        # read from the event loop (lag gauges, stale-read bounds), so
+        # the tiny lock keeps the pair coherent across threads.
+        self._repl = None               # ReplicationSession once leader
+        self._repl_lock = threading.Lock()
+        self._repl_retain = max(1, int(repl_log_retain))
+        self._repl_hwm = 0
+        self._repl_epoch = 0
+        if self.replication:
+            row = self._conn.execute(
+                "SELECT hwm, epoch FROM repl_meta WHERE id = 1").fetchone()
+            self._repl_hwm, self._repl_epoch = int(row[0]), int(row[1])
 
         # Dedicated writer thread (owns self._conn after init) and, for
         # file stores, a dedicated reader thread with its own WAL
@@ -518,6 +561,51 @@ class SqliteStateStore(StateStore):
             return self._apply_delete(cur, op[1], op[2], mutations)
         return self._apply_transact(cur, op[1], mutations, alloc)
 
+    # -- replication record stream (leader side, writer thread) -----------
+
+    def _repl_append(self, cur: sqlite3.Cursor,
+                     mutations: list[tuple]) -> dict | None:
+        """Append one logical record covering ``mutations`` to the
+        write-ahead stream, INSIDE the data transaction — the record
+        and the rows it describes commit or roll back together. The
+        record carries the post-batch ``etag_seq`` value so followers
+        keep allocating fresh etags after a failover, and the leader's
+        epoch so stale-epoch zombies are refused downstream."""
+        if not self.replication or not mutations:
+            return None
+        seq = self._repl_hwm + 1
+        (etag_n,) = cur.execute(
+            "SELECT n FROM etag_seq WHERE id = 1").fetchone()
+        record = {"seq": seq, "epoch": self._repl_epoch,
+                  "ops": mutations, "etag_n": etag_n, "ts": time.time()}
+        cur.execute(
+            "INSERT INTO repl_log(seq, epoch, record) VALUES (?, ?, ?)",
+            (seq, self._repl_epoch,
+             json.dumps(record, separators=(",", ":"))))
+        cur.execute("UPDATE repl_meta SET hwm = ? WHERE id = 1", (seq,))
+        # bounded log: a follower further behind than the retained
+        # window catches up via snapshot instead
+        cur.execute("DELETE FROM repl_log WHERE seq <= ?",
+                    (seq - self._repl_retain,))
+        return record
+
+    def _repl_committed(self, record: dict | None) -> None:
+        """Post-COMMIT bookkeeping for an appended record."""
+        if record is not None:
+            with self._repl_lock:
+                self._repl_hwm = record["seq"]
+
+    def _repl_fail_fast(self) -> BaseException | None:
+        """A fenced member refuses new writes before touching the db —
+        its stream can no longer reach quorum, so accepting the commit
+        would only grow the divergent suffix a resync must discard."""
+        repl = self._repl
+        if repl is not None and getattr(repl, "fenced", False):
+            return ReplicaFencedError(
+                f"state store {self.name!r}: this member lost shard "
+                "leadership (epoch fenced); retry against the new leader")
+        return None
+
     # -- group-commit flush (writer thread) -------------------------------
 
     def _flush_writes(self) -> None:
@@ -562,8 +650,13 @@ class SqliteStateStore(StateStore):
         validated before writing, so the shared transaction is clean);
         ops apply in enqueue order, so an op sees the effects of the
         ops queued before it exactly as if each had committed alone."""
+        fast = self._repl_fail_fast()
+        if fast is not None:
+            _resolve_batch([(row, None, fast) for row in batch])
+            return
         results: list[tuple[Any, BaseException | None]] = [None] * len(batch)
         mutations: list[tuple] = []
+        rec: dict | None = None
         batch_start = time.monotonic()
         if metrics.histograms_enabled:
             metrics.observe_many(
@@ -607,6 +700,7 @@ class SqliteStateStore(StateStore):
                     except EtagMismatch as exc:
                         results[i] = (None, exc)
                     i += 1
+                rec = self._repl_append(cur, mutations)
                 self._conn.commit()
             except BaseException:
                 self._conn.rollback()
@@ -620,33 +714,61 @@ class SqliteStateStore(StateStore):
             return
         self._dirty = True
         self._cache_apply(mutations)
+        self._repl_committed(rec)
         metrics.observe("state_commit_seconds",
                         time.monotonic() - batch_start, store=self.name)
-        _resolve_batch([(row, value, exc)
-                        for row, (value, exc) in zip(batch, results)])
+        pairs = [(row, value, exc)
+                 for row, (value, exc) in zip(batch, results)]
+        repl = self._repl
+        if rec is not None and repl is not None:
+            # ack-after-replication: the record is durable locally, but
+            # the callers' futures resolve only once it reached the ack
+            # quorum (or the quorum timeout fails them). A row refused
+            # by its own etag keeps its own EtagMismatch either way.
+            def _quorum_fail(qexc: BaseException) -> None:
+                _resolve_batch([(row, None, exc if exc is not None else qexc)
+                                for row, _value, exc in pairs])
+            repl.on_commit(rec, lambda: _resolve_batch(pairs), _quorum_fail)
+        else:
+            _resolve_batch(pairs)
 
     def _exec_single(self, op: tuple) -> Any:
         """One op in its own transaction (writer thread); the
         group_commit=False path and the batch-failure fallback."""
+        value, _rec = self._exec_single_repl(op)
+        return value
+
+    def _exec_single_repl(self, op: tuple) -> tuple[Any, dict | None]:
+        fast = self._repl_fail_fast()
+        if fast is not None:
+            raise fast
         mutations: list[tuple] = []
         cur = self._conn.cursor()
         self._begin_immediate(cur)
         try:
             value = self._apply_op(cur, op, mutations,
                                    lambda: str(self._reserve_etags(cur, 1)))
+            rec = self._repl_append(cur, mutations)
             self._conn.commit()
         except BaseException:
             self._conn.rollback()
             raise
         self._dirty = True
         self._cache_apply(mutations)
-        return value
+        self._repl_committed(rec)
+        return value, rec
 
     def _exec_single_resolve(self, row: _PendingWrite) -> None:
         try:
-            value = self._exec_single(row.op)
+            value, rec = self._exec_single_repl(row.op)
         except BaseException as exc:
             _resolve(row, None, exc)
+            return
+        repl = self._repl
+        if rec is not None and repl is not None:
+            repl.on_commit(rec,
+                           lambda: _resolve(row, value, None),
+                           lambda qexc: _resolve(row, None, qexc))
         else:
             _resolve(row, value, None)
 
@@ -790,6 +912,10 @@ class SqliteStateStore(StateStore):
     def _stage_job(self, ops: list[tuple], txn: StagedTransaction) -> None:
         """Writer thread: BEGIN + validate + apply, park on the
         coordinator's decision, then COMMIT or ROLLBACK."""
+        fast = self._repl_fail_fast()
+        if fast is not None:
+            txn._resolve_staged(fast)
+            return
         cur = self._conn.cursor()
         mutations: list[tuple] = []
         try:
@@ -810,15 +936,195 @@ class SqliteStateStore(StateStore):
         decision = txn._await_decision(self._STAGE_DECISION_TIMEOUT)
         try:
             if decision == "commit":
-                self._conn.commit()
+                rec = None
+                try:
+                    rec = self._repl_append(cur, mutations)
+                    self._conn.commit()
+                except BaseException:
+                    self._conn.rollback()
+                    raise
                 self._dirty = True
                 self._cache_apply(mutations)
-                txn._finish("committed", None)
+                self._repl_committed(rec)
+                repl = self._repl
+                if rec is not None and repl is not None:
+                    repl.on_commit(rec,
+                                   lambda: txn._finish("committed", None),
+                                   lambda qexc: txn._finish(None, qexc))
+                else:
+                    txn._finish("committed", None)
             else:
                 self._conn.rollback()
                 txn._finish("rolledback", None)
         except BaseException as exc:  # pragma: no cover - disk-level failure
             txn._finish(None, exc)
+
+    # -- replication: follower apply + leader catch-up (writer thread) ----
+    # All of these run on the writer executor (state/replication.py
+    # submits them via run_in_executor), so they serialize with the
+    # group-commit flusher on self._conn — a snapshot read never
+    # interleaves with a half-applied batch.
+
+    def apply_repl_records(self, records: list[dict]) -> int:
+        """Apply leader records in order (follower side). Returns the
+        new high-water mark. Epoch rules: a record below the member's
+        epoch is a zombie's — :class:`ReplicaFencedError`. A record at
+        a HIGHER epoch whose seq this member already holds means our
+        own suffix diverged (we were the fenced ex-leader) — a
+        ``diverged`` :class:`ReplicationGapError` asks for a snapshot.
+        A same-epoch duplicate is skipped (records are idempotent by
+        seq); a seq beyond hwm+1 is a plain gap answered by log
+        catch-up."""
+        if not records:
+            return self._repl_hwm
+        cur = self._conn.cursor()
+        mutations: list[tuple] = []
+        hwm, epoch = self._repl_hwm, self._repl_epoch
+        max_etag_n = 0
+        self._begin_immediate(cur)
+        try:
+            for rec in records:
+                seq, rec_epoch = int(rec["seq"]), int(rec["epoch"])
+                if rec_epoch < epoch:
+                    raise ReplicaFencedError(
+                        f"record epoch {rec_epoch} is behind member epoch "
+                        f"{epoch} (fenced ex-leader)")
+                if seq <= hwm:
+                    if rec_epoch > epoch:
+                        raise ReplicationGapError(
+                            f"seq {seq} already held at epoch {epoch} but "
+                            f"offered at epoch {rec_epoch}: diverged suffix",
+                            hwm=hwm, diverged=True)
+                    continue
+                if seq != hwm + 1:
+                    raise ReplicationGapError(
+                        f"record seq {seq} does not extend hwm {hwm}",
+                        hwm=hwm)
+                for m in rec["ops"]:
+                    if m[0] == "set":
+                        cur.execute(self._SET_SQL, (m[1], m[2], m[3]))
+                    else:
+                        cur.execute("DELETE FROM state WHERE key = ?", (m[1],))
+                    mutations.append(tuple(m))
+                cur.execute(
+                    "INSERT OR REPLACE INTO repl_log(seq, epoch, record) "
+                    "VALUES (?, ?, ?)",
+                    (seq, rec_epoch, json.dumps(rec, separators=(",", ":"))))
+                hwm, epoch = seq, rec_epoch
+                max_etag_n = max(max_etag_n, int(rec.get("etag_n", 0)))
+            cur.execute("UPDATE repl_meta SET hwm = ?, epoch = ? WHERE id = 1",
+                        (hwm, epoch))
+            cur.execute("DELETE FROM repl_log WHERE seq <= ?",
+                        (hwm - self._repl_retain,))
+            if max_etag_n:
+                # never move the sequence backwards: a promoted follower
+                # must allocate etags fresher than anything the old
+                # leader ever handed out
+                cur.execute("UPDATE etag_seq SET n = ? WHERE id = 1 AND n < ?",
+                            (max_etag_n, max_etag_n))
+            self._conn.commit()
+        except BaseException:
+            self._conn.rollback()
+            raise
+        with self._repl_lock:
+            self._repl_hwm, self._repl_epoch = hwm, epoch
+        self._dirty = True
+        self._cache_apply(mutations)
+        return hwm
+
+    def read_repl_log(self, after_seq: int, limit: int = 512) -> list[dict] | None:
+        """Records strictly after ``after_seq`` in order, or ``None``
+        when the log was pruned past the gap (the caller ships a
+        snapshot instead)."""
+        hwm, _epoch = self.repl_position()
+        if after_seq >= hwm:
+            return []
+        rows = self._conn.execute(
+            "SELECT record FROM repl_log WHERE seq > ? ORDER BY seq LIMIT ?",
+            (after_seq, limit)).fetchall()
+        records = [json.loads(r[0]) for r in rows]
+        if not records or records[0]["seq"] != after_seq + 1:
+            return None
+        return records
+
+    def read_repl_epoch_at(self, seq: int) -> int | None:
+        """Epoch of this member's log entry at ``seq``, or ``None``
+        when no such entry exists (pruned, or past our hwm). The
+        leader uses this for the log-matching check: a follower whose
+        (hwm, epoch) doesn't match our entry at its hwm has a
+        divergent suffix and must be reinstalled from a snapshot."""
+        row = self._conn.execute(
+            "SELECT epoch FROM repl_log WHERE seq = ?", (seq,)).fetchone()
+        return None if row is None else int(row[0])
+
+    def read_repl_snapshot(self) -> dict:
+        """Full-state snapshot at the current position; consistent
+        because it runs on the single writer thread."""
+        rows = self._conn.execute(
+            "SELECT key, value, etag FROM state ORDER BY key").fetchall()
+        (etag_n,) = self._conn.execute(
+            "SELECT n FROM etag_seq WHERE id = 1").fetchone()
+        hwm, epoch = self.repl_position()
+        return {"rows": [list(r) for r in rows], "hwm": hwm,
+                "epoch": epoch, "etag_n": etag_n}
+
+    def install_repl_snapshot(self, snap: dict) -> None:
+        """Replace this member's entire state with a leader snapshot —
+        the resync path for a diverged suffix or a pruned-log gap."""
+        cur = self._conn.cursor()
+        self._begin_immediate(cur)
+        try:
+            cur.execute("DELETE FROM state")
+            cur.execute("DELETE FROM repl_log")
+            cur.executemany(
+                "INSERT INTO state(key, value, etag) VALUES (?, ?, ?)",
+                [tuple(r) for r in snap["rows"]])
+            cur.execute("UPDATE repl_meta SET hwm = ?, epoch = ? WHERE id = 1",
+                        (int(snap["hwm"]), int(snap["epoch"])))
+            cur.execute("UPDATE etag_seq SET n = ? WHERE id = 1",
+                        (int(snap["etag_n"]),))
+            self._conn.commit()
+        except BaseException:
+            self._conn.rollback()
+            raise
+        with self._repl_lock:
+            self._repl_hwm = int(snap["hwm"])
+            self._repl_epoch = int(snap["epoch"])
+        self._dirty = True
+        with self._cache_lock:
+            self._cache.clear()
+
+    def append_repl_barrier(self, epoch: int) -> dict:
+        """A new leader's first act: append an empty record at its
+        (higher) epoch — Raft's no-op leadership barrier. Makes the
+        epoch durable on this member and gives followers a record whose
+        epoch proves the leadership change before any data flows."""
+        cur = self._conn.cursor()
+        self._begin_immediate(cur)
+        try:
+            seq = self._repl_hwm + 1
+            (etag_n,) = cur.execute(
+                "SELECT n FROM etag_seq WHERE id = 1").fetchone()
+            record = {"seq": seq, "epoch": int(epoch), "ops": [],
+                      "etag_n": etag_n, "ts": time.time(), "barrier": True}
+            cur.execute(
+                "INSERT INTO repl_log(seq, epoch, record) VALUES (?, ?, ?)",
+                (seq, int(epoch), json.dumps(record, separators=(",", ":"))))
+            cur.execute("UPDATE repl_meta SET hwm = ?, epoch = ? WHERE id = 1",
+                        (seq, int(epoch)))
+            self._conn.commit()
+        except BaseException:
+            self._conn.rollback()
+            raise
+        with self._repl_lock:
+            self._repl_hwm, self._repl_epoch = seq, int(epoch)
+        self._dirty = True
+        return record
+
+    def repl_position(self) -> tuple[int, int]:
+        """(high-water mark, epoch) — safe from any thread."""
+        with self._repl_lock:
+            return self._repl_hwm, self._repl_epoch
 
     # -- query -------------------------------------------------------------
 
@@ -982,11 +1288,33 @@ def _sqlite_state(spec: ComponentSpec, metadata: dict[str, str]) -> StateStore:
     keeps today's single-file layout and code path bit-for-bit (a
     plain SqliteStateStore, no facade). ``hashSeed`` (default empty)
     perturbs the key→shard assignment; it must be identical on every
-    replica opening the same files."""
+    replica opening the same files.
+
+    ``replicas`` (default 1) turns each shard into a replica set of
+    that many members with leased leadership, epoch fencing, and
+    ack-after-replication (state/replication.py). ``ackQuorum``
+    (default: majority) is the ack count a write needs including the
+    leader; ``followerReads: true`` serves reads from followers when
+    their lag is within ``maxLagRecords`` (default
+    ``TASKSRUNNER_REPL_MAX_LAG_RECORDS``). ``replicas: 1`` is exactly
+    today's unreplicated engine — no extra tables, no meta store."""
     shards = metadata_int(metadata, "shards", 1)
+    replicas = metadata_int(metadata, "replicas", 1)
     path = metadata.get("databasePath", ":memory:")
     group_commit = metadata_bool(metadata, "groupCommit", True)
     cache_size = metadata_int(metadata, "readCacheSize", 0)
+    if replicas > 1:
+        from tasksrunner.state.replication import build_replicated_store
+        ack_quorum = metadata_int(metadata, "ackQuorum", 0)
+        max_lag = metadata_int(metadata, "maxLagRecords", 0)
+        return build_replicated_store(
+            spec.name, path, shards=shards, replicas=replicas,
+            ack_quorum=ack_quorum or None,
+            hash_seed=metadata.get("hashSeed", ""),
+            group_commit=group_commit, cache_size=cache_size,
+            follower_reads=metadata_bool(metadata, "followerReads", False),
+            max_lag=max_lag or None,
+        )
     if shards == 1:
         # no facade, no -shard0 rename: the single-shard layout stays
         # bit-for-bit today's (hashSeed is moot — one shard wins every

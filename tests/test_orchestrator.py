@@ -835,3 +835,116 @@ async def test_autoscale_cooldown_resets_when_load_returns(tmp_path):
     await asyncio.sleep(0.15)
     assert await scaler.step() == 1      # full quiet cooldown elapsed
     assert calls == [3, 1]
+
+
+# -- restartable control plane (replication PR) ------------------------------
+
+def _survivor_config(tmp_path, **kw):
+    from tasksrunner.orchestrator.config import RunConfig
+
+    pkg = tmp_path / "hapkg"
+    if not pkg.is_dir():
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "survivor.py").write_text(textwrap.dedent("""
+            from tasksrunner import App
+
+            def make_app():
+                return App("survivor")
+        """))
+    return RunConfig(
+        apps=[AppSpec(app_id="survivor", module="hapkg.survivor:make_app")],
+        registry_file=str(tmp_path / "apps.json"),
+        base_dir=tmp_path,
+        **kw,
+    )
+
+
+async def _wait_registered(tmp_path, *, app_id="survivor", timeout=20):
+    import json
+    registry = tmp_path / "apps.json"
+    deadline = asyncio.get_running_loop().time() + timeout
+    while True:
+        if registry.is_file():
+            entries = json.loads(registry.read_text() or "{}").get(app_id, [])
+            if entries and entries[0].get("pid"):
+                return entries[0]["pid"]
+        assert asyncio.get_running_loop().time() < deadline, \
+            f"{app_id} never registered"
+        await asyncio.sleep(0.1)
+
+
+@pytest.mark.asyncio
+async def test_orchestrator_restart_adopts_running_replicas(tmp_path,
+                                                            monkeypatch):
+    """kill -9 the orchestrator (abandon() is its in-process test
+    double): the data plane keeps running, and a successor — here a
+    standby waiting on the control-plane lease — re-adopts the live
+    replicas instead of respawning them. Same pids, no restart."""
+    import os
+
+    from tasksrunner.orchestrator.run import Orchestrator
+
+    monkeypatch.setenv("TASKSRUNNER_REPL_LEASE_SECONDS", "0.5")
+    monkeypatch.setenv("PYTHONPATH", f"{tmp_path}{os.pathsep}{REPO}")
+    orch_a = Orchestrator(_survivor_config(tmp_path))
+    await orch_a.start()
+    try:
+        pid = await _wait_registered(tmp_path)
+        # the orchestrator process "dies": no lease release, no
+        # registry cleanup, replicas keep running unsupervised
+        await orch_a.abandon()
+
+        orch_b = Orchestrator(_survivor_config(tmp_path, standby=True))
+        await orch_b.start()  # waits out the dead holder's lease
+        try:
+            adopted = orch_b.replicas["survivor"]
+            assert [r.proc.pid for r in adopted] == [pid], \
+                "the successor should adopt, not respawn"
+            assert adopted[0].restarts == 0
+            reasons = [r["reason"] for r in orch_b.revisions["survivor"]]
+            assert any("adopted" in r for r in reasons), reasons
+            # the adopted process is genuinely supervised: it is alive
+            # and its exit would be noticed (returncode still None)
+            assert adopted[0].proc.returncode is None
+        finally:
+            await orch_b.stop()
+    finally:
+        await orch_a.abandon()  # idempotent if already abandoned
+
+
+@pytest.mark.asyncio
+async def test_second_orchestrator_is_fenced_out(tmp_path):
+    """Two orchestrators over one registry dir would fight for ports
+    and entries: the second (non-standby) start must refuse, naming
+    the holder and the --standby escape hatch."""
+    from tasksrunner.orchestrator.config import RunConfig
+    from tasksrunner.orchestrator.run import Orchestrator
+
+    config = RunConfig(apps=[], registry_file=str(tmp_path / "apps.json"),
+                       base_dir=tmp_path)
+    orch_a = Orchestrator(config)
+    await orch_a.start()
+    try:
+        orch_b = Orchestrator(RunConfig(
+            apps=[], registry_file=str(tmp_path / "apps.json"),
+            base_dir=tmp_path))
+        with pytest.raises(SystemExit, match="--standby"):
+            await orch_b.start()
+    finally:
+        await orch_a.stop()
+
+
+def test_cli_heals_torn_orchestrator_info_file(tmp_path):
+    """A torn/garbage orchestrator.json (crash debris — live writes
+    are atomic rename) is removed by the CLI reader instead of
+    wedging every admin verb until someone deletes it by hand."""
+    from tasksrunner import cli
+    from tasksrunner.orchestrator.admin import info_path
+
+    registry_file = str(tmp_path / "apps.json")
+    info_file = info_path(registry_file)
+    info_file.write_text('{"admin_url": truncated-mid-wri')
+    with pytest.raises(SystemExit, match="unreadable"):
+        cli._admin_request(registry_file, "GET", "/apps")
+    assert not info_file.exists(), "crash debris should be healed away"

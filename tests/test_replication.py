@@ -1,0 +1,629 @@
+"""Replicated state plane (tasksrunner/state/replication.py + replmesh).
+
+Covers the tentpole contract end to end: the per-shard record stream
+(monotonic seq, follower apply order, exact-hwm acks), lease/epoch
+leadership with zombie fencing, ack-after-replication quorum semantics
+under chaos, follower resync (log catch-up AND snapshot reinstall past
+the pruned retention window), stale-tolerant follower reads bounded by
+``maxLagRecords``, the mesh transport for cross-process members, and
+the two acceptance drills: ``kill -9`` the shard leader process
+mid-load (follower promotes, zombie's late commit fenced, zero lost
+acked writes at RF 2) and the declarative chaos replication-lane
+targets.
+"""
+
+import asyncio
+import json
+import os
+import pathlib
+import sqlite3
+import sys
+import textwrap
+import time
+
+import pytest
+
+from tasksrunner.chaos.engine import ChaosPolicies
+from tasksrunner.chaos.spec import parse_chaos
+from tasksrunner.errors import (
+    ReplicaFencedError,
+    ReplicationQuorumError,
+    StaleReadError,
+)
+from tasksrunner.state.replication import (
+    Lease,
+    ReplicaSetStore,
+    ReplicationNode,
+    build_replicated_store,
+)
+from tasksrunner.state.sqlite import SqliteStateStore
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+#: fast lease for tests — promotion paths complete in well under a
+#: second instead of the production 5 s default
+LEASE = 0.4
+
+
+def _build(tmp_path, name="repl", *, replicas=2, **kw):
+    kw.setdefault("lease_seconds", LEASE)
+    return build_replicated_store(
+        name, tmp_path / f"{name}.db", replicas=replicas, **kw)
+
+
+async def _wait_for(predicate, *, timeout=6.0, message="condition"):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not predicate():
+        assert asyncio.get_running_loop().time() < deadline, \
+            f"timed out waiting for {message}"
+        await asyncio.sleep(0.02)
+
+
+# -- record stream ----------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_replicates_to_followers_exact_hwm(tmp_path):
+    """Every committed mutation reaches every follower in order; all
+    members converge on the same high-water mark and the same rows."""
+    store = _build(tmp_path, replicas=3, ack_quorum=3)
+    try:
+        for i in range(40):
+            await store.set(f"k{i}", {"v": i})
+        await store.delete("k3")
+        from tasksrunner.state.base import TransactionOp
+        await store.transact([TransactionOp("upsert", "tx-a", {"t": 1}),
+                              TransactionOp("upsert", "tx-b", {"t": 2})])
+        positions = {n.node_id: n.store.repl_position() for n in store.nodes}
+        hwms = {hwm for hwm, _ in positions.values()}
+        assert len(hwms) == 1, f"members diverged: {positions}"
+        # quorum 3 means acks waited for both followers: check a
+        # follower's own sqlite copy, not the leader's
+        leader = store.leader_member()
+        follower = next(n for n in store.nodes if n.node_id != leader)
+        assert (await follower.store.get("k7")).value == {"v": 7}
+        assert await follower.store.get("k3") is None
+        assert (await follower.store.get("tx-b")).value == {"t": 2}
+    finally:
+        await store.aclose()
+
+
+@pytest.mark.asyncio
+async def test_rf1_is_plain_unreplicated_store(tmp_path):
+    """``replicas: 1`` is the exact pre-replication code path: a plain
+    SqliteStateStore on the configured file, no repl tables."""
+    store = _build(tmp_path, replicas=1)
+    try:
+        assert type(store) is SqliteStateStore
+        await store.set("k", {"v": 1})
+    finally:
+        store.close()
+    con = sqlite3.connect(tmp_path / "repl.db")
+    tables = {r[0] for r in con.execute(
+        "SELECT name FROM sqlite_master WHERE type='table'")}
+    con.close()
+    assert "repl_log" not in tables and "repl_meta" not in tables
+
+
+@pytest.mark.asyncio
+async def test_driver_metadata_builds_replica_set(tmp_path):
+    """``replicas: 2`` on a state.sqlite component builds the replica
+    set through the normal driver path; default metadata stays plain."""
+    from tasksrunner.component.registry import ComponentRegistry
+    from tasksrunner.component.spec import parse_component
+
+    def build(extra):
+        spec = parse_component({
+            "componentType": "state.sqlite",
+            "metadata": [
+                {"name": "databasePath", "value": str(tmp_path / "s.db")},
+                *extra,
+            ],
+        }, default_name="st")
+        return ComponentRegistry([spec]).get("st")
+
+    plain = build([])
+    assert type(plain) is SqliteStateStore
+    plain.close()
+
+    store = build([{"name": "replicas", "value": "2"},
+                   {"name": "ackQuorum", "value": "2"}])
+    try:
+        assert isinstance(store, ReplicaSetStore)
+        await store.set("driver-key", {"ok": True})
+        assert (await store.get("driver-key")).value == {"ok": True}
+    finally:
+        await store.aclose()
+    assert (tmp_path / "s-r1.db").is_file()
+
+
+# -- leadership: lease, epochs, fencing -------------------------------------
+
+class _SeverableLink:
+    """Wraps a follower link; while severed, every protocol call fails
+    like a dropped connection (one-way partition test double)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.severed = True
+
+    def _check(self):
+        if self.severed:
+            raise OSError("link severed (test partition)")
+
+    async def position(self):
+        self._check()
+        return await self.inner.position()
+
+    async def append(self, records):
+        self._check()
+        return await self.inner.append(records)
+
+    async def install(self, snapshot):
+        self._check()
+        return await self.inner.install(snapshot)
+
+@pytest.mark.asyncio
+async def test_lease_epochs_are_monotonic():
+    meta = SqliteStateStore("meta")
+    lease = Lease(meta, "l", lease_seconds=0.25)
+    try:
+        assert await lease.acquire("a") == 1
+        assert await lease.renew("a") is True
+        assert await lease.acquire("b") is None  # holder alive
+        await asyncio.sleep(0.3)                 # expire
+        assert await lease.acquire("b") == 2     # takeover bumps epoch
+        await lease.release("b")
+        assert await lease.acquire("a") == 3     # release keeps epoch line
+        assert await lease.renew("b") is False
+    finally:
+        await meta.aclose()
+
+
+@pytest.mark.asyncio
+async def test_zombie_leader_fenced_and_no_acked_write_lost(tmp_path):
+    """The acceptance drill, in-process: the leader stops renewing
+    (zombie), a follower promotes within the lease window, the
+    zombie's late commit fails fenced and is NOT applied anywhere
+    durable, and every previously acked write survives at RF 2."""
+    store = _build(tmp_path, replicas=2, ack_quorum=2)
+    acked = []
+    try:
+        for i in range(25):
+            await store.set(f"k{i}", {"v": i})
+            acked.append(f"k{i}")
+        zombie = next(n for n in store.nodes
+                      if n.node_id == store.leader_member())
+        zombie.renewal_paused = True
+        survivor = next(n for n in store.nodes if n is not zombie)
+        # one-way partition: the survivor can't reach the zombie (so
+        # its epoch-2 barrier can't demote it in place — the zombie
+        # genuinely still believes it leads), but the zombie can still
+        # ship — which is exactly how its late commit gets refused
+        partition = _SeverableLink(survivor.links[zombie.node_id])
+        survivor.links[zombie.node_id] = partition
+        t0 = time.monotonic()
+        await _wait_for(lambda: survivor.is_leader,
+                        message="follower promotion")
+        assert time.monotonic() - t0 < 3.0 * LEASE + 1.0, \
+            "promotion exceeded the lease window"
+
+        # the zombie still *thinks* it leads; its late commit must die
+        # fenced when the survivor's higher epoch rejects the record
+        with pytest.raises(ReplicaFencedError):
+            await zombie.store.set("zombie-write", {"evil": True})
+        assert await survivor.store.get("zombie-write") is None
+
+        # partition heals; the facade followed leadership and the
+        # new leader can reach quorum 2 again: writes keep working
+        partition.severed = False
+        await store.set("post-failover", {"ok": True})
+        acked.append("post-failover")
+        lost = [k for k in acked if await store.get(k) is None]
+        assert lost == []
+
+        # the fenced ex-leader resyncs from the new leader and drops
+        # its divergent unacked commit
+        await _wait_for(
+            lambda: zombie.store.repl_position()
+            == survivor.store.repl_position(),
+            message="zombie resync")
+        assert await zombie.store.get("zombie-write") is None
+    finally:
+        await store.aclose()
+
+
+@pytest.mark.asyncio
+async def test_crashed_leader_failover_keeps_acked_writes(tmp_path):
+    """kill-style crash (no renewals, no shipping): the follower
+    promotes and serves the full acked history."""
+    store = _build(tmp_path, replicas=2, ack_quorum=2)
+    try:
+        for i in range(15):
+            await store.set(f"k{i}", {"v": i})
+        victim = next(n for n in store.nodes
+                      if n.node_id == store.leader_member())
+        victim.crash()
+        # the crashed process restarts shortly after (supervisor
+        # restart); until then quorum 2 can't be met, so the rejoin is
+        # what lets the post-failover write ack
+        asyncio.get_running_loop().call_later(0.15, victim.revive)
+        await store.set("after", {"v": -1})  # blocks until promotion
+        assert store.leader_member() != victim.node_id
+        for i in range(15):
+            assert (await store.get(f"k{i}")).value == {"v": i}
+    finally:
+        await store.aclose()
+
+
+# -- quorum semantics -------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_ack_quorum_timeout_fails_the_write(tmp_path):
+    """With the only follower crashed and ackQuorum 2, a write commits
+    locally but must fail its ack within the quorum deadline."""
+    store = _build(tmp_path, replicas=2, ack_quorum=2, ack_timeout=0.4)
+    try:
+        await store.set("seed", {"v": 0})
+        leader = next(n for n in store.nodes
+                      if n.node_id == store.leader_member())
+        follower = next(n for n in store.nodes if n is not leader)
+        follower.crash()
+        with pytest.raises(ReplicationQuorumError):
+            await store.set("unreplicated", {"v": 1})
+        follower.revive()
+        # quorum restored: the next write acks normally
+        await _wait_for(lambda: True, timeout=0.1, message="beat")
+        await store.set("replicated-again", {"v": 2})
+    finally:
+        await store.aclose()
+
+
+# -- resync -----------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_follower_resync_after_gap(tmp_path):
+    """A follower that was down while the leader committed rejoins and
+    catches up to the exact high-water mark via the retained log."""
+    store = _build(tmp_path, replicas=2, ack_quorum=1)
+    try:
+        await store.set("warm", {"v": 0})
+        leader = next(n for n in store.nodes
+                      if n.node_id == store.leader_member())
+        follower = next(n for n in store.nodes if n is not leader)
+        follower.crash()
+        for i in range(30):
+            await store.set(f"gap{i}", {"v": i})
+        l_hwm, _ = leader.store.repl_position()
+        f_hwm, _ = follower.store.repl_position()
+        assert f_hwm < l_hwm
+        follower.revive()
+        await _wait_for(
+            lambda: follower.store.repl_position()[0]
+            == leader.store.repl_position()[0],
+            message="follower catch-up")
+        assert (await follower.store.get("gap29")).value == {"v": 29}
+    finally:
+        await store.aclose()
+
+
+@pytest.mark.asyncio
+async def test_follower_resync_via_snapshot_past_pruned_log(tmp_path):
+    """When the gap exceeds the retained log, catch-up falls back to a
+    full snapshot install and still lands on the exact hwm."""
+    store = _build(tmp_path, replicas=2, ack_quorum=1, log_retain=4)
+    try:
+        await store.set("warm", {"v": 0})
+        leader = next(n for n in store.nodes
+                      if n.node_id == store.leader_member())
+        follower = next(n for n in store.nodes if n is not leader)
+        follower.crash()
+        for i in range(40):  # >> log_retain: the gap is unfillable
+            await store.set(f"s{i}", {"v": i})
+        follower.revive()
+        await _wait_for(
+            lambda: follower.store.repl_position()[0]
+            == leader.store.repl_position()[0],
+            message="snapshot resync")
+        assert (await follower.store.get("s0")).value == {"v": 0}
+        assert (await follower.store.get("s39")).value == {"v": 39}
+        assert (await follower.store.get("warm")).value == {"v": 0}
+    finally:
+        await store.aclose()
+
+
+# -- follower reads ---------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_stale_follower_reads_bounded_by_max_lag(tmp_path):
+    """``followerReads`` within the bound serve from a follower;
+    beyond ``maxLagRecords`` the facade redirects to the leader and
+    the member-addressed read fails loudly with StaleReadError."""
+    store = _build(tmp_path, replicas=2, ack_quorum=1,
+                   follower_reads=True, max_lag=5)
+    try:
+        await store.set("k", {"v": "fresh"})
+        leader = next(n for n in store.nodes
+                      if n.node_id == store.leader_member())
+        follower = next(n for n in store.nodes if n is not leader)
+        await _wait_for(
+            lambda: follower.store.repl_position()[0]
+            == leader.store.repl_position()[0],
+            message="follower in sync")
+        item = await store.read_follower("k", member=follower.node_id)
+        assert item.value == {"v": "fresh"}
+
+        follower.crash()
+        for i in range(20):  # lag 20 > maxLagRecords 5
+            await store.set(f"lag{i}", {"v": i})
+        await store.set("k", {"v": "newer"})
+        with pytest.raises(StaleReadError):
+            await store.read_follower("k", member=follower.node_id)
+        # the facade read path redirects instead of serving stale data
+        assert (await store.get("k")).value == {"v": "newer"}
+
+        follower.revive()
+        await _wait_for(
+            lambda: follower.store.repl_position()[0]
+            == leader.store.repl_position()[0],
+            message="follower back in bound")
+        item = await store.read_follower("k", member=follower.node_id)
+        assert item.value == {"v": "newer"}
+    finally:
+        await store.aclose()
+
+
+# -- chaos replication-lane targets -----------------------------------------
+
+def _chaos_policies(seed=7):
+    spec = parse_chaos({
+        "apiVersion": "tasksrunner/v1alpha1",
+        "kind": "Chaos",
+        "metadata": {"name": "repl-chaos"},
+        "spec": {
+            "seed": seed,
+            "faults": {
+                "deadLane": {"blackhole": {"deadline": "2s"}},
+                "slowLane": {"latency": {"duration": "5ms"}},
+            },
+            "targets": {
+                "replication": {
+                    "repl/0/r1": ["deadLane"],
+                    "repl": ["slowLane"],
+                },
+            },
+        },
+    })
+    return ChaosPolicies([spec])
+
+
+def test_chaos_replication_targets_parse_and_resolve():
+    """Declarative replication-lane targets parse and resolve most-
+    specific-first: the per-member key beats the store-wide key."""
+    policies = _chaos_policies()
+    specific = policies.for_replication("repl", 0, "r1")
+    assert specific is not None
+    assert [i.rule.name for i in specific.injectors] == ["deadLane"]
+    fallback = policies.for_replication("repl", 0, "r2")
+    assert fallback is not None
+    assert [i.rule.name for i in fallback.injectors] == ["slowLane"]
+    assert policies.for_replication("other", 0, "r1") is None
+
+
+@pytest.mark.asyncio
+async def test_chaos_blackhole_on_replication_lane_fails_quorum(tmp_path):
+    """A blackholed leader→follower lane stalls the record stream;
+    with ackQuorum 2 the write fails its quorum deadline — seeded,
+    declarative, and scoped to exactly one lane."""
+    store = _build(tmp_path, replicas=2, ack_quorum=2, ack_timeout=0.4)
+    try:
+        await store.set("before-faults", {"v": 0})  # links warm
+        store.attach_chaos(_chaos_policies())
+        with pytest.raises(ReplicationQuorumError):
+            await store.set("into-the-void", {"v": 1})
+    finally:
+        await store.aclose()
+
+
+# -- mesh transport ---------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_mesh_follower_link_replicates_over_tcp(tmp_path):
+    """A follower behind the mesh-framed transport behaves like a
+    local member: records apply in order, acks carry the exact hwm,
+    and a log gap resyncs through the same typed-error protocol."""
+    from tasksrunner.state.replmesh import MeshFollowerLink, ReplicationServer
+
+    meta = SqliteStateStore("mesh.repl-meta", tmp_path / "meta.db")
+    leader = ReplicationNode("mesh", tmp_path / "leader.db", member=0,
+                             shard=0, meta_store=meta, lease_seconds=LEASE,
+                             ack_quorum=2, ack_timeout=5.0)
+    follower = ReplicationNode("mesh", tmp_path / "follower.db", member=1,
+                               shard=0, meta_store=meta, lease_seconds=LEASE,
+                               ack_quorum=2, ack_timeout=5.0)
+    server = ReplicationServer()
+    server.register(follower)
+    await server.start()
+    link = MeshFollowerLink("mesh", 0, follower.node_id,
+                            "127.0.0.1", server.port)
+    leader.links[follower.node_id] = link
+    try:
+        await leader.start()
+        await _wait_for(lambda: leader.is_leader, message="mesh leader")
+        for i in range(25):
+            await leader.store.set(f"m{i}", {"v": i})
+        assert follower.store.repl_position() == leader.store.repl_position()
+        assert (await follower.store.get("m24")).value == {"v": 24}
+    finally:
+        await leader.stop()
+        await link.aclose()
+        await server.aclose()
+        leader.store.close()
+        follower.store.close()
+        await meta.aclose()
+
+
+_DRILL_CHILD = textwrap.dedent("""
+    import asyncio, sys
+
+    from tasksrunner.state.replication import ReplicationNode
+    from tasksrunner.state.replmesh import MeshFollowerLink, ReplicationServer
+    from tasksrunner.state.sqlite import SqliteStateStore
+
+
+    async def main():
+        tmp, parent_port = sys.argv[1], int(sys.argv[2])
+        meta = SqliteStateStore("drill.repl-meta", f"{tmp}/meta.db")
+        node = ReplicationNode("drill", f"{tmp}/leader.db", member=0,
+                               shard=0, meta_store=meta, lease_seconds=0.6,
+                               ack_quorum=2, ack_timeout=10.0)
+        node.links["r1"] = MeshFollowerLink(
+            "drill", 0, "r1", "127.0.0.1", parent_port)
+        server = ReplicationServer()
+        server.register(node)
+        await server.start()
+        await node.start()
+        while not node.is_leader:
+            await asyncio.sleep(0.02)
+        print(f"CHILD_PORT {server.port}", flush=True)
+        i = 0
+        while True:
+            await node.store.set(f"k-{i}", {"v": i})
+            # quorum 2: this line is only printed once the follower
+            # has durably applied the record
+            print(f"ACKED k-{i}", flush=True)
+            i += 1
+
+
+    asyncio.run(main())
+""")
+
+
+@pytest.mark.asyncio
+async def test_kill9_leader_process_failover_drill(tmp_path):
+    """THE acceptance drill, cross-process: ``kill -9`` the shard
+    leader's OS process mid-load. The surviving follower (this
+    process) promotes within the lease window and every write the
+    dead leader ever acked is durably present — lost_acked_keys must
+    be empty at RF 2."""
+    import signal as signal_mod
+
+    from tasksrunner.state.replmesh import MeshFollowerLink, ReplicationServer
+
+    meta = SqliteStateStore("drill.repl-meta", tmp_path / "meta.db")
+    follower = ReplicationNode("drill", tmp_path / "follower.db", member=1,
+                               shard=0, meta_store=meta, lease_seconds=0.6,
+                               ack_quorum=1, ack_timeout=5.0)
+    server = ReplicationServer()
+    server.register(follower)
+    await server.start()
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (str(REPO), env.get("PYTHONPATH")) if p)
+    script = tmp_path / "leader_child.py"
+    script.write_text(_DRILL_CHILD)
+    child = await asyncio.create_subprocess_exec(
+        sys.executable, str(script), str(tmp_path), str(server.port),
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT,
+        env=env)
+    acked: list[str] = []
+    try:
+        child_port = None
+        deadline = asyncio.get_running_loop().time() + 30
+        while len(acked) < 30:
+            assert asyncio.get_running_loop().time() < deadline, \
+                f"child never produced 30 acks (got {len(acked)})"
+            line = (await asyncio.wait_for(child.stdout.readline(), 30)
+                    ).decode().strip()
+            if line.startswith("CHILD_PORT "):
+                child_port = int(line.split()[1])
+                # the leader is up: join as a follower with a return
+                # link so promotion can check the peer's position
+                follower.links["r0"] = MeshFollowerLink(
+                    "drill", 0, "r0", "127.0.0.1", child_port)
+                await follower.start()
+            elif line.startswith("ACKED "):
+                acked.append(line.split()[1])
+        assert child_port is not None, "child never announced its port"
+
+        child.kill()  # SIGKILL: no shutdown path, no lease release
+        t0 = time.monotonic()
+        # drain: acks already printed before the kill still count
+        rest = (await child.stdout.read()).decode()
+        for line in rest.splitlines():
+            if line.strip().startswith("ACKED "):
+                acked.append(line.strip().split()[1])
+        await child.wait()
+
+        await _wait_for(lambda: follower.is_leader, timeout=6.0,
+                        message="follower promotion after kill -9")
+        await follower.store.set("post-failover", {"ok": True})
+        failover_s = time.monotonic() - t0
+        assert failover_s < 5.0, f"failover took {failover_s:.2f}s"
+
+        lost = [k for k in acked
+                if await follower.store.get(k) is None]
+        assert lost == [], f"lost {len(lost)} acked writes: {lost[:5]}"
+        assert (await follower.store.get("post-failover")).value == {"ok": True}
+    finally:
+        if child.returncode is None:
+            child.kill()
+            await child.wait()
+        await follower.stop()
+        for link in follower.links.values():
+            await link.aclose()
+        await server.aclose()
+        follower.store.close()
+        await meta.aclose()
+
+
+# -- sharded + replicated ---------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_sharded_replicated_store_routes_and_survives(tmp_path):
+    """shards × replicas compose: each shard is its own replica set
+    with its own lease; a one-shard leader crash only stalls that
+    shard's writes until its follower promotes."""
+    store = build_replicated_store(
+        "grid", tmp_path / "grid.db", shards=2, replicas=2,
+        ack_quorum=2, lease_seconds=LEASE)
+    try:
+        for i in range(30):
+            await store.set(f"k{i}", {"v": i})
+        shard0 = store._shards[0]
+        victim = next(n for n in shard0.nodes
+                      if n.node_id == shard0.leader_member())
+        victim.crash()
+        for i in range(30):  # both shards keep serving
+            assert (await store.get(f"k{i}")).value == {"v": i}
+        await store.set("k0-after", {"v": 1})
+        assert (await store.get("k0-after")).value == {"v": 1}
+    finally:
+        await store.aclose()
+    # on-disk layout: shard files plus -rN follower copies, one meta db
+    names = {p.name for p in tmp_path.iterdir()}
+    assert {"grid-shard0.db", "grid-shard1.db", "grid-shard0-r1.db",
+            "grid-shard1-r1.db", "grid-repl-meta.db"} <= names
+
+
+# -- CLI status -------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_cli_repl_status_reads_databases(tmp_path, capsys):
+    """``tasksrunner repl <databasePath>`` reports leases and member
+    positions straight from the sqlite files, live runtime or not."""
+    from tasksrunner.cli import main as cli_main
+
+    store = _build(tmp_path, replicas=2, ack_quorum=2)
+    try:
+        for i in range(5):
+            await store.set(f"k{i}", {"v": i})
+    finally:
+        await store.aclose()
+    cli_main(["repl", str(tmp_path / "repl.db"), "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    entry = doc["replication"][0]
+    assert entry["store"] == "repl" and entry["shard"] == 0
+    members = {m["member"]: m["hwm"] for m in entry["members"]}
+    assert set(members) == {"r0", "r1"}
+    assert len(set(members.values())) == 1, "members should agree on hwm"
