@@ -1,6 +1,6 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test lint lint-program lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-overload bench-actors bench-repl chaos sweep-flash run validate docs-serve docs-build clean
+.PHONY: test lint lint-program lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-overload bench-actors bench-repl bench-mesh chaos sweep-flash run validate docs-serve docs-build clean
 
 test: lint lint-program
 	python -m pytest tests/ -q
@@ -73,6 +73,14 @@ bench-actors:
 bench-repl:
 	python -m pytest tests/test_replication.py -q -m "not slow"
 	python bench.py --replication-bench
+
+# mesh fast lane: the transport test matrix (codec negotiation, legacy
+# interop, coalescing, prewarm, condemnation), then the per-lever
+# ladder — JSON vs binary headers, per-frame drain vs coalesced
+# writes, cold vs pre-warmed dial, uvloop when installed
+bench-mesh:
+	python -m pytest tests/test_mesh_fastpath.py tests/test_mesh.py -q -m "not slow"
+	python bench.py --mesh-bench
 
 # chaos verification: the deterministic fault-injection harness, the
 # faulty-broker convergence soak, and the proof that the disabled gate

@@ -162,6 +162,7 @@ def _cmd_sidecar(args) -> None:
                                      sidecar_port=sidecar.port,
                                      app_port=args.app_port,
                                      mesh_port=sidecar.mesh_port))
+        runtime.kick_mesh_prewarm()
         print(f"ready app={args.app_id} sidecar_port={sidecar.port}", flush=True)
         try:
             await asyncio.Event().wait()
@@ -1335,6 +1336,11 @@ def _cmd_stop(args) -> None:
 
 
 def _run_until_interrupt(coro) -> None:
+    # every server entry point (host/serve/sidecar/run) funnels through
+    # here, so the optional uvloop policy covers them all
+    from tasksrunner.eventloop import maybe_enable_uvloop
+
+    maybe_enable_uvloop()
     try:
         asyncio.run(coro)
     except KeyboardInterrupt:

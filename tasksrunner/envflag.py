@@ -90,8 +90,20 @@ FLAGS: dict[str, Flag] = dict([
        "CA bundle path; with CERT and KEY enables mesh mTLS"),
     _f("TASKSRUNNER_MESH_CERT", "path", "unset",
        "mesh mTLS certificate path"),
+    _f("TASKSRUNNER_MESH_COALESCE", "bool", "on",
+       "write-behind frame coalescing (off = per-frame write+drain)"),
+    _f("TASKSRUNNER_MESH_COALESCE_SECONDS", "float", "0",
+       "extra coalescing window per flush (0 = event-loop-natural batching)"),
+    _f("TASKSRUNNER_MESH_CODEC", "enum", "binary",
+       "mesh header codec ceiling (binary | json); json forces the v1 headers"),
+    _f("TASKSRUNNER_MESH_CONNECT_TIMEOUT_SECONDS", "float", "2",
+       "mesh dial deadline before the caller falls back to HTTP"),
     _f("TASKSRUNNER_MESH_KEY", "path", "unset",
        "mesh mTLS private-key path"),
+    _f("TASKSRUNNER_MESH_PING_SECONDS", "float", "15",
+       "pre-warm/keepalive tick: idle-ping cadence (<= 0 disables)"),
+    _f("TASKSRUNNER_MESH_REQUEST_TIMEOUT_SECONDS", "float", "300",
+       "per-request mesh ceiling; consecutive expiries condemn the connection"),
     _f("TASKSRUNNER_PERF_TESTS", "bool", "off",
        "opt-in performance assertions in the test suite"),
     _f("TASKSRUNNER_REPLICA", "int", "0",
@@ -114,6 +126,8 @@ FLAGS: dict[str, Flag] = dict([
        "span-recorder SQLite path (set empty to disable recording)"),
     _f("TASKSRUNNER_TRACE_RETENTION_SECONDS", "float", "2592000",
        "span retention sweep horizon in seconds (<= 0 keeps everything)"),
+    _f("TASKSRUNNER_UVLOOP", "bool", "off",
+       "install uvloop's event-loop policy when the package is available"),
 ])
 
 #: names env_flag accepts — the env-flag-discipline rule sends every

@@ -1,0 +1,46 @@
+"""Optional uvloop event-loop policy for server entry points.
+
+uvloop is NOT a dependency of this package: the flag degrades to a
+logged no-op when the wheel is absent, so the same config can roll
+across a fleet where only some images bundle it. Every long-running
+entry point (`tasksrunner host/serve/sidecar/run` via
+cli._run_until_interrupt, and the bench's worker processes) calls
+:func:`maybe_enable_uvloop` before creating its event loop; the bench
+reports availability honestly instead of silently measuring asyncio.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from tasksrunner.envflag import env_flag
+
+logger = logging.getLogger(__name__)
+
+
+def maybe_enable_uvloop() -> bool:
+    """Install uvloop's event-loop policy when ``TASKSRUNNER_UVLOOP=1``
+    and the package is importable. Returns True iff installed. Must be
+    called before the event loop is created (``asyncio.run``)."""
+    if not env_flag("TASKSRUNNER_UVLOOP", default=False):
+        return False
+    try:
+        import uvloop
+    except ImportError:
+        logger.warning(
+            "TASKSRUNNER_UVLOOP is set but uvloop is not installed; "
+            "continuing on the default asyncio event loop")
+        return False
+    asyncio.set_event_loop_policy(uvloop.EventLoopPolicy())
+    logger.info("uvloop event-loop policy installed")
+    return True
+
+
+def uvloop_available() -> bool:
+    """True when the uvloop package can be imported (bench reporting)."""
+    try:
+        import uvloop  # noqa: F401
+    except ImportError:
+        return False
+    return True

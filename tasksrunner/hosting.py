@@ -255,6 +255,10 @@ class AppHost:
                 sidecar_port=self.sidecar_port, app_port=self.app_port,
                 mesh_port=self.sidecar.mesh_port,
             ))
+            # our registration may have made US visible to peers — and
+            # their registrations visible to us: pre-dial them now
+            # instead of waiting out the first keepalive interval
+            runtime.kick_mesh_prewarm()
         # the app's client talks to its sidecar runtime directly — same
         # process, same Runtime object the HTTP surface serves, same
         # grant/scope enforcement (runtime.py is transport-neutral).

@@ -30,6 +30,7 @@ COUNTERS: dict[str, str] = {
     "binding_delivery": "input-binding deliveries to app routes, by status",
     "invoke": "service invocations issued, by target app",
     "invoke_transport": "invocation attempts per transport lane (mesh/http)",
+    "mesh_frames_total": "mesh frames moved, by direction (in/out)",
     "admission_shed_total": "requests shed with 429 by admission control",
     "chaos_injected_total": "faults injected by the chaos engine",
     "resiliency_retry_total": "resiliency-policy retry attempts",
@@ -51,6 +52,7 @@ GAUGES: dict[str, str] = {
     "autoscale_desired_replicas": "replica count the autoscaler last computed",
     "resiliency_breaker_state": "circuit breaker state (0 closed/2 open)",
     "event_loop_lag_seconds": "asyncio timer drift sampled per process",
+    "mesh_pool_connections": "live pooled mesh connections, per process",
     "state_write_queue_depth": "pending writes in the state group-commit queue",
     "broker_publish_queue_depth": "pending publishes in the broker write queue",
     "broker_dlq_depth": "dead-lettered messages per topic/group",
@@ -64,6 +66,8 @@ GAUGES: dict[str, str] = {
 HISTOGRAMS: dict[str, str] = {
     "sidecar_request_latency_seconds": "sidecar HTTP API handling, per route",
     "invoke_latency_seconds": "service invocation client, per target app",
+    "mesh_dial_latency_seconds": "mesh connection dial + codec negotiation",
+    "mesh_frame_bytes": "mesh frame size on the wire, by direction (in/out)",
     "state_op_latency_seconds": "runtime state operations, per store and op",
     "state_queue_wait_seconds": "group-commit queue wait (enqueue to batch start)",
     "state_commit_seconds": "group-commit batch execution (begin to resolve)",

@@ -664,8 +664,48 @@ class Runtime:
                     f"app {self.app_id!r} did not become healthy within {timeout}s")
             await asyncio.sleep(0.1)
 
+    def _mesh_peers(self) -> list[tuple[str, int, str | None]]:
+        """Every mesh address the resolver currently advertises for
+        OTHER apps — the keepalive loop's dial list. Under mTLS each
+        triple pins the peer's app-id so the pre-warmed connection
+        carries the same identity check a request-path dial would."""
+        from tasksrunner.invoke.pki import mesh_tls_enabled
+
+        pin_identity = mesh_tls_enabled()
+        peers: list[tuple[str, int, str | None]] = []
+        for app_id in self.resolver.known_apps():
+            if app_id == self.app_id:
+                continue
+            for addr in self.resolver.resolve_all(app_id):
+                if addr.mesh_port:
+                    peers.append((addr.host, addr.mesh_port,
+                                  app_id if pin_identity else None))
+        return peers
+
+    def kick_mesh_prewarm(self) -> None:
+        """Wake the mesh keepalive loop now — called right after a
+        registration lands so freshly-visible peers are dialed before
+        the first ping interval elapses."""
+        if self._mesh_pool is not None:
+            self._mesh_pool.kick()
+
+    def _start_mesh_prewarm(self) -> None:
+        from tasksrunner.invoke.mesh import MeshPool, ping_interval
+
+        if ping_interval() <= 0:
+            return
+        if self._mesh_pool is None:
+            self._mesh_pool = MeshPool()
+        self._mesh_pool.start_keepalive(self._mesh_peers)
+
     async def start(self) -> None:
         """Run the subscribe handshake and start input bindings."""
+        if not self._started and self._mesh_enabled and self.app_id:
+            # pre-warm routing: dial peers the resolver already knows
+            # off the request path, and keep the pool live with idle
+            # pings (invoke/mesh.py) — first-request latency then
+            # excludes CONNECT_TIMEOUT-class dial cost
+            self._start_mesh_prewarm()
         if self._started or self.app_channel is None:
             self._started = True
             return
@@ -741,9 +781,11 @@ class Runtime:
     def _make_subscription_handler(self, pubsub_name: str, route: str):
         policy = self._inbound_policy(pubsub_name)
         # bound once per subscription: delivery observations are a
-        # closure call, no per-message label resolution
+        # closure call, no per-message label resolution — and the log
+        # knob is read here for the same reason
         record_delivery = metrics.recorder(
             "delivery_latency_seconds", route=route)
+        log_deliveries = _delivery_logs()
 
         async def deliver(msg: Message) -> bool:
             ctx = ensure_trace(msg.metadata.get(TRACEPARENT_HEADER))
@@ -775,7 +817,7 @@ class Runtime:
                 # sidecar→app hop is an in-process call in host mode,
                 # so no access-log line marks it); honors the same
                 # knob that silences per-request access-log formatting
-                if _delivery_logs():
+                if log_deliveries:
                     logger.info('pubsub delivery "POST %s" %d', route, status)
                 return 200 <= status < 300
         return deliver

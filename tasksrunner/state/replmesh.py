@@ -4,10 +4,17 @@ The in-process replica sets (state/replication.py) wire members with
 direct :class:`~tasksrunner.state.replication.LocalLink` calls; this
 module carries the same three-verb protocol — ``append`` / ``install``
 / ``position`` — across processes over the mesh lane's frame format
-(``[u32 frame_len][u32 header_len][header JSON][body]``,
-invoke/mesh.py), so a follower can live on another host and a
-``kill -9`` of the leader *process* is survivable, not just a leader
-*object* going away.
+(``[u32 frame_len][u32 header_len][header][body]``, invoke/mesh.py),
+so a follower can live on another host and a ``kill -9`` of the leader
+*process* is survivable, not just a leader *object* going away.
+
+The lane inherits the invoke mesh's per-connection header codec: the
+shipper sends the same JSON hello on connect, and when both ends are
+v2 builds the three-verb headers travel struct-packed
+(:class:`~tasksrunner.invoke.mesh.BinaryHeaderCodec` kinds 5/6)
+instead of as JSON — a pre-v2 peer on either side degrades the
+connection to the v1 JSON headers, exactly like the invoke lane, so
+replication keeps flowing through a rolling upgrade.
 
 Error mapping is explicit: a follower's
 :class:`~tasksrunner.errors.ReplicationGapError` and
@@ -30,7 +37,15 @@ import json
 import logging
 
 from tasksrunner.errors import ReplicaFencedError, ReplicationGapError
-from tasksrunner.invoke.mesh import CONNECT_TIMEOUT, MAX_FRAME, _pack, _read_frame
+from tasksrunner.invoke.mesh import (
+    MAX_FRAME,
+    JsonHeaderCodec,
+    _read_frame,
+    connect_timeout,
+    negotiate_client,
+    negotiate_server,
+    pack_frame,
+)
 from tasksrunner.state.replication import ReplicationNode
 
 logger = logging.getLogger(__name__)
@@ -73,10 +88,19 @@ class ReplicationServer:
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
         try:
+            # the FIRST frame picks the codec: a v2 shipper's hello, or
+            # a legacy shipper's first real request (stays JSON)
+            codec, first = await negotiate_server(reader, writer,
+                                                  max_body=MAX_FRAME)
             while True:
-                header, body = await _read_frame(reader, max_body=MAX_FRAME)
+                if first is not None:
+                    header, body = first
+                    first = None
+                else:
+                    header, body = await _read_frame(reader, codec,
+                                                     max_body=MAX_FRAME)
                 resp_header, resp_body = await self._dispatch(header, body)
-                writer.write(_pack(resp_header, resp_body))
+                writer.writelines(pack_frame(codec, resp_header, resp_body))
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass  # peer went away; its shipper reconnects
@@ -137,6 +161,7 @@ class MeshFollowerLink:
         self.timeout = float(timeout)
         self.chaos = None  # ChaosPolicy | None
         self._ssl = ssl_context
+        self._codec = JsonHeaderCodec
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._lock = asyncio.Lock()
@@ -149,6 +174,7 @@ class MeshFollowerLink:
 
     async def _teardown(self) -> None:
         writer, self._reader, self._writer = self._writer, None, None
+        self._codec = JsonHeaderCodec
         if writer is not None:
             writer.close()
             try:
@@ -162,15 +188,23 @@ class MeshFollowerLink:
                 self._reader, self._writer = await asyncio.wait_for(
                     asyncio.open_connection(self.host, self.port,
                                             ssl=self._ssl),
-                    CONNECT_TIMEOUT)
+                    connect_timeout())
+                try:
+                    self._codec, _ = await negotiate_client(
+                        self._reader, self._writer,
+                        timeout=connect_timeout())
+                except (OSError, asyncio.IncompleteReadError,
+                        ConnectionError, asyncio.TimeoutError):
+                    await self._teardown()
+                    raise
             header = {"op": op, "store": self.store, "shard": self.shard}
             body = (b"" if payload is None
                     else json.dumps(payload, separators=(",", ":")).encode())
             try:
-                self._writer.write(_pack(header, body))
+                self._writer.writelines(pack_frame(self._codec, header, body))
                 await self._writer.drain()
                 resp, resp_body = await asyncio.wait_for(
-                    _read_frame(self._reader), self.timeout)
+                    _read_frame(self._reader, self._codec), self.timeout)
             except (OSError, asyncio.IncompleteReadError, ConnectionError,
                     asyncio.TimeoutError):
                 await self._teardown()

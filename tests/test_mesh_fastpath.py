@@ -1,0 +1,627 @@
+"""Mesh fast path (tasksrunner/invoke/mesh.py): v2 binary header codec
+with per-connection hello negotiation, coalesced writes, pre-warmed
+routing, and the hung-connection condemnation bugfix.
+
+The rolling-upgrade contract under test: a v2 peer and a JSON-header
+peer (pre-PR build, emulated faithfully by ``_legacy_json_server`` —
+it answers a hello the only way an unaware server can, as a failed
+request) must interoperate in BOTH directions, and the codec is always
+chosen per connection by the first frame, never guessed per frame.
+"""
+
+import asyncio
+import json
+import os
+import struct
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tasksrunner import App, AppHost
+from tasksrunner.component.registry import ComponentRegistry
+from tasksrunner.chaos import ChaosPolicies, parse_chaos
+from tasksrunner.errors import InvocationError
+from tasksrunner.invoke.mesh import (
+    MAX_FRAME,
+    BinaryHeaderCodec,
+    JsonHeaderCodec,
+    MeshConnectError,
+    MeshPool,
+    MeshServer,
+    _pack,
+    pack_frame,
+)
+from tasksrunner.invoke.resolver import AppAddress, NameResolver
+from tasksrunner.runtime import Runtime
+
+
+class EchoRuntime:
+    """Minimal Runtime stand-in: the mesh server only needs .invoke()."""
+
+    def __init__(self):
+        self.calls = []
+
+    async def invoke(self, target, path, *, http_method="POST", query="",
+                     headers=None, body=b""):
+        self.calls.append((target, path))
+        if path.endswith("hang"):
+            await asyncio.sleep(30)
+        payload = json.dumps({"path": path, "echo": body.decode() or None})
+        return 200, {"content-type": "application/json"}, payload.encode()
+
+
+async def _start_server(**kw):
+    srv = MeshServer(EchoRuntime(), **kw)
+    await srv.start()
+    return srv
+
+
+async def _read_json_frame(reader):
+    (frame_len,) = struct.unpack(">I", await reader.readexactly(4))
+    (hdr_len,) = struct.unpack(">I", await reader.readexactly(4))
+    header = json.loads(await reader.readexactly(hdr_len))
+    body = await reader.readexactly(frame_len - 4 - hdr_len)
+    return header, body
+
+
+async def _legacy_json_server():
+    """The pre-v2 server loop, byte-faithful: JSON headers only, no
+    hello awareness — EVERY frame (the hello included) is dispatched
+    as a request and answered as one."""
+
+    async def handler(reader, writer):
+        try:
+            while True:
+                header, body = await _read_json_frame(reader)
+                payload = json.dumps({"path": header.get("p"),
+                                      "echo": body.decode() or None}).encode()
+                writer.write(_pack({"i": header.get("i"), "s": 200, "h": {}},
+                                   payload))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            writer.close()
+
+    return await asyncio.start_server(handler, "127.0.0.1", 0)
+
+
+# ---------------------------------------------------------------------------
+# codec unit: every header shape round-trips the binary encoding
+# ---------------------------------------------------------------------------
+
+def test_binary_codec_roundtrips_every_header_shape():
+    shapes = [
+        {"i": 7, "t": "backend-api", "m": "POST", "p": "/api/tasks",
+         "q": "a=1&b=2", "h": {"content-type": "application/json",
+                               "x-corr": "abc"}},
+        {"i": 7, "t": "x", "m": "GET", "p": "/", "q": "", "h": {}},
+        {"i": 9, "s": 503, "h": {"retry-after": "1"}},
+        {"i": 1 << 40, "s": 200, "h": {}},
+        {"ping": 12}, {"pong": 12},
+        {"op": "append", "store": "statestore", "shard": 3},
+        {"op": "position", "store": "s", "shard": 0},
+        {"ok": True},
+        {"ok": False, "kind": "gap", "hwm": 41, "epoch": 0, "diverged": True},
+        {"ok": False, "kind": "fenced", "error": "stale epoch 2 < 3"},
+        {"ok": False, "kind": "error", "error": "KeyError: 'x'"},
+    ]
+    for header in shapes:
+        raw = BinaryHeaderCodec.encode(header)
+        assert raw[0] == 0xB2  # can never be mistaken for JSON's '{'
+        assert BinaryHeaderCodec.decode(raw) == header
+
+
+def test_binary_codec_rejects_garbage_with_connection_error():
+    for raw in [b"", b"\xb2", b"\xb2\x63", b"\x7b\x01\x02",
+                BinaryHeaderCodec.encode({"ping": 1}) + b"xx"]:
+        with pytest.raises(ConnectionError):
+            BinaryHeaderCodec.decode(raw)
+
+
+# ---------------------------------------------------------------------------
+# negotiation matrix — per connection, decided by the first frame
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_v2_to_v2_negotiates_binary():
+    srv = await _start_server(api_token=None)
+    pool = MeshPool()
+    try:
+        status, _, body = await pool.request(
+            "127.0.0.1", srv.port, "t", "POST", "/api/x", body=b"hello")
+        assert status == 200 and json.loads(body)["echo"] == "hello"
+        (conn,) = pool._conns.values()
+        assert conn.codec is BinaryHeaderCodec
+        assert conn.peer_aware
+    finally:
+        await pool.close()
+        await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_v2_client_against_json_only_server_falls_back():
+    server = await _legacy_json_server()
+    port = server.sockets[0].getsockname()[1]
+    pool = MeshPool()
+    try:
+        status, _, body = await pool.request(
+            "127.0.0.1", port, "t", "POST", "/api/x", body=b"up")
+        assert status == 200 and json.loads(body)["echo"] == "up"
+        (conn,) = pool._conns.values()
+        assert conn.codec is JsonHeaderCodec
+        assert not conn.peer_aware  # the hello was answered as a request
+    finally:
+        await pool.close()
+        server.close()
+        await server.wait_closed()
+
+
+@pytest.mark.asyncio
+async def test_json_only_client_against_v2_server_stays_json():
+    """A pre-PR client sends no hello; its first real request doubles
+    as its codec declaration and the v2 server answers in kind."""
+    srv = await _start_server(api_token=None)
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
+        try:
+            writer.write(_pack({"i": 1, "t": "t", "m": "GET", "p": "/api/y",
+                                "q": "", "h": {}}, b"legacy"))
+            await writer.drain()
+            header, body = await _read_json_frame(reader)
+            assert header["i"] == 1 and header["s"] == 200
+            assert json.loads(body)["echo"] == "legacy"
+            # and the SAME connection keeps working (codec is sticky)
+            writer.write(_pack({"i": 2, "t": "t", "m": "GET", "p": "/z",
+                                "q": "", "h": {}}, b""))
+            await writer.drain()
+            header, _ = await _read_json_frame(reader)
+            assert header["i"] == 2 and header["s"] == 200
+        finally:
+            writer.close()
+    finally:
+        await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_forced_json_client_skips_hello(monkeypatch):
+    monkeypatch.setenv("TASKSRUNNER_MESH_CODEC", "json")
+    srv = await _start_server(api_token=None)
+    pool = MeshPool()
+    try:
+        status, _, body = await pool.request(
+            "127.0.0.1", srv.port, "t", "POST", "/api/x", body=b"f")
+        assert status == 200 and json.loads(body)["echo"] == "f"
+        (conn,) = pool._conns.values()
+        assert conn.codec is JsonHeaderCodec and not conn.peer_aware
+    finally:
+        await pool.close()
+        await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_forced_json_server_caps_negotiation_at_v1():
+    srv = await _start_server(api_token=None)
+    srv.max_version = 1  # what TASKSRUNNER_MESH_CODEC=json does server-side
+    pool = MeshPool()
+    try:
+        status, _, _ = await pool.request(
+            "127.0.0.1", srv.port, "t", "GET", "/api/x")
+        assert status == 200
+        (conn,) = pool._conns.values()
+        assert conn.codec is JsonHeaderCodec
+        assert conn.peer_aware  # hello was acked, so pings still work
+        assert await conn.ping() is True
+    finally:
+        await pool.close()
+        await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_corrupt_hello_is_a_clean_connection_error():
+    # server side: a non-integer hello closes the connection
+    srv = await _start_server(api_token=None)
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
+        try:
+            writer.write(_pack({"i": 0, "hello": "bogus"}, b""))
+            await writer.drain()
+            assert await reader.read(1) == b""
+        finally:
+            writer.close()
+    finally:
+        await srv.stop()
+
+    # client side: a garbled hello ack surfaces as MeshConnectError
+    # (the fall-back-to-HTTP signal), never a hang or a raw parse error
+    async def bad_ack(reader, writer):
+        await _read_json_frame(reader)
+        writer.write(_pack({"i": 0, "hello": "zero-point-five"}, b""))
+        await writer.drain()
+        await reader.read()
+        writer.close()
+
+    server = await asyncio.start_server(bad_ack, "127.0.0.1", 0)
+    pool = MeshPool()
+    try:
+        with pytest.raises(MeshConnectError):
+            await pool.request("127.0.0.1",
+                               server.sockets[0].getsockname()[1],
+                               "t", "GET", "/x")
+    finally:
+        await pool.close()
+        server.close()
+        await server.wait_closed()
+
+
+@pytest.mark.asyncio
+async def test_binary_frame_before_hello_is_refused():
+    """The codec is negotiated, never guessed: a v2 frame from a peer
+    that skipped the handshake is a protocol violation → teardown."""
+    srv = await _start_server(api_token=None)
+    try:
+        reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
+        try:
+            writer.writelines(pack_frame(BinaryHeaderCodec, {"ping": 1}, b""))
+            await writer.drain()
+            assert await reader.read(1) == b""
+        finally:
+            writer.close()
+    finally:
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# coalesced writes: ordering/interleaving under 64-way concurrency
+# ---------------------------------------------------------------------------
+
+async def _flood_64(pool, port):
+    async def one(i):
+        status, _, body = await pool.request(
+            "127.0.0.1", port, "t", "POST", f"/api/{i}",
+            body=f"payload-{i}".encode())
+        assert status == 200
+        doc = json.loads(body)
+        assert doc == {"path": f"/api/{i}", "echo": f"payload-{i}"}
+
+    await asyncio.gather(*(one(i) for i in range(64)))
+    assert len(pool._conns) == 1  # all multiplexed on one connection
+
+
+@pytest.mark.asyncio
+async def test_coalesced_writes_keep_frame_integrity_64way():
+    srv = await _start_server(api_token=None)
+    pool = MeshPool()
+    try:
+        await _flood_64(pool, srv.port)
+        (conn,) = pool._conns.values()
+        assert conn.codec is BinaryHeaderCodec
+    finally:
+        await pool.close()
+        await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_per_frame_drain_mode_matches(monkeypatch):
+    monkeypatch.setenv("TASKSRUNNER_MESH_COALESCE", "0")
+    srv = await _start_server(api_token=None)
+    pool = MeshPool()
+    try:
+        await _flood_64(pool, srv.port)
+    finally:
+        await pool.close()
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# bugfix: consecutive request timeouts condemn the connection
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_stalled_handler_condemns_connection_and_pool_redials(
+        monkeypatch):
+    """Regression: a REQUEST_TIMEOUT expiry used to pop only the future
+    and leave the hung connection pooled — every later request to that
+    peer then queued behind the same dead socket for up to 300 s each.
+    After TIMEOUTS_BEFORE_CLOSE consecutive expiries the connection
+    must be condemned so the pool re-dials."""
+    monkeypatch.setenv("TASKSRUNNER_MESH_REQUEST_TIMEOUT_SECONDS", "0.2")
+    srv = await _start_server(api_token=None)
+    pool = MeshPool()
+    try:
+        status, _, _ = await pool.request(
+            "127.0.0.1", srv.port, "t", "GET", "/warm")
+        assert status == 200
+        (first,) = pool._conns.values()
+        for _ in range(2):
+            with pytest.raises(OSError):  # builtin TimeoutError ⊂ OSError
+                await pool.request("127.0.0.1", srv.port, "t", "GET", "/hang")
+        assert first.closed  # condemned, not left pooled
+        # next request re-dials a fresh connection and succeeds
+        status, _, _ = await pool.request(
+            "127.0.0.1", srv.port, "t", "GET", "/after")
+        assert status == 200
+        (conn,) = pool._conns.values()
+        assert conn is not first and not conn.closed
+    finally:
+        await pool.close()
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# pre-warmed routing: keepalive dials off the request path, pings detect
+# dead peers early
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_keepalive_prewarms_and_detects_dead_peer():
+    srv = await _start_server(api_token=None)
+    pool = MeshPool()
+    key = ("127.0.0.1", srv.port, None)
+    try:
+        pool.start_keepalive(lambda: [key], interval=0.05)
+        pool.kick()
+        for _ in range(100):
+            if key in pool._conns and not pool._conns[key].closed:
+                break
+            await asyncio.sleep(0.01)
+        conn = pool._conns[key]
+        assert not conn.closed  # dialed with NO request issued
+        assert await conn.ping() is True
+        await srv.stop()
+        for _ in range(100):
+            if conn.closed:
+                break
+            await asyncio.sleep(0.02)
+        assert conn.closed  # failed ping condemned it before any caller
+    finally:
+        await pool.close()
+
+
+@pytest.mark.asyncio
+async def test_runtime_prewarms_registered_peers(tmp_path):
+    """Runtime.start wires the keepalive to the resolver: a peer that
+    advertised a mesh port at registration is dialed off the request
+    path, so the first invoke pays no CONNECT_TIMEOUT-class cost."""
+    srv = await _start_server(api_token=None)
+    resolver = NameResolver(registry_file=tmp_path / "apps.json")
+    resolver.register(AppAddress(
+        app_id="backend", host="127.0.0.1", sidecar_port=1, app_port=2,
+        mesh_port=srv.port))
+    runtime = Runtime("caller", ComponentRegistry([], app_id="caller"),
+                      resolver=resolver)
+    try:
+        assert runtime._mesh_peers() == [("127.0.0.1", srv.port, None)]
+        runtime._start_mesh_prewarm()
+        runtime.kick_mesh_prewarm()
+        pool = runtime._mesh_pool
+        key = ("127.0.0.1", srv.port, None)
+        for _ in range(100):
+            if key in pool._conns and not pool._conns[key].closed:
+                break
+            await asyncio.sleep(0.01)
+        assert key in pool._conns and not pool._conns[key].closed
+    finally:
+        await runtime.stop()
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos still bites on the fast lane (faults inject before transport)
+# ---------------------------------------------------------------------------
+
+def _chaos_doc(faults, targets):
+    return {"apiVersion": "tasksrunner/v1alpha1", "kind": "Chaos",
+            "metadata": {"name": "fastlane"},
+            "spec": {"faults": faults, "targets": targets}}
+
+
+async def _chaos_runtime(tmp_path, srv, spec):
+    resolver = NameResolver(registry_file=tmp_path / "apps.json")
+    resolver.register(AppAddress(
+        app_id="backend", host="127.0.0.1", sidecar_port=1, app_port=2,
+        mesh_port=srv.port))
+    return Runtime("caller", ComponentRegistry([], app_id="caller"),
+                   resolver=resolver,
+                   chaos=ChaosPolicies([spec], app_id="caller"))
+
+
+@pytest.mark.asyncio
+async def test_chaos_latency_bites_on_mesh_lane(tmp_path):
+    spec = parse_chaos(_chaos_doc(
+        faults={"lag": {"latency": {"duration": "120ms"}}},
+        targets={"apps": {"backend": ["lag"]}}))
+    srv = await _start_server(api_token=None)
+    runtime = await _chaos_runtime(tmp_path, srv, spec)
+    try:
+        t0 = asyncio.get_running_loop().time()
+        status, _, _ = await runtime.invoke("backend", "/api/x")
+        elapsed = asyncio.get_running_loop().time() - t0
+        assert status == 200
+        assert elapsed >= 0.11  # the injected delay applied to the fast lane
+        assert srv.runtime.calls  # and the request DID ride the mesh
+    finally:
+        await runtime.stop()
+        await srv.stop()
+
+
+@pytest.mark.asyncio
+async def test_chaos_blackhole_bites_on_mesh_lane(tmp_path):
+    spec = parse_chaos(_chaos_doc(
+        faults={"dead": {"blackhole": {"deadline": "50ms"}}},
+        targets={"apps": {"backend": ["dead"]}}))
+    srv = await _start_server(api_token=None)
+    runtime = await _chaos_runtime(tmp_path, srv, spec)
+    try:
+        with pytest.raises(InvocationError):
+            await runtime.invoke("backend", "/api/x")
+        assert srv.runtime.calls == []  # blackholed before the wire
+    finally:
+        await runtime.stop()
+        await srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# e2e: forced-JSON fallback passes the same AppHost mesh path, and a
+# live cross-process JSON-header peer interoperates with a v2 peer
+# ---------------------------------------------------------------------------
+
+COMPONENTS = """
+apiVersion: dapr.io/v1alpha1
+kind: Component
+metadata:
+  name: statestore
+spec:
+  type: state.in-memory
+  version: v1
+"""
+
+
+@pytest.mark.asyncio
+async def test_apphost_pair_forced_json_passes_mesh_e2e(tmp_path, monkeypatch):
+    from tasksrunner import load_components
+
+    monkeypatch.setenv("TASKSRUNNER_MESH_CODEC", "json")
+    monkeypatch.delenv("TASKSRUNNER_MESH", raising=False)
+    (tmp_path / "components.yaml").write_text(COMPONENTS)
+    specs = load_components(tmp_path)
+    registry = str(tmp_path / "apps.json")
+
+    api = App("backend-api")
+
+    @api.post("/api/echo")
+    async def echo(req):
+        return {"got": req.json()}
+
+    front = App("frontend")
+
+    @front.get("/go")
+    async def go(req):
+        resp = await front.client.invoke_method(
+            "backend-api", "api/echo", http_method="POST", data={"n": 5})
+        resp.raise_for_status()
+        return resp.json()
+
+    hosts = [AppHost(api, specs=specs, registry_file=registry),
+             AppHost(front, specs=specs, registry_file=registry)]
+    for h in hosts:
+        await h.start()
+    try:
+        resp = await hosts[1].client.invoke_method(
+            "frontend", "go", http_method="GET")
+        assert resp.json() == {"got": {"n": 5}}
+        pool = hosts[1].sidecar.runtime._mesh_pool
+        conns = [c for c in pool._conns.values() if not c.closed]
+        assert conns and all(c.codec is JsonHeaderCodec for c in conns)
+    finally:
+        for h in hosts:
+            await h.stop()
+
+
+_CHILD_SCRIPT = textwrap.dedent("""
+    import asyncio
+    import sys
+
+    from tasksrunner import App, AppHost
+
+    async def main():
+        app = App("legacy-api")
+
+        @app.post("/api/chain")
+        async def chain(req):
+            # exercises the REVERSE direction too: this JSON-header
+            # peer invokes the v2 peer over the mesh
+            resp = await app.client.invoke_method(
+                "modern-api", "api/pong", http_method="POST",
+                data=req.json())
+            resp.raise_for_status()
+            return {"child": "json-peer", "parent_said": resp.json()}
+
+        host = AppHost(app, specs=[], registry_file=sys.argv[1])
+        await host.start()
+        print("READY", flush=True)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await host.stop()
+
+    asyncio.run(main())
+""")
+
+
+@pytest.mark.asyncio
+async def test_live_cross_process_json_peer_interop(tmp_path, monkeypatch):
+    """Rolling-upgrade drill with a real process boundary: the child
+    speaks only JSON headers (TASKSRUNNER_MESH_CODEC=json) in both
+    directions; the parent is a stock v2 build. One request chains
+    parent → child → parent, so both codec mixes ride live sockets."""
+    monkeypatch.delenv("TASKSRUNNER_MESH_CODEC", raising=False)
+    monkeypatch.delenv("TASKSRUNNER_MESH", raising=False)
+    registry = str(tmp_path / "apps.json")
+    script = tmp_path / "json_peer.py"
+    script.write_text(_CHILD_SCRIPT)
+
+    import tasksrunner
+    repo_root = os.path.dirname(os.path.dirname(tasksrunner.__file__))
+    env = dict(os.environ, TASKSRUNNER_MESH_CODEC="json")
+    env.pop("TASKSRUNNER_MESH", None)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, str(script), registry], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+
+    api = App("modern-api")
+
+    @api.post("/api/pong")
+    async def pong(req):
+        return {"pong": req.json(), "codec": "v2-peer"}
+
+    host = AppHost(api, specs=[], registry_file=registry)
+    try:
+        line = await asyncio.wait_for(
+            asyncio.to_thread(proc.stdout.readline), timeout=60)
+        assert line.strip() == "READY", line
+        await host.start()
+        resp = await host.client.invoke_method(
+            "legacy-api", "api/chain", http_method="POST", data={"k": 1})
+        assert resp.status == 200
+        assert resp.json() == {
+            "child": "json-peer",
+            "parent_said": {"pong": {"k": 1}, "codec": "v2-peer"}}
+        # the parent's connection TO the json-forced peer degraded to
+        # v1 headers via the hello (its server acks at version 1)
+        pool = host.sidecar.runtime._mesh_pool
+        conns = [c for c in pool._conns.values() if not c.closed]
+        assert conns and all(c.codec is JsonHeaderCodec for c in conns)
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+        await host.stop()
+
+
+# ---------------------------------------------------------------------------
+# replication lane inherits the codec
+# ---------------------------------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_replication_lane_negotiates_binary_headers():
+    from tasksrunner.state.replmesh import MeshFollowerLink, ReplicationServer
+
+    class Node:
+        name, shard = "store", 0
+
+        def position(self):
+            return 41, 3
+
+    srv = ReplicationServer()
+    await srv.start()
+    srv.register(Node())
+    link = MeshFollowerLink("store", 0, "m1", "127.0.0.1", srv.port)
+    try:
+        assert await link.position() == (41, 3)
+        assert link._codec is BinaryHeaderCodec
+    finally:
+        await link.aclose()
+        await srv.aclose()

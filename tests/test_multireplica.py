@@ -179,6 +179,22 @@ async def _tamper_replica0(hosts, *, mesh_port):
         pid=victim.pid, mesh_port=mesh_port))
 
 
+async def _ack_hello(reader, writer):
+    """Consume the client's codec hello and ack it at v1 — the tarpit
+    then counts as an ESTABLISHED connection (negotiation done), so the
+    fault it injects next lands mid-flight, not at dial time (where the
+    pool would classify it MeshConnectError and fall back to HTTP
+    within the same attempt)."""
+    import struct
+
+    from tasksrunner.invoke.mesh import _pack
+
+    (frame_len,) = struct.unpack(">I", await reader.readexactly(4))
+    await reader.readexactly(frame_len)
+    writer.write(_pack({"i": 0, "hello": 1}, b""))
+    await writer.drain()
+
+
 @pytest.mark.asyncio
 async def test_established_mesh_conn_dropped_midflight_fails_over(tmp_path):
     """The connection DIALS fine, then the peer dies after reading the
@@ -191,8 +207,9 @@ async def test_established_mesh_conn_dropped_midflight_fails_over(tmp_path):
 
     async def drop_after_first_frame(reader, writer):
         try:
-            await reader.readexactly(4)   # accept the dial, take bytes,
-        except asyncio.IncompleteReadError:
+            await _ack_hello(reader, writer)  # dial + handshake succeed,
+            await reader.readexactly(4)   # the request frame arrives,
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         writer.transport.abort()          # then die abruptly mid-flight
 
@@ -231,8 +248,9 @@ async def test_blackholed_mesh_conn_times_out_and_fails_over(
 
     async def blackhole(reader, writer):
         try:
+            await _ack_hello(reader, writer)  # handshake completes, then
             await reader.read(-1)         # consume forever, reply never
-        except (ConnectionError, OSError):
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
 
     tarpit = await asyncio.start_server(blackhole, "127.0.0.1", 0)
