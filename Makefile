@@ -1,8 +1,8 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test lint lint-program lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-overload bench-actors bench-repl bench-mesh chaos sweep-flash run validate docs-serve docs-build clean
+.PHONY: test lint lint-program lint-dataflow lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-overload bench-actors bench-repl bench-mesh chaos sweep-flash run validate docs-serve docs-build clean
 
-test: lint lint-program
+test: lint lint-program lint-dataflow
 	python -m pytest tests/ -q
 
 # tasklint: AST enforcement of the runtime's invariants — no blocking
@@ -18,8 +18,14 @@ lint:
 lint-program:
 	python -m tasksrunner.analysis --rules transitive-blocking,lock-order-cycle,held-lock-across-await,thread-shared-state,route-conformance
 
+# dataflow phase only: CFG-based secret-taint, resource-lifetime,
+# cancellation-safety, and exception-flow analysis over the full
+# package (tree-digest cached like the program phase)
+lint-dataflow:
+	python -m tasksrunner.analysis --rules secret-taint,resource-lifetime,cancellation-safety,exception-flow
+
 # fast pre-commit loop: per-file phase on the git delta vs main; the
-# program phase still covers the whole tree
+# program and dataflow phases still cover the whole tree
 lint-changed:
 	python -m tasksrunner.analysis --changed
 

@@ -229,15 +229,20 @@ def _dist_files(name: str):
 
 
 def _bytecompile_tree(src: pathlib.Path, scratch: pathlib.Path,
-                      container_dir: str) -> pathlib.Path:
+                      container_dir: str,
+                      prune: tuple[str, ...] = ()) -> pathlib.Path:
     """Copy ``src`` into scratch and compile with hash-based pyc
     invalidation (no timestamps in pyc headers) and the CONTAINER
     path embedded as co_filename (stripdir/prependdir) — without
     that, every build would bake its own temp path into the pycs and
-    the layer digest would never reproduce."""
+    the layer digest would never reproduce. ``prune`` drops named
+    top-level subpackages before compiling (the Dockerfile's `rm -rf`
+    of dev-only code)."""
     dst = scratch / src.name
     shutil.copytree(src, dst, ignore=shutil.ignore_patterns(
         "__pycache__", ".tasksrunner", "*.db", "*.db-wal", "*.db-shm"))
+    for name in prune:
+        shutil.rmtree(dst / name, ignore_errors=True)
     compileall.compile_dir(
         str(dst), quiet=2,
         stripdir=str(dst), prependdir=container_dir,
@@ -276,8 +281,11 @@ def payload_layer(variant: str, scratch: pathlib.Path) -> Layer:
             for arc, p in _dist_files(name):
                 layer.add_file(arc, p, 0o755 if arc.startswith("usr/local/bin")
                                else 0o644)
+        # the linter (tasksrunner/analysis) is CI/dev tooling and is
+        # imported lazily by the `lint` subcommand only — chisel it out
         compiled = _bytecompile_tree(REPO / "tasksrunner", scratch,
-                                     f"/{SITE}/tasksrunner")
+                                     f"/{SITE}/tasksrunner",
+                                     prune=("analysis",))
         layer.add_tree(f"{SITE}/tasksrunner", compiled,
                        exclude_parts=frozenset())
     return layer

@@ -79,13 +79,17 @@ def dist_size(name: str) -> int:
     return total
 
 
-def compiled_size(tree: pathlib.Path) -> int:
+def compiled_size(tree: pathlib.Path, *, prune: tuple[str, ...] = ()) -> int:
     """Size of ``tree`` after the optimized variant's `compileall`
-    (sources + .pyc), measured on a scratch copy."""
+    (sources + .pyc), measured on a scratch copy. ``prune`` drops
+    named top-level subpackages first, matching the Dockerfile's
+    `rm -rf` of dev-only code."""
     with tempfile.TemporaryDirectory() as tmp:
         dst = pathlib.Path(tmp) / tree.name
         shutil.copytree(tree, dst, ignore=shutil.ignore_patterns(
             "__pycache__", ".tasksrunner", "*.db", "*.db-wal", "*.db-shm"))
+        for name in prune:
+            shutil.rmtree(dst / name, ignore_errors=True)
         compileall.compile_dir(str(dst), quiet=2)
         return du(dst)
 
@@ -97,7 +101,9 @@ def measure() -> dict:
 
     framework_src = du(REPO / "tasksrunner", exclude_pycache=True)
     samples_src = du(REPO / "samples", exclude_pycache=True)
-    framework_opt = compiled_size(REPO / "tasksrunner")
+    # the optimized image drops the linter (tasksrunner/analysis):
+    # it is CI/dev tooling, and `tasksrunner lint` imports it lazily
+    framework_opt = compiled_size(REPO / "tasksrunner", prune=("analysis",))
     samples_opt = compiled_size(REPO / "samples")
 
     deps = {name: dist_size(name) for name in RUNTIME_DEPS}

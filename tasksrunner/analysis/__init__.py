@@ -26,5 +26,11 @@ and a checked-in baseline for grandfathered findings
 from __future__ import annotations
 
 from tasksrunner.analysis.core import RULES, Finding, Rule, register
+# Import the rule modules while this package init holds the floor: the
+# registration imports run in an order where each dependency (blocking
+# tables -> program graph -> dataflow engine) completes before its
+# dependents, which makes *direct* imports of any analysis submodule
+# (``import tasksrunner.analysis.dataflow``) safe instead of circular.
+from tasksrunner.analysis import rules as _rules  # noqa: F401
 
 __all__ = ["RULES", "Finding", "Rule", "register"]

@@ -35,8 +35,10 @@ from tasksrunner.analysis.core import Finding
 
 _PKG = pathlib.Path(__file__).resolve().parent
 
-#: reserved table key for the whole-program phase entry — not a path
+#: reserved table keys for the whole-tree phase entries — not paths
 PROGRAM_KEY = "__program__"
+DATAFLOW_KEY = "__dataflow__"
+_RESERVED_KEYS = frozenset({PROGRAM_KEY, DATAFLOW_KEY})
 
 #: (path, mtime_ns, size) → sha1, memoised per process. The proxy key
 #: is safe *within* one run (nothing restores mtimes mid-lint); the
@@ -93,8 +95,20 @@ class ResultCache:
                 self._table = json.loads(path.read_text()) or {}
             except ValueError:  # corrupt cache: rebuild silently
                 self._table = {}
+            # deleted sources leave dead entries behind forever (the
+            # save() sweep only drops old-signature rows) — prune any
+            # path key whose file is gone, so renames/removals don't
+            # grow the cache without bound
+            stale = [k for k in self._table
+                     if k not in _RESERVED_KEYS
+                     and not pathlib.Path(k).is_file()]
+            for k in stale:
+                del self._table[k]
+            if stale:
+                self._dirty = True
 
-    def get(self, path: pathlib.Path) -> list[Finding] | None:
+    def get(self, path: pathlib.Path
+            ) -> tuple[list[Finding], int] | None:
         entry = self._table.get(str(path))
         if entry is None or entry.get("sig") != self.signature:
             return None
@@ -102,9 +116,11 @@ class ResultCache:
         if digest is None or entry.get("sha1") != digest:
             return None
         self.hits += 1
-        return [Finding.from_json(d) for d in entry.get("findings", [])]
+        return ([Finding.from_json(d) for d in entry.get("findings", [])],
+                int(entry.get("suppressed", 0)))
 
-    def put(self, path: pathlib.Path, findings: list[Finding]) -> None:
+    def put(self, path: pathlib.Path, findings: list[Finding],
+            suppressed: int = 0) -> None:
         try:
             stat = path.stat()
         except OSError:
@@ -114,13 +130,14 @@ class ResultCache:
             "mtime": stat.st_mtime_ns,
             "size": stat.st_size,
             "sha1": file_digest(path),
+            "suppressed": suppressed,
             "findings": [f.to_json() for f in findings],
         }
         self._dirty = True
 
-    def get_program(self, tree_hash: str,
+    def get_program(self, tree_hash: str, key: str = PROGRAM_KEY,
                     ) -> tuple[list[Finding], int] | None:
-        entry = self._table.get(PROGRAM_KEY)
+        entry = self._table.get(key)
         if entry is None or entry.get("sig") != self.signature or \
                 entry.get("tree") != tree_hash:
             return None
@@ -129,8 +146,8 @@ class ResultCache:
                 int(entry.get("suppressed", 0)))
 
     def put_program(self, tree_hash: str, findings: list[Finding],
-                    suppressed: int) -> None:
-        self._table[PROGRAM_KEY] = {
+                    suppressed: int, key: str = PROGRAM_KEY) -> None:
+        self._table[key] = {
             "sig": self.signature,
             "tree": tree_hash,
             "suppressed": suppressed,

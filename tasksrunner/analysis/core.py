@@ -205,6 +205,20 @@ class ProgramRule:
         raise NotImplementedError
 
 
+class DataflowRule:
+    """Base class for dataflow rules: ``check`` sees a
+    :class:`~tasksrunner.analysis.dataflow.DataflowAnalysis` — the
+    ProgramGraph plus per-function CFGs and the shared taint /
+    exception-escape engines. Findings carry source→sink chains and
+    flow through the same chain-aware suppression as program rules."""
+
+    id: str = ""
+    doc: str = ""
+
+    def check(self, dfa) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
 #: rule id → singleton instance; populated at import of ``.rules``
 RULES: dict[str, Rule] = {}
 
@@ -212,15 +226,19 @@ RULES: dict[str, Rule] = {}
 #: RULES (the suppression validator and ``--rules`` see one table)
 PROGRAM_RULES: dict[str, ProgramRule] = {}
 
+#: dataflow rule id → singleton; same shared id namespace
+DATAFLOW_RULES: dict[str, DataflowRule] = {}
+
 
 def known_rule_ids() -> set[str]:
-    return set(RULES) | set(PROGRAM_RULES)
+    return set(RULES) | set(PROGRAM_RULES) | set(DATAFLOW_RULES)
 
 
 def _register_into(table: dict, inst) -> None:
     if not inst.id:
         raise ValueError(f"{type(inst).__name__} has no rule id")
-    if inst.id in RULES or inst.id in PROGRAM_RULES:
+    if inst.id in RULES or inst.id in PROGRAM_RULES or \
+            inst.id in DATAFLOW_RULES:
         raise ValueError(f"duplicate rule id {inst.id!r}")
     table[inst.id] = inst
 
@@ -232,4 +250,9 @@ def register(cls: type[Rule]) -> type[Rule]:
 
 def register_program(cls: type[ProgramRule]) -> type[ProgramRule]:
     _register_into(PROGRAM_RULES, cls())
+    return cls
+
+
+def register_dataflow(cls: type[DataflowRule]) -> type[DataflowRule]:
+    _register_into(DATAFLOW_RULES, cls())
     return cls

@@ -1,0 +1,948 @@
+"""Dataflow phase — per-function CFGs plus interprocedural summaries.
+
+PR 4 gave tasklint syntax (one AST at a time), PR 8 gave it structure
+(the whole-program call/lock graph). Neither can answer *flow*
+questions: does the value read from the secrets store ever reach a log
+call, is this connection closed on the early-return path, which
+exception types can escape a route handler. This module supplies the
+missing layer:
+
+* :func:`build_cfg` — a per-function control-flow graph over the
+  existing AST. Basic blocks hold *events* (simple statements and
+  :class:`Bind` markers for loop/with/except bindings); compound
+  statements contribute edges, not events. ``try``/``finally`` is
+  modelled by pre-creating the handler and finally entry blocks so
+  ``return``/``raise``/``break`` inside the body route *through* the
+  finally chain, and every function exit is recorded with its kind
+  (explicit ``return``, uncaught ``raise``, or falling off the end).
+
+* :func:`run_forward` — the worklist engine: forward abstract
+  interpretation to a fixpoint, parameterised by the rule's transfer
+  function and join. All shipped abstractions are may-analyses over
+  finite label sets, so termination is by lattice height.
+
+* :class:`TaintEngine` — gen/kill taint over the CFG with
+  **interprocedural summaries**: one pass per function computes which
+  labels (``SECRET`` origins and ``PARAM i`` placeholders) reach each
+  sink and the return value; summaries propagate along the
+  ProgramGraph call graph to fixpoint, so a token that travels two
+  helper calls deep before hitting a logger is still caught, and the
+  finding's chain names every hop.
+
+* exception **escape sets** — per-function may-raise summaries
+  (explicit raises plus callee escapes, filtered through enclosing
+  ``except`` clauses with package + builtin subclass knowledge),
+  propagated to fixpoint for the exception-flow rule.
+
+The phase is conservative the same way the program phase is: an edge
+the call graph cannot resolve produces no propagation, so a reported
+source→sink chain is a real syntactic path, while a silent function is
+not a proof. Results are cached under the program-phase tree digest
+(see :mod:`.cache`), so warm runs cost one digest pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Iterator
+
+from tasksrunner.analysis.program import (
+    FunctionInfo,
+    ModuleInfo,
+    ProgramGraph,
+    _resolve_dotted,
+)
+
+# --------------------------------------------------------------------------
+# CFG
+# --------------------------------------------------------------------------
+
+
+class Bind:
+    """A binding event that is not an ``ast.Assign``: ``for x in it``,
+    ``with expr as x``, ``except E as x``. ``target`` may be None
+    (``with self._lock:``); ``value`` may be None (except-binding)."""
+
+    __slots__ = ("target", "value", "kind", "lineno")
+
+    def __init__(self, target: ast.AST | None, value: ast.AST | None,
+                 kind: str, lineno: int):
+        self.target = target
+        self.value = value
+        self.kind = kind  # "for" | "with" | "except"
+        self.lineno = lineno
+
+
+class Block:
+    __slots__ = ("idx", "events", "succs", "preds", "in_finally")
+
+    def __init__(self, idx: int):
+        self.idx = idx
+        #: simple statements and Bind markers, in execution order
+        self.events: list = []
+        self.succs: list[int] = []
+        self.preds: list[int] = []
+        #: True when the block belongs to a ``finally`` suite
+        self.in_finally = False
+
+
+class Exit:
+    """One way out of the function."""
+
+    __slots__ = ("block", "kind", "lineno", "node")
+
+    def __init__(self, block: int, kind: str, lineno: int,
+                 node: ast.AST | None):
+        self.block = block
+        self.kind = kind  # "return" | "raise" | "fall"
+        self.lineno = lineno
+        self.node = node  # the Return/Raise statement, None for "fall"
+
+
+class CFG:
+    __slots__ = ("blocks", "exits")
+
+    def __init__(self) -> None:
+        self.blocks: list[Block] = []
+        self.exits: list[Exit] = []
+
+    @property
+    def entry(self) -> Block:
+        return self.blocks[0]
+
+
+class NestedDef:
+    """Event marker for a nested function/class definition: no control
+    flow of its own, but its body closes over outer names."""
+
+    __slots__ = ("node", "lineno")
+
+    def __init__(self, node: ast.stmt):
+        self.node = node
+        self.lineno = node.lineno
+
+
+_CATCH_ALL = frozenset({"", "Exception", "BaseException"})
+
+
+def _handler_names(handler: ast.ExceptHandler) -> list[str]:
+    """Leaf names of the caught types; [""] for a bare ``except:``."""
+    if handler.type is None:
+        return [""]
+    types = handler.type.elts if isinstance(handler.type, ast.Tuple) \
+        else [handler.type]
+    out = []
+    for t in types:
+        if isinstance(t, ast.Attribute):
+            out.append(t.attr)
+        elif isinstance(t, ast.Name):
+            out.append(t.id)
+    return out
+
+
+class _TryFrame:
+    __slots__ = ("handlers", "finally_entry", "catch_all", "pending")
+
+    def __init__(self) -> None:
+        #: (handler node, entry block) pairs
+        self.handlers: list[tuple[ast.ExceptHandler, Block]] = []
+        self.finally_entry: Block | None = None
+        self.catch_all = False
+        #: exit kinds routed through this finally: (kind, lineno, node)
+        self.pending: list[tuple[str, int, ast.AST | None]] = []
+
+
+class _CFGBuilder:
+    def __init__(self, fn_node: ast.AST):
+        self.cfg = CFG()
+        self.cur = self._new()
+        #: (header block, after block) per enclosing loop
+        self.loops: list[tuple[Block, Block]] = []
+        self.tries: list[_TryFrame] = []
+        self.fn_node = fn_node
+
+    def _new(self) -> Block:
+        b = Block(len(self.cfg.blocks))
+        self.cfg.blocks.append(b)
+        return b
+
+    def _edge(self, a: Block, b: Block) -> None:
+        if b.idx not in a.succs:
+            a.succs.append(b.idx)
+            b.preds.append(a.idx)
+
+    def _reachable(self, b: Block) -> bool:
+        return b.idx == 0 or bool(b.preds)
+
+    # -- exits --------------------------------------------------------------
+
+    def _route_exit(self, kind: str, lineno: int, node: ast.AST | None,
+                    frames: list[_TryFrame] | None = None) -> None:
+        """Route a return/raise/break target through enclosing
+        ``finally`` suites. ``frames`` defaults to the live try stack;
+        recursive calls pass the not-yet-unwound tail."""
+        if frames is None:
+            frames = self.tries
+        for i in range(len(frames) - 1, -1, -1):
+            frame = frames[i]
+            if frame.finally_entry is not None:
+                self._edge(self.cur, frame.finally_entry)
+                frame.pending.append((kind, lineno, node))
+                return
+        self.cfg.exits.append(Exit(self.cur.idx, kind, lineno, node))
+
+    def _route_raise(self, lineno: int, node: ast.AST | None) -> None:
+        """A ``raise``: conservatively reaches the handlers of each
+        enclosing try (stopping at a catch-all), else exits raising."""
+        for i in range(len(self.tries) - 1, -1, -1):
+            frame = self.tries[i]
+            for _handler, entry in frame.handlers:
+                self._edge(self.cur, entry)
+            if frame.catch_all:
+                self.cur = self._new()  # nothing runs after a caught raise
+                return
+        self._route_exit("raise", lineno, node)
+        self.cur = self._new()
+
+    # -- statements ---------------------------------------------------------
+
+    def build(self, body: list[ast.stmt]) -> CFG:
+        self._stmts(body)
+        if self._reachable(self.cur):
+            last = body[-1].lineno if body else 1
+            self.cfg.exits.append(Exit(self.cur.idx, "fall", last, None))
+        return self.cfg
+
+    def _stmts(self, stmts: list[ast.stmt]) -> None:
+        for stmt in stmts:
+            self._stmt(stmt)
+
+    def _stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, ast.If):
+            before = self.cur
+            after = self._new()
+            self.cur = self._new()
+            self._edge(before, self.cur)
+            self._stmts(node.body)
+            if self._reachable(self.cur):
+                self._edge(self.cur, after)
+            self.cur = self._new()
+            self._edge(before, self.cur)
+            self._stmts(node.orelse)
+            if self._reachable(self.cur):
+                self._edge(self.cur, after)
+            self.cur = after
+        elif isinstance(node, (ast.While, ast.For, ast.AsyncFor)):
+            header = self._new()
+            self._edge(self.cur, header)
+            after = self._new()
+            self._edge(header, after)
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                header.events.append(
+                    Bind(node.target, node.iter, "for", node.lineno))
+            body = self._new()
+            self._edge(header, body)
+            self.cur = body
+            self.loops.append((header, after))
+            self._stmts(node.body)
+            self.loops.pop()
+            if self._reachable(self.cur):
+                self._edge(self.cur, header)
+            self.cur = self._new()
+            self._edge(header, self.cur)
+            self._stmts(node.orelse)
+            if self._reachable(self.cur):
+                self._edge(self.cur, after)
+            self.cur = after
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self.cur.events.append(Bind(item.optional_vars,
+                                            item.context_expr, "with",
+                                            node.lineno))
+            self._stmts(node.body)
+        elif isinstance(node, ast.Try):
+            self._try(node)
+        elif isinstance(node, ast.Return):
+            self.cur.events.append(node)
+            self._route_exit("return", node.lineno, node)
+            self.cur = self._new()
+        elif isinstance(node, ast.Raise):
+            self.cur.events.append(node)
+            self._route_raise(node.lineno, node)
+        elif isinstance(node, ast.Break):
+            if self.loops:
+                self._edge(self.cur, self.loops[-1][1])
+            self.cur = self._new()
+        elif isinstance(node, ast.Continue):
+            if self.loops:
+                self._edge(self.cur, self.loops[-1][0])
+            self.cur = self._new()
+        elif isinstance(node, ast.Match):
+            before = self.cur
+            after = self._new()
+            self._edge(before, after)  # no case may match
+            for case in node.cases:
+                self.cur = self._new()
+                self._edge(before, self.cur)
+                self._stmts(case.body)
+                if self._reachable(self.cur):
+                    self._edge(self.cur, after)
+            self.cur = after
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            # nested defs have their own FunctionInfo and contribute no
+            # flow here, but closures *capture* outer names — leave a
+            # marker so rules can model the capture (e.g. a closure
+            # taking ownership of a resource)
+            self.cur.events.append(NestedDef(node))
+        else:
+            self.cur.events.append(node)
+
+    def _try(self, node: ast.Try) -> None:
+        frame = _TryFrame()
+        after = self._new()
+        for handler in node.handlers:
+            entry = self._new()
+            frame.handlers.append((handler, entry))
+            if set(_handler_names(handler)) & _CATCH_ALL:
+                frame.catch_all = True
+        if node.finalbody:
+            frame.finally_entry = self._new()
+        body_entry = self._new()
+        self._edge(self.cur, body_entry)
+        # an exception may fire before any body statement ran: seed each
+        # handler with the pre-body state too
+        for _handler, entry in frame.handlers:
+            self._edge(self.cur, entry)
+        self.cur = body_entry
+        self.tries.append(frame)
+        self._stmts(node.body)
+        body_end = self.cur
+        # exception after the last body statement
+        for _handler, entry in frame.handlers:
+            if self._reachable(body_end):
+                self._edge(body_end, entry)
+        if self._reachable(self.cur):
+            self._stmts(node.orelse)
+        normal_end = self.cur
+        self.tries.pop()
+
+        handler_ends: list[Block] = []
+        for handler, entry in frame.handlers:
+            self.cur = entry
+            if handler.name:
+                entry.events.append(Bind(
+                    ast.Name(id=handler.name, ctx=ast.Store(),
+                             lineno=handler.lineno, col_offset=0),
+                    None, "except", handler.lineno))
+            self._stmts(handler.body)
+            if self._reachable(self.cur):
+                handler_ends.append(self.cur)
+
+        if node.finalbody:
+            fin = frame.finally_entry
+            assert fin is not None
+            if self._reachable(normal_end):
+                self._edge(normal_end, fin)
+            for end in handler_ends:
+                self._edge(end, fin)
+            self.cur = fin
+            mark_from = len(self.cfg.blocks)
+            self._stmts(node.finalbody)
+            fin.in_finally = True
+            for b in self.cfg.blocks[mark_from:]:
+                b.in_finally = True
+            fin_end = self.cur
+            if self._reachable(fin_end) or fin_end is fin:
+                self._edge(fin_end, after)
+                # re-dispatch the exits that were parked on this finally
+                for kind, lineno, enode in frame.pending:
+                    self.cur = fin_end
+                    self._route_exit(kind, lineno, enode)
+            self.cur = after
+        else:
+            if self._reachable(normal_end):
+                self._edge(normal_end, after)
+            for end in handler_ends:
+                self._edge(end, after)
+            self.cur = after
+
+
+def build_cfg(fn_node: ast.AST) -> CFG:
+    """CFG over one function body (the def's own statements only —
+    nested defs contribute no events)."""
+    return _CFGBuilder(fn_node).build(list(fn_node.body))
+
+
+# --------------------------------------------------------------------------
+# worklist engine
+# --------------------------------------------------------------------------
+
+
+def run_forward(cfg: CFG, init, transfer: Callable, join: Callable,
+                ) -> dict[int, object]:
+    """Forward may-analysis to fixpoint. ``transfer(block, state) ->
+    state`` must be monotone; ``join(a, b)`` the lattice union.
+    Returns the state at *entry* of each reachable block index."""
+    states: dict[int, object] = {0: init}
+    work = [0]
+    out_memo: dict[int, object] = {}
+    while work:
+        idx = work.pop()
+        block = cfg.blocks[idx]
+        out = transfer(block, states[idx])
+        if idx in out_memo and out_memo[idx] == out:
+            continue
+        out_memo[idx] = out
+        for succ in block.succs:
+            if succ not in states:
+                states[succ] = out
+                work.append(succ)
+            else:
+                merged = join(states[succ], out)
+                if merged != states[succ]:
+                    states[succ] = merged
+                    work.append(succ)
+    return states
+
+
+# --------------------------------------------------------------------------
+# analysis facade handed to DataflowRule.check
+# --------------------------------------------------------------------------
+
+
+class DataflowAnalysis:
+    """What a dataflow rule sees: the ProgramGraph plus memoised CFGs
+    and the shared interprocedural engines."""
+
+    def __init__(self, graph: ProgramGraph):
+        self.graph = graph
+        self._cfgs: dict[str, CFG] = {}
+        self._taint: TaintEngine | None = None
+        self._escapes: dict[str, frozenset] | None = None
+
+    def cfg(self, fn: FunctionInfo) -> CFG:
+        hit = self._cfgs.get(fn.key)
+        if hit is None:
+            hit = self._cfgs[fn.key] = build_cfg(fn.node)
+        return hit
+
+    def module(self, fn: FunctionInfo) -> ModuleInfo:
+        return self.graph.modules[fn.relpath]
+
+    def iter_functions(self) -> Iterator[FunctionInfo]:
+        return self.graph.iter_functions()
+
+    def resolve_dotted(self, fn: FunctionInfo, expr: ast.AST) -> str | None:
+        """Canonical dotted target of a call through the module's
+        import table ("asyncio.shield", "logging.getLogger")."""
+        return _resolve_dotted(self.module(fn).imports, expr)
+
+    @property
+    def taint(self) -> "TaintEngine":
+        if self._taint is None:
+            self._taint = TaintEngine(self)
+            self._taint.solve()
+        return self._taint
+
+    @property
+    def escapes(self) -> dict[str, dict[str, tuple]]:
+        """function key → {exception name → (lineno, via-callee key or
+        None)} for every type that may escape it (see
+        :func:`solve_escapes`). The provenance pair reconstructs the
+        finding's chain down to the leaf ``raise``."""
+        if self._escapes is None:
+            self._escapes = solve_escapes(self)
+        return self._escapes
+
+    def escape_chain(self, key: str, name: str) -> tuple[str, ...]:
+        """``file:line`` frames from ``key``'s raise/call site down to
+        the leaf raise of exception ``name``."""
+        frames: list[str] = []
+        seen: set[str] = set()
+        while key and key not in seen:
+            seen.add(key)
+            site = self.escapes.get(key, {}).get(name)
+            if site is None:
+                break
+            lineno, via = site
+            fn = self.graph.functions.get(key)
+            if fn is not None:
+                frames.append(f"{fn.relpath}:{lineno}")
+            if via is None:
+                break
+            key = via
+        return tuple(frames)
+
+
+# --------------------------------------------------------------------------
+# taint engine
+# --------------------------------------------------------------------------
+
+#: taint labels: a SECRET origin carries its provenance, a PARAM is a
+#: placeholder substituted at call sites during summary application
+Label = tuple  # ("SECRET", relpath, lineno, desc) | ("PARAM", index)
+
+#: builtins whose result reveals nothing about a secret argument
+_STRIP_CALLS = frozenset({"len", "type", "id", "bool", "isinstance",
+                          "hasattr", "callable"})
+
+
+class SinkHit:
+    """A tainted value reaching one sink inside one function."""
+
+    __slots__ = ("lineno", "desc", "labels", "tail")
+
+    def __init__(self, lineno: int, desc: str, labels: frozenset,
+                 tail: tuple[str, ...] = ()):
+        self.lineno = lineno
+        self.desc = desc          # "logging call", "metric label", ...
+        self.labels = labels      # which taint reached it
+        self.tail = tail          # chain frames below this one (callee side)
+
+    def __eq__(self, other) -> bool:
+        return (self.lineno, self.desc, self.labels, self.tail) == \
+            (other.lineno, other.desc, other.labels, other.tail)
+
+    def __hash__(self) -> int:
+        return hash((self.lineno, self.desc, self.labels, self.tail))
+
+
+class TaintSpec:
+    """The rule-supplied policy: what starts taint, what must not
+    receive it, what cleanses it. Subclassed by the secret-taint rule;
+    kept here so the engine is testable with toy specs."""
+
+    def source(self, engine: "TaintEngine", fn: FunctionInfo,
+               call: ast.Call) -> str | None:
+        """Non-None description when the call's result is secret."""
+        return None
+
+    def source_expr(self, engine: "TaintEngine", fn: FunctionInfo,
+                    expr: ast.AST) -> str | None:
+        """Non-call source expressions (attribute reads etc.)."""
+        return None
+
+    def sink(self, engine: "TaintEngine", fn: FunctionInfo,
+             call: ast.Call) -> str | None:
+        """Non-None description when the call is a forbidden sink for
+        secret-labelled arguments."""
+        return None
+
+    def sanitizer(self, engine: "TaintEngine", fn: FunctionInfo,
+                  call: ast.Call) -> bool:
+        """True when the call cleanses taint (redact/hash_token)."""
+        return False
+
+
+class TaintEngine:
+    """Label-set taint over every function, to interprocedural
+    fixpoint. One CFG pass per function per round; labels are
+    ``SECRET`` origins (with provenance) plus ``PARAM i``
+    placeholders, so a single pass yields both the local findings and
+    the caller-facing summary."""
+
+    def __init__(self, dfa: DataflowAnalysis, spec: TaintSpec | None = None):
+        self.dfa = dfa
+        self.spec = spec or TaintSpec()
+        #: fn key → labels that may flow to the return value
+        self.ret_labels: dict[str, frozenset] = {}
+        #: fn key → sink hits observed inside (labels may be PARAMs)
+        self.sink_hits: dict[str, tuple[SinkHit, ...]] = {}
+        #: call-site resolution memo: (fn key, lineno) → callee keys
+        self._callees: dict[tuple[str, int], list[str]] = {}
+
+    # -- summary application ------------------------------------------------
+
+    def _callee_keys(self, fn: FunctionInfo, call: ast.Call) -> list[str]:
+        memo_key = (fn.key, call.lineno)
+        hit = self._callees.get(memo_key)
+        if hit is None:
+            hit = [e.callee for e in fn.edges
+                   if e.lineno == call.lineno and not e.dispatch]
+            self._callees[memo_key] = hit
+        return hit
+
+    def _arg_labels(self, fn: FunctionInfo, call: ast.Call,
+                    state: dict) -> list[frozenset]:
+        out = []
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            out.append(self._expr_labels(fn, arg, state))
+        return out
+
+    def _substitute(self, labels: frozenset,
+                    args: list[frozenset]) -> frozenset:
+        """Replace PARAM placeholders in a callee summary with the
+        labels of the actual arguments."""
+        out = set()
+        for label in labels:
+            if label[0] == "PARAM":
+                idx = label[1]
+                if idx < len(args):
+                    out |= args[idx]
+            else:
+                out.add(label)
+        return frozenset(out)
+
+    def _expr_labels(self, fn: FunctionInfo, expr: ast.AST,
+                     state: dict) -> frozenset:
+        """May-labels of one expression under ``state`` (name →
+        labels). Calls are NOT descended into — ``_call_labels``
+        decides what of its arguments' taint survives the call, which
+        is what lets ``redact(token)`` and ``len(token)`` actually
+        strip the label instead of re-leaking the inner name."""
+        labels: set = set()
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Call):
+                labels |= self._call_labels(fn, node, state)
+                continue
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                labels |= state.get(node.id, frozenset())
+            elif isinstance(node, (ast.Attribute, ast.Subscript)):
+                desc = self.spec.source_expr(self, fn, node)
+                if desc:
+                    labels.add(("SECRET", fn.relpath, node.lineno, desc))
+                    continue
+            stack.extend(ast.iter_child_nodes(node))
+        return frozenset(labels)
+
+    def _call_labels(self, fn: FunctionInfo, call: ast.Call,
+                     state: dict) -> frozenset:
+        """Labels of a call's *result* (args evaluated via state)."""
+        desc = self.spec.source(self, fn, call)
+        if desc:
+            return frozenset({("SECRET", fn.relpath, call.lineno, desc)})
+        if self.spec.sanitizer(self, fn, call):
+            return frozenset()
+        name = call.func.id if isinstance(call.func, ast.Name) else None
+        if name in _STRIP_CALLS:
+            return frozenset()
+        args = self._arg_labels(fn, call, state)
+        merged: set = set()
+        for a in args:
+            merged |= a
+        callees = self._callee_keys(fn, call)
+        if callees:
+            out: set = set()
+            for key in callees:
+                out |= self._substitute(
+                    self.ret_labels.get(key, frozenset()), args)
+            return frozenset(out)
+        # unresolved call: assume the result carries its arguments
+        return frozenset(merged)
+
+    # -- per-function pass --------------------------------------------------
+
+    def _transfer(self, fn: FunctionInfo, hits: list[SinkHit]):
+        def transfer(block: Block, state_in: dict) -> dict:
+            state = dict(state_in)
+            for event in block.events:
+                self._event(fn, event, state, hits)
+            return state
+        return transfer
+
+    def _assign_names(self, target: ast.AST) -> list[str]:
+        if isinstance(target, ast.Name):
+            return [target.id]
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out = []
+            for elt in target.elts:
+                out.extend(self._assign_names(elt))
+            return out
+        if isinstance(target, ast.Starred):
+            return self._assign_names(target.value)
+        return []
+
+    def _event(self, fn: FunctionInfo, event, state: dict,
+               hits: list[SinkHit]) -> None:
+        if isinstance(event, NestedDef):
+            return  # the nested def is analysed as its own function
+        if isinstance(event, Bind):
+            if event.value is not None:
+                self._scan_calls(fn, event.value, state, hits)
+            if event.target is not None:
+                labels = self._expr_labels(fn, event.value, state) \
+                    if event.value is not None else frozenset()
+                for name in self._assign_names(event.target):
+                    state[name] = labels
+            return
+        if isinstance(event, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = event.value
+            if value is None:
+                return
+            self._scan_calls(fn, value, state, hits)
+            labels = self._expr_labels(fn, value, state)
+            targets = event.targets if isinstance(event, ast.Assign) \
+                else [event.target]
+            for tgt in targets:
+                if isinstance(event, ast.AugAssign) and \
+                        isinstance(tgt, ast.Name):
+                    state[tgt.id] = state.get(tgt.id, frozenset()) | labels
+                    continue
+                for name in self._assign_names(tgt):
+                    state[name] = labels
+            return
+        if isinstance(event, ast.Return):
+            if event.value is not None:
+                self._scan_calls(fn, event.value, state, hits)
+                labels = self._expr_labels(fn, event.value, state)
+                if labels:
+                    self.ret_labels[fn.key] = \
+                        self.ret_labels.get(fn.key, frozenset()) | labels
+            return
+        # any other simple statement: walk it for sink / summary calls
+        for node in ast.walk(event):
+            if isinstance(node, ast.Call):
+                self._check_call(fn, node, state, hits)
+
+    def _scan_calls(self, fn: FunctionInfo, expr: ast.AST, state: dict,
+                    hits: list[SinkHit]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._check_call(fn, node, state, hits)
+
+    def _check_call(self, fn: FunctionInfo, call: ast.Call, state: dict,
+                    hits: list[SinkHit]) -> None:
+        """Sink check + interprocedural param→sink summaries for one
+        call site."""
+        desc = self.spec.sink(self, fn, call)
+        args = self._arg_labels(fn, call, state)
+        if desc:
+            merged: set = set()
+            for a in args:
+                merged |= a
+            if merged:
+                hits.append(SinkHit(call.lineno, desc, frozenset(merged)))
+            return
+        if self.spec.sanitizer(self, fn, call):
+            return
+        for key in self._callee_keys(fn, call):
+            for hit in self.sink_hits.get(key, ()):
+                # only the parameter-dependent part of a callee hit is
+                # the caller's problem — the callee's own SECRET labels
+                # are reported once, in the callee
+                params = frozenset(lb for lb in hit.labels
+                                   if lb[0] == "PARAM")
+                labels = self._substitute(params, args)
+                if labels:
+                    callee = self.dfa.graph.functions.get(key)
+                    frame = f"{callee.relpath}:{hit.lineno}" if callee \
+                        else f"?:{hit.lineno}"
+                    hits.append(SinkHit(call.lineno, hit.desc, labels,
+                                        tail=(frame,) + hit.tail))
+
+    def _analyse(self, fn: FunctionInfo) -> tuple[frozenset, tuple]:
+        cfg = self.dfa.cfg(fn)
+        init: dict = {}
+        posonly = getattr(fn.node.args, "posonlyargs", [])
+        params = list(posonly) + list(fn.node.args.args)
+        for i, arg in enumerate(params):
+            if arg.arg in ("self", "cls") and i == 0:
+                continue
+            init[arg.arg] = frozenset({("PARAM", i)})
+        hits: list[SinkHit] = []
+        self.ret_labels.setdefault(fn.key, frozenset())
+        before = self.ret_labels[fn.key]
+
+        def join(a: dict, b: dict) -> dict:
+            merged = dict(a)
+            for name, labels in b.items():
+                merged[name] = merged.get(name, frozenset()) | labels
+            return merged
+
+        run_forward(cfg, init, self._transfer(fn, hits), join)
+        # dedupe, keep deterministic order
+        seen: set = set()
+        uniq: list[SinkHit] = []
+        for hit in sorted(hits, key=lambda h: (h.lineno, h.desc)):
+            marker = (hit.lineno, hit.desc, hit.labels, hit.tail)
+            if marker not in seen:
+                seen.add(marker)
+                uniq.append(hit)
+        return (self.ret_labels[fn.key] | before, tuple(uniq))
+
+    # -- interprocedural fixpoint -------------------------------------------
+
+    def solve(self, max_rounds: int = 8) -> None:
+        """Iterate per-function passes until return/sink summaries are
+        stable. The lattice is finite (labels ⊆ params ∪ sources), so
+        this converges; ``max_rounds`` is a safety stop for the
+        pathological mutual-recursion case."""
+        fns = sorted(self.dfa.graph.functions.values(),
+                     key=lambda f: (f.relpath, f.lineno))
+        for _round in range(max_rounds):
+            changed = False
+            for fn in fns:
+                ret, hits = self._analyse(fn)
+                if ret != self.ret_labels.get(fn.key) or \
+                        hits != self.sink_hits.get(fn.key, ()):
+                    changed = True
+                self.ret_labels[fn.key] = ret
+                self.sink_hits[fn.key] = hits
+            if not changed:
+                break
+
+
+# --------------------------------------------------------------------------
+# exception escape sets
+# --------------------------------------------------------------------------
+
+#: builtin exception → parent, enough hierarchy for handler matching
+_BUILTIN_PARENT = {
+    "ConnectionError": "OSError",
+    "ConnectionResetError": "ConnectionError",
+    "ConnectionRefusedError": "ConnectionError",
+    "ConnectionAbortedError": "ConnectionError",
+    "BrokenPipeError": "ConnectionError",
+    "FileNotFoundError": "OSError",
+    "FileExistsError": "OSError",
+    "PermissionError": "OSError",
+    "TimeoutError": "OSError",
+    "IOError": "OSError",
+    "NotADirectoryError": "OSError",
+    "IsADirectoryError": "OSError",
+    "IndexError": "LookupError",
+    "KeyError": "LookupError",
+    "JSONDecodeError": "ValueError",
+    "UnicodeDecodeError": "ValueError",
+    "UnicodeEncodeError": "ValueError",
+    "ZeroDivisionError": "ArithmeticError",
+    "OverflowError": "ArithmeticError",
+    "RecursionError": "RuntimeError",
+    "NotImplementedError": "RuntimeError",
+}
+
+#: these never subclass Exception — a catch-all except Exception
+#: does not stop them
+_NON_EXCEPTION = frozenset({"CancelledError", "SystemExit",
+                            "KeyboardInterrupt", "GeneratorExit",
+                            "BaseException"})
+
+
+def exception_catches(graph: ProgramGraph, caught: str, raised: str) -> bool:
+    """Does ``except <caught>:`` stop a propagating ``raised``?  Name
+    based, walking the package class hierarchy and the builtin table."""
+    if caught == "":  # bare except
+        return True
+    if caught == "BaseException":
+        return True
+    if caught == "Exception":
+        return raised not in _NON_EXCEPTION
+    seen: set[str] = set()
+    frontier = [raised]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        if name == caught:
+            return True
+        parent = _BUILTIN_PARENT.get(name)
+        if parent:
+            frontier.append(parent)
+        for ckey in graph._class_by_name.get(name, ()):
+            frontier.extend(graph.classes[ckey].base_names)
+    return False
+
+
+def _raise_name(node: ast.Raise) -> str | None:
+    exc = node.exc
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    if isinstance(exc, ast.Attribute):
+        return exc.attr
+    if isinstance(exc, ast.Name):
+        return exc.id
+    return None
+
+
+def solve_escapes(dfa: DataflowAnalysis) -> dict[str, dict[str, tuple]]:
+    """Per-function may-escape exception sets, to fixpoint.
+
+    escape(fn) = { explicit raises } ∪ { escapes of resolved callees },
+    each filtered through the ``except`` clauses lexically enclosing
+    the raise/call site. Bare ``raise`` inside a handler re-raises the
+    handler's caught names. Dispatch edges (thread targets) do not
+    propagate — their exceptions surface elsewhere. Each escaping name
+    maps to ``(lineno, via)``: the first site that introduced it
+    (``via`` = callee key when it arrived through a call, None for a
+    local raise)."""
+    graph = dfa.graph
+    # precompute, per function, the raise/call sites with their
+    # enclosing handler-name stacks
+    sites: dict[str, list[tuple[str, object, tuple]]] = {}
+    for fn in graph.functions.values():
+        callee_by_line: dict[int, list[str]] = {}
+        for edge in fn.edges:
+            if not edge.dispatch:
+                callee_by_line.setdefault(edge.lineno, []).append(edge.callee)
+        events: list[tuple[str, object, tuple]] = []
+
+        def walk(node: ast.AST, guards: tuple, handler_of: tuple) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn.node:
+                return
+            if isinstance(node, ast.Try):
+                body_guards = guards + (tuple(
+                    name for h in node.handlers for name in _handler_names(h)),)
+                for child in node.body + node.orelse:
+                    walk(child, body_guards, handler_of)
+                for handler in node.handlers:
+                    names = tuple(_handler_names(handler))
+                    for child in handler.body:
+                        walk(child, guards, names)
+                for child in node.finalbody:
+                    walk(child, guards, handler_of)
+                return
+            if isinstance(node, ast.Raise):
+                if node.exc is None:
+                    for name in handler_of:
+                        events.append(("raise", name or "Exception", guards,
+                                       node.lineno))
+                else:
+                    name = _raise_name(node)
+                    if name:
+                        events.append(("raise", name, guards, node.lineno))
+            if isinstance(node, ast.Call):
+                for key in callee_by_line.get(node.lineno, ()):
+                    events.append(("call", key, guards, node.lineno))
+            if isinstance(node, ast.Assert):
+                events.append(("raise", "AssertionError", guards,
+                               node.lineno))
+            for child in ast.iter_child_nodes(node):
+                walk(child, guards, handler_of)
+
+        for child in ast.iter_child_nodes(fn.node):
+            walk(child, (), ())
+        sites[fn.key] = events
+
+    def filtered(name: str, guards: tuple) -> bool:
+        """True when the exception survives every enclosing guard."""
+        for names in guards:
+            for caught in names:
+                if exception_catches(graph, caught, name):
+                    return False
+        return True
+
+    escapes: dict[str, dict[str, tuple]] = {key: {}
+                                            for key in graph.functions}
+    changed = True
+    rounds = 0
+    while changed and rounds < 50:
+        changed = False
+        rounds += 1
+        for key, events in sites.items():
+            out = escapes[key]
+            for kind, payload, guards, lineno in events:
+                if kind == "raise":
+                    names: list[tuple[str, str | None]] = [(payload, None)]
+                else:
+                    names = [(n, payload)
+                             for n in sorted(escapes.get(payload, ()))]
+                for name, via in names:
+                    if name not in out and filtered(name, guards):
+                        out[name] = (lineno, via)
+                        changed = True
+    return escapes

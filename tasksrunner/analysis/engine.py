@@ -1,23 +1,32 @@
 """File walking, suppression handling, baseline plumbing, and the CLI.
 
-Two phases per run. The **per-file phase** parses each target file and
-runs the ``RULES`` table against its AST, exactly as in PR 4. The
-**whole-program phase** then builds one
+Three phases per run. The **per-file phase** parses each target file
+and runs the ``RULES`` table against its AST, exactly as in PR 4. The
+**whole-program phase** builds one
 :class:`~tasksrunner.analysis.program.ProgramGraph` over the full lint
 target and runs the ``PROGRAM_RULES`` table against it — call-graph,
 lock-graph, and thread-boundary rules that no single file can express.
-Program findings flow through the same suppression, baseline, and
-``--json`` machinery; their extra ``chain`` field lists the call path
-as ``file:line`` frames.
+The **dataflow phase** reuses the same graph, adds per-function CFGs
+and interprocedural taint/escape summaries
+(:mod:`~tasksrunner.analysis.dataflow`), and runs the
+``DATAFLOW_RULES`` table. Program and dataflow findings flow through
+the same suppression, baseline, and ``--json`` machinery; their extra
+``chain`` field lists the source→sink path as ``file:line`` frames.
+Both whole-tree phases cache under the tree digest, independently, so
+editing nothing makes warm runs near-free.
 
 Exit codes: 0 clean, 1 findings, 2 usage error. ``--json`` emits one
 machine-readable document::
 
-    {"version": 2,
+    {"version": 3,
      "findings": [{"rule", "path", "line", "col", "message",
                    "chain", "fingerprint"}, ...],
      "files": N, "suppressed": N, "baselined": N,
      "stale_baseline": [...]}
+
+``--sarif PATH`` additionally writes the post-baseline findings as a
+SARIF 2.1.0 document (:mod:`~tasksrunner.analysis.sarif`) for CI
+annotation upload.
 """
 
 from __future__ import annotations
@@ -33,17 +42,20 @@ from typing import Iterable, TextIO
 from tasksrunner.analysis import baseline as baseline_mod
 from tasksrunner.analysis import rules  # noqa: F401 - populates the tables
 from tasksrunner.analysis.cache import (
+    DATAFLOW_KEY,
     ResultCache,
     ruleset_signature,
     tree_digest,
 )
 from tasksrunner.analysis.core import (
+    DATAFLOW_RULES,
     PROGRAM_RULES,
     RULES,
     SUPPRESS_RE,
     Finding,
     known_rule_ids,
 )
+from tasksrunner.analysis.dataflow import DataflowAnalysis
 from tasksrunner.analysis.program import ProgramGraph
 
 #: repo root = parent of the tasksrunner package
@@ -52,7 +64,7 @@ DEFAULT_TARGET = REPO_ROOT / "tasksrunner"
 DEFAULT_BASELINE = REPO_ROOT / "tasklint-baseline.json"
 DEFAULT_CACHE = REPO_ROOT / ".tasksrunner" / "tasklint-cache.json"
 
-JSON_VERSION = 2
+JSON_VERSION = 3
 
 
 def relpath(path: pathlib.Path) -> str:
@@ -149,14 +161,42 @@ def _program_suppressed(graph: ProgramGraph, finding: Finding) -> bool:
     return False
 
 
+def build_graph(files: list[pathlib.Path]) -> ProgramGraph:
+    return ProgramGraph.build([(p, relpath(p)) for p in files])
+
+
 def lint_program(files: list[pathlib.Path], rule_ids: tuple[str, ...],
+                 graph: ProgramGraph | None = None,
                  ) -> tuple[list[Finding], int]:
-    """Build the ProgramGraph over ``files`` and run the
-    whole-program rules. Returns (findings, suppressed-count)."""
-    graph = ProgramGraph.build([(p, relpath(p)) for p in files])
+    """Build the ProgramGraph over ``files`` (or reuse ``graph``) and
+    run the whole-program rules. Returns (findings, suppressed)."""
+    if graph is None:
+        graph = build_graph(files)
     raw: list[Finding] = []
     for rid in rule_ids:
         raw.extend(PROGRAM_RULES[rid].check(graph))
+    findings: list[Finding] = []
+    suppressed = 0
+    for f in raw:
+        if _program_suppressed(graph, f):
+            suppressed += 1
+        else:
+            findings.append(f)
+    return sorted(findings), suppressed
+
+
+def lint_dataflow(files: list[pathlib.Path], rule_ids: tuple[str, ...],
+                  graph: ProgramGraph | None = None,
+                  ) -> tuple[list[Finding], int]:
+    """Run the dataflow rules over one DataflowAnalysis (shared CFGs
+    and taint/escape summaries). Suppression is chain-aware, exactly
+    like the program phase."""
+    if graph is None:
+        graph = build_graph(files)
+    dfa = DataflowAnalysis(graph)
+    raw: list[Finding] = []
+    for rid in rule_ids:
+        raw.extend(DATAFLOW_RULES[rid].check(dfa))
     findings: list[Finding] = []
     suppressed = 0
     for f in raw:
@@ -173,41 +213,62 @@ def run(paths: list[pathlib.Path], rule_ids: tuple[str, ...], *,
         cache_path: pathlib.Path | None = None,
         json_out: bool = False,
         program_paths: list[pathlib.Path] | None = None,
+        sarif_path: pathlib.Path | None = None,
         out: TextIO | None = None) -> int:
     """``paths`` feeds the per-file phase; ``program_paths`` (default:
-    the same) feeds the whole-program graph — ``--changed`` narrows the
-    former but never the latter, since interprocedural rules are only
-    sound over the full tree."""
+    the same) feeds the whole-program and dataflow graphs —
+    ``--changed`` narrows the former but never the latter, since
+    interprocedural rules are only sound over the full tree."""
     if out is None:  # resolved at call time so redirection works
         out = sys.stdout
     files = iter_py_files(paths)
     file_rules = tuple(r for r in rule_ids if r in RULES)
     program_rules = tuple(r for r in rule_ids if r in PROGRAM_RULES)
+    dataflow_rules = tuple(r for r in rule_ids if r in DATAFLOW_RULES)
     cache = ResultCache(cache_path, ruleset_signature(rule_ids))
     all_findings: list[Finding] = []
     suppressed = 0
     for path in files:
         cached = cache.get(path)
         if cached is not None:
-            all_findings.extend(cached)
+            cfindings, csup = cached
+            all_findings.extend(cfindings)
+            suppressed += csup
             continue
         findings, nsup = lint_file(path, file_rules)
         suppressed += nsup
-        cache.put(path, findings)
+        cache.put(path, findings, nsup)
         all_findings.extend(findings)
 
-    if program_rules:
+    if program_rules or dataflow_rules:
         pfiles = iter_py_files(program_paths) if program_paths is not None \
             else files
         tree_hash = tree_digest(pfiles)
-        cached_prog = cache.get_program(tree_hash)
-        if cached_prog is not None:
-            pfindings, psup = cached_prog
-        else:
-            pfindings, psup = lint_program(pfiles, program_rules)
-            cache.put_program(tree_hash, pfindings, psup)
-        all_findings.extend(pfindings)
-        suppressed += psup
+        graph: ProgramGraph | None = None  # built once, shared by both
+
+        if program_rules:
+            cached_prog = cache.get_program(tree_hash)
+            if cached_prog is not None:
+                pfindings, psup = cached_prog
+            else:
+                graph = graph or build_graph(pfiles)
+                pfindings, psup = lint_program(pfiles, program_rules, graph)
+                cache.put_program(tree_hash, pfindings, psup)
+            all_findings.extend(pfindings)
+            suppressed += psup
+
+        if dataflow_rules:
+            cached_flow = cache.get_program(tree_hash, key=DATAFLOW_KEY)
+            if cached_flow is not None:
+                dfindings, dsup = cached_flow
+            else:
+                graph = graph or build_graph(pfiles)
+                dfindings, dsup = lint_dataflow(pfiles, dataflow_rules,
+                                                graph)
+                cache.put_program(tree_hash, dfindings, dsup,
+                                  key=DATAFLOW_KEY)
+            all_findings.extend(dfindings)
+            suppressed += dsup
 
     cache.save()
     all_findings.sort()
@@ -222,6 +283,17 @@ def run(paths: list[pathlib.Path], rule_ids: tuple[str, ...], *,
               file=out)
         return 0
     fresh, matched, stale = baseline_mod.apply(all_findings, base)
+
+    if sarif_path is not None:
+        from tasksrunner.analysis.sarif import to_sarif
+        table: dict = {}
+        table.update(RULES)
+        table.update(PROGRAM_RULES)
+        table.update(DATAFLOW_RULES)
+        docs = {rid: table[rid].doc for rid in rule_ids if rid in table}
+        sarif_path.parent.mkdir(parents=True, exist_ok=True)
+        sarif_path.write_text(
+            json.dumps(to_sarif(fresh, docs), indent=2) + "\n")
 
     if json_out:
         json.dump({
@@ -316,6 +388,10 @@ def build_parser() -> argparse.ArgumentParser:
                              "target (cached, so warm runs are cheap)")
     parser.add_argument("--json", action="store_true", dest="json_out",
                         help="machine-readable findings on stdout")
+    parser.add_argument("--sarif", type=pathlib.Path, default=None,
+                        metavar="PATH",
+                        help="also write post-baseline findings as a "
+                             "SARIF 2.1.0 document (for CI annotations)")
     parser.add_argument("--baseline", type=pathlib.Path,
                         default=DEFAULT_BASELINE,
                         help="grandfathered-findings file "
@@ -337,9 +413,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.list_rules:
         table = dict(RULES)
         table.update(PROGRAM_RULES)
+        table.update(DATAFLOW_RULES)
         width = max(len(r) for r in table)
         for rid in sorted(table):
-            kind = "program" if rid in PROGRAM_RULES else "file"
+            kind = "program" if rid in PROGRAM_RULES else \
+                "dataflow" if rid in DATAFLOW_RULES else "file"
             print(f"{rid:<{width}}  [{kind}] {table[rid].doc}")
         return 0
     if args.rules:
@@ -371,7 +449,8 @@ def main(argv: list[str] | None = None) -> int:
                update_baseline=args.update_baseline,
                cache_path=None if args.no_cache else args.cache,
                json_out=args.json_out,
-               program_paths=program_paths)
+               program_paths=program_paths,
+               sarif_path=args.sarif)
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -1,9 +1,11 @@
-"""Rule modules — importing this package populates ``core.RULES``
-and ``core.PROGRAM_RULES``.
+"""Rule modules — importing this package populates ``core.RULES``,
+``core.PROGRAM_RULES``, and ``core.DATAFLOW_RULES``.
 
 Import order note: the whole-program modules (transitive, lockgraph,
 threadshared, routes) import :mod:`tasksrunner.analysis.program`,
-which reuses the blocking-call tables from :mod:`.blocking`.
+which reuses the blocking-call tables from :mod:`.blocking`; the
+dataflow modules (secrettaint, lifetime, cancelsafety, exflow) import
+:mod:`tasksrunner.analysis.dataflow` on top of that.
 """
 
 from __future__ import annotations
@@ -11,12 +13,16 @@ from __future__ import annotations
 from tasksrunner.analysis.rules import (  # noqa: F401
     actors,
     blocking,
+    cancelsafety,
     coroutines,
     envflags,
+    exflow,
+    lifetime,
     lockgraph,
     locks,
     metricnames,
     routes,
+    secrettaint,
     taxonomy,
     threadshared,
     transitive,

@@ -1,0 +1,248 @@
+"""cancellation-safety — teardown must survive task cancellation.
+
+Three asyncio hazards, all of which have bitten real runtimes:
+
+* **await-in-finally** — when the task is cancelled, the first bare
+  ``await`` inside a ``finally`` raises ``CancelledError`` immediately
+  and the rest of the cleanup never runs (the socket stays open, the
+  lease stays held). Safe forms: ``await asyncio.shield(...)``,
+  ``await asyncio.wait_for(...)``, or a local
+  ``try/except CancelledError`` around the await — catching the *new*
+  CancelledError raised at that point does not swallow the one already
+  propagating.
+
+* **swallowed CancelledError** — an ``except CancelledError`` (alone
+  or in a tuple) whose body neither re-raises nor is the *reap idiom*
+  (``x.cancel()`` earlier in the same function, then
+  ``try: await x / except CancelledError: pass`` — awaiting a task you
+  just cancelled yourself is how asyncio says "collect the corpse").
+  Anywhere else, eating CancelledError turns cooperative shutdown into
+  a hang.
+
+* **acquire without finally-release** — ``await x.acquire()`` paired
+  with an ``x.release()`` that does not sit in a ``finally`` suite: a
+  cancellation between the two leaks the lock/lease forever. Use
+  ``async with`` or move the release into ``finally``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from tasksrunner.analysis.core import Finding, register_dataflow, DataflowRule
+from tasksrunner.analysis.dataflow import (
+    DataflowAnalysis,
+    FunctionInfo,
+    _handler_names,
+)
+
+_SAFE_AWAIT_WRAPPERS = frozenset({"asyncio.shield", "asyncio.wait_for"})
+
+
+def _own_nodes(fn_node: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested defs."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _catches_cancel(handler: ast.ExceptHandler) -> bool:
+    names = set(_handler_names(handler))
+    return bool(names & {"CancelledError", "", "BaseException"})
+
+
+def _expr_text(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.9+
+        return ""
+
+
+@register_dataflow
+class CancellationSafetyRule(DataflowRule):
+    id = "cancellation-safety"
+    doc = ("finally-blocks must not await unshielded, CancelledError "
+           "must not be swallowed outside the cancel-then-reap idiom, "
+           "and acquire() needs its release() in a finally")
+
+    def check(self, dfa: DataflowAnalysis) -> Iterable[Finding]:
+        for fn in sorted(dfa.graph.functions.values(),
+                         key=lambda f: (f.relpath, f.lineno)):
+            yield from self._await_in_finally(dfa, fn)
+            yield from self._swallowed_cancel(dfa, fn)
+            yield from self._acquire_release(dfa, fn)
+
+    # -- (a) await inside finally ------------------------------------------
+
+    def _await_in_finally(self, dfa: DataflowAnalysis,
+                          fn: FunctionInfo) -> Iterable[Finding]:
+        if not fn.is_async:
+            return
+        for node in _own_nodes(fn.node):
+            if not isinstance(node, ast.Try) or not node.finalbody:
+                continue
+            for finding in self._scan_finally(dfa, fn, node.finalbody,
+                                              guarded=False):
+                yield finding
+
+    def _scan_finally(self, dfa: DataflowAnalysis, fn: FunctionInfo,
+                      stmts: list, guarded: bool) -> Iterator[Finding]:
+        for stmt in stmts:
+            yield from self._scan_node(dfa, fn, stmt, guarded)
+
+    def _scan_node(self, dfa: DataflowAnalysis, fn: FunctionInfo,
+                   node: ast.AST, guarded: bool) -> Iterator[Finding]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.Try):
+            inner_guarded = guarded or any(
+                _catches_cancel(h) for h in node.handlers)
+            yield from self._scan_finally(
+                dfa, fn, node.body + node.orelse, inner_guarded)
+            for handler in node.handlers:
+                yield from self._scan_finally(dfa, fn, handler.body, guarded)
+            yield from self._scan_finally(dfa, fn, node.finalbody, guarded)
+            return
+        if isinstance(node, ast.Await) and not guarded:
+            value = node.value
+            wrapped = isinstance(value, ast.Call) and \
+                dfa.resolve_dotted(fn, value.func) in _SAFE_AWAIT_WRAPPERS
+            if not wrapped:
+                yield Finding(
+                    path=fn.relpath, line=node.lineno, col=1,
+                    rule=self.id,
+                    message=(f"await in finally of {fn.qualname} aborts "
+                             "cleanup when the task is cancelled — wrap "
+                             "in asyncio.shield()/wait_for() or catch "
+                             "CancelledError around it"),
+                    chain=(f"{fn.relpath}:{fn.lineno}",
+                           f"{fn.relpath}:{node.lineno}"))
+                return
+        for child in ast.iter_child_nodes(node):
+            yield from self._scan_node(dfa, fn, child, guarded)
+
+    # -- (b) swallowed CancelledError --------------------------------------
+
+    def _swallowed_cancel(self, dfa: DataflowAnalysis,
+                          fn: FunctionInfo) -> Iterable[Finding]:
+        cancelled = self._cancelled_exprs(fn)
+        for node in _own_nodes(fn.node):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if "CancelledError" not in _handler_names(handler):
+                    continue  # bare/BaseException: the coroutines rule's job
+                if any(isinstance(n, ast.Raise)
+                       for stmt in handler.body for n in ast.walk(stmt)):
+                    continue
+                if self._is_reap(node, cancelled):
+                    continue
+                yield Finding(
+                    path=fn.relpath, line=handler.lineno, col=1,
+                    rule=self.id,
+                    message=(f"{fn.qualname} swallows CancelledError "
+                             "without re-raising — shutdown will hang; "
+                             "re-raise it (the cancel-then-reap idiom "
+                             "is recognised and exempt)"),
+                    chain=(f"{fn.relpath}:{fn.lineno}",
+                           f"{fn.relpath}:{handler.lineno}"))
+
+    def _cancelled_exprs(self, fn: FunctionInfo) -> set[str]:
+        """Textual forms of every expression this function calls
+        ``.cancel()`` on (``self._task``, ``task``...)."""
+        out: set[str] = set()
+        for node in _own_nodes(fn.node):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "cancel" and not node.args:
+                text = _expr_text(node.func.value)
+                if text:
+                    out.add(text)
+        return out
+
+    def _is_reap(self, try_node: ast.Try, cancelled: set[str]) -> bool:
+        """``try: await X`` where ``X.cancel()`` happens in the same
+        function — awaiting a task you cancelled is the documented way
+        to wait for it to actually die."""
+        for stmt in try_node.body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Await):
+                    target = node.value
+                    if isinstance(target, ast.Call):
+                        # asyncio.gather(*tasks) / wait_for(task, ...)
+                        inner = [a.value if isinstance(a, ast.Starred) else a
+                                 for a in target.args]
+                    else:
+                        inner = [target]
+                    for expr in inner:
+                        if _expr_text(expr) in cancelled:
+                            return True
+        return False
+
+    # -- (c) acquire without finally-release -------------------------------
+
+    def _acquire_release(self, dfa: DataflowAnalysis,
+                         fn: FunctionInfo) -> Iterable[Finding]:
+        acquires: dict[str, int] = {}
+        releases: dict[str, list[tuple[int, bool]]] = {}
+        # a release in a finally is safe; so is one in an except handler
+        # that re-raises — the checkout idiom (release the permit on
+        # failure, hold it past the return on success for a later
+        # checkin) intentionally has no release on the happy path
+        safe_lines = self._finally_linenos(fn) | self._reraise_linenos(fn)
+        for node in _own_nodes(fn.node):
+            if isinstance(node, ast.Await) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Attribute) \
+                    and node.value.func.attr == "acquire":
+                acquires.setdefault(
+                    _expr_text(node.value.func.value), node.lineno)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "release":
+                text = _expr_text(node.func.value)
+                releases.setdefault(text, []).append(
+                    (node.lineno, node.lineno in safe_lines))
+        for text, lineno in sorted(acquires.items(), key=lambda kv: kv[1]):
+            sites = releases.get(text)
+            if not sites or any(in_finally for _l, in_finally in sites):
+                continue  # no release here (owner elsewhere) or safe
+            yield Finding(
+                path=fn.relpath, line=lineno, col=1, rule=self.id,
+                message=(f"{text}.acquire() in {fn.qualname} releases at "
+                         f"line {sites[0][0]} outside a finally — a "
+                         "cancellation in between leaks the lock; use "
+                         "async with or try/finally"),
+                chain=(f"{fn.relpath}:{lineno}",
+                       f"{fn.relpath}:{sites[0][0]}"))
+
+    def _finally_linenos(self, fn: FunctionInfo) -> set[int]:
+        lines: set[int] = set()
+        for node in _own_nodes(fn.node):
+            if isinstance(node, ast.Try):
+                for stmt in node.finalbody:
+                    lines.update(range(stmt.lineno,
+                                       (stmt.end_lineno or stmt.lineno) + 1))
+        return lines
+
+    def _reraise_linenos(self, fn: FunctionInfo) -> set[int]:
+        """Line ranges of except-handler bodies that end in a bare
+        ``raise`` (failure-cleanup blocks)."""
+        lines: set[int] = set()
+        for node in _own_nodes(fn.node):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if any(isinstance(n, ast.Raise) and n.exc is None
+                       for stmt in handler.body for n in ast.walk(stmt)):
+                    for stmt in handler.body:
+                        lines.update(range(
+                            stmt.lineno, (stmt.end_lineno or stmt.lineno) + 1))
+        return lines

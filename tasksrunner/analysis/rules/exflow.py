@@ -1,0 +1,118 @@
+"""exception-flow — route handlers may only escape taxonomy types.
+
+The static twin of the chaos harness (module 16): chaos *injects*
+faults at runtime and asserts the error surface stays typed; this rule
+*computes* the surface. For every aiohttp route handler (``@routes
+.verb`` decorated, or registered through ``router.add_*``), the
+interprocedural escape sets from :func:`~tasksrunner.analysis.dataflow
+.solve_escapes` give the exception types that can reach the route
+boundary. The sidecar's ``_traced`` wrapper translates
+``TasksRunnerError`` subclasses to their ``http_status`` and
+``json.JSONDecodeError`` to 400 — anything else becomes a raw 500
+with a stack trace in the log, which is exactly the "it just blew up"
+behaviour the errors.py taxonomy exists to prevent.
+
+Allowed at the boundary: the errors.py taxonomy (and its in-package
+subclasses), aiohttp's ``HTTPException`` family (web-layer redirects
+and 4xx raised on purpose), ``JSONDecodeError`` (mapped to 400), and
+``CancelledError`` (the client went away — aiohttp handles it). Every
+other escaping type is a finding whose chain walks handler → call →
+leaf ``raise``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tasksrunner.analysis.core import Finding, register_dataflow, DataflowRule
+from tasksrunner.analysis.dataflow import DataflowAnalysis, FunctionInfo
+
+_VERBS = frozenset({"get", "post", "put", "delete", "patch", "head",
+                    "options", "route", "view"})
+_ADD_VERBS = frozenset({"add_get", "add_post", "add_put", "add_delete",
+                        "add_patch", "add_head", "add_route", "add_view"})
+
+#: escaping these at the boundary is fine (translated or intentional)
+_BOUNDARY_OK = frozenset({"JSONDecodeError", "CancelledError",
+                          "StopAsyncIteration"})
+
+
+def _taxonomy(dfa: DataflowAnalysis) -> frozenset:
+    """Names of errors.py classes plus their in-package subclasses."""
+    graph = dfa.graph
+    allowed: set[str] = set()
+    for cinfo in graph.classes.values():
+        if cinfo.relpath == "tasksrunner/errors.py":
+            allowed.add(cinfo.name)
+    grew = True
+    while grew:
+        grew = False
+        for cinfo in graph.classes.values():
+            if cinfo.name not in allowed and \
+                    any(b in allowed for b in cinfo.base_names):
+                allowed.add(cinfo.name)
+                grew = True
+    return frozenset(allowed)
+
+
+def _route_handlers(dfa: DataflowAnalysis) -> list[FunctionInfo]:
+    """Functions declared as HTTP route handlers: decorator form
+    (``@routes.post(...)``, ``@x.route(...)``) or registration form
+    (``router.add_get("/p", handler)``)."""
+    graph = dfa.graph
+    handlers: dict[str, FunctionInfo] = {}
+    for fn in graph.functions.values():
+        for dec in getattr(fn.node, "decorator_list", []):
+            if isinstance(dec, ast.Call) \
+                    and isinstance(dec.func, ast.Attribute) \
+                    and dec.func.attr in _VERBS:
+                handlers[fn.key] = fn
+    for mod in graph.modules.values():
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _ADD_VERBS):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    fn = mod.functions.get(arg.id)
+                    if fn is not None:
+                        handlers[fn.key] = fn
+                elif isinstance(arg, ast.Attribute) \
+                        and isinstance(arg.value, ast.Name):
+                    # self.handler / obj.handler registrations
+                    for cinfo in mod.classes.values():
+                        hit = cinfo.methods.get(arg.attr)
+                        if hit is not None:
+                            handlers[hit.key] = hit
+    return sorted(handlers.values(), key=lambda f: (f.relpath, f.lineno))
+
+
+@register_dataflow
+class ExceptionFlowRule(DataflowRule):
+    id = "exception-flow"
+    doc = ("route handlers may only let errors.py taxonomy types (or "
+           "web.HTTPException) escape — anything else surfaces as a "
+           "raw 500")
+
+    def check(self, dfa: DataflowAnalysis) -> Iterable[Finding]:
+        allowed = _taxonomy(dfa)
+        for fn in _route_handlers(dfa):
+            escapes = dfa.escapes.get(fn.key, {})
+            for name in sorted(escapes):
+                if name in allowed or name in _BOUNDARY_OK:
+                    continue
+                if name.startswith("HTTP"):  # web.HTTPNotFound & co
+                    continue
+                lineno, _via = escapes[name]
+                chain = (f"{fn.relpath}:{fn.lineno}",) + \
+                    dfa.escape_chain(fn.key, name)
+                yield Finding(
+                    path=fn.relpath, line=lineno, col=1, rule=self.id,
+                    message=(f"route handler {fn.qualname} may raise "
+                             f"{name}, which is outside the errors.py "
+                             "taxonomy — the sidecar will answer a raw "
+                             "500; translate it to a TasksRunnerError "
+                             "subclass"),
+                    chain=chain)

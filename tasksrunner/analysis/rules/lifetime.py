@@ -1,0 +1,308 @@
+"""resource-lifetime — closable objects must be released on all paths.
+
+A *resource* is the result of a call that hands the function something
+it must give back: an in-package constructor whose class defines
+``close``/``aclose``/``cancel``/``stop`` (mesh connections, frame
+writers, span recorders), a known stdlib factory (``sqlite3.connect``,
+``asyncio.open_connection``, ``open``, sockets), or
+``asyncio.create_task``. The CFG-based pass tracks each acquisition
+along every path and reports the explicit ``return``/``raise`` (or
+fall-off-the-end) through which a still-held resource leaks.
+
+A resource stops being the function's problem when it is **released**
+(a ``close``/``aclose``/``cancel``/``stop``-style call, or awaiting a
+task to completion), **context-managed** (``with``/``async with`` on
+the acquisition — never held at all), or **escapes to an owner** (returned,
+yielded, stored into an attribute/subscript/container, or passed as a
+call argument — the mesh pool appending a connection, the orchestrator
+tracking a supervisor task). Exceptional paths are reported only for
+*explicit* ``raise`` statements: modelling "any call may throw" would
+drown the tree in paths Python programmers handle with outer
+try/finally blocks they can see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tasksrunner.analysis.core import Finding, register_dataflow, DataflowRule
+from tasksrunner.analysis.dataflow import (
+    Bind,
+    Block,
+    DataflowAnalysis,
+    FunctionInfo,
+    NestedDef,
+    run_forward,
+)
+
+#: stdlib factories whose result must be closed
+_FACTORIES = {
+    "sqlite3.connect": "sqlite3 connection",
+    "asyncio.open_connection": "asyncio stream pair",
+    "socket.create_connection": "socket",
+    "socket.socket": "socket",
+    "open": "file handle",
+    "asyncio.create_task": "task",
+}
+
+_RELEASE_METHODS = frozenset({"close", "aclose", "cancel", "stop",
+                              "shutdown", "release", "terminate", "join",
+                              "wait_closed", "unlink", "detach",
+                              "close_now"})
+
+#: reserved state key → frozenset of rids released/escaped on some path
+_KILLED = "\0killed"
+
+
+def _kill(state: dict, res: "_Resource") -> None:
+    """Release/escape: drop every alias and remember the rid so the
+    join does not resurrect it from a sibling path."""
+    for other in [k for k, v in state.items()
+                  if k != _KILLED and v.rid == res.rid]:
+        state.pop(other, None)
+    state[_KILLED] = state.get(_KILLED, frozenset()) | {res.rid}
+
+
+def _unwrap_await(expr: ast.AST) -> ast.AST:
+    return expr.value if isinstance(expr, ast.Await) else expr
+
+
+class _Resource:
+    """Identity is the acquisition site, so the fixpoint's state
+    comparison is stable across repeated transfer runs."""
+
+    __slots__ = ("rid", "lineno", "desc")
+
+    def __init__(self, rid: tuple, lineno: int, desc: str):
+        self.rid = rid  # (lineno, col) of the acquiring statement
+        self.lineno = lineno
+        self.desc = desc
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, _Resource) and self.rid == other.rid
+
+    def __hash__(self) -> int:
+        return hash(self.rid)
+
+
+@register_dataflow
+class ResourceLifetimeRule(DataflowRule):
+    id = "resource-lifetime"
+    doc = ("objects with close/aclose/cancel must be released, "
+           "context-managed, or handed to an owner on every "
+           "return/raise path")
+
+    def check(self, dfa: DataflowAnalysis) -> Iterable[Finding]:
+        for fn in sorted(dfa.graph.functions.values(),
+                         key=lambda f: (f.relpath, f.lineno)):
+            yield from self._check_fn(dfa, fn)
+
+    # -- acquisition --------------------------------------------------------
+
+    def _acquired(self, dfa: DataflowAnalysis, fn: FunctionInfo,
+                  expr: ast.AST) -> str | None:
+        """Resource description when ``expr`` is an acquiring call."""
+        expr = _unwrap_await(expr)
+        if not isinstance(expr, ast.Call):
+            return None
+        dotted = dfa.resolve_dotted(fn, expr.func)
+        if dotted in _FACTORIES:
+            return _FACTORIES[dotted]
+        mod = dfa.module(fn)
+        cinfo = dfa.graph._class_of_call(mod, expr)
+        if cinfo is not None:
+            for method in ("close", "aclose", "cancel", "stop"):
+                if dfa.graph._method(cinfo, method) is not None:
+                    return f"{cinfo.name} (defines {method}())"
+        return None
+
+    # -- the per-function pass ---------------------------------------------
+
+    def _check_fn(self, dfa: DataflowAnalysis,
+                  fn: FunctionInfo) -> Iterable[Finding]:
+        cfg = dfa.cfg(fn)
+
+        def transfer_events(events, state: dict, upto=None) -> dict:
+            """state: name → _Resource. Returns the post-state;
+            ``upto`` stops *after* processing that event (exit nodes)."""
+            state = dict(state)
+            for event in events:
+                self._event(dfa, fn, event, state)
+                if upto is not None and event is upto:
+                    break
+            return state
+
+        def transfer(block: Block, state_in: dict) -> dict:
+            return transfer_events(block.events, state_in)
+
+        def join(a: dict, b: dict) -> dict:
+            # may-hold union — but a release/escape observed on *any*
+            # merged path kills the resource on all of them. That is
+            # what makes ``if conn is not None: conn.close()`` in a
+            # finally (the None branch is exactly the never-acquired
+            # path) and ``for ...: owner.append(conn)`` (the zero-
+            # iteration edge) precise instead of false positives.
+            merged = dict(a)
+            merged.update({k: v for k, v in b.items() if k not in merged})
+            killed = a.get(_KILLED, frozenset()) | b.get(_KILLED, frozenset())
+            merged = {k: v for k, v in merged.items()
+                      if k == _KILLED or v.rid not in killed}
+            if killed:
+                merged[_KILLED] = killed
+            return merged
+
+        states = run_forward(cfg, {}, transfer, join)
+        seen: set[tuple[int, int, str]] = set()
+        for exit_ in cfg.exits:
+            if exit_.block not in states:
+                continue
+            block = cfg.blocks[exit_.block]
+            state = transfer_events(block.events, states[exit_.block],
+                                    upto=exit_.node)
+            for name, res in sorted(state.items()):
+                if name == _KILLED:
+                    continue
+                marker = (res.lineno, exit_.lineno, exit_.kind)
+                if marker in seen:
+                    continue
+                seen.add(marker)
+                verb = {"return": "the return at line",
+                        "raise": "the raise at line",
+                        "fall": "falling off the end at line"}[exit_.kind]
+                yield Finding(
+                    path=fn.relpath, line=res.lineno, col=1, rule=self.id,
+                    message=(f"{res.desc} acquired in {fn.qualname} is "
+                             f"not released on {verb} {exit_.lineno} — "
+                             "close it in a finally, use a with-block, "
+                             "or hand it to a tracked owner"),
+                    chain=(f"{fn.relpath}:{res.lineno}",
+                           f"{fn.relpath}:{exit_.lineno}"))
+
+    # -- transfer -----------------------------------------------------------
+
+    def _event(self, dfa: DataflowAnalysis, fn: FunctionInfo, event,
+               state: dict) -> None:
+        if isinstance(event, NestedDef):
+            # a closure reading a held name takes (shared) ownership —
+            # cli-style ``async def main(): ... await host.stop()``
+            for node in ast.walk(event.node):
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Load):
+                    res = state.get(node.id)
+                    if res is not None:
+                        _kill(state, res)
+            return
+        if isinstance(event, Bind):
+            # ``with ACQ() as x`` / ``async with`` — context-managed,
+            # never held; a with on a *held* name releases it
+            if event.kind == "with" and event.value is not None:
+                base = _unwrap_await(event.value)
+                if isinstance(base, ast.Name) and base.id in state \
+                        and base.id != _KILLED:
+                    _kill(state, state[base.id])
+                self._escape_uses(event.value, state, skip_value=base)
+            return
+        if isinstance(event, (ast.Assign, ast.AnnAssign)):
+            value = event.value
+            if value is None:
+                return
+            targets = event.targets if isinstance(event, ast.Assign) \
+                else [event.target]
+            desc = self._acquired(dfa, fn, value)
+            names: list[str] = []
+            if desc is not None:
+                for tgt in targets:
+                    if isinstance(tgt, ast.Name):
+                        names.append(tgt.id)
+                    elif isinstance(tgt, (ast.Tuple, ast.List)):
+                        names.extend(e.id for e in tgt.elts
+                                     if isinstance(e, ast.Name))
+                    else:
+                        desc = None  # stored straight into an owner
+                        break
+            self._escape_uses(value, state)
+            self._releases(dfa, fn, value, state)
+            inner = _unwrap_await(value)
+            if isinstance(inner, ast.Name) and inner.id in state \
+                    and inner.id != _KILLED and any(
+                    not isinstance(t, ast.Name) for t in targets):
+                _kill(state, state[inner.id])  # self.x = conn: owner store
+            if desc is not None and names:
+                res = _Resource((event.lineno, event.col_offset),
+                                event.lineno, desc)
+                killed = state.get(_KILLED, frozenset())
+                if res.rid in killed:
+                    # re-acquisition at the same site (loop body after a
+                    # release) — live again
+                    state[_KILLED] = killed - {res.rid}
+                for name in names:
+                    state[name] = res
+            else:
+                for tgt in targets:
+                    if isinstance(tgt, ast.Name):
+                        state.pop(tgt.id, None)  # rebound, not released
+            return
+        if isinstance(event, ast.Return):
+            if event.value is not None:
+                self._releases(dfa, fn, event.value, state)
+                for node in ast.walk(event.value):
+                    if isinstance(node, ast.Name) and node.id in state \
+                            and node.id != _KILLED:
+                        _kill(state, state[node.id])  # returned = escaped
+            return
+        if isinstance(event, ast.Delete):
+            for tgt in event.targets:
+                if isinstance(tgt, ast.Name):
+                    state.pop(tgt.id, None)
+            return
+        # generic statement: releases, then escapes
+        self._releases(dfa, fn, event, state)
+        self._escape_uses(event, state)
+
+    def _releases(self, dfa: DataflowAnalysis, fn: FunctionInfo,
+                  tree: ast.AST, state: dict) -> None:
+        """``x.close()`` / ``await x`` / ``x.cancel()`` — drop every
+        name sharing the released resource."""
+        def drop(name: str) -> None:
+            res = state.get(name)
+            if res is not None and name != _KILLED:
+                _kill(state, res)
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _RELEASE_METHODS \
+                    and isinstance(node.func.value, ast.Name):
+                drop(node.func.value.id)
+            elif isinstance(node, ast.Await) \
+                    and isinstance(node.value, ast.Name):
+                drop(node.value.id)  # awaited to completion (tasks)
+
+    def _escape_uses(self, tree: ast.AST, state: dict,
+                     skip_value: ast.AST | None = None) -> None:
+        """A held name passed as a call argument, yielded, or placed in
+        a container/attribute/subscript store escapes to an owner."""
+        for node in ast.walk(tree):
+            args: list[ast.AST] = []
+            if isinstance(node, ast.Call):
+                args = list(node.args) + [kw.value for kw in node.keywords]
+            elif isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+                args = list(node.elts)
+            elif isinstance(node, ast.Dict):
+                args = [v for v in node.values if v is not None]
+            elif isinstance(node, (ast.Yield, ast.YieldFrom)) \
+                    and node.value is not None:
+                args = [node.value]
+            elif isinstance(node, ast.Starred):
+                args = [node.value]
+            elif isinstance(node, ast.Lambda):
+                args = [n for n in ast.walk(node.body)
+                        if isinstance(n, ast.Name)]
+            for arg in args:
+                if arg is skip_value:
+                    continue
+                inner = _unwrap_await(arg)
+                if isinstance(inner, ast.Name) and inner.id in state \
+                        and inner.id != _KILLED:
+                    _kill(state, state[inner.id])

@@ -1,0 +1,217 @@
+"""secret-taint — no credential may reach an observable sink unredacted.
+
+The runtime handles four kinds of secret: values resolved from
+``tasksrunner/secrets`` stores, the API tokens (env, header, and the
+orchestrator-issued per-app tokens), TLS key material from the mesh
+PKI, and any env flag declared ``secret=True`` in
+:data:`tasksrunner.envflag.FLAGS`. None of them may flow into a log
+call, a metric label, a span record, or an HTTP *error* body unless it
+first passes :func:`tasksrunner.security.redact` (or ``hash_token``,
+whose digests are what sidecars legitimately store and compare).
+
+The flow itself is solved by :class:`~tasksrunner.analysis.dataflow.
+TaintEngine` — this module only supplies the policy (sources, sinks,
+sanitizers) and turns the engine's sink hits into findings whose chain
+walks source → intermediate calls → sink.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from tasksrunner.analysis.core import Finding, register_dataflow, DataflowRule
+from tasksrunner.analysis.dataflow import (
+    DataflowAnalysis,
+    FunctionInfo,
+    TaintEngine,
+    TaintSpec,
+)
+from tasksrunner.envflag import FLAGS
+
+#: env names whose values are credentials (the inventory's secret
+#: flags; TASKSRUNNER_API_TOKEN today)
+SECRET_ENV = frozenset(n for n, f in FLAGS.items() if f.secret)
+
+#: header names (lowercased) that carry tokens
+SECRET_HEADERS = frozenset({"authorization", "tr-api-token",
+                            "proxy-authorization"})
+
+#: methods on secrets stores/resolvers whose results are secret values
+_SECRET_METHODS = frozenset({"resolve_value", "resolve_metadata",
+                             "get", "bulk", "keys"})
+
+#: unresolved attribute calls distinctive enough to trust by name
+_SECRET_ATTR_CALLS = frozenset({"resolve_value", "resolve_metadata",
+                                "private_bytes", "load_pem_private_key"})
+
+_LOG_METHODS = frozenset({"debug", "info", "warning", "error",
+                          "exception", "critical", "log"})
+_METRIC_METHODS = frozenset({"inc", "set_gauge", "observe",
+                             "observe_many", "recorder", "labels"})
+_SPAN_METHODS = frozenset({"set_attribute"})
+
+
+def _module_constant(engine: TaintEngine, fn: FunctionInfo,
+                     name: str) -> str | None:
+    """Resolve ``NAME`` to its module-level string constant, following
+    one ``from x import NAME`` hop (``TOKEN_HEADER`` etc.)."""
+    mod = engine.dfa.module(fn)
+    for target in (mod, None):
+        if target is None:
+            fq = mod.imports.get(name)
+            if not fq or "." not in fq:
+                return None
+            owner, _, name = fq.rpartition(".")
+            target = engine.dfa.graph.by_modname.get(owner)
+            if target is None:
+                return None
+        for node in target.tree.body:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == name:
+                        return node.value.value
+    return None
+
+
+def _literal(engine: TaintEngine, fn: FunctionInfo,
+             expr: ast.AST) -> str | None:
+    """String value of an expression: literal, module constant, or
+    either with a trailing ``.lower()``/``.upper()``."""
+    if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute) \
+            and expr.func.attr in ("lower", "upper") and not expr.args:
+        inner = _literal(engine, fn, expr.func.value)
+        return inner.lower() if inner is not None else None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, ast.Name):
+        return _module_constant(engine, fn, expr.id)
+    return None
+
+
+class SecretTaintSpec(TaintSpec):
+    def source(self, engine: TaintEngine, fn: FunctionInfo,
+               call: ast.Call) -> str | None:
+        func = call.func
+        # resolved call into the secrets package
+        for key in engine._callee_keys(fn, call):
+            callee = engine.dfa.graph.functions.get(key)
+            if callee is not None \
+                    and callee.relpath.startswith("tasksrunner/secrets/") \
+                    and callee.name in _SECRET_METHODS:
+                return f"secret store {callee.qualname}()"
+        if isinstance(func, ast.Attribute):
+            if func.attr in _SECRET_ATTR_CALLS:
+                return f".{func.attr}() result"
+            # request.headers.get("authorization" | TOKEN_HEADER)
+            if func.attr == "get" and isinstance(func.value, ast.Attribute) \
+                    and func.value.attr == "headers" and call.args:
+                header = _literal(engine, fn, call.args[0])
+                if header and header.lower() in SECRET_HEADERS:
+                    return f"{header} header"
+            # os.environ.get(SECRET_ENV) / os.getenv(...)
+            dotted = engine.dfa.resolve_dotted(fn, func)
+            if dotted in ("os.environ.get", "os.getenv") and call.args:
+                env = _literal(engine, fn, call.args[0])
+                if env in SECRET_ENV:
+                    return f"secret env {env}"
+            # freshly minted token material (per-app tokens et al.)
+            if dotted in ("secrets.token_hex", "secrets.token_bytes",
+                          "secrets.token_urlsafe"):
+                return f"{dotted}() token"
+        return None
+
+    def source_expr(self, engine: TaintEngine, fn: FunctionInfo,
+                    expr: ast.AST) -> str | None:
+        # request.headers[TOKEN_HEADER] / os.environ[SECRET]
+        if isinstance(expr, ast.Subscript):
+            base = expr.value
+            key = _literal(engine, fn, expr.slice)
+            if key is None:
+                return None
+            if isinstance(base, ast.Attribute) and base.attr == "headers" \
+                    and key.lower() in SECRET_HEADERS:
+                return f"{key} header"
+            dotted = engine.dfa.resolve_dotted(fn, base)
+            if dotted == "os.environ" and key in SECRET_ENV:
+                return f"secret env {key}"
+        return None
+
+    def sink(self, engine: TaintEngine, fn: FunctionInfo,
+             call: ast.Call) -> str | None:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            base = func.value
+            if func.attr in _LOG_METHODS and isinstance(base, ast.Name) \
+                    and ("log" in base.id.lower() or base.id == "logging"):
+                return "logging call"
+            if func.attr in _LOG_METHODS:
+                dotted = engine.dfa.resolve_dotted(fn, func)
+                if dotted and dotted.startswith("logging."):
+                    return "logging call"
+            if func.attr in _SPAN_METHODS:
+                return "span attribute"
+            if func.attr in _METRIC_METHODS:
+                for key in engine._callee_keys(fn, call):
+                    callee = engine.dfa.graph.functions.get(key)
+                    if callee is not None and callee.relpath.startswith(
+                            "tasksrunner/observability/"):
+                        return "metric label"
+                if func.attr == "labels":
+                    return "metric label"
+        name = func.id if isinstance(func, ast.Name) else None
+        if name == "record_span":
+            return "span record"
+        if name == "_json_error":
+            return "HTTP error body"
+        if name == "json_response" or (
+                isinstance(func, ast.Attribute)
+                and func.attr == "json_response"):
+            for kw in call.keywords:
+                if kw.arg == "status" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, int) \
+                        and kw.value.value >= 400:
+                    return "HTTP error body"
+        return None
+
+    def sanitizer(self, engine: TaintEngine, fn: FunctionInfo,
+                  call: ast.Call) -> bool:
+        func = call.func
+        name = func.attr if isinstance(func, ast.Attribute) else \
+            func.id if isinstance(func, ast.Name) else None
+        if name in ("redact", "hash_token"):
+            return True
+        for key in engine._callee_keys(fn, call):
+            if key in ("tasksrunner/security.py::redact",
+                       "tasksrunner/security.py::hash_token"):
+                return True
+        return False
+
+
+@register_dataflow
+class SecretTaintRule(DataflowRule):
+    id = "secret-taint"
+    doc = ("secrets (store values, tokens, key material, secret env "
+           "flags) must pass redact()/hash_token() before any log, "
+           "metric, span, or HTTP error body")
+
+    def check(self, dfa: DataflowAnalysis) -> Iterable[Finding]:
+        engine = TaintEngine(dfa, SecretTaintSpec())
+        engine.solve()
+        for fn in sorted(dfa.graph.functions.values(),
+                         key=lambda f: (f.relpath, f.lineno)):
+            for hit in engine.sink_hits.get(fn.key, ()):
+                for label in sorted(lb for lb in hit.labels
+                                    if lb[0] == "SECRET"):
+                    _, src_path, src_line, src_desc = label
+                    chain = (f"{src_path}:{src_line}",
+                             f"{fn.relpath}:{hit.lineno}") + hit.tail
+                    yield Finding(
+                        path=fn.relpath, line=hit.lineno, col=1,
+                        rule=self.id,
+                        message=(f"{src_desc} (from {src_path}:{src_line}) "
+                                 f"reaches {hit.desc} in {fn.qualname} "
+                                 "without redact()"),
+                        chain=chain)

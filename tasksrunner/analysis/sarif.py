@@ -1,0 +1,87 @@
+"""SARIF 2.1.0 emitter — tasklint findings as CI-consumable results.
+
+One run object, one ``tool.driver`` listing every rule that executed
+(so viewers can show the rule docs), one result per finding. The
+``chain`` of interprocedural findings becomes a ``codeFlow`` —
+GitHub's SARIF viewer renders it as a step-through path from the async
+entry (or taint source) to the offending leaf. ``partialFingerprints``
+carries the same line-number-free fingerprint the baseline uses, so CI
+annotation dedup survives unrelated edits.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from tasksrunner.analysis.core import Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _location(path: str, line: int, col: int = 1) -> dict:
+    return {
+        "physicalLocation": {
+            "artifactLocation": {"uri": path, "uriBaseId": "SRCROOT"},
+            "region": {"startLine": max(line, 1),
+                       "startColumn": max(col, 1)},
+        },
+    }
+
+
+def _code_flow(finding: Finding) -> dict:
+    locations = []
+    for frame in finding.chain:
+        rel, _, line = frame.rpartition(":")
+        if not rel or not line.isdigit():
+            continue
+        locations.append({
+            "location": dict(_location(rel, int(line)),
+                             message={"text": frame}),
+        })
+    return {"threadFlows": [{"locations": locations}]}
+
+
+def to_sarif(findings: Iterable[Finding], rule_docs: dict[str, str]) -> dict:
+    """One SARIF document for one lint run. ``rule_docs`` maps every
+    executed rule id to its one-line doc (drives the driver.rules
+    metadata; ids seen only in findings are added defensively)."""
+    findings = list(findings)
+    docs = dict(rule_docs)
+    for f in findings:
+        docs.setdefault(f.rule, "")
+    rule_ids = sorted(docs)
+    index = {rid: i for i, rid in enumerate(rule_ids)}
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [_location(f.path, f.line, f.col)],
+            "partialFingerprints": {"tasklint/v1": f.fingerprint()},
+        }
+        if f.chain:
+            result["codeFlows"] = [_code_flow(f)]
+        results.append(result)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "tasklint",
+                    "informationUri": ("https://github.com/tasksrunner/"
+                                       "tasksrunner"),
+                    "rules": [{
+                        "id": rid,
+                        "shortDescription": {"text": docs[rid] or rid},
+                    } for rid in rule_ids],
+                },
+            },
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }

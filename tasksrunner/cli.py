@@ -104,10 +104,9 @@ def _cmd_host(args) -> None:
               f"sidecar_port={host.sidecar_port}", flush=True)
         try:
             await asyncio.Event().wait()
-        except asyncio.CancelledError:
-            pass
         finally:
-            await host.stop()
+            # Ctrl-C cancels this task; the stop must still complete
+            await asyncio.shield(host.stop())
 
     _run_until_interrupt(main())
 
@@ -133,8 +132,8 @@ def _cmd_serve(args) -> None:
         try:
             await asyncio.Event().wait()
         finally:
-            await app.shutdown()
-            await runner.cleanup()
+            await asyncio.shield(app.shutdown())
+            await asyncio.shield(runner.cleanup())
 
     _run_until_interrupt(main())
 
@@ -169,7 +168,7 @@ def _cmd_sidecar(args) -> None:
         finally:
             resolver.unregister(args.app_id, pid=os.getpid(),
                                 sidecar_port=sidecar.port)
-            await sidecar.stop()
+            await asyncio.shield(sidecar.stop())
 
     _run_until_interrupt(main())
 

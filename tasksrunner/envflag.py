@@ -30,10 +30,14 @@ class Flag:
     kind: str      # "bool" | "int" | "float" | "string" | "path" | "enum" | "json"
     default: str   # human-readable default ("on"/"off" for bools)
     doc: str
+    #: the value is a credential: the tasklint secret-taint rule treats
+    #: env reads of it as taint sources (never logged unredacted)
+    secret: bool = False
 
 
-def _f(name: str, kind: str, default: str, doc: str) -> tuple[str, Flag]:
-    return name, Flag(name, kind, default, doc)
+def _f(name: str, kind: str, default: str, doc: str,
+       *, secret: bool = False) -> tuple[str, Flag]:
+    return name, Flag(name, kind, default, doc, secret)
 
 
 #: every TASKSRUNNER_* variable any part of the repo reads. Keep the
@@ -61,7 +65,8 @@ FLAGS: dict[str, Flag] = dict([
     _f("TASKSRUNNER_ADMISSION_MAX_QUEUE_DEPTH", "int", "512",
        "state/broker write-queue depth at which the score reaches 1.0"),
     _f("TASKSRUNNER_API_TOKEN", "string", "unset",
-       "bearer token the sidecar and admin APIs require when set"),
+       "bearer token the sidecar and admin APIs require when set",
+       secret=True),
     _f("TASKSRUNNER_APP_ID", "string", "unset",
        "app-id grants are evaluated against (injected by the orchestrator)"),
     _f("TASKSRUNNER_BENCH_TPU_FORCE", "bool", "off",

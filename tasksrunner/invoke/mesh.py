@@ -662,10 +662,11 @@ class MeshServer:
             for task in inflight:
                 task.cancel()
             if fw is not None:
-                await fw.aclose()
+                # stop() cancels this handler; the close must still run
+                await asyncio.shield(fw.aclose())
             writer.close()
             try:
-                await writer.wait_closed()
+                await asyncio.shield(writer.wait_closed())
             except (ConnectionError, OSError):  # pragma: no cover
                 pass
 

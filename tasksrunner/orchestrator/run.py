@@ -848,4 +848,5 @@ async def run_from_config(config: RunConfig) -> None:
     try:
         await orch.wait()
     finally:
-        await orch.stop()
+        # ctrl-c cancels us mid-wait; the children must still be reaped
+        await asyncio.shield(orch.stop())

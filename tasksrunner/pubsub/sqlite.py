@@ -511,8 +511,10 @@ class SqliteBroker(PubSubBroker):
                 await task
             except asyncio.CancelledError:
                 # broker.aclose() may have force-cancelled the poll loop
-                # already (shared broker, multiple runtimes)
-                pass
+                # already (shared broker, multiple runtimes) — reap it;
+                # but if *we* were cancelled while waiting, propagate
+                if not task.cancelled():
+                    raise
 
         return Subscription(topic=topic, group=group, _cancel=cancel)
 

@@ -177,7 +177,10 @@ class RedisClient:
         conn = RedisConnection(self.host, self.port)
         try:
             await conn.connect()
-        except Exception:
+        except BaseException:
+            # BaseException: a cancellation here must not leak the
+            # permit or the half-open socket either
+            conn.close_now()
             self._sem.release()
             raise
         self._all.append(conn)

@@ -161,6 +161,22 @@ def grants_from_env() -> AppGrants | None:
         "TASKSRUNNER_APP_ID", "?"))
 
 
+def redact(value: object) -> str:
+    """Collapse a secret to a loggable marker: length plus a truncated
+    sha256, so two log lines can still be correlated ("same token?")
+    without the value ever leaving the process.
+
+    This is the **designated sanitizer** of the tasklint secret-taint
+    rule: a value read from a secret store, a token header, or TLS key
+    material may only reach a log call, a metric label, a span
+    attribute, or an HTTP error body after passing through here (or
+    :func:`hash_token`, for full digests that sidecars compare)."""
+    import hashlib
+
+    data = value if isinstance(value, bytes) else str(value).encode()
+    return f"<redacted len={len(data)} sha256:{hashlib.sha256(data).hexdigest()[:8]}>"
+
+
 def hash_token(token: str) -> str:
     """sha256 hex digest of a peer token — what sidecars store and
     compare so plaintext peer tokens never leave their own replica."""

@@ -107,7 +107,7 @@ class ReplicationServer:
         finally:
             writer.close()
             try:
-                await writer.wait_closed()
+                await asyncio.shield(writer.wait_closed())
             except (ConnectionError, OSError):  # pragma: no cover
                 pass
 

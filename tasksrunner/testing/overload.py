@@ -280,7 +280,7 @@ async def run_overload_drill(
                     break  # hysteresis exited; trajectory complete
                 await asyncio.sleep(0.2)
     finally:
-        await orch.stop()
+        await asyncio.shield(orch.stop())
 
     result["acked"] = len(acked)
     durable = await asyncio.to_thread(stored_keys, db_path)

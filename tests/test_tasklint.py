@@ -343,7 +343,7 @@ def test_json_output_schema(tmp_path):
     rc = run([target], ALL_RULES, json_out=True, out=out)
     assert rc == 1
     doc = json.loads(out.getvalue())
-    assert doc["version"] == 2
+    assert doc["version"] == 3
     assert doc["files"] == 1
     assert isinstance(doc["suppressed"], int)
     assert isinstance(doc["baselined"], int)
@@ -365,13 +365,14 @@ def test_cache_roundtrip_and_invalidation(tmp_path):
     cache_file = tmp_path / "cache.json"
     cache = ResultCache(cache_file, sig)
     assert cache.get(target) is None
-    findings, _ = lint_file(target, ALL_RULES)
-    cache.put(target, findings)
+    findings, nsup = lint_file(target, ALL_RULES)
+    cache.put(target, findings, nsup)
     cache.save()
 
-    # fresh instance: hit, identical findings
+    # fresh instance: hit, identical findings + suppressed count (the
+    # summary line must not drift between cold and warm runs)
     cache2 = ResultCache(cache_file, sig)
-    assert cache2.get(target) == findings
+    assert cache2.get(target) == (findings, nsup)
     assert cache2.hits == 1
 
     # content change invalidates (the sha1 is authoritative; see

@@ -1,0 +1,511 @@
+"""Dataflow tasklint: CFG rules + SARIF + cache mechanics.
+
+Same two-layer shape as test_tasklint_program.py: seeded-bad-code
+fixtures prove each dataflow rule fires (and stays quiet on the
+healthy variant — including the idioms that bit the first cut of each
+rule: guarded releases in a finally, closure-owned resources, the
+cancel-then-reap pattern, connection-checkout ownership transfer), and
+the mechanics tests pin the phase contracts — chain-aware suppression,
+the SARIF 2.1.0 round trip, the deleted-file cache prune, and the
+wall-time budget over the real tree.
+"""
+
+import io
+import json
+import pathlib
+import sys
+import textwrap
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tasksrunner.analysis.cache import ResultCache, ruleset_signature
+from tasksrunner.analysis.core import DATAFLOW_RULES
+from tasksrunner.analysis.dataflow import DataflowAnalysis
+from tasksrunner.analysis.engine import (
+    DEFAULT_TARGET, _program_suppressed, run,
+)
+from tasksrunner.analysis.program import ProgramGraph
+
+DATAFLOW_ONLY = tuple(sorted(DATAFLOW_RULES))
+
+
+def _dataflow(tmp_path, sources, rules=DATAFLOW_ONLY):
+    """Run the dataflow rules over ``sources`` ({relpath: code}) with
+    controlled relpaths, through the real suppression filter."""
+    files = []
+    for name, src in sources.items():
+        path = tmp_path / name
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+        files.append((path, name))
+    graph = ProgramGraph.build(files)
+    dfa = DataflowAnalysis(graph)
+    raw = []
+    for rid in rules:
+        raw.extend(DATAFLOW_RULES[rid].check(dfa))
+    findings = sorted(f for f in raw if not _program_suppressed(graph, f))
+    return findings, len(raw) - len(findings)
+
+
+# -- secret-taint -------------------------------------------------------
+
+
+TAINT_BAD = """\
+import logging
+import os
+
+logger = logging.getLogger("x")
+
+
+def boom():
+    token = os.environ.get("TASKSRUNNER_API_TOKEN")
+    logger.info("auth token is %s", token)
+"""
+
+
+def test_secret_taint_env_to_log(tmp_path):
+    findings, _ = _dataflow(tmp_path, {"mod.py": TAINT_BAD},
+                            rules=("secret-taint",))
+    (f,) = findings
+    assert f.rule == "secret-taint"
+    assert (f.path, f.line) == ("mod.py", 9)  # the logger.info sink
+    assert "TASKSRUNNER_API_TOKEN" in f.message
+    assert "redact()" in f.message
+    # chain: source -> sink
+    assert f.chain == ("mod.py:8", "mod.py:9")
+
+
+def test_secret_taint_interprocedural_chain(tmp_path):
+    """The secret enters in the caller, the sink lives in the callee —
+    the finding is reported at the *call site* with the callee's sink
+    frame appended to the chain."""
+    findings, _ = _dataflow(tmp_path, {
+        "creds.py": """\
+            import os
+
+
+            def fetch_token():
+                return os.environ.get("TASKSRUNNER_API_TOKEN")
+            """,
+        "app.py": """\
+            import logging
+
+            from creds import fetch_token
+
+            logger = logging.getLogger("x")
+
+
+            def log_it(value):
+                logger.warning("got %s", value)
+
+
+            def boom():
+                log_it(fetch_token())
+            """,
+    }, rules=("secret-taint",))
+    (f,) = findings
+    assert f.path == "app.py" and f.line == 13  # log_it(fetch_token())
+    # chain: the env read in creds.py, the call site, the callee's sink
+    assert f.chain[0].startswith("creds.py:")
+    assert "app.py:13" in f.chain
+    assert any(frame == "app.py:9" for frame in f.chain)  # callee sink
+
+
+def test_secret_taint_sanitizer_interposed(tmp_path):
+    clean = TAINT_BAD.replace("logger.info(\"auth token is %s\", token)",
+                              "logger.info(\"auth %s\", redact(token))")
+    findings, _ = _dataflow(tmp_path, {"mod.py": clean},
+                            rules=("secret-taint",))
+    assert findings == []
+
+
+def test_secret_taint_len_is_not_a_leak(tmp_path):
+    clean = TAINT_BAD.replace("logger.info(\"auth token is %s\", token)",
+                              "logger.info(\"%d bytes\", len(token))")
+    findings, _ = _dataflow(tmp_path, {"mod.py": clean},
+                            rules=("secret-taint",))
+    assert findings == []
+
+
+def test_secret_taint_suppression_on_sink_and_chain_line(tmp_path):
+    # on the sink line
+    src = TAINT_BAD.replace(
+        "logger.info(\"auth token is %s\", token)",
+        "logger.info(\"auth token is %s\", token)"
+        "  # tasklint: disable=secret-taint")
+    findings, suppressed = _dataflow(tmp_path, {"mod.py": src},
+                                     rules=("secret-taint",))
+    assert findings == [] and suppressed == 1
+    # on the *source* line (chain-aware suppression)
+    src = TAINT_BAD.replace(
+        'token = os.environ.get("TASKSRUNNER_API_TOKEN")',
+        'token = os.environ.get("TASKSRUNNER_API_TOKEN")'
+        "  # tasklint: disable=secret-taint")
+    findings, suppressed = _dataflow(tmp_path / "b", {"mod.py": src},
+                                     rules=("secret-taint",))
+    assert findings == [] and suppressed == 1
+
+
+# -- resource-lifetime --------------------------------------------------
+
+
+LEAK_BAD = """\
+import sqlite3
+
+
+def leak(flag):
+    conn = sqlite3.connect("db")
+    if flag:
+        return None
+    conn.close()
+    return True
+"""
+
+
+def test_lifetime_reports_the_leaking_early_return(tmp_path):
+    findings, _ = _dataflow(tmp_path, {"mod.py": LEAK_BAD},
+                            rules=("resource-lifetime",))
+    (f,) = findings
+    assert f.rule == "resource-lifetime"
+    assert f.line == 5  # the acquisition
+    assert "the return at line 7" in f.message  # names the leaking path
+    assert f.chain == ("mod.py:5", "mod.py:7")
+
+
+def test_lifetime_reports_raise_path_for_inpackage_class(tmp_path):
+    findings, _ = _dataflow(tmp_path, {"mod.py": """\
+        class Conn:
+            async def aclose(self):
+                pass
+
+
+        def leak():
+            c = Conn()
+            raise ValueError("boom")
+        """}, rules=("resource-lifetime",))
+    (f,) = findings
+    assert "Conn" in f.message and "aclose" in f.message
+    assert "the raise at line 8" in f.message
+
+
+def test_lifetime_clean_variants(tmp_path):
+    """with-block, finally-close, owner hand-off, and return-the-
+    resource all discharge the obligation."""
+    findings, _ = _dataflow(tmp_path, {"mod.py": """\
+        import sqlite3
+
+
+        def ctx():
+            with sqlite3.connect("db") as conn:
+                conn.execute("select 1")
+
+
+        def fin():
+            conn = sqlite3.connect("db")
+            try:
+                conn.execute("select 1")
+            finally:
+                conn.close()
+
+
+        def owner(pool):
+            conn = sqlite3.connect("db")
+            pool.append(conn)
+
+
+        def transfer():
+            return sqlite3.connect("db")
+        """}, rules=("resource-lifetime",))
+    assert findings == []
+
+
+def test_lifetime_guarded_release_in_finally_is_clean(tmp_path):
+    """``if conn is not None: conn.close()`` in a finally — the None
+    branch is exactly the never-acquired path, not a leak."""
+    findings, _ = _dataflow(tmp_path, {"mod.py": """\
+        import sqlite3
+
+
+        def loop(items):
+            conn = None
+            try:
+                for item in items:
+                    if conn is None:
+                        conn = sqlite3.connect("db")
+                    conn.execute("insert")
+            finally:
+                if conn is not None:
+                    conn.close()
+        """}, rules=("resource-lifetime",))
+    assert findings == []
+
+
+def test_lifetime_closure_capture_is_ownership(tmp_path):
+    """A nested def that closes over the resource (the CLI's
+    ``async def main(): ... await host.stop()`` shape) owns it."""
+    findings, _ = _dataflow(tmp_path, {"mod.py": """\
+        import sqlite3
+
+
+        def hold(runner):
+            conn = sqlite3.connect("db")
+
+            def closer():
+                conn.close()
+
+            runner(closer)
+        """}, rules=("resource-lifetime",))
+    assert findings == []
+
+
+# -- cancellation-safety ------------------------------------------------
+
+
+def test_cancel_await_in_finally_fires_and_shield_is_safe(tmp_path):
+    findings, _ = _dataflow(tmp_path, {"mod.py": """\
+        import asyncio
+
+
+        async def bad(server):
+            try:
+                await asyncio.sleep(1)
+            finally:
+                await server.stop()
+
+
+        async def good(server):
+            try:
+                await asyncio.sleep(1)
+            finally:
+                await asyncio.shield(server.stop())
+
+
+        async def guarded(server):
+            try:
+                await asyncio.sleep(1)
+            finally:
+                try:
+                    await server.stop()
+                except asyncio.CancelledError:
+                    raise
+        """}, rules=("cancellation-safety",))
+    (f,) = findings
+    assert f.line == 8 and "await in finally" in f.message
+    assert "bad" in f.message
+
+
+def test_cancel_swallow_fires_and_reap_idiom_is_exempt(tmp_path):
+    findings, _ = _dataflow(tmp_path, {"mod.py": """\
+        import asyncio
+
+
+        async def bad(task):
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+
+
+        async def reap(task):
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        """}, rules=("cancellation-safety",))
+    (f,) = findings  # only bad() fires; reap() at line 13 is exempt
+    assert f.line == 7 and "swallows CancelledError" in f.message
+    assert f.message.startswith("bad ")
+
+
+def test_cancel_acquire_release_placement(tmp_path):
+    findings, _ = _dataflow(tmp_path, {"mod.py": """\
+        async def bad(lock, work):
+            await lock.acquire()
+            await work()
+            lock.release()
+
+
+        async def good(lock, work):
+            await lock.acquire()
+            try:
+                await work()
+            finally:
+                lock.release()
+
+
+        async def checkout(sem, dial):
+            await sem.acquire()
+            try:
+                conn = await dial()
+            except BaseException:
+                sem.release()
+                raise
+            return conn
+        """}, rules=("cancellation-safety",))
+    (f,) = findings
+    assert f.line == 2 and "outside a finally" in f.message
+
+
+# -- exception-flow -----------------------------------------------------
+
+
+ROUTES_PRELUDE = """\
+class _Routes:
+    def get(self, path):
+        def deco(fn):
+            return fn
+        return deco
+
+
+routes = _Routes()
+"""
+
+
+def test_exflow_reports_untyped_escape_with_chain(tmp_path):
+    findings, _ = _dataflow(tmp_path, {"app.py": ROUTES_PRELUDE + """\
+
+
+@routes.get("/boom")
+async def handler(request):
+    helper()
+
+
+def helper():
+    raise ValueError("nope")
+"""}, rules=("exception-flow",))
+    (f,) = findings
+    assert f.rule == "exception-flow"
+    assert "handler" in f.message and "ValueError" in f.message
+    # chain walks handler def -> call site -> the leaf raise
+    assert f.chain[0] == "app.py:12"  # the handler def
+    assert f.chain[-1] == "app.py:17"  # the leaf raise in helper
+
+
+def test_exflow_taxonomy_and_cancel_are_allowed(tmp_path):
+    findings, _ = _dataflow(tmp_path, {
+        "tasksrunner/errors.py": """\
+            class AppError(Exception):
+                http_status = 400
+            """,
+        "app.py": ROUTES_PRELUDE + """\
+
+
+import asyncio
+
+from tasksrunner.errors import AppError
+
+
+@routes.get("/typed")
+async def handler(request):
+    raise AppError("known")
+
+
+@routes.get("/gone")
+async def handler2(request):
+    raise asyncio.CancelledError()
+"""}, rules=("exception-flow",))
+    assert findings == []
+
+
+def test_exflow_handler_catching_locally_is_clean(tmp_path):
+    findings, _ = _dataflow(tmp_path, {"app.py": ROUTES_PRELUDE + """\
+
+
+@routes.get("/safe")
+async def handler(request):
+    try:
+        helper()
+    except ValueError:
+        return None
+
+
+def helper():
+    raise ValueError("nope")
+"""}, rules=("exception-flow",))
+    assert findings == []
+
+
+# -- mechanics: SARIF, cache prune, budget ------------------------------
+
+
+def test_sarif_round_trip(tmp_path):
+    target = tmp_path / "mod.py"
+    target.write_text(TAINT_BAD)
+    sarif_path = tmp_path / "out.sarif"
+    rc = run([target], DATAFLOW_ONLY, json_out=True, out=io.StringIO(),
+             baseline_path=tmp_path / "baseline.json",
+             sarif_path=sarif_path)
+    assert rc == 1
+    doc = json.loads(sarif_path.read_text())
+    assert doc["version"] == "2.1.0"
+    (run_obj,) = doc["runs"]
+    driver = run_obj["tool"]["driver"]
+    assert driver["name"] == "tasklint"
+    rule_ids = [r["id"] for r in driver["rules"]]
+    assert rule_ids == sorted(rule_ids)
+    assert "secret-taint" in rule_ids
+    (result,) = run_obj["results"]
+    assert result["ruleId"] == "secret-taint"
+    assert rule_ids[result["ruleIndex"]] == "secret-taint"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["region"]["startLine"] == 9
+    assert result["partialFingerprints"]["tasklint/v1"]
+    # the source->sink chain became a codeFlow
+    steps = result["codeFlows"][0]["threadFlows"][0]["locations"]
+    lines = [s["location"]["physicalLocation"]["region"]["startLine"]
+             for s in steps]
+    assert lines == [8, 9]
+
+    # green tree -> empty results, rules still listed
+    target.write_text("x = 1\n")
+    rc = run([target], DATAFLOW_ONLY, out=io.StringIO(),
+             baseline_path=tmp_path / "baseline.json",
+             sarif_path=sarif_path)
+    assert rc == 0
+    doc = json.loads(sarif_path.read_text())
+    assert doc["runs"][0]["results"] == []
+    assert [r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]]
+
+
+def test_cache_prunes_deleted_file_entries(tmp_path):
+    """Regression: entries for deleted/renamed sources used to live in
+    the cache forever (save() only sweeps old-signature rows)."""
+    target = tmp_path / "mod.py"
+    target.write_text("x = 1\n")
+    cache_file = tmp_path / "cache.json"
+    sig = ruleset_signature(("blocking-call-in-async",))
+
+    cache = ResultCache(cache_file, sig)
+    cache.put(target, [])
+    cache.put_program("treehash", [], 0)
+    cache.save()
+    assert str(target) in json.loads(cache_file.read_text())
+
+    target.unlink()
+    reloaded = ResultCache(cache_file, sig)
+    assert str(target) not in reloaded._table  # pruned on load
+    assert "__program__" in reloaded._table   # reserved keys survive
+    reloaded.save()                           # prune marked it dirty
+    on_disk = json.loads(cache_file.read_text())
+    assert str(target) not in on_disk
+    assert "__program__" in on_disk
+
+
+def test_dataflow_zero_findings_and_wall_time_budget(tmp_path):
+    """The tree must stay clean under the dataflow rules with an empty
+    baseline, cold under 30s and tree-digest-warm under 5s."""
+    cache_file = tmp_path / "cache.json"
+    t0 = time.perf_counter()
+    rc = run([DEFAULT_TARGET], DATAFLOW_ONLY, cache_path=cache_file,
+             baseline_path=tmp_path / "baseline.json", out=io.StringIO())
+    cold = time.perf_counter() - t0
+    assert rc == 0
+    t0 = time.perf_counter()
+    rc = run([DEFAULT_TARGET], DATAFLOW_ONLY, cache_path=cache_file,
+             baseline_path=tmp_path / "baseline.json", out=io.StringIO())
+    warm = time.perf_counter() - t0
+    assert rc == 0
+    assert cold < 30.0, f"cold dataflow lint took {cold:.1f}s"
+    assert warm < 5.0, f"warm dataflow lint took {warm:.1f}s"

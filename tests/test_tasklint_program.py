@@ -453,7 +453,7 @@ def test_run_emits_program_findings_with_chain_in_json(tmp_path):
     rc = run([target], ("transitive-blocking",), json_out=True, out=out)
     assert rc == 1
     doc = json.loads(out.getvalue())
-    assert doc["version"] == 2
+    assert doc["version"] == 3
     (finding,) = doc["findings"]
     assert finding["rule"] == "transitive-blocking"
     assert len(finding["chain"]) == 3
@@ -475,7 +475,8 @@ def test_program_phase_uses_tree_digest_cache(tmp_path):
     rc2, text2 = _run()
     assert (rc1, rc2) == (1, 1)
     assert "cached" not in text1
-    assert "2 cached" in text2  # one per-file hit + the program entry
+    # one per-file hit + the program entry + the dataflow entry
+    assert "3 cached" in text2
 
     # any content change invalidates the tree digest
     target.write_text(PROG_BAD + "# trailing comment\n")
@@ -513,7 +514,7 @@ def test_content_hash_invalidates_same_size_touch_r(tmp_path):
     findings, _ = engine.lint_file(target, ("blocking-call-in-async",))
     cache.put(target, findings)
     cache.save()
-    assert ResultCache(cache_file, sig).get(target) == findings
+    assert ResultCache(cache_file, sig).get(target) == (findings, 0)
 
     target.write_text(bad2)
     os.utime(target, ns=(stat.st_atime_ns, stat.st_mtime_ns))
