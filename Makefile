@@ -1,6 +1,6 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test lint lint-program lint-dataflow lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-overload bench-actors bench-repl bench-mesh chaos sweep-flash run validate docs-serve docs-build clean
+.PHONY: test lint lint-program lint-dataflow lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-overload bench-actors bench-repl bench-mesh bench-ml-serve chaos sweep-flash run validate docs-serve docs-build clean
 
 test: lint lint-program lint-dataflow
 	python -m pytest tests/ -q
@@ -87,6 +87,14 @@ bench-repl:
 bench-mesh:
 	python -m pytest tests/test_mesh_fastpath.py tests/test_mesh.py -q -m "not slow"
 	python bench.py --mesh-bench
+
+# ML serving plane: the batcher test matrix (flush discipline, bucket
+# jit cache, error isolation, shed, warmup backoff), then continuous
+# batching vs batch-of-one through the real service plus the
+# admission-protected flood drill
+bench-ml-serve:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_ml_batching.py -q -m "not slow"
+	JAX_PLATFORMS=cpu python bench.py --ml-serve-bench
 
 # chaos verification: the deterministic fault-injection harness, the
 # faulty-broker convergence soak, and the proof that the disabled gate

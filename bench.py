@@ -1803,6 +1803,292 @@ def run_mesh_bench() -> dict:
 
 
 # ---------------------------------------------------------------------------
+# ML serving plane: continuous batching vs batch-of-one (`--ml-serve-bench`)
+# ---------------------------------------------------------------------------
+
+def _ml_hist_rows(before: dict, after: dict, name: str) -> dict:
+    """Per-bucket p50/p99 rows for one histogram, from a snapshot diff
+    (so each lane reports only its own observations despite the
+    process-wide registry)."""
+    from tasksrunner.observability.metrics import estimate_percentile
+
+    hist = after.get(name)
+    if not hist:
+        return {}
+    prior = {
+        tuple(sorted(s["labels"].items())): s
+        for s in before.get(name, {}).get("series", [])
+    }
+    rows = {}
+    for series in hist["series"]:
+        prev = prior.get(tuple(sorted(series["labels"].items())))
+        counts = [c - (prev["counts"][i] if prev else 0)
+                  for i, c in enumerate(series["counts"])]
+        count = series["count"] - (prev["count"] if prev else 0)
+        if count <= 0:
+            continue
+        rows[series["labels"].get("bucket", "all")] = {
+            "count": count,
+            "p50_ms": round(estimate_percentile(
+                hist["bounds"], counts, 0.5) * 1000, 3),
+            "p99_ms": round(estimate_percentile(
+                hist["bounds"], counts, 0.99) * 1000, 3),
+        }
+    return rows
+
+
+async def _ml_serve_lane(n_requests: int, concurrency: int,
+                         env: dict[str, str]) -> dict:
+    """One serving lane: the real priority-scorer app on an in-proc
+    cluster, ``n_requests`` POST /score calls from ``concurrency``
+    workers over sidecar invoke, every response checked against its
+    request's taskId."""
+    from tasksrunner import App, InProcCluster
+    from tasksrunner.component.spec import parse_component
+    from tasksrunner.ml import service as ml_service
+    from tasksrunner.observability.metrics import metrics
+
+    prior = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        specs = [
+            parse_component({"componentType": "state.in-memory"},
+                            default_name="scores"),
+            parse_component({"componentType": "pubsub.in-memory"},
+                            default_name="taskspubsub"),
+        ]
+        cluster = InProcCluster(specs)
+        scorer = ml_service.make_app()
+        driver = App("bench-driver")
+        cluster.add_app(scorer)
+        cluster.add_app(driver)
+        await cluster.start()  # returns with warmup done (on_startup ran)
+        try:
+            client = cluster.client("bench-driver")
+            stats0 = (await client.invoke_method(
+                "priority-scorer", "ml/stats", http_method="GET")).json()
+            hists0 = metrics.snapshot_histograms()
+            latencies: list[float] = []
+            mismatches = 0
+
+            async def worker(w: int) -> None:
+                nonlocal mismatches
+                for i in range(n_requests // concurrency):
+                    task_id = f"t-{w}-{i}"
+                    t0 = time.perf_counter()
+                    resp = await client.invoke_method(
+                        "priority-scorer", "score",
+                        data={"taskId": task_id,
+                              "taskName": f"bench task {w} {i} "
+                                          + "word " * (i % 7)})
+                    latencies.append(time.perf_counter() - t0)
+                    if resp.status != 200 or resp.json().get("taskId") != task_id:
+                        mismatches += 1
+
+            wall0 = time.perf_counter()
+            await asyncio.gather(*(worker(w) for w in range(concurrency)))
+            wall = time.perf_counter() - wall0
+            stats1 = (await client.invoke_method(
+                "priority-scorer", "ml/stats", http_method="GET")).json()
+            hists1 = metrics.snapshot_histograms()
+            latencies.sort()
+            done = len(latencies)
+            return {
+                "requests": done,
+                "concurrency": concurrency,
+                "req_per_sec": round(done / wall, 1),
+                "latency_p50_ms": round(latencies[done // 2] * 1000, 2),
+                "latency_p99_ms": round(latencies[int(done * 0.99)] * 1000, 2),
+                "response_mismatches": mismatches,
+                "jit_cache_size_after_warmup": stats0["jit_cache_size"],
+                "jit_cache_size_after_load": stats1["jit_cache_size"],
+                "recompiles": stats1["jit_cache_size"] - stats0["jit_cache_size"],
+                "batches": stats1["batches"],
+                "shed": stats1["shed"],
+                "queue_wait_per_bucket": _ml_hist_rows(
+                    hists0, hists1, "ml_queue_wait_seconds"),
+                "service_time_per_bucket": _ml_hist_rows(
+                    hists0, hists1, "ml_infer_latency_seconds"),
+            }
+        finally:
+            await cluster.stop()
+    finally:
+        for key, value in prior.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
+async def _ml_flood_drill(max_queue: int, max_delay_ms: float, *,
+                          concurrency: int = 64,
+                          duration_s: float = 2.5,
+                          ramp_s: float = 0.6) -> dict:
+    """Admission-protected flood: sustain more offered load than the
+    queue admits, assert the overflow sheds 429+Retry-After and the
+    p99 queue wait of the *served* requests stays bounded by the
+    assembly budget plus the device time of the batches ahead.
+
+    The wait histogram is snapshotted ``ramp_s`` into the flood so the
+    bound is checked against steady state — the opening convoy (every
+    worker's first request lands on one event-loop tick) measures loop
+    scheduling, not batch assembly. The admitted queue is pinned at
+    ``max_queue`` throughout, so every flush is full-size: an admitted
+    request waits at most its own assembly window plus
+    ``ceil(max_queue / max_batch) + 1`` batch executions (the ``+1``
+    is the batch holding the device when it arrives). Histogram bounds
+    are powers of two, so the p99 estimate can overstate the true wait
+    by up to 2x — the check compares against the bound scaled by that
+    resolution factor (both numbers are reported raw)."""
+    from tasksrunner import App, InProcCluster
+    from tasksrunner.component.spec import parse_component
+    from tasksrunner.ml import service as ml_service
+    from tasksrunner.observability.metrics import metrics
+
+    env = {"TASKSRUNNER_ML_MAX_QUEUE": str(max_queue),
+           "TASKSRUNNER_ML_MAX_DELAY_MS": str(max_delay_ms)}
+    prior = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        specs = [
+            parse_component({"componentType": "state.in-memory"},
+                            default_name="scores"),
+            parse_component({"componentType": "pubsub.in-memory"},
+                            default_name="taskspubsub"),
+        ]
+        cluster = InProcCluster(specs)
+        scorer = ml_service.make_app()
+        driver = App("bench-driver")
+        cluster.add_app(scorer)
+        cluster.add_app(driver)
+        await cluster.start()
+        try:
+            client = cluster.client("bench-driver")
+            loop = asyncio.get_running_loop()
+            stop_at = loop.time() + duration_s
+            served = shed = other = 0
+            retry_afters: set[str] = set()
+            hists0 = metrics.snapshot_histograms()
+
+            async def ramp_snapshot() -> None:
+                nonlocal hists0
+                await asyncio.sleep(ramp_s)
+                hists0 = metrics.snapshot_histograms()
+
+            async def worker(w: int) -> None:
+                nonlocal served, shed, other
+                i = 0
+                while loop.time() < stop_at:
+                    resp = await client.invoke_method(
+                        "priority-scorer", "score",
+                        data={"taskId": f"flood-{w}-{i}",
+                              "taskName": f"flood {w} {i}"})
+                    i += 1
+                    if resp.status == 200:
+                        served += 1
+                    elif resp.status == 429:
+                        shed += 1
+                        ra = (resp.headers.get("Retry-After")
+                              or resp.headers.get("retry-after"))
+                        if ra is not None:
+                            retry_afters.add(ra)
+                        # a shed response completes without touching the
+                        # network, so a hot retry loop would never yield
+                        # the event loop — back off briefly, the way a
+                        # Retry-After-honoring client would (scaled down
+                        # to keep the flood sustained)
+                        await asyncio.sleep(0.002)
+                    else:
+                        other += 1
+
+            await asyncio.gather(ramp_snapshot(),
+                                 *(worker(w) for w in range(concurrency)))
+            hists1 = metrics.snapshot_histograms()
+            waits = _ml_hist_rows(hists0, hists1, "ml_queue_wait_seconds")
+            infer = _ml_hist_rows(hists0, hists1, "ml_infer_latency_seconds")
+            p99_wait = max((r["p99_ms"] for r in waits.values()), default=0.0)
+            p50_wait = max((r["p50_ms"] for r in waits.values()), default=0.0)
+            p99_infer = max((r["p99_ms"] for r in infer.values()), default=0.0)
+            from tasksrunner.ml.batching import BatcherConfig
+            max_batch = BatcherConfig.from_env().max_batch
+            depth = -(-max_queue // max_batch) + 1
+            bound_ms = max_delay_ms + depth * p99_infer
+            return {
+                "flooded": served + shed + other,
+                "served": served,
+                "shed": shed,
+                "other_statuses": other,
+                "shed_carry_retry_after": sorted(retry_afters),
+                "max_queue": max_queue,
+                "concurrency": concurrency,
+                "budget_ms": max_delay_ms,
+                "queue_wait_p50_ms": p50_wait,
+                "queue_wait_p99_ms": p99_wait,
+                "queue_wait_bound_ms": round(bound_ms, 3),
+                "bound_with_resolution_ms": round(bound_ms * 2, 3),
+                "queue_wait_bounded": p99_wait <= bound_ms * 2,
+            }
+        finally:
+            await cluster.stop()
+    finally:
+        for key, value in prior.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
+async def run_ml_serve_bench(n_requests: int = 384, *,
+                             concurrency: int = 16) -> dict:
+    """``ml_serving``: the continuous-batching inference plane measured
+    against the batch-of-one path it replaced, through the real app +
+    sidecar-invoke lane (EXTENSION ONLY). Three sections:
+
+    * serial lane — ``TASKSRUNNER_ML_BATCHING=off``: every request its
+      own device dispatch (the pre-change architecture);
+    * batched lane — micro-batch assembly + padding buckets, same
+      request mix and concurrency;
+    * flood drill — 4x the queue bound at once: overflow sheds
+      429+Retry-After, served p99 queue wait stays inside the
+      assembly budget + device-occupancy bound.
+
+    The jit cache size is read before and after each load: any growth
+    after warmup is a recompile, and the acceptance bar is zero.
+    """
+    # CPU runs measure the SCHEDULING win, so keep attention on the
+    # fused-einsum core: the Pallas kernels run in interpreter mode
+    # off-TPU and would swamp the signal (the kernels get their own
+    # parity suite + on-chip bench)
+    import jax
+    flash_forced_off = False
+    if jax.default_backend() != "tpu" and "TASKSRUNNER_FLASH" not in os.environ:
+        os.environ["TASKSRUNNER_FLASH"] = "0"
+        flash_forced_off = True
+    try:
+        serial = await _ml_serve_lane(
+            n_requests, concurrency, {"TASKSRUNNER_ML_BATCHING": "0"})
+        batched = await _ml_serve_lane(
+            n_requests, concurrency, {"TASKSRUNNER_ML_BATCHING": "1"})
+        flood = await _ml_flood_drill(max_queue=32, max_delay_ms=25.0)
+    finally:
+        if flash_forced_off:
+            os.environ.pop("TASKSRUNNER_FLASH", None)
+    return {
+        "backend": jax.default_backend(),
+        "devices": jax.device_count(),
+        "flash_attention": not flash_forced_off,
+        "serial": serial,
+        "batched": batched,
+        "flood": flood,
+        "throughput_ratio": round(
+            batched["req_per_sec"] / serial["req_per_sec"], 2)
+        if serial["req_per_sec"] else None,
+        "zero_recompiles": (serial["recompiles"] == 0
+                            and batched["recompiles"] == 0),
+    }
+
+
+# ---------------------------------------------------------------------------
 # entry point
 # ---------------------------------------------------------------------------
 
@@ -1855,6 +2141,13 @@ def main() -> None:
                              "headers, per-frame drain vs coalesced "
                              "writes, cold vs pre-warmed dial, and the "
                              "uvloop lane when the package exists")
+    parser.add_argument("--ml-serve-bench", action="store_true",
+                        help="run ONLY the ML serving-plane section "
+                             "(`make bench-ml-serve`): continuous "
+                             "batching vs batch-of-one through the real "
+                             "service, per-bucket queue-wait/service-"
+                             "time percentiles, jit recompile count, "
+                             "and the admission-protected flood drill")
     args = parser.parse_args()
 
     if args.tpu_bench:
@@ -1960,6 +2253,23 @@ def main() -> None:
         _log(f"  -> fast lane vs v1: x{mesh_bench['fast_vs_v1_throughput_ratio']}"
              f" throughput, x{mesh_bench['fast_vs_v1_rtt_ratio']} rtt")
         print(json.dumps({"mesh_fastpath": mesh_bench}))
+        return
+
+    if args.ml_serve_bench:
+        _log("ML serving plane: continuous batching vs batch-of-one ...")
+        ml_serving = asyncio.run(run_ml_serve_bench())
+        s, b, f = ml_serving["serial"], ml_serving["batched"], ml_serving["flood"]
+        _log(f"  -> serial {s['req_per_sec']} req/s, batched "
+             f"{b['req_per_sec']} req/s "
+             f"(x{ml_serving['throughput_ratio']}), recompiles "
+             f"serial={s['recompiles']} batched={b['recompiles']}")
+        _log(f"  -> flood: served {f['served']}, shed {f['shed']} "
+             f"(Retry-After {f['shed_carry_retry_after']}), queue-wait "
+             f"p50/p99 {f['queue_wait_p50_ms']}/{f['queue_wait_p99_ms']} ms "
+             f"vs bound {f['queue_wait_bound_ms']} ms "
+             f"(x2 resolution {f['bound_with_resolution_ms']}, "
+             f"bounded={f['queue_wait_bounded']})")
+        print(json.dumps({"ml_serving": ml_serving}))
         return
 
     if args.worker:

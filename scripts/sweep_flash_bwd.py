@@ -13,6 +13,10 @@ first compile ~15-50 s) with the variant expressed as env overrides:
   kernel, dropping the ``o`` stream (flash-v2 arrangement).
 
 Usage (tunnel up):   python scripts/sweep_flash_bwd.py
+No chip available:   python scripts/sweep_flash_bwd.py --cpu
+  (interpret-mode run at a small config — ranks per-program overhead
+  and stream count, not VMEM pressure; good enough to pick between
+  numerically-identical arrangements when the tunnel is down)
 Results: ranked table on stdout + build/sweep_flash_bwd.json.
 """
 
@@ -29,35 +33,43 @@ import time
 REPO = pathlib.Path(__file__).resolve().parents[1]
 OUT = REPO / "build" / "sweep_flash_bwd.json"
 
-#: (label, env overrides). The baseline row is the round-4 shipped
-#: configuration: heuristic blocks (4 at bench shapes), Δ in-kernel.
+#: (label, env overrides). The baseline row is the SHIPPED default —
+#: heuristic blocks (4 at bench shapes) and, since the sweep promoted
+#: it (flash.py module docstring), Δ precomputed outside the kernel.
+#: ``delta_fused`` rows restore the round-4 in-kernel Δ for A/B.
 VARIANTS: list[tuple[str, dict[str, str]]] = [
-    ("baseline(heuristic)", {}),
+    ("baseline(heuristic+delta_pre)", {}),
     ("bwd_hblk=2", {"TASKSRUNNER_FLASH_HBLK_BWD": "2"}),
     ("bwd_hblk=8", {"TASKSRUNNER_FLASH_HBLK_BWD": "8"}),
-    ("delta_pre", {"TASKSRUNNER_FLASH_BWD_DELTA": "precompute"}),
-    ("delta_pre+bwd8", {"TASKSRUNNER_FLASH_BWD_DELTA": "precompute",
-                        "TASKSRUNNER_FLASH_HBLK_BWD": "8"}),
-    ("delta_pre+bwd2", {"TASKSRUNNER_FLASH_BWD_DELTA": "precompute",
-                        "TASKSRUNNER_FLASH_HBLK_BWD": "2"}),
+    ("delta_fused", {"TASKSRUNNER_FLASH_BWD_DELTA": "fused"}),
+    ("delta_fused+bwd8", {"TASKSRUNNER_FLASH_BWD_DELTA": "fused",
+                          "TASKSRUNNER_FLASH_HBLK_BWD": "8"}),
+    ("delta_fused+bwd2", {"TASKSRUNNER_FLASH_BWD_DELTA": "fused",
+                          "TASKSRUNNER_FLASH_HBLK_BWD": "2"}),
     ("fwd_hblk=8", {"TASKSRUNNER_FLASH_HBLK_FWD": "8"}),
-    ("fwd8+delta_pre+bwd8", {"TASKSRUNNER_FLASH_HBLK_FWD": "8",
-                             "TASKSRUNNER_FLASH_BWD_DELTA": "precompute",
-                             "TASKSRUNNER_FLASH_HBLK_BWD": "8"}),
+    ("fwd8+bwd8", {"TASKSRUNNER_FLASH_HBLK_FWD": "8",
+                   "TASKSRUNNER_FLASH_HBLK_BWD": "8"}),
 ]
 
 
-def child() -> None:
+def child(cpu: bool = False) -> None:
     """One timing run under the current env. Bench-sized config, sync
     via value fetch (block_until_ready returns early on the tunneled
-    backend — see bench.py measure())."""
+    backend — see bench.py measure()). ``--cpu`` shrinks to an
+    interpret-mode-feasible shape (n_heads=8 so every hblk variant
+    still divides) and fewer iterations."""
     import jax
 
     from tasksrunner.ml.model import ModelConfig, init_params, make_train_step
 
-    cfg = ModelConfig(vocab=32768, seq_len=512, d_model=1024,
-                      n_heads=16, d_ff=4096, n_layers=8)
-    batch = 32
+    if cpu:
+        cfg = ModelConfig(vocab=1024, seq_len=128, d_model=128,
+                          n_heads=8, d_ff=256, n_layers=2)
+        batch, n = 4, 5
+    else:
+        cfg = ModelConfig(vocab=32768, seq_len=512, d_model=1024,
+                          n_heads=16, d_ff=4096, n_layers=8)
+        batch, n = 32, 20
     key = jax.random.key(0)
     import jax.numpy as jnp
     tokens = jax.random.randint(key, (batch, cfg.seq_len), 0, cfg.vocab,
@@ -70,7 +82,6 @@ def child() -> None:
     params, loss = step(params, tokens, labels)
     float(loss)
     compile_s = time.perf_counter() - t0
-    n = 20
     t0 = time.perf_counter()
     for _ in range(n):
         params, loss = step(params, tokens, labels)
@@ -82,20 +93,29 @@ def child() -> None:
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--child", action="store_true")
+    parser.add_argument("--cpu", action="store_true",
+                        help="interpret-mode sweep at a small config "
+                             "(no chip required)")
     parser.add_argument("--timeout", type=int, default=600)
     args = parser.parse_args()
     if args.child:
-        child()
+        child(cpu=args.cpu)
         return
+
+    child_cmd = [sys.executable, str(pathlib.Path(__file__)), "--child"]
+    child_env = dict(os.environ)
+    if args.cpu:
+        child_cmd.append("--cpu")
+        child_env["JAX_PLATFORMS"] = "cpu"
 
     results = []
     for label, env in VARIANTS:
         print(f"[{label}] ...", flush=True)
         try:
             proc = subprocess.run(
-                [sys.executable, str(pathlib.Path(__file__)), "--child"],
+                child_cmd,
                 capture_output=True, text=True, timeout=args.timeout,
-                env={**os.environ, **env}, cwd=str(REPO))
+                env={**child_env, **env}, cwd=str(REPO))
         except subprocess.TimeoutExpired:
             print(f"[{label}] TIMED OUT (tunnel?)", flush=True)
             results.append({"variant": label, "env": env, "error": "timeout"})

@@ -75,8 +75,8 @@ FLAGS: dict[str, Flag] = dict([
        "master gate for declarative fault injection (kind: Chaos)"),
     _f("TASKSRUNNER_FLASH", "bool", "on",
        "flash-attention path in the ML extension"),
-    _f("TASKSRUNNER_FLASH_BWD_DELTA", "enum", "fused",
-       "flash backward delta strategy (fused | precompute)"),
+    _f("TASKSRUNNER_FLASH_BWD_DELTA", "enum", "precompute",
+       "flash backward delta strategy (precompute | fused)"),
     _f("TASKSRUNNER_FLASH_HBLK_BWD", "int", "auto",
        "head-block size override for the flash backward kernel"),
     _f("TASKSRUNNER_FLASH_HBLK_FWD", "int", "auto",
@@ -109,6 +109,18 @@ FLAGS: dict[str, Flag] = dict([
        "pre-warm/keepalive tick: idle-ping cadence (<= 0 disables)"),
     _f("TASKSRUNNER_MESH_REQUEST_TIMEOUT_SECONDS", "float", "300",
        "per-request mesh ceiling; consecutive expiries condemn the connection"),
+    _f("TASKSRUNNER_ML_BATCHING", "bool", "on",
+       "continuous micro-batching in the ML serving plane (off = batch-of-one)"),
+    _f("TASKSRUNNER_ML_BUCKETS", "string", "1,2,4,8,16,32",
+       "padding-bucket ladder; each bucket jit-compiles exactly once at warmup"),
+    _f("TASKSRUNNER_ML_MAX_BATCH", "int", "32",
+       "micro-batch size that flushes assembly immediately (size flush)"),
+    _f("TASKSRUNNER_ML_MAX_DELAY_MS", "float", "5",
+       "micro-batch assembly latency budget before a partial batch flushes"),
+    _f("TASKSRUNNER_ML_MAX_QUEUE", "int", "256",
+       "queued inference requests beyond which submits shed with 429"),
+    _f("TASKSRUNNER_ML_MAX_TOKENS", "int", "8192",
+       "tokens in flight at which the ML admission signal reaches 1.0"),
     _f("TASKSRUNNER_PERF_TESTS", "bool", "off",
        "opt-in performance assertions in the test suite"),
     _f("TASKSRUNNER_REPLICA", "int", "0",

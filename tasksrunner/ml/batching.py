@@ -1,0 +1,325 @@
+"""Continuous-batching engine for the ML serving plane.
+
+EXTENSION ONLY (see package docstring) — this is the scheduling layer
+that turns batch-of-one scoring into device-occupancy-shaped serving,
+the compile-once/serve-many framing from the TVM / Julia-to-TPU line
+of work: fix the set of compiled shapes up front, keep the executable
+hot, and reduce throughput to a queueing problem the runtime's
+admission/autoscale loop can already see.
+
+Three mechanisms, one class:
+
+* **Micro-batch assembly under a latency budget.** Requests enter a
+  queue; the worker flushes a batch on ``max_batch`` OR when the
+  *oldest* queued request has waited ``max_delay_ms`` — whichever
+  comes first. Each request resolves through its own future, so one
+  poisoned request fails alone, never its batchmates.
+* **Padding-bucket shape discipline.** Assembled batches are padded up
+  to a fixed ladder (default 1/2/4/8/16/32). The model function is
+  warmed once per bucket at startup, so ``jax.jit`` compiles each
+  shape exactly once and no request ever pays an XLA compile. The jit
+  cache size is surfaced via the owner's stats route — tests and the
+  bench assert it stays flat after warmup.
+* **Saturation signalling.** Queue depth and tokens-in-flight are
+  published as gauges; ``saturation()`` reports the worst ratio
+  against ``max_queue`` / ``max_tokens`` and is registered with
+  :mod:`tasksrunner.observability.admission` by the serving app, so a
+  flood sheds 429+Retry-After at the front door before the queue grows
+  unbounded. ``submit`` itself sheds with
+  :class:`~tasksrunner.errors.SaturatedError` once the queue is full —
+  the last line of defense when admission is off.
+
+The engine is model-agnostic: it schedules opaque items through a
+caller-supplied ``run_batch(items, bucket) -> results`` executed in a
+worker thread (JAX releases the GIL during device compute, so the
+event loop keeps serving while a batch runs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Sequence
+
+from tasksrunner.errors import SaturatedError
+from tasksrunner.observability.metrics import (
+    MetricsRegistry, metrics as default_metrics,
+)
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_BUCKETS = (1, 2, 4, 8, 16, 32)
+DEFAULT_MAX_BATCH = 32
+DEFAULT_MAX_DELAY_MS = 5.0
+DEFAULT_MAX_QUEUE = 256
+DEFAULT_MAX_TOKENS = 8192
+
+
+def parse_buckets(raw: str) -> tuple[int, ...]:
+    """``"1,2,4,8"`` → ``(1, 2, 4, 8)`` — sorted, deduplicated,
+    positives only. Falls back to :data:`DEFAULT_BUCKETS` on garbage
+    rather than refusing to serve."""
+    try:
+        buckets = sorted({int(part) for part in raw.split(",") if part.strip()})
+    except ValueError:
+        logger.warning("ignoring malformed bucket ladder %r; using %s",
+                       raw, DEFAULT_BUCKETS)
+        return DEFAULT_BUCKETS
+    buckets = tuple(b for b in buckets if b > 0)
+    return buckets or DEFAULT_BUCKETS
+
+
+def _env_number(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r; using %s", name, raw, default)
+        return default
+
+
+@dataclass(frozen=True)
+class BatcherConfig:
+    """Knobs for one :class:`MicroBatcher` (env: ``TASKSRUNNER_ML_*``)."""
+
+    max_batch: int = DEFAULT_MAX_BATCH
+    max_delay_ms: float = DEFAULT_MAX_DELAY_MS
+    buckets: tuple[int, ...] = DEFAULT_BUCKETS
+    max_queue: int = DEFAULT_MAX_QUEUE
+    max_tokens: int = DEFAULT_MAX_TOKENS
+
+    def __post_init__(self) -> None:
+        # max_batch can never exceed the largest compiled shape — a
+        # bigger assembly would force a compile outside the ladder
+        object.__setattr__(self, "buckets", tuple(sorted(set(self.buckets))))
+        object.__setattr__(
+            self, "max_batch", max(1, min(self.max_batch, self.buckets[-1])))
+
+    @classmethod
+    def from_env(cls) -> BatcherConfig:
+        return cls(
+            max_batch=int(_env_number(
+                "TASKSRUNNER_ML_MAX_BATCH", DEFAULT_MAX_BATCH)),
+            max_delay_ms=_env_number(
+                "TASKSRUNNER_ML_MAX_DELAY_MS", DEFAULT_MAX_DELAY_MS),
+            buckets=parse_buckets(os.environ.get(
+                "TASKSRUNNER_ML_BUCKETS",
+                ",".join(map(str, DEFAULT_BUCKETS)))),
+            max_queue=int(_env_number(
+                "TASKSRUNNER_ML_MAX_QUEUE", DEFAULT_MAX_QUEUE)),
+            max_tokens=int(_env_number(
+                "TASKSRUNNER_ML_MAX_TOKENS", DEFAULT_MAX_TOKENS)),
+        )
+
+    def serial(self) -> BatcherConfig:
+        """The batch-of-one variant (``TASKSRUNNER_ML_BATCHING=off``
+        and the bench baseline): same queue/shed semantics, no
+        assembly, single compiled shape."""
+        return replace(self, max_batch=1, buckets=(1,), max_delay_ms=0.0)
+
+
+class _Pending:
+    __slots__ = ("item", "tokens", "enqueued", "future")
+
+    def __init__(self, item: Any, tokens: int, enqueued: float,
+                 future: asyncio.Future) -> None:
+        self.item = item
+        self.tokens = tokens
+        self.enqueued = enqueued
+        self.future = future
+
+
+class MicroBatcher:
+    """Request queue + micro-batch assembly + padding buckets.
+
+    ``run_batch(items, bucket)`` receives the assembled items (length
+    <= bucket) and the bucket to pad to; it runs in a worker thread
+    and returns one result per item, in order. A result that is an
+    ``Exception`` instance fails that item's future alone (per-request
+    error isolation inside a shared batch); ``run_batch`` raising
+    fails only that batch's futures — the engine itself survives both.
+    """
+
+    def __init__(
+        self,
+        run_batch: Callable[[list[Any], int], Sequence[Any]],
+        *,
+        config: BatcherConfig | None = None,
+        tokens_of: Callable[[Any], int] | None = None,
+        registry: MetricsRegistry | None = None,
+    ) -> None:
+        self.config = config if config is not None else BatcherConfig()
+        self._run_batch = run_batch
+        self._tokens_of = tokens_of if tokens_of is not None else (lambda _: 1)
+        self._registry = registry if registry is not None else default_metrics
+        self._queue: asyncio.Queue[_Pending] = asyncio.Queue()
+        self._task: asyncio.Task | None = None
+        self._tokens_in_flight = 0
+        self._submitted = 0
+        self._completed = 0
+        self._shed = 0
+        self._batch_counts: dict[int, int] = {}
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        while not self._queue.empty():
+            pending = self._queue.get_nowait()
+            self._account_done([pending])
+            if not pending.future.done():
+                pending.future.set_exception(
+                    RuntimeError("batcher stopped before the request ran"))
+
+    @property
+    def running(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    # -- submission ------------------------------------------------------
+
+    async def submit(self, item: Any) -> Any:
+        """Enqueue one item; resolves with its result once the batch it
+        lands in has executed. Sheds with :class:`SaturatedError`
+        (429 + Retry-After) when the queue is full."""
+        if not self.running:
+            raise RuntimeError("MicroBatcher.submit before start()")
+        if self._queue.qsize() >= self.config.max_queue:
+            self._shed += 1
+            self._registry.inc("ml_shed_total")
+            exc = SaturatedError(
+                f"inference queue full ({self.config.max_queue} pending)")
+            exc.retry_after = 1.0
+            raise exc
+        pending = _Pending(item, max(1, int(self._tokens_of(item))),
+                           time.monotonic(),
+                           asyncio.get_running_loop().create_future())
+        self._submitted += 1
+        self._tokens_in_flight += pending.tokens
+        self._queue.put_nowait(pending)
+        self._publish_depth()
+        return await pending.future
+
+    # -- saturation ------------------------------------------------------
+
+    def saturation(self) -> float:
+        """Worst ratio across the batcher's capacity signals, on the
+        admission-controller scale (>= 1.0 → shed at the front door)."""
+        score = 0.0
+        if self.config.max_tokens > 0:
+            score = max(score, self._tokens_in_flight / self.config.max_tokens)
+        if self.config.max_queue > 0:
+            score = max(score, self._queue.qsize() / self.config.max_queue)
+        return score
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "submitted": self._submitted,
+            "completed": self._completed,
+            "shed": self._shed,
+            "queue_depth": self._queue.qsize(),
+            "tokens_in_flight": self._tokens_in_flight,
+            "batches": {str(k): v for k, v in sorted(self._batch_counts.items())},
+            "buckets": list(self.config.buckets),
+            "max_batch": self.config.max_batch,
+            "max_delay_ms": self.config.max_delay_ms,
+        }
+
+    # -- the worker ------------------------------------------------------
+
+    def bucket_for(self, size: int) -> int:
+        """Smallest ladder entry >= size (sizes above the ladder are
+        impossible: max_batch is clamped to the top bucket)."""
+        for bucket in self.config.buckets:
+            if bucket >= size:
+                return bucket
+        return self.config.buckets[-1]
+
+    async def _run(self) -> None:
+        while True:
+            batch = [await self._queue.get()]
+            # the budget runs from the OLDEST request's enqueue, so a
+            # request that already waited behind a slow batch isn't
+            # charged a fresh window on top
+            deadline = batch[0].enqueued + self.config.max_delay_ms / 1000.0
+            while len(batch) < self.config.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(await asyncio.wait_for(
+                        self._queue.get(), remaining))
+                except asyncio.TimeoutError:
+                    break
+            # whatever accumulated while the previous batch held the
+            # device rides along for free (this is the "continuous"
+            # part — no idle gap, no extra waiting)
+            while len(batch) < self.config.max_batch and not self._queue.empty():
+                batch.append(self._queue.get_nowait())
+            self._publish_depth()
+            await self._execute(batch)
+
+    async def _execute(self, batch: list[_Pending]) -> None:
+        bucket = self.bucket_for(len(batch))
+        label = str(bucket)
+        now = time.monotonic()
+        self._registry.observe("ml_batch_size", float(len(batch)))
+        self._registry.observe_many(
+            "ml_queue_wait_seconds", [now - p.enqueued for p in batch],
+            bucket=label)
+        started = time.monotonic()
+        try:
+            results = await asyncio.to_thread(
+                self._run_batch, [p.item for p in batch], bucket)
+        except Exception as exc:
+            logger.exception("inference batch of %d (bucket %d) failed",
+                             len(batch), bucket)
+            self._account_done(batch)
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(exc)
+            return
+        self._registry.observe("ml_infer_latency_seconds",
+                               time.monotonic() - started, bucket=label)
+        self._registry.inc("ml_batches_total", bucket=label)
+        self._batch_counts[bucket] = self._batch_counts.get(bucket, 0) + 1
+        self._account_done(batch)
+        if len(results) != len(batch):
+            mismatch = RuntimeError(
+                f"run_batch returned {len(results)} results for "
+                f"{len(batch)} items")
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(mismatch)
+            return
+        for p, result in zip(batch, results):
+            if p.future.done():
+                continue  # the caller gave up waiting; nothing to tell
+            if isinstance(result, Exception):
+                p.future.set_exception(result)
+            else:
+                p.future.set_result(result)
+
+    def _account_done(self, batch: list[_Pending]) -> None:
+        self._completed += len(batch)
+        self._tokens_in_flight -= sum(p.tokens for p in batch)
+        self._publish_depth()
+
+    def _publish_depth(self) -> None:
+        self._registry.set_gauge("ml_queue_depth", float(self._queue.qsize()))
+        self._registry.set_gauge("ml_tokens_in_flight",
+                                 float(self._tokens_in_flight))

@@ -34,6 +34,20 @@ Design (per /opt/skills/guides/pallas_guide.md):
 * Off-TPU the kernels run in interpreter mode, so the correctness
   suite (tests/test_ml_extension.py) exercises the exact kernel code
   on CPU against the einsum reference.
+* Backward default: Δ = Σ(dO∘O) is PREcomputed outside the kernel
+  (``_bwd_kernel_delta``, the flash-v2 arrangement) — promoted from
+  the staged sweep (``scripts/sweep_flash_bwd.py --cpu``, interpret
+  mode, the chip tunnel being down): delta-precompute ran the small-
+  config train step at 50.6 ms vs 60.8 ms for the in-kernel-Δ
+  baseline (−17%), and the win is structural (one fewer double-
+  buffered [h_blk, S, D] input stream) rather than shape-dependent.
+  The same sweep ranked ``bwd_hblk=8`` fastest outright, but that is
+  an interpret-mode artifact — fewer program invocations — that
+  contradicts the on-chip round-4 measurement (8 heads/program
+  regresses under VMEM pressure; see ``_head_block``), so the block
+  heuristic stays. ``TASKSRUNNER_FLASH_BWD_DELTA=fused`` restores the
+  in-kernel Δ for A/B runs; both variants stay numerically pinned by
+  ``test_flash_backward_variants_match_einsum``.
 """
 
 from __future__ import annotations
@@ -198,11 +212,12 @@ def _bwd_kernel_delta(q_ref, k_ref, v_ref, do_ref, l_ref, delta_ref,
 
 
 def _bwd_delta_precompute() -> bool:
-    """TASKSRUNNER_FLASH_BWD_DELTA=precompute switches the backward to
-    _bwd_kernel_delta (trace-time; default keeps Δ in-kernel — the
-    round-4 measured configuration)."""
+    """Δ placement for the backward, resolved at trace time. Default
+    is PREcompute (_bwd_kernel_delta) — promoted by the sweep result
+    in the module docstring; TASKSRUNNER_FLASH_BWD_DELTA=fused
+    restores the in-kernel Δ of the round-4 configuration."""
     import os
-    return os.environ.get("TASKSRUNNER_FLASH_BWD_DELTA") == "precompute"
+    return os.environ.get("TASKSRUNNER_FLASH_BWD_DELTA", "precompute") != "fused"
 
 
 def _flash_bwd_call(q, k, v, out, lse, dout, scale):

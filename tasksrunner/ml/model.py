@@ -239,14 +239,38 @@ def make_train_step(cfg: ModelConfig, mesh: Mesh | None = None, *,
     )
 
 
-def hash_tokens(texts: list[str], cfg: ModelConfig) -> jnp.ndarray:
-    """Deterministic hashed tokenizer (no external vocab): words →
-    buckets in [1, vocab); 0 is padding."""
+def hash_token_ids(text: str, cfg: ModelConfig) -> list[int]:
+    """Deterministic hashed tokenizer for one text (no external
+    vocab): words → buckets in [1, vocab); 0 is padding. Pure Python —
+    the serving encode path runs it per request on the event loop
+    without touching a device."""
     import zlib
-    out = []
-    for text in texts:
-        ids = [1 + (zlib.crc32(w.lower().encode()) % (cfg.vocab - 1))
-               for w in text.split()][: cfg.seq_len]
-        ids += [0] * (cfg.seq_len - len(ids))
-        out.append(ids)
-    return jnp.asarray(out, jnp.int32)
+    ids = [1 + (zlib.crc32(w.lower().encode()) % (cfg.vocab - 1))
+           for w in text.split()][: cfg.seq_len]
+    return ids + [0] * (cfg.seq_len - len(ids))
+
+
+def hash_tokens(texts: list[str], cfg: ModelConfig) -> jnp.ndarray:
+    """Batched :func:`hash_token_ids`, committed as a device array."""
+    return jnp.asarray([hash_token_ids(t, cfg) for t in texts], jnp.int32)
+
+
+# -- serving placement ---------------------------------------------------
+
+def serving_mesh(devices: list | None = None) -> Mesh | None:
+    """A 1-D data mesh over every visible device for the serving path,
+    or None single-chip. Inference has no tp-worthy weights at this
+    model size: the win is batch-dimension data parallelism, so the
+    mesh is just ``("dp",)``."""
+    import numpy as np
+    devices = jax.devices() if devices is None else devices
+    if len(devices) <= 1:
+        return None
+    return Mesh(np.asarray(devices), ("dp",))
+
+
+def replicate_params(params: dict, mesh: Mesh) -> dict:
+    """Device-put every leaf once, fully replicated over the mesh —
+    after this no serving call ever re-feeds weights."""
+    sharding = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), params)

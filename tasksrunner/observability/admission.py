@@ -25,7 +25,9 @@ Two design points keep this safe:
 
 The score is the max of the per-signal ratios (a replica is as
 saturated as its worst resource): ``lag / max_lag``, worst write-queue
-``depth / max_depth``, and ``inflight / max_inflight``. Thresholds come
+``depth / max_depth``, ``inflight / max_inflight``, plus any ratios
+subsystems contributed through :func:`register_signal` (the ML
+batcher's tokens-in-flight signal rides this). Thresholds come
 from ``TASKSRUNNER_ADMISSION_MAX_*``; setting one to 0 disables that
 signal. Shedding state and the raw score are published as
 ``admission_state`` / ``admission_saturation`` gauges and every shed
@@ -67,6 +69,27 @@ DEFAULT_MAX_INFLIGHT = 64
 #: clients further away — clamped to this ceiling so a pathological
 #: score can't park clients for minutes
 MAX_RETRY_AFTER_SECONDS = 30
+
+
+#: extra saturation signals registered by subsystems the controller
+#: can't know about up front (e.g. the ML batcher's tokens-in-flight
+#: ratio). Each is a zero-arg callable returning a ratio on the same
+#: scale as the built-in signals: >= 1.0 means saturated. Process-wide
+#: by design — AppHost shares one controller between the app server
+#: and the sidecar, and a subsystem registering here must not need a
+#: handle on either.
+_EXTRA_SIGNALS: dict[str, Callable[[], float]] = {}
+
+
+def register_signal(name: str, fn: Callable[[], float]) -> None:
+    """Fold ``fn()`` into every subsequent :meth:`AdmissionController.sample`
+    as one more saturation ratio (the score is the max across signals).
+    Re-registering a name replaces the previous callable."""
+    _EXTRA_SIGNALS[name] = fn
+
+
+def unregister_signal(name: str) -> None:
+    _EXTRA_SIGNALS.pop(name, None)
 
 
 def _env_number(name: str, default: float) -> float:
@@ -152,6 +175,11 @@ class AdmissionController:
                     score = max(score, depth / self.max_queue_depth)
         if self.max_inflight > 0 and self.inflight is not None:
             score = max(score, self.inflight() / self.max_inflight)
+        for name, fn in list(_EXTRA_SIGNALS.items()):
+            try:
+                score = max(score, float(fn()))
+            except Exception:  # pragma: no cover - buggy signal providers
+                logger.exception("admission: extra signal %s failed", name)
         self.score = score
         if not self.shedding and score >= 1.0:
             self.shedding = True

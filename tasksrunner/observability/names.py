@@ -40,6 +40,8 @@ COUNTERS: dict[str, str] = {
     "actor_fenced_total": "zombie-owner commits rejected by epoch fencing",
     "actor_failover_total": "ownership acquisitions from a dead or expired owner",
     "repl_records_total": "replication records shipped to followers, per member",
+    "ml_batches_total": "micro-batches executed by the inference plane, per bucket",
+    "ml_shed_total": "inference submits shed because the batch queue was full",
     "repl_fenced_total": "shard-leader sessions fenced by an epoch bump",
     "repl_failover_total": "shard leadership takeovers (epoch > 1 acquisitions)",
 }
@@ -60,6 +62,8 @@ GAUGES: dict[str, str] = {
     "actor_owned": "actor activations this replica currently owns, per type",
     "repl_epoch": "current shard leadership epoch, per store and shard",
     "repl_follower_lag_records": "records a follower trails the leader by",
+    "ml_queue_depth": "inference requests waiting for micro-batch assembly",
+    "ml_tokens_in_flight": "tokens queued or executing in the inference plane",
 }
 
 #: latency distributions (seconds); exposed as _bucket/_sum/_count
@@ -76,6 +80,9 @@ HISTOGRAMS: dict[str, str] = {
     "binding_latency_seconds": "output-binding invocation, per binding and op",
     "binding_delivery_latency_seconds": "input-binding delivery, per binding",
     "actor_turn_latency_seconds": "actor turn execution, per actor type",
+    "ml_batch_size": "assembled micro-batch size (before bucket padding)",
+    "ml_queue_wait_seconds": "inference queue wait (submit to batch start), per bucket",
+    "ml_infer_latency_seconds": "micro-batch device execution, per padding bucket",
 }
 
 ALL: dict[str, str] = {**COUNTERS, **GAUGES, **HISTOGRAMS}

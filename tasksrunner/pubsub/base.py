@@ -34,9 +34,52 @@ class Message:
     attempt: int = 1
 
 
-#: Handler returns True to ack, False to nack (→ redelivery). A raised
-#: exception counts as nack.
-Handler = Callable[[Message], Awaitable[bool]]
+class Nack:
+    """A falsy handler outcome carrying redelivery hints.
+
+    A bare ``False`` tells the broker *that* delivery failed; a
+    ``Nack`` also tells it *when to try again* (``retry_after``
+    seconds instead of the broker's fixed ``retry_delay``) and whether
+    the try should count against the bounded-attempt budget at all.
+    ``counts_attempt=False`` is for deliveries the app never processed
+    — a 503 during model warmup, a 429 admission shed — where burning
+    attempts would dead-letter messages the consumer merely asked to
+    see later. ``__bool__`` is ``False`` so brokers that only know the
+    ack contract (``if not ok: redeliver``) keep working unchanged.
+    """
+
+    __slots__ = ("retry_after", "counts_attempt")
+
+    def __init__(self, retry_after: float | None = None, *,
+                 counts_attempt: bool = True) -> None:
+        self.retry_after = retry_after
+        self.counts_attempt = counts_attempt
+
+    def __bool__(self) -> bool:
+        return False
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Nack(retry_after={self.retry_after!r}, "
+                f"counts_attempt={self.counts_attempt!r})")
+
+
+def retry_after_from_headers(headers: dict[str, str] | None) -> float | None:
+    """Numeric ``Retry-After`` from a response header map (any case),
+    or None. HTTP-date forms are ignored — every producer in this
+    codebase emits seconds."""
+    for key, value in (headers or {}).items():
+        if key.lower() == "retry-after":
+            try:
+                return max(0.0, float(value.strip()))
+            except (TypeError, ValueError):
+                return None
+    return None
+
+
+#: Handler returns True to ack; False — or a :class:`Nack` carrying
+#: redelivery hints — to nack (→ redelivery). A raised exception
+#: counts as nack.
+Handler = Callable[[Message], Awaitable["bool | Nack"]]
 
 
 @dataclass

@@ -18,7 +18,7 @@ from typing import Any
 from tasksrunner.component.registry import driver
 from tasksrunner.component.spec import ComponentSpec
 from tasksrunner.ids import hex16
-from tasksrunner.pubsub.base import Handler, Message, PubSubBroker, Subscription
+from tasksrunner.pubsub.base import Handler, Message, Nack, PubSubBroker, Subscription
 
 logger = logging.getLogger(__name__)
 
@@ -87,16 +87,24 @@ class InMemoryBroker(PubSubBroker):
                 logger.exception("handler error on topic %s group %s", topic, group_name)
                 ok = False
             if not ok:
-                if msg.attempt >= self.max_attempts:
+                hint = ok if isinstance(ok, Nack) else None
+                counts = hint is None or hint.counts_attempt
+                delay = (self.retry_delay if hint is None
+                         or hint.retry_after is None else hint.retry_after)
+                if counts and msg.attempt >= self.max_attempts:
                     logger.warning(
                         "dead-lettering message %s on %s/%s after %d attempts",
                         msg.id, topic, group_name, msg.attempt,
                     )
                     self.dead_letters.append(msg)
                 else:
-                    msg.attempt += 1
+                    # a counts_attempt=False nack (consumer not ready,
+                    # never processed the message) parks it without
+                    # burning an attempt — warmup can't dead-letter
+                    if counts:
+                        msg.attempt += 1
                     asyncio.get_running_loop().call_later(
-                        self.retry_delay, g.queue.put_nowait, msg
+                        delay, g.queue.put_nowait, msg
                     )
 
     async def aclose(self) -> None:

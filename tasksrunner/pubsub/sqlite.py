@@ -30,7 +30,7 @@ from tasksrunner.component.registry import driver
 from tasksrunner.component.spec import ComponentSpec
 from tasksrunner.ids import hex16
 from tasksrunner.observability.metrics import metrics
-from tasksrunner.pubsub.base import Handler, Message, PubSubBroker, Subscription
+from tasksrunner.pubsub.base import Handler, Message, Nack, PubSubBroker, Subscription
 
 logger = logging.getLogger(__name__)
 
@@ -433,8 +433,11 @@ class SqliteBroker(PubSubBroker):
                           topic=topic, group=group)
 
     @_locked
-    def _nack(self, msg: Message, group: str) -> None:
-        if msg.attempt >= self.max_attempts:
+    def _nack(self, msg: Message, group: str, hint: Nack | None = None) -> None:
+        counts = hint is None or hint.counts_attempt
+        delay = (self.retry_delay if hint is None or hint.retry_after is None
+                 else hint.retry_after)
+        if counts and msg.attempt >= self.max_attempts:
             logger.warning(
                 "dead-lettering message %s on %s/%s after %d attempts",
                 msg.id, msg.topic, group, msg.attempt,
@@ -444,10 +447,14 @@ class SqliteBroker(PubSubBroker):
                 (msg.id, group)))
             self._dlq_gauge(msg.topic, group)
         else:
+            # claiming charged this attempt up front; a not-ready nack
+            # (counts_attempt=False — the consumer never processed the
+            # message) refunds it so warmup backoff can't dead-letter
+            refund = "" if counts else ", attempts = attempts - 1"
             self._write_txn(lambda cur: cur.execute(
-                "UPDATE deliveries SET visible_at = ?, claimed_until = 0 "
-                "WHERE msg_id = ? AND grp = ?",
-                (time.time() + self.retry_delay, msg.id, group)))
+                "UPDATE deliveries SET visible_at = ?, claimed_until = 0"
+                f"{refund} WHERE msg_id = ? AND grp = ?",
+                (time.time() + delay, msg.id, group)))
 
     async def subscribe(self, topic: str, group: str, handler: Handler) -> Subscription:
         await self.ensure_group(topic, group)
@@ -493,7 +500,8 @@ class SqliteBroker(PubSubBroker):
                         if ok:
                             acks.append(msg.id)
                         else:
-                            await self._run(self._nack, msg, group)
+                            await self._run(self._nack, msg, group,
+                                            ok if isinstance(ok, Nack) else None)
             finally:
                 # cancelled (or loop exit) with unsettled acks: flush
                 # them now — shutdown must not cause redelivery of

@@ -52,7 +52,9 @@ from tasksrunner.observability.tracing import (
     ensure_trace,
     trace_scope,
 )
-from tasksrunner.pubsub.base import Message, PubSubBroker
+from tasksrunner.pubsub.base import (
+    Message, Nack, PubSubBroker, retry_after_from_headers,
+)
 from tasksrunner.resiliency.policy import ResiliencyPolicies
 from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER, AppGrants
 from tasksrunner.state.base import StateStore, TransactionOp
@@ -804,10 +806,10 @@ class Runtime:
                 started = time.perf_counter()
                 try:
                     if policy is not None:
-                        status, _, _ = await policy.execute(
+                        status, resp_headers, _ = await policy.execute(
                             _deliver_once, retriable=(OSError,))
                     else:
-                        status, _, _ = await _deliver_once()
+                        status, resp_headers, _ = await _deliver_once()
                 except Exception:
                     logger.exception("delivery to %s failed", route)
                     return False
@@ -819,7 +821,19 @@ class Runtime:
                 # knob that silences per-request access-log formatting
                 if log_deliveries:
                     logger.info('pubsub delivery "POST %s" %d', route, status)
-                return 200 <= status < 300
+                if 200 <= status < 300:
+                    return True
+                if status in (429, 503):
+                    # the app declined the delivery without processing
+                    # it (admission shed, model warmup) and said when
+                    # to come back: honor that instead of hot-looping
+                    # the broker's tight retry_delay, and don't charge
+                    # the bounded-attempt budget for a message the
+                    # handler never looked at
+                    delay = retry_after_from_headers(resp_headers)
+                    if delay is not None:
+                        return Nack(retry_after=delay, counts_attempt=False)
+                return False
         return deliver
 
     def _make_binding_sink(self, binding: InputBinding):

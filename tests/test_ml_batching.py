@@ -1,0 +1,383 @@
+"""Continuous-batching engine + serving-plane wiring
+(tasksrunner/ml/batching.py, service.py, and the Retry-After nack lane
+through the brokers).
+
+Covers the scheduling contract the bench relies on: flush on size OR
+the oldest request's deadline, padding buckets that jit-compile exactly
+once, per-request error isolation, queue-full shedding, the warmup
+backoff (503+Retry-After → broker redelivery that doesn't burn the
+attempt budget), the admission-controller signal hookup, and a burst
+through the real service over sidecar invoke.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from tasksrunner.errors import SaturatedError
+from tasksrunner.ml.batching import (
+    BatcherConfig, DEFAULT_BUCKETS, MicroBatcher, parse_buckets,
+)
+from tasksrunner.observability.metrics import MetricsRegistry
+
+
+def echo_batch(items, bucket):
+    return list(items)
+
+
+async def start_batcher(run_batch, **cfg):
+    mb = MicroBatcher(run_batch, config=BatcherConfig(**cfg),
+                      registry=MetricsRegistry())
+    mb.start()
+    return mb
+
+
+# -- config parsing ------------------------------------------------------
+
+def test_parse_buckets_sorts_dedups_and_survives_garbage():
+    assert parse_buckets("8, 2,2, 4") == (2, 4, 8)
+    assert parse_buckets("") == DEFAULT_BUCKETS
+    assert parse_buckets("zero,-3") == DEFAULT_BUCKETS
+
+
+def test_config_clamps_max_batch_to_top_bucket():
+    cfg = BatcherConfig(max_batch=64, buckets=(1, 4, 2))
+    assert cfg.buckets == (1, 2, 4)
+    assert cfg.max_batch == 4
+    serial = cfg.serial()
+    assert serial.max_batch == 1 and serial.buckets == (1,)
+
+
+def test_bucket_for_picks_smallest_fit():
+    mb = MicroBatcher(echo_batch, config=BatcherConfig())
+    assert [mb.bucket_for(n) for n in (1, 2, 3, 5, 9, 17, 32)] == \
+        [1, 2, 4, 8, 16, 32, 32]
+
+
+# -- flush discipline ----------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_size_flush_does_not_wait_for_the_deadline():
+    """A full batch goes to the device immediately even when the
+    latency budget is far away."""
+    mb = await start_batcher(echo_batch, max_batch=4, max_delay_ms=10_000)
+    t0 = time.monotonic()
+    results = await asyncio.wait_for(
+        asyncio.gather(*(mb.submit(i) for i in range(4))), timeout=2.0)
+    assert results == [0, 1, 2, 3]
+    assert time.monotonic() - t0 < 2.0  # nowhere near the 10s budget
+    assert mb.stats()["batches"] == {"4": 1}
+    await mb.stop()
+
+
+@pytest.mark.asyncio
+async def test_deadline_flushes_a_partial_batch():
+    """Short of max_batch, the batch leaves when the OLDEST request
+    has waited max_delay_ms."""
+    mb = await start_batcher(echo_batch, max_batch=32, max_delay_ms=50)
+    t0 = time.monotonic()
+    results = await asyncio.gather(*(mb.submit(i) for i in range(3)))
+    waited = time.monotonic() - t0
+    assert results == [0, 1, 2]
+    assert 0.04 <= waited < 1.0  # the deadline, not the 32-size flush
+    # 3 items pad up to the 4-bucket
+    assert mb.stats()["batches"] == {"4": 1}
+    await mb.stop()
+
+
+@pytest.mark.asyncio
+async def test_arrivals_during_execution_ride_the_next_batch():
+    """The continuous part: whatever queued while a batch held the
+    device is drained into the next batch without a fresh wait."""
+    release = asyncio.Event()
+
+    def slow_batch(items, bucket):
+        if items[0] == 0:  # only the first batch blocks
+            while not release.is_set():
+                time.sleep(0.005)
+        return list(items)
+
+    mb = await start_batcher(slow_batch, max_batch=8, max_delay_ms=5)
+    first = asyncio.ensure_future(mb.submit(0))
+    await asyncio.sleep(0.05)  # batch 1 (just item 0) is on the device
+    rest = [asyncio.ensure_future(mb.submit(i)) for i in range(1, 7)]
+    await asyncio.sleep(0.05)  # they all queue behind the running batch
+    release.set()
+    assert await asyncio.wait_for(first, 2.0) == 0
+    assert await asyncio.wait_for(asyncio.gather(*rest), 2.0) == \
+        list(range(1, 7))
+    stats = mb.stats()
+    assert stats["batches"]["1"] == 1      # the blocker ran alone
+    assert stats["batches"]["8"] == 1      # the six backlogged → one batch
+    await mb.stop()
+
+
+# -- padding buckets + jit cache ----------------------------------------
+
+@pytest.mark.asyncio
+async def test_buckets_jit_compile_once():
+    """Every executed batch pads to a ladder shape, so the jit cache
+    holds exactly one entry per bucket touched — zero recompiles on
+    repeat traffic."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda x: x * 2.0)
+
+    def run_batch(items, bucket):
+        padded = np.zeros((bucket, 4), np.float32)
+        for i, item in enumerate(items):
+            padded[i] = item
+        out = np.asarray(fn(jnp.asarray(padded)))
+        return [out[i] for i in range(len(items))]
+
+    mb = await start_batcher(run_batch, max_batch=8, max_delay_ms=5)
+    for size in (1, 3, 3, 7, 2, 1):
+        await asyncio.gather(*(mb.submit(np.full(4, i, np.float32))
+                               for i in range(size)))
+    touched = set(mb.stats()["batches"])
+    assert touched <= {"1", "2", "4", "8"}
+    assert fn._cache_size() == len(touched)
+    before = fn._cache_size()
+    for size in (3, 7, 1):  # repeat traffic: no new shapes
+        await asyncio.gather(*(mb.submit(np.full(4, i, np.float32))
+                               for i in range(size)))
+    assert fn._cache_size() == before
+    await mb.stop()
+
+
+# -- error isolation -----------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_bad_request_fails_alone():
+    """run_batch may return an Exception per item; only that caller
+    sees it, batchmates get their results."""
+
+    def picky(items, bucket):
+        return [ValueError(f"bad {i}") if i == "poison" else i
+                for i in items]
+
+    mb = await start_batcher(picky, max_batch=4, max_delay_ms=10_000)
+    futures = [asyncio.ensure_future(mb.submit(x))
+               for x in ("a", "poison", "c", "d")]
+    done = await asyncio.gather(*futures, return_exceptions=True)
+    assert done[0] == "a" and done[2] == "c" and done[3] == "d"
+    assert isinstance(done[1], ValueError)
+    await mb.stop()
+
+
+@pytest.mark.asyncio
+async def test_batch_crash_fails_only_that_batch():
+    """run_batch raising fails the in-flight batch; the engine keeps
+    serving the next one."""
+    crash = {"armed": True}
+
+    def flaky(items, bucket):
+        if crash["armed"]:
+            crash["armed"] = False
+            raise RuntimeError("device fell over")
+        return list(items)
+
+    mb = await start_batcher(flaky, max_batch=2, max_delay_ms=10_000)
+    first = await asyncio.gather(mb.submit(1), mb.submit(2),
+                                 return_exceptions=True)
+    assert all(isinstance(r, RuntimeError) for r in first)
+    assert await asyncio.gather(mb.submit(3), mb.submit(4)) == [3, 4]
+    await mb.stop()
+
+
+# -- shedding + saturation ----------------------------------------------
+
+@pytest.mark.asyncio
+async def test_queue_full_sheds_with_retry_after():
+    release = asyncio.Event()
+
+    def gated(items, bucket):
+        while not release.is_set():
+            time.sleep(0.005)
+        return list(items)
+
+    mb = await start_batcher(gated, max_batch=1, max_delay_ms=0,
+                             buckets=(1,), max_queue=2)
+    first = asyncio.ensure_future(mb.submit("runs"))
+    await asyncio.sleep(0.05)  # item 1 on the device; queue empty again
+    queued = [asyncio.ensure_future(mb.submit(f"q{i}")) for i in range(2)]
+    await asyncio.sleep(0)     # both enqueued: the queue is now full
+    with pytest.raises(SaturatedError) as exc:
+        await mb.submit("overflow")
+    assert exc.value.retry_after >= 1
+    assert mb.saturation() >= 1.0
+    release.set()
+    assert await asyncio.wait_for(first, 2.0) == "runs"
+    assert await asyncio.wait_for(asyncio.gather(*queued), 2.0) == \
+        ["q0", "q1"]
+    assert mb.stats()["shed"] == 1
+    await mb.stop()
+
+
+@pytest.mark.asyncio
+async def test_saturation_signal_reaches_the_admission_controller():
+    """register_signal folds the batcher's worst ratio into the
+    replica's saturation score — a token flood sheds at the front
+    door, and unregister detaches it."""
+    from tasksrunner.observability import admission
+    from tasksrunner.observability.metrics import MetricsRegistry as Reg
+
+    gate = admission.AdmissionController(registry=Reg())
+    mb = MicroBatcher(echo_batch,
+                      config=BatcherConfig(max_queue=4, max_tokens=100),
+                      registry=MetricsRegistry())
+    admission.register_signal("test_ml_tokens", mb.saturation)
+    try:
+        assert gate.sample() < 1.0
+        mb._tokens_in_flight = 250   # 2.5x the token ceiling
+        assert gate.sample() >= 1.0 and gate.shedding
+    finally:
+        admission.unregister_signal("test_ml_tokens")
+    mb._tokens_in_flight = 250
+    gate2 = admission.AdmissionController(registry=Reg())
+    assert gate2.sample() < 1.0  # detached: the flood is invisible
+
+
+# -- Retry-After nack lane (warmup backoff) ------------------------------
+
+def test_nack_is_falsy_and_carries_the_hint():
+    from tasksrunner.pubsub.base import Nack, retry_after_from_headers
+
+    nack = Nack(2.5, counts_attempt=False)
+    assert not nack and nack.retry_after == 2.5 and not nack.counts_attempt
+    assert retry_after_from_headers({"Retry-After": "3"}) == 3.0
+    assert retry_after_from_headers({"retry-after": "0"}) == 0.0
+    assert retry_after_from_headers({"Retry-After": "soon"}) is None
+    assert retry_after_from_headers({}) is None
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("kind", ["memory", "sqlite"])
+async def test_backoff_nack_does_not_burn_the_attempt_budget(kind, tmp_path):
+    """A Nack(counts_attempt=False) — the runtime's translation of
+    503/429+Retry-After — redelivers MORE times than max_attempts
+    without ever dead-lettering, and the attempt counter stays at 1
+    the whole time (warmup is not a failure)."""
+    from tasksrunner.pubsub import InMemoryBroker, SqliteBroker
+    from tasksrunner.pubsub.base import Nack
+
+    if kind == "memory":
+        broker = InMemoryBroker("b", max_attempts=2, retry_delay=0.01)
+    else:
+        broker = SqliteBroker("b", tmp_path / "broker.db", max_attempts=2,
+                              retry_delay=0.01, poll_interval=0.01)
+    attempts = []
+
+    async def warming(msg):
+        attempts.append(msg.attempt)
+        if len(attempts) <= 4:  # twice the attempt budget
+            return Nack(retry_after=0.01, counts_attempt=False)
+        return True
+
+    await broker.subscribe("t", "g", warming)
+    await broker.publish("t", {"x": 1})
+    deadline = asyncio.get_running_loop().time() + 5
+    while len(attempts) < 5:
+        assert asyncio.get_running_loop().time() < deadline
+        await asyncio.sleep(0.01)
+    assert attempts == [1, 1, 1, 1, 1]  # the budget never moved
+    await broker.aclose()
+
+
+@pytest.mark.asyncio
+async def test_runtime_turns_retry_after_responses_into_backoff(tmp_path):
+    """End to end through the runtime: a subscription handler answering
+    503+Retry-After (the serving app's warmup answer) gets the message
+    back after the hinted delay, with no attempt burned — more 503
+    rounds than maxRetries and it still completes instead of
+    dead-lettering."""
+    from tasksrunner import App, InProcCluster
+    from tasksrunner.app import Response
+    from tasksrunner.component.spec import parse_component
+
+    specs = [parse_component(
+        {"componentType": "pubsub.in-memory",
+         "metadata": [{"name": "maxRetries", "value": "2"},
+                      {"name": "retryDelaySeconds", "value": "0.01"}]},
+        default_name="bus")]
+    app = App("warming-worker")
+    calls = []
+
+    @app.subscribe(pubsub="bus", topic="jobs", route="/job")
+    async def job(req):
+        calls.append(req.data["n"])
+        if len(calls) <= 4:  # twice the attempt budget
+            return Response(503, {"error": "model loading"},
+                            headers={"Retry-After": "0.01"})
+        return 200
+
+    cluster = InProcCluster(specs)
+    cluster.add_app(app)
+    cluster.add_app(App("sender"))
+    await cluster.start()
+    try:
+        await cluster.client("sender").publish_event("bus", "jobs", {"n": 7})
+        deadline = asyncio.get_running_loop().time() + 5
+        while len(calls) < 5:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        assert calls == [7, 7, 7, 7, 7]
+    finally:
+        await cluster.stop()
+
+
+# -- the real service under burst ---------------------------------------
+
+@pytest.mark.asyncio
+async def test_score_burst_over_the_sidecar(monkeypatch):
+    """A concurrent /score burst through the real service on the
+    runtime: every response matches its request's taskId, batches
+    bigger than one actually formed, and the jit cache is exactly one
+    entry per warmed bucket before AND after the burst."""
+    from tasksrunner import App, InProcCluster
+    from tasksrunner.component.spec import parse_component
+    from tasksrunner.ml.service import PRIORITY_LABELS, make_app
+
+    monkeypatch.setenv("TASKSRUNNER_ML_BUCKETS", "1,2,4,8")
+    monkeypatch.setenv("TASKSRUNNER_ML_MAX_BATCH", "8")
+    specs = [
+        parse_component({"componentType": "state.in-memory"},
+                        default_name="scores"),
+        parse_component({"componentType": "pubsub.in-memory"},
+                        default_name="taskspubsub"),
+    ]
+    cluster = InProcCluster(specs)
+    cluster.add_app(make_app())
+    cluster.add_app(App("burst-driver"))
+    await cluster.start()
+    try:
+        client = cluster.client("burst-driver")
+        stats = (await client.invoke_method(
+            "priority-scorer", "ml/stats", http_method="GET")).json()
+        assert stats["ready"]
+        assert stats["jit_cache_size"] == 4  # one per bucket, warmed
+
+        async def one(i: int):
+            resp = await client.invoke_method(
+                "priority-scorer", "score",
+                data={"taskId": f"burst-{i}",
+                      "taskName": f"task number {i} " + "pad " * (i % 5)})
+            assert resp.status == 200
+            doc = resp.json()
+            assert doc["taskId"] == f"burst-{i}"
+            assert doc["priority"] in PRIORITY_LABELS
+            assert 0.0 < doc["confidence"] <= 1.0
+
+        await asyncio.gather(*(one(i) for i in range(48)))
+        stats = (await client.invoke_method(
+            "priority-scorer", "ml/stats", http_method="GET")).json()
+        assert stats["jit_cache_size"] == 4  # burst compiled nothing
+        assert stats["submitted"] == 48 and stats["completed"] == 48
+        # concurrency actually batched: fewer executions than requests
+        assert sum(stats["batches"].values()) < 48
+        assert any(int(b) > 1 for b in stats["batches"])
+    finally:
+        await cluster.stop()
