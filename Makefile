@@ -1,6 +1,6 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test lint lint-program lint-dataflow lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-overload bench-actors bench-repl bench-mesh bench-ml-serve chaos sweep-flash run validate docs-serve docs-build clean
+.PHONY: test lint lint-program lint-dataflow lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-overload bench-actors bench-workflows bench-repl bench-mesh bench-ml-serve chaos sweep-flash run validate docs-serve docs-build clean
 
 test: lint lint-program lint-dataflow
 	python -m pytest tests/ -q
@@ -71,6 +71,14 @@ bench-overload:
 bench-actors:
 	python -m pytest tests/test_actors.py -q -m "not slow"
 	python bench.py --actor-bench
+
+# durable workflows: the test suite (replay determinism, sagas, the
+# chaos + kill -9 recovery drills), then the bench section — saga
+# throughput, replay-recovery latency after an owner crash, and the
+# history-append overhead of a workflow step vs a bare actor turn
+bench-workflows:
+	python -m pytest tests/test_workflows.py -q -m "not slow"
+	python bench.py --workflow-bench
 
 # replicated state plane: the replication test matrix (record stream,
 # fencing, resync, mesh transport, kill -9 drill), then the RF {1,2,3}

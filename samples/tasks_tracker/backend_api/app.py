@@ -26,6 +26,7 @@ from samples.tasks_tracker.backend_api.managers import (
     TasksManager,
     TasksStoreManager,
 )
+from samples.tasks_tracker.backend_api.workflows import register_workflows
 
 APP_ID = "tasksmanager-backend-api"
 
@@ -98,5 +99,10 @@ def make_app(manager: str | TasksManager | None = None) -> App:
     async def mark_overdue(req):
         await tasks().mark_overdue_tasks(req.json() or [])
         return 200
+
+    # -- durable workflows (module 21) -----------------------------------
+    # registration is unconditional and cheap: the engine is lazy, and
+    # with TASKSRUNNER_WORKFLOWS unset the runtime never hosts it
+    register_workflows(app, tasks)
 
     return app

@@ -1,0 +1,152 @@
+"""Durable workflow scenarios for the tasks tracker (docs module 21).
+
+Three shapes, one per guarantee the engine adds over bare handlers:
+
+* ``checkout`` — the saga: reserve each line item, charge the card,
+  send the confirmation; any late failure runs the registered
+  compensations in reverse order, exactly once, even across a
+  ``kill -9`` of the owning replica.
+* ``overdue-escalation`` — reminder-driven: a durable timer wakes the
+  instance per escalation level, so the nag survives host loss and
+  fires on whichever replica adopts the instance.
+* ``overdue-sweep`` — fan-out/fan-in: one collection activity, then a
+  per-task marking activity for every due task, joined by
+  ``ctx.when_all``.
+
+Orchestrators are replayed, so they touch the world ONLY through
+``ctx.*`` (the ``workflow-determinism`` lint rule enforces this);
+every effect lives in an activity. Effects staged with
+``actx.stage_effect`` commit atomically with the history event that
+records the activity — exactly-once. Manager calls from activities are
+at-least-once (the body may re-run after a crash), which is fine here
+because marking a task overdue is idempotent.
+"""
+
+from __future__ import annotations
+
+from tasksrunner.resiliency.policy import RetrySpec
+
+#: the card limit the sample's pretend payment gateway enforces —
+#: start a checkout above it to watch the compensations run
+CARD_LIMIT = 500.0
+
+
+def register_workflows(app, tasks) -> None:
+    """Attach the scenario workflows to ``app``; ``tasks`` is the
+    zero-arg accessor returning the active ``TasksManager``."""
+
+    # -- checkout: the compensation saga ------------------------------
+
+    @app.workflow("checkout")
+    async def checkout(ctx, order):
+        order = dict(order or {})
+        order_id = order.get("orderId") or ctx.uuid4()
+        for item in order.get("items", []):
+            stock = await ctx.call_activity(
+                "reserve-stock", {"orderId": order_id, "item": item})
+            ctx.register_compensation("release-stock", stock)
+        receipt = await ctx.call_activity(
+            "charge-card",
+            {"orderId": order_id, "amount": order.get("amount", 0)})
+        ctx.register_compensation("refund-card", receipt)
+        await ctx.call_activity(
+            "send-confirmation",
+            {"orderId": order_id, "placedAt": ctx.now()})
+        return {"orderId": order_id, "receipt": receipt}
+
+    @app.activity("reserve-stock")
+    async def reserve_stock(actx, data):
+        actx.stage_effect(
+            f"checkout||{data['orderId']}||reserved||{data['item']}", data)
+        return data
+
+    @app.activity("release-stock")
+    async def release_stock(actx, data):
+        # the undo is a staged DELETE of the reservation — committed
+        # atomically with the `compensated` history event, so a crash
+        # between compensations never half-releases
+        actx.stage_effect(
+            f"checkout||{data['orderId']}||reserved||{data['item']}",
+            operation="delete")
+        return data["item"]
+
+    @app.activity("charge-card",
+                  retry=RetrySpec(policy="exponential", duration=0.05,
+                                  max_retries=3),
+                  timeout=10.0)
+    async def charge_card(actx, data):
+        amount = float(data.get("amount") or 0)
+        if amount > CARD_LIMIT:
+            raise RuntimeError(
+                f"card declined: {amount} exceeds limit {CARD_LIMIT}")
+        receipt = {"orderId": data["orderId"], "amount": amount,
+                   "attempt": actx.attempt}
+        actx.stage_effect(f"checkout||{data['orderId']}||charge", receipt)
+        return receipt
+
+    @app.activity("refund-card")
+    async def refund_card(actx, receipt):
+        actx.stage_effect(f"checkout||{receipt['orderId']}||charge",
+                          operation="delete")
+        actx.stage_effect(f"checkout||{receipt['orderId']}||refund", receipt)
+        return receipt["orderId"]
+
+    @app.activity("send-confirmation")
+    async def send_confirmation(actx, data):
+        actx.stage_effect(
+            f"checkout||{data['orderId']}||confirmation", data)
+        return data["orderId"]
+
+    # -- overdue escalation: durable timers ---------------------------
+
+    @app.workflow("overdue-escalation")
+    async def overdue_escalation(ctx, req):
+        req = dict(req or {})
+        task_id = req["taskId"]
+        interval = float(req.get("intervalSeconds", 3600.0))
+        levels = int(req.get("maxLevels", 3))
+        for level in range(1, levels + 1):
+            await ctx.sleep(interval)
+            task = await ctx.call_activity("check-task", task_id)
+            if task is None or task.get("isCompleted"):
+                return {"taskId": task_id, "outcome": "completed",
+                        "nags": level - 1}
+            await ctx.call_activity(
+                "escalate", {"taskId": task_id, "level": level,
+                             "at": ctx.now()})
+        await ctx.call_activity("mark-task-overdue", {"taskId": task_id})
+        return {"taskId": task_id, "outcome": "overdue", "nags": levels}
+
+    @app.activity("check-task")
+    async def check_task(actx, task_id):
+        task = await tasks().get_task_by_id(task_id)
+        return None if task is None else task.to_json()
+
+    @app.activity("escalate")
+    async def escalate(actx, data):
+        # the audit trail is the exactly-once part; a real deployment
+        # would also publish a nag notification here (at-least-once)
+        actx.stage_effect(
+            f"escalation||{data['taskId']}||{data['level']}", data)
+        return data["level"]
+
+    @app.activity("mark-task-overdue")
+    async def mark_task_overdue(actx, doc):
+        # idempotent by construction: marking an overdue task overdue
+        # again is a no-op, so at-least-once execution is harmless
+        await tasks().mark_overdue_tasks([doc])
+        actx.stage_effect(f"overdue||{doc['taskId']}", doc)
+        return doc["taskId"]
+
+    # -- overdue sweep: fan-out/fan-in --------------------------------
+
+    @app.workflow("overdue-sweep")
+    async def overdue_sweep(ctx, _req):
+        due = await ctx.call_activity("collect-due-tasks", None)
+        marked = await ctx.when_all(
+            [ctx.call_activity("mark-task-overdue", doc) for doc in due])
+        return {"swept": len(marked), "taskIds": marked}
+
+    @app.activity("collect-due-tasks")
+    async def collect_due_tasks(actx, _data):
+        return [t.to_json() for t in await tasks().get_yesterdays_due_tasks()]

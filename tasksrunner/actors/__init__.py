@@ -5,7 +5,7 @@ named unit of state + behavior (``("Counter", "user-7")``) that the
 runtime materializes on exactly one replica at a time. Apps register a
 turn handler per actor type with ``@app.actor("Counter")``; clients
 call ``client.invoke_actor(...)`` and never learn (or care) where the
-actor lives. See docs/modules/10-actors.md for the model, guarantees,
+actor lives. See docs/modules/18-actors.md for the model, guarantees,
 and failure semantics; gated by ``TASKSRUNNER_ACTORS`` (off).
 """
 

@@ -149,6 +149,12 @@ class ActorRuntime:
         self._sweep_task: asyncio.Task | None = None
         self._session = None  # outbound forwards to peer sidecars
         self._rec_turn: dict[str, Any] = {}
+        #: async callbacks ``(actor_type, actor_id, method, result)``
+        #: invoked after a reminder-driven turn commits — how the
+        #: workflow runtime learns an adopted instance made progress
+        #: and needs pumping (a direct invoke already returns its
+        #: result to the caller; reminder results die here otherwise)
+        self.turn_observers: list = []
 
     # -- lifecycle -------------------------------------------------------
 
@@ -530,8 +536,21 @@ class ActorRuntime:
                         reminders[reminder_name] = rem
                     else:
                         reminders.pop(reminder_name)
+            # staged reminder changes land AFTER the fired-reminder
+            # re-arm/pop above, so a handler re-setting (or clearing)
+            # the very reminder that fired wins over the default
+            now = time.time()
+            for rname, spec in (doc.get("reminders_set") or {}).items():
+                reminders[rname] = {
+                    "due": now + max(0.0, float(spec.get("dueSeconds", 0.0))),
+                    "period": spec.get("periodSeconds"),
+                    "data": spec.get("data"),
+                }
+            for rname in doc.get("reminders_clear") or []:
+                reminders.pop(rname, None)
             await self._commit(act, actor_type, actor_id,
-                               new_data=new_state, new_reminders=reminders)
+                               new_data=new_state, new_reminders=reminders,
+                               effects=doc.get("effects") or None)
             rec_latency(time.perf_counter() - started)
             metrics.inc("actor_turns_total", type=actor_type, status="ok")
             if kind == "reminder":
@@ -540,16 +559,34 @@ class ActorRuntime:
 
     async def _commit(self, act: _Activation, actor_type: str,
                       actor_id: str, *, new_data: dict,
-                      new_reminders: dict) -> None:
+                      new_reminders: dict,
+                      effects: list | None = None) -> None:
         """The only writer of the actor record — etag-guarded, called
         with the turn lock held. Success is the precondition for the
-        ack; EtagMismatch means we were fenced."""
+        ack; EtagMismatch means we were fenced.
+
+        With ``effects`` the record write and every staged effect go
+        through ONE store transaction guarded by the record's etag: a
+        fenced zombie loses the whole transaction, so effects inherit
+        the record's exactly-once-per-acked-turn guarantee."""
         record = {"epoch": act.epoch, "data": new_data,
                   "reminders": new_reminders}
+        rkey = record_key(actor_type, actor_id)
         try:
-            act.etag = await self.runtime.save_state_item(
-                self.store, record_key(actor_type, actor_id), record,
-                etag=act.etag)
+            if not effects:
+                act.etag = await self.runtime.save_state_item(
+                    self.store, rkey, record, etag=act.etag)
+            else:
+                ops = [{"operation": "upsert",
+                        "request": {"key": rkey, "value": record,
+                                    "etag": act.etag}}]
+                for eff in effects:
+                    req: dict[str, Any] = {"key": str(eff["key"])}
+                    if eff.get("operation", "upsert") == "upsert":
+                        req["value"] = eff.get("value")
+                    ops.append({"operation": eff.get("operation", "upsert"),
+                                "request": req})
+                await self.runtime.transact_state(self.store, ops)
         except EtagMismatch as exc:
             self._deactivate(actor_type, actor_id)
             metrics.inc("actor_fenced_total", type=actor_type)
@@ -558,6 +595,20 @@ class ActorRuntime:
                 f"actor {actor_type}/{actor_id}: commit fenced — a newer "
                 f"owner bumped past epoch {act.epoch}; this turn was NOT "
                 "applied (retry against the new owner)") from exc
+        if effects:
+            # transact returns no etag; read back and adopt it — but
+            # only while the record still carries OUR epoch. Epochs are
+            # unique per ownership generation, so an epoch mismatch
+            # means a new owner fenced in between and the etag we'd
+            # adopt is theirs, not ours.
+            check = await self.runtime.get_state(self.store, rkey)
+            if check is None or int(check.value.get("epoch", -1)) != act.epoch:
+                self._deactivate(actor_type, actor_id)
+                raise ActorFencedError(
+                    f"actor {actor_type}/{actor_id}: fenced right after an "
+                    f"effectful commit (epoch {act.epoch} superseded); the "
+                    "turn WAS applied but this owner is done")
+            act.etag = check.etag
         act.data = new_data
         act.reminders = new_reminders
 
@@ -717,11 +768,18 @@ class ActorRuntime:
             if float(rem.get("due", 0.0)) > now:
                 continue
             try:
-                await self._execute_turn(
+                result = await self._execute_turn(
                     act, actor_type, actor_id, method=name,
                     data=rem.get("data"), kind="reminder",
                     reminder_name=name)
                 fired += 1
+                for observer in self.turn_observers:
+                    try:
+                        await observer(actor_type, actor_id, name, result)
+                    except Exception:  # tasklint: disable=error-taxonomy (observer)
+                        logger.exception(
+                            "turn observer failed after reminder %s on "
+                            "%s/%s", name, actor_type, actor_id)
             except ActorFencedError:
                 return fired  # lost the actor mid-sweep; the new owner fires
             except TasksRunnerError as exc:

@@ -17,7 +17,21 @@ class ActorTurn:
     it) and returns a JSON-serializable result for the caller. The
     owning replica commits ``state`` with an etag-guarded write AFTER
     the handler returns — the turn is acked only once that commit
-    resolves, which is what makes an ack durable across a crash."""
+    resolves, which is what makes an ack durable across a crash.
+
+    Beyond ``state``, a handler may stage two further kinds of change
+    that ride the SAME etag-guarded commit:
+
+    * **effects** (:meth:`stage_effect`) — writes to other keys in the
+      actor store, applied in one transaction with the record. A fenced
+      zombie loses the whole transaction, so an effect is applied
+      exactly once per acked turn — the primitive the workflow engine's
+      exactly-once activity contract is built on.
+    * **reminder changes** (:meth:`set_reminder` /
+      :meth:`clear_reminder`) — folded into the record's reminder table
+      before the commit, so a turn and the schedule it arms (or
+      disarms) are atomic: no crash window between them.
+    """
 
     actor_type: str
     actor_id: str
@@ -29,7 +43,39 @@ class ActorTurn:
     kind: str = "turn"
     #: reminder name when kind == "reminder"
     reminder: str | None = None
+    #: staged state ops committed atomically with the record
+    effects: list = field(default_factory=list)
+    #: staged reminder registrations / removals (name → spec / names)
+    reminder_sets: dict = field(default_factory=dict)
+    reminder_clears: list = field(default_factory=list)
 
     @property
     def is_reminder(self) -> bool:
         return self.kind == "reminder"
+
+    def stage_effect(self, key: str, value: Any = None, *,
+                     operation: str = "upsert") -> None:
+        """Stage a write to ``key`` in the actor store, committed in
+        one transaction with this turn's record write (and therefore
+        fenced together with it)."""
+        if operation not in ("upsert", "delete"):
+            raise ValueError(f"unknown effect operation {operation!r}")
+        self.effects.append(
+            {"operation": operation, "key": key, "value": value})
+
+    def set_reminder(self, name: str, due_seconds: float, *,
+                     period_seconds: float | None = None,
+                     data: Any = None) -> None:
+        """Register (or replace) a reminder atomically with this turn."""
+        self.reminder_clears = [n for n in self.reminder_clears if n != name]
+        self.reminder_sets[name] = {
+            "dueSeconds": float(due_seconds),
+            "periodSeconds": period_seconds,
+            "data": data,
+        }
+
+    def clear_reminder(self, name: str) -> None:
+        """Remove a reminder atomically with this turn."""
+        self.reminder_sets.pop(name, None)
+        if name not in self.reminder_clears:
+            self.reminder_clears.append(name)

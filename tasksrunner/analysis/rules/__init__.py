@@ -26,4 +26,5 @@ from tasksrunner.analysis.rules import (  # noqa: F401
     taxonomy,
     threadshared,
     transitive,
+    workflows,
 )

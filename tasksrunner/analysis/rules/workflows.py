@@ -1,0 +1,128 @@
+"""workflow-determinism — orchestrators observe the world through ctx.
+
+The workflow engine replays an orchestrator from its recorded history
+after every suspension and every crash (``workflows/engine.py``). The
+correctness of replay rests on the orchestrator being a *deterministic
+function of (history, input)*: re-executing it must take the same
+branches and create the same task sequence, or the recorded outcomes
+no longer line up and the engine fails the instance with
+``WorkflowNondeterminismError`` — at runtime, possibly days in.
+
+This rule moves that failure to lint time by flagging the two ways
+orchestrators go nondeterministic:
+
+* **ambient inputs** — wall clock (``time.time()``,
+  ``datetime.now()``), randomness (``random.*``, ``uuid.uuid4()``),
+  and process environment (``os.environ`` / ``os.getenv``) differ
+  between the original run and its replays. The deterministic
+  equivalents live on the context: ``ctx.now()``, ``ctx.random()``,
+  ``ctx.uuid4()``.
+* **direct side effects** — calling state / pubsub / invocation APIs
+  from the orchestrator body re-executes them on every replay. Effects
+  belong in activities (exactly-once via the history commit) —
+  ``ctx.call_activity`` is the only sanctioned way to touch the world.
+
+Activities (``@app.activity``) are intentionally NOT checked: they are
+the effectful half and may do anything an actor turn may do.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from tasksrunner.analysis.core import FileContext, Finding, Rule, register
+
+#: module-level calls whose results differ between replay passes:
+#: root name -> attribute names (empty set = every attribute)
+AMBIENT_CALLS: dict[str, set[str]] = {
+    "time": {"time", "time_ns", "monotonic", "monotonic_ns",
+             "perf_counter", "perf_counter_ns"},
+    "datetime": {"now", "utcnow", "today"},
+    "random": set(),  # every random.* draw is nondeterministic
+    "uuid": {"uuid1", "uuid4"},
+    "os": {"getenv"},
+}
+
+#: Runtime/AppClient effect-surface methods that must not be called
+#: from an orchestrator body — effects ride activities, which the
+#: history commit makes exactly-once
+EFFECT_API_ATTRS = {
+    "save_state", "save_state_item", "get_state", "delete_state",
+    "get_bulk_state", "publish", "invoke", "invoke_output_binding",
+    "invoke_actor",
+}
+
+
+def _is_workflow_decorator(dec: ast.expr) -> bool:
+    """``@app.workflow("name")`` — a call of an attribute ``workflow``."""
+    return (isinstance(dec, ast.Call)
+            and isinstance(dec.func, ast.Attribute)
+            and dec.func.attr == "workflow")
+
+
+def _root_name(node: ast.expr) -> str | None:
+    """The leftmost Name of an attribute chain: ``datetime.datetime.now``
+    → ``datetime``; ``self.x.y`` → None."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+@register
+class WorkflowDeterminism(Rule):
+    id = "workflow-determinism"
+    doc = ("workflow orchestrators must be deterministic: no wall clock "
+           "/ random / uuid / environ reads (use ctx.now / ctx.random / "
+           "ctx.uuid4) and no direct state/pubsub/invoke calls (do "
+           "effects in activities via ctx.call_activity)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in self.walk(ctx):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not any(_is_workflow_decorator(d)
+                       for d in node.decorator_list):
+                continue
+            yield from self._scan_body(ctx, node)
+
+    def _scan_body(self, ctx: FileContext, fn: ast.AST) -> Iterator[Finding]:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                yield from self._check_call(ctx, node)
+            elif (isinstance(node, ast.Attribute)
+                    and node.attr == "environ"
+                    and _root_name(node) == "os"):
+                yield ctx.finding(
+                    self.id, node,
+                    "os.environ read inside a workflow orchestrator — "
+                    "the environment differs between replays; resolve "
+                    "config in an activity and pass it through history")
+
+    def _check_call(self, ctx: FileContext,
+                    node: ast.Call) -> Iterator[Finding]:
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return
+        root = _root_name(func)
+        ambient = AMBIENT_CALLS.get(root) if root is not None else None
+        if ambient is not None and (not ambient or func.attr in ambient):
+            hint = {
+                "time": "use ctx.now()",
+                "datetime": "use ctx.now()",
+                "random": "use ctx.random()",
+                "uuid": "use ctx.uuid4()",
+                "os": "resolve config in an activity",
+            }[root]
+            yield ctx.finding(
+                self.id, node,
+                f"{root}.{func.attr}() inside a workflow orchestrator "
+                f"replays to a different value — {hint}; orchestrators "
+                "must be deterministic functions of (history, input)")
+        elif func.attr in EFFECT_API_ATTRS:
+            yield ctx.finding(
+                self.id, node,
+                f".{func.attr}() inside a workflow orchestrator re-runs "
+                "on every replay — move the effect into an activity "
+                "(ctx.call_activity), which the history commit makes "
+                "exactly-once")

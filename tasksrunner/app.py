@@ -150,6 +150,9 @@ class App:
         self.binding_routes: list[BindingEntry] = []
         #: actor type → turn handler, registered with @app.actor(...)
         self.actors: dict[str, Handler] = {}
+        #: WorkflowEngine once the first @app.workflow / @app.activity
+        #: registered (it hosts the ``_Workflow`` actor type above)
+        self.workflow_engine: Any = None
         self._startup_hooks: list[Callable[[], Awaitable[None]]] = []
         self._shutdown_hooks: list[Callable[[], Awaitable[None]]] = []
         #: set by the serving harness; the app's handle to its sidecar
@@ -310,6 +313,66 @@ class App:
 
         return register
 
+    def _workflow_engine(self):
+        """Lazily build the workflow engine and host its actor type —
+        importing tasksrunner.workflows only when an app actually
+        registers a workflow keeps the plain-app import graph flat."""
+        engine = self.workflow_engine
+        if engine is None:
+            from tasksrunner.workflows import (
+                WORKFLOW_ACTOR_TYPE,
+                WorkflowEngine,
+            )
+            engine = self.workflow_engine = WorkflowEngine(self)
+            self.actors[WORKFLOW_ACTOR_TYPE] = engine.handle_turn
+        return engine
+
+    def workflow(self, name: str):
+        """Register a deterministic orchestrator function (replayed
+        from history — observe the world only through ``ctx``; the
+        workflow-determinism lint rule enforces this)::
+
+            @app.workflow("checkout")
+            async def checkout(ctx, order):
+                paid = await ctx.call_activity("charge", order)
+                ctx.register_compensation("refund", paid)
+                await ctx.call_activity("ship", order)
+                return {"paid": paid}
+        """
+        def register(handler: Handler) -> Handler:
+            if not inspect.iscoroutinefunction(handler):
+                raise ValidationError(
+                    f"workflow orchestrators must be 'async def' "
+                    f"({name}: {getattr(handler, '__name__', handler)!r} "
+                    "is synchronous)")
+            self._workflow_engine().register_workflow(name, handler)
+            return handler
+
+        return register
+
+    def activity(self, name: str, *, retry=None, timeout: float | None = None):
+        """Register an activity — the effectful half of a workflow.
+        ``retry`` takes a :class:`~tasksrunner.resiliency.RetrySpec`
+        (defaulting to a bounded exponential policy), ``timeout`` a
+        per-attempt deadline in seconds::
+
+            @app.activity("charge", retry=RetrySpec(max_retries=5))
+            async def charge(ctx, order):
+                ctx.stage_effect(f"charge||{ctx.instance}", order)
+                return {"charged": order["amount"]}
+        """
+        def register(handler: Handler) -> Handler:
+            if not inspect.iscoroutinefunction(handler):
+                raise ValidationError(
+                    f"workflow activities must be 'async def' "
+                    f"({name}: {getattr(handler, '__name__', handler)!r} "
+                    "is synchronous)")
+            self._workflow_engine().register_activity(
+                name, handler, retry=retry, timeout=timeout)
+            return handler
+
+        return register
+
     def on_startup(self, fn: Callable[[], Awaitable[None]]):
         self._startup_hooks.append(fn)
         return fn
@@ -414,7 +477,17 @@ class App:
         started = time.time()
         try:
             result = await handler(turn)
-            resp = Response(body={"state": turn.state, "result": result})
+            out = {"state": turn.state, "result": result}
+            # staged atomics ride the response only when used, keeping
+            # the wire doc identical to the pre-workflow protocol for
+            # plain actors (old sidecars ignore unknown keys anyway)
+            if turn.effects:
+                out["effects"] = turn.effects
+            if turn.reminder_sets:
+                out["reminders_set"] = turn.reminder_sets
+            if turn.reminder_clears:
+                out["reminders_clear"] = turn.reminder_clears
+            resp = Response(body=out)
         except TasksRunnerError as exc:
             resp = Response(status=exc.http_status, body={"error": str(exc)})
         except Exception:

@@ -183,6 +183,23 @@ class ChaosPolicies:
                 return policy
         return None
 
+    def for_workflow(self, workflow: str,
+                     activity: str | None = None) -> ChaosPolicy | None:
+        """Faults applied inside workflow activity attempts. Resolution
+        is most-specific first — ``workflow/activity`` beats
+        ``workflow`` — so a drill can poison exactly one saga step. The
+        engine consults this on the instance's OWNING replica, inside
+        the attempt, so a crashEveryN rule here fells whoever is
+        executing that step right now (placement-following, like
+        :meth:`for_actor`)."""
+        keys = ((f"{workflow}/{activity}", workflow)
+                if activity is not None else (workflow,))
+        for key in keys:
+            policy = self._resolve("workflows", key, "activity")
+            if policy is not None:
+                return policy
+        return None
+
     def _resolve(self, kind: str, name: str, direction: str) -> ChaosPolicy | None:
         cache_key = (kind, name, direction)
         if cache_key in self._cache:
@@ -195,6 +212,8 @@ class ChaosPolicies:
                 refs = spec.actor_targets.get(name)
             elif kind == "replication":
                 refs = spec.replication_targets.get(name)
+            elif kind == "workflows":
+                refs = spec.workflow_targets.get(name)
             else:
                 refs = (spec.component_targets.get(name) or {}).get(direction)
             if not refs:
@@ -234,6 +253,10 @@ class ChaosPolicies:
                 ] + [
                     f"replication/{lane}/stream"
                     for lane, refs in spec.replication_targets.items()
+                    if rule.name in refs
+                ] + [
+                    f"workflows/{key}/activity"
+                    for key, refs in spec.workflow_targets.items()
                     if rule.name in refs
                 ]
                 out.append({

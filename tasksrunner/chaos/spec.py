@@ -38,12 +38,22 @@ are inert unless the host runs with ``TASKSRUNNER_CHAOS=1``.
         replication:
           statestore/0/r1: [deadPeer]          # one leader→follower lane
           statestore: [slowStore]              # every lane of the store
+        workflows:
+          checkout/charge: [poison]            # one activity of one workflow
+          checkout: [slowStore]                # every activity of the workflow
 
 Replication targets address the record stream between a shard's leader
 and a follower (state/replication.py): the key is ``<store>``,
 ``<store>/<shard>``, or ``<store>/<shard>/<member>`` — most specific
 wins at resolution time, so a drill can blackhole exactly one
 leader→follower lane while the rest of the set replicates normally.
+
+Workflow targets follow the same most-specific-first shape: the key is
+``<workflow>`` or ``<workflow>/<activity>``, and the engine consults
+it on the OWNING replica inside each activity attempt — so a
+``crashEveryN`` rule on ``checkout/charge`` deterministically fells
+whichever replica is executing that saga step, wherever placement
+moved the instance (the workflow recovery drill's primitive).
 
 Each named fault carries exactly one fault kind:
 
@@ -163,6 +173,11 @@ class ChaosSpec:
     #: ``store/shard`` or ``store/shard/member`` (most specific wins).
     replication_targets: dict[str, tuple[str, ...]] = field(
         default_factory=dict)
+    #: workflow key → rule names, injected inside activity attempts on
+    #: the instance's owning replica. Keys are ``workflow`` or
+    #: ``workflow/activity`` (most specific wins).
+    workflow_targets: dict[str, tuple[str, ...]] = field(
+        default_factory=dict)
 
     def in_scope(self, app_id: str | None) -> bool:
         if not self.scopes or app_id is None:
@@ -280,6 +295,10 @@ def parse_chaos(doc: Mapping[str, Any], *, source: str | None = None) -> ChaosSp
         str(lane): _parse_rule_refs(raw, where=where, target=str(lane))
         for lane, raw in (targets.get("replication") or {}).items()
     }
+    workflow_targets = {
+        str(key): _parse_rule_refs(raw, where=where, target=str(key))
+        for key, raw in (targets.get("workflows") or {}).items()
+    }
 
     scopes = doc.get("scopes") or []
     if not isinstance(scopes, list) or not all(isinstance(s, str) for s in scopes):
@@ -288,7 +307,8 @@ def parse_chaos(doc: Mapping[str, Any], *, source: str | None = None) -> ChaosSp
     # dangling rule references fail at load time, like the Resiliency
     # loader: a typo must fail startup, not silently inject nothing
     all_refs = (list(app_targets.items()) + list(actor_targets.items())
-                + list(replication_targets.items())) + [
+                + list(replication_targets.items())
+                + list(workflow_targets.items())) + [
         (comp, ref)
         for comp, dirs in component_targets.items()
         for ref in dirs.values()
@@ -309,6 +329,7 @@ def parse_chaos(doc: Mapping[str, Any], *, source: str | None = None) -> ChaosSp
         component_targets=component_targets,
         actor_targets=actor_targets,
         replication_targets=replication_targets,
+        workflow_targets=workflow_targets,
     )
 
 

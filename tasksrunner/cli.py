@@ -559,6 +559,80 @@ def _cmd_actors(args) -> None:
               f"{dead or '-'}")
 
 
+def _cmd_workflows(args) -> None:
+    """The workflow plane, via ``--app-id``'s sidecar: list instances,
+    inspect one (``--history`` for the event log), start, terminate, or
+    deliver an external event."""
+    import json as json_mod
+
+    addr, headers = _resolve_sidecar(args)
+    input_doc = json_mod.loads(args.input) if args.input else None
+
+    async def go():
+        import aiohttp
+
+        timeout = aiohttp.ClientTimeout(total=15.0)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            if args.start:
+                url = (f"{addr.base_url}/v1.0/workflows/engine/"
+                       f"{args.start}/start")
+                params = ({"instanceID": args.instance}
+                          if args.instance else None)
+                async with s.post(url, headers=headers, json=input_doc,
+                                  params=params) as r:
+                    return r.status, await r.read()
+            if args.terminate:
+                url = (f"{addr.base_url}/v1.0/workflows/engine/"
+                       f"{args.instance}/terminate")
+                async with s.post(url, headers=headers,
+                                  json={"reason": args.reason}) as r:
+                    return r.status, await r.read()
+            if args.raise_event:
+                url = (f"{addr.base_url}/v1.0/workflows/engine/"
+                       f"{args.instance}/raiseEvent/{args.raise_event}")
+                async with s.post(url, headers=headers, json=input_doc) as r:
+                    return r.status, await r.read()
+            if args.instance:
+                url = f"{addr.base_url}/v1.0/workflows/engine/{args.instance}"
+                if args.history:
+                    url += "/history"
+                async with s.get(url, headers=headers) as r:
+                    return r.status, await r.read()
+            async with s.get(f"{addr.base_url}/v1.0/workflows",
+                             headers=headers) as r:
+                return r.status, await r.read()
+
+    if (args.terminate or args.raise_event or args.history) \
+            and not args.instance:
+        raise SystemExit("this operation needs an instance id")
+    status, raw = asyncio.run(go())
+    if status == 404 and not args.instance and not args.start:
+        raise SystemExit("workflow API not found — is "
+                         "TASKSRUNNER_WORKFLOWS=1 set on the app?")
+    if status >= 400:
+        raise SystemExit(f"HTTP {status}: {raw.decode('utf-8', 'replace')}")
+    if not raw:
+        print("ok")
+        return
+    doc = json_mod.loads(raw)
+    if args.json or args.history or args.start \
+            or not isinstance(doc, dict) or "instances" not in doc:
+        print(json_mod.dumps(doc, indent=2))
+        return
+    rows = doc["instances"]
+    if not rows:
+        print("no workflow instances")
+        return
+    width = max(8, max(len(r["instance"]) for r in rows))
+    wfw = max(8, max(len(r.get("workflow") or "") for r in rows))
+    print(f"{'INSTANCE':<{width}}  {'WORKFLOW':<{wfw}}  "
+          f"{'STATUS':<10}  {'EVENTS':>6}  PARENT")
+    for r in rows:
+        print(f"{r['instance']:<{width}}  {r.get('workflow') or '-':<{wfw}}  "
+              f"{r.get('status') or '-':<10}  {r.get('events') or 0:>6}  "
+              f"{r.get('parent') or '-'}")
+
+
 def _cmd_lint(args) -> None:
     from tasksrunner.analysis.engine import main as tasklint_main
     # argparse.REMAINDER keeps a leading "--" separator; drop it
@@ -1504,6 +1578,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true")
     p.add_argument("--registry-file", **registry_arg)
     p.set_defaults(fn=_cmd_actors)
+
+    p = sub.add_parser(
+        "workflows", help="durable workflow instances "
+                          "(list / status / start / terminate / raise)")
+    p.add_argument("instance", nargs="?", default=None,
+                   help="instance id: show its status (default lists all)")
+    p.add_argument("--app-id", required=True,
+                   help="any workflow-hosting app replica")
+    p.add_argument("--history", action="store_true",
+                   help="dump the instance's full event history")
+    p.add_argument("--start", default=None, metavar="WORKFLOW",
+                   help="start WORKFLOW (optionally with a fixed instance "
+                        "id and --input)")
+    p.add_argument("--terminate", action="store_true",
+                   help="terminate the instance (--reason records why)")
+    p.add_argument("--reason", default="terminated")
+    p.add_argument("--raise-event", default=None, metavar="EVENT",
+                   help="deliver external event EVENT (payload via --input)")
+    p.add_argument("--input", default=None,
+                   help="JSON payload for --start / --raise-event")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--registry-file", **registry_arg)
+    p.set_defaults(fn=_cmd_workflows)
 
     p = sub.add_parser("stop", help="SIGTERM a registered app host")
     p.add_argument("app_id")

@@ -145,6 +145,18 @@ FLAGS: dict[str, Flag] = dict([
        "span retention sweep horizon in seconds (<= 0 keeps everything)"),
     _f("TASKSRUNNER_UVLOOP", "bool", "off",
        "install uvloop's event-loop policy when the package is available"),
+    _f("TASKSRUNNER_WORKFLOWS", "bool", "off",
+       "durable workflow engine (orchestrators, activities, sagas) on "
+       "the actor runtime"),
+    _f("TASKSRUNNER_WORKFLOW_ACTIVITY_TIMEOUT_SECONDS", "float", "30",
+       "default per-attempt activity deadline when the activity "
+       "declares none"),
+    _f("TASKSRUNNER_WORKFLOW_HISTORY_RETAIN_SECONDS", "float", "3600",
+       "how long a terminal instance keeps its full history before the "
+       "GC reminder truncates it to a summary (<= 0 keeps everything)"),
+    _f("TASKSRUNNER_WORKFLOW_REPLAY_BATCH", "int", "16",
+       "max activity executions committed per workflow step turn; "
+       "bounds both turn length and the work a crash can lose"),
 ])
 
 #: names env_flag accepts — the env-flag-discipline rule sends every

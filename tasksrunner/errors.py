@@ -267,3 +267,39 @@ class PortInUseError(TasksRunnerError):
     naming the port and the usual causes (another replica, a leftover
     process) rather than a runpy traceback — the failure every
     workshop attendee hits at least once."""
+
+
+class WorkflowError(TasksRunnerError):
+    """A durable-workflow operation failed (tasksrunner/workflows/)."""
+
+    http_status = 500
+
+
+class WorkflowNotFound(WorkflowError):
+    """No workflow instance (or registered workflow name) matches."""
+
+    http_status = 404
+
+
+class WorkflowNondeterminismError(WorkflowError):
+    """Replay diverged from the recorded history.
+
+    The orchestrator scheduled different work on re-execution than the
+    history records (a different activity name at the same sequence
+    number, or fewer steps than events). That means the function read
+    something outside the workflow context — wall clock, randomness,
+    environment, live state — and its past decisions can no longer be
+    reconstructed. The instance is faulted rather than allowed to
+    re-run side effects; the workflow-determinism lint rule exists to
+    catch the mistake before it ships."""
+
+    http_status = 500
+
+
+class ActivityError(WorkflowError):
+    """An activity exhausted its retry policy (or failed with a
+    non-retriable error). Awaiting the activity's task inside the
+    orchestrator raises this — catchable there, so a saga can branch
+    into its compensation path."""
+
+    http_status = 500

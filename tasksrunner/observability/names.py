@@ -44,6 +44,11 @@ COUNTERS: dict[str, str] = {
     "ml_shed_total": "inference submits shed because the batch queue was full",
     "repl_fenced_total": "shard-leader sessions fenced by an epoch bump",
     "repl_failover_total": "shard leadership takeovers (epoch > 1 acquisitions)",
+    "workflow_started_total": "workflow instances started, by workflow",
+    "workflow_completed_total": "workflow instances reaching a terminal status, by workflow and status",
+    "workflow_activity_total": "workflow activity executions, by activity and status",
+    "workflow_compensation_total": "saga compensations fired, by workflow",
+    "workflow_replays_total": "orchestrator replays executed, by workflow",
 }
 
 #: point-in-time levels (the saturation probes live here)
@@ -83,6 +88,8 @@ HISTOGRAMS: dict[str, str] = {
     "ml_batch_size": "assembled micro-batch size (before bucket padding)",
     "ml_queue_wait_seconds": "inference queue wait (submit to batch start), per bucket",
     "ml_infer_latency_seconds": "micro-batch device execution, per padding bucket",
+    "workflow_activity_latency_seconds": "workflow activity execution, per activity",
+    "workflow_history_events": "history length at workflow commit, per workflow",
 }
 
 ALL: dict[str, str] = {**COUNTERS, **GAUGES, **HISTOGRAMS}

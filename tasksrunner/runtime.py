@@ -184,6 +184,9 @@ class Runtime:
         #: so peer replicas can forward turns here; set by
         #: Sidecar.start() before it calls runtime.start()
         self.actor_address: tuple[str, int] | None = None
+        #: WorkflowRuntime when TASKSRUNNER_WORKFLOWS is on and the app
+        #: hosts the workflow actor type; None otherwise
+        self.workflows = None
         #: drill switch forwarded to ActorRuntime (chaos failover test)
         self._actor_crash_on_chaos = False
         # cached metrics.recorder() closures for the per-request latency
@@ -751,9 +754,12 @@ class Runtime:
                 self._input_bindings.append(instance)
                 logger.info("input binding %s -> %s", name, instance.route)
 
-        # 3. virtual actors (gated; the off path costs one env read)
+        # 3. virtual actors (gated; the off path costs one env read).
+        # Workflows ride the actor substrate, so the workflow gate also
+        # boots actors — a workflow app need not set both flags.
         from tasksrunner.envflag import env_flag
-        if env_flag("TASKSRUNNER_ACTORS", default=False):
+        if (env_flag("TASKSRUNNER_ACTORS", default=False)
+                or env_flag("TASKSRUNNER_WORKFLOWS", default=False)):
             await self._start_actors()
         self._started = True
 
@@ -770,6 +776,30 @@ class Runtime:
         self.actors = ActorRuntime(self, types,
                                    crash_on_chaos=self._actor_crash_on_chaos)
         await self.actors.start()
+        await self._start_workflows()
+
+    async def _start_workflows(self) -> None:
+        """Attach the workflow runtime when the gate is on and the app
+        hosts the workflow actor type (it does as soon as it registered
+        one ``@app.workflow``)."""
+        from tasksrunner.envflag import env_flag
+        from tasksrunner.workflows import WORKFLOW_ACTOR_TYPE, WorkflowRuntime
+        if not env_flag("TASKSRUNNER_WORKFLOWS", default=False):
+            return
+        if self.actors is None or WORKFLOW_ACTOR_TYPE not in self.actors.types:
+            return
+        self.workflows = WorkflowRuntime(self, self.actors)
+        # in-proc apps get the runtime-side wiring pushed into their
+        # engine: chaos (so faults can target an activity), the crash
+        # hook (so a crash-mode fault fells THIS replica the way
+        # SIGKILL would), and the drive cadence (reminder period)
+        app = getattr(self.app_channel, "app", None)
+        engine = getattr(app, "workflow_engine", None)
+        if engine is not None:
+            engine.chaos = self.chaos
+            engine.crash_on_chaos = self._actor_crash_on_chaos
+            engine.crash_hook = self.actors.simulate_crash
+            engine.drive_period = self.actors.poll_seconds
 
     def _inbound_policy(self, component_name: str):
         """The component's inbound resiliency policy (if any) — applied
@@ -890,9 +920,14 @@ class Runtime:
         }
         if self.actors is not None:
             out["actors"] = self.actors.summary()
+        if self.workflows is not None:
+            out["workflows"] = self.workflows.summary()
         return out
 
     async def stop(self) -> None:
+        if self.workflows is not None:
+            self.workflows.detach()
+            self.workflows = None
         if self.actors is not None:
             await self.actors.stop()
             self.actors = None

@@ -257,8 +257,13 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
     # unset the sidecar's route table is byte-identical to before this
     # subsystem existed, so the off path adds zero routing or dispatch
     # cost (the <1% overhead budget measured by bench.py --actor-bench).
+    # TASKSRUNNER_WORKFLOWS also opens the gate: workflow instances ARE
+    # actors, and a replica that does not own an instance forwards the
+    # turn to the owner THROUGH these routes — without them every
+    # cross-replica workflow operation would 404 at the owner's door.
     from tasksrunner.envflag import env_flag
-    if env_flag("TASKSRUNNER_ACTORS", default=False):
+    if (env_flag("TASKSRUNNER_ACTORS", default=False)
+            or env_flag("TASKSRUNNER_WORKFLOWS", default=False)):
 
         @routes.route("*", "/v1.0/actors/{atype}/{aid}/method/{m}")
         @_traced(allow_peer=True)
@@ -324,6 +329,77 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
                 "replica": runtime.actors.summary(),
                 "placement": await runtime.actors.placement_table(),
             })
+
+    # -- workflows -------------------------------------------------------
+
+    # Dapr-shaped workflow routes ({component} is accepted for wire
+    # compatibility and ignored — the engine is the only backend).
+    # Gated like the actor routes: flag off = route table unchanged.
+    if env_flag("TASKSRUNNER_WORKFLOWS", default=False):
+        from tasksrunner.errors import WorkflowError
+
+        def _wf_plane():
+            if runtime.workflows is None:
+                raise WorkflowError(
+                    "the workflow plane is not running on this replica "
+                    "(no @app.workflow registered?)")
+            return runtime.workflows
+
+        @routes.post("/v1.0/workflows/{component}/{name}/start")
+        @_traced
+        async def start_workflow(request: web.Request):
+            body = await request.read()
+            data = json.loads(body) if body else None
+            instance = await _wf_plane().start(
+                request.match_info["name"], data,
+                instance=request.query.get("instanceID") or None)
+            return web.json_response({"instanceID": instance})
+
+        @routes.get("/v1.0/workflows/{component}/{instance}")
+        @_traced
+        async def workflow_status(request: web.Request):
+            return web.json_response(
+                await _wf_plane().status(request.match_info["instance"]))
+
+        @routes.get("/v1.0/workflows/{component}/{instance}/history")
+        @_traced
+        async def workflow_history(request: web.Request):
+            return web.json_response({
+                "instance": request.match_info["instance"],
+                "history": await _wf_plane().history(
+                    request.match_info["instance"]),
+            })
+
+        @routes.post("/v1.0/workflows/{component}/{instance}/terminate")
+        @_traced
+        async def terminate_workflow(request: web.Request):
+            body = await request.read()
+            data = json.loads(body) if body else {}
+            await _wf_plane().terminate(
+                request.match_info["instance"],
+                reason=str((data or {}).get("reason") or "terminated"))
+            return web.Response(status=202)
+
+        @routes.post("/v1.0/workflows/{component}/{instance}"
+                     "/raiseEvent/{event}")
+        @_traced
+        async def raise_workflow_event(request: web.Request):
+            body = await request.read()
+            data = json.loads(body) if body else None
+            await _wf_plane().raise_event(
+                request.match_info["instance"],
+                request.match_info["event"], data=data,
+                id=request.query.get("eventID") or None)
+            return web.Response(status=202)
+
+        @routes.get("/v1.0/workflows")
+        @_traced(exempt=True)
+        async def list_workflows(request: web.Request):
+            # operator surface, admission-exempt like /v1.0/actors
+            if runtime.workflows is None:
+                return web.json_response({"instances": []})
+            return web.json_response(
+                {"instances": await runtime.workflows.list()})
 
     # -- meta ------------------------------------------------------------
 

@@ -1,0 +1,32 @@
+"""Durable replay-based workflows on the actor runtime.
+
+See docs/modules/21-workflows.md. An orchestrator is a deterministic
+``async def (ctx, input)`` replayed against its committed event
+history; activities carry the side effects (with per-activity retry
+policies), compensations give saga semantics, timers ride the durable
+reminder machinery, and every scheduling turn commits atomically on
+the actor state plane — which is what makes the whole thing survive
+``kill -9`` between (and during) steps.
+"""
+
+from tasksrunner.workflows.context import (
+    ActivityContext,
+    WorkflowContext,
+)
+from tasksrunner.workflows.engine import (
+    DRIVE_REMINDER,
+    GC_REMINDER,
+    WORKFLOW_ACTOR_TYPE,
+    WorkflowEngine,
+)
+from tasksrunner.workflows.runtime import WorkflowRuntime
+
+__all__ = [
+    "ActivityContext",
+    "DRIVE_REMINDER",
+    "GC_REMINDER",
+    "WORKFLOW_ACTOR_TYPE",
+    "WorkflowContext",
+    "WorkflowEngine",
+    "WorkflowRuntime",
+]

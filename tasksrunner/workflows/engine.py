@@ -1,0 +1,520 @@
+"""The workflow engine: replay, scheduling, sagas — all inside actor turns.
+
+Every workflow instance IS a virtual actor (type ``_Workflow``, id =
+instance id), which buys the whole durability story for free:
+
+* **Single writer.** The actor's one-turn-at-a-time lock plus epoch
+  fencing mean exactly one live replica appends to an instance's
+  history; a zombie's commit dies on the etag chain.
+* **Atomic progress.** One scheduling turn = one commit: the history
+  events appended this turn, the activity effects they record, and the
+  reminder changes all land in a single etag-guarded store transaction
+  (``ActorRuntime._commit`` with effects). A crash mid-turn loses the
+  whole turn — the activities re-execute on replay (at-least-once
+  bodies), but their *effects* apply exactly once, because an effect
+  only exists in the same transaction as the event recording it.
+* **Automatic recovery.** The periodic ``__wfdrive`` reminder makes a
+  running instance adoptable: when its owner dies, a surviving
+  replica's sweep adopts the actor, fires the reminder, and the replay
+  converges from the committed history prefix.
+
+The orchestrator function itself is driven by ONE ``coro.send(None)``
+per replay: awaiting a task with a recorded outcome never suspends
+(see context.py), so the coroutine runs to its first unresolved await
+and every unresolved task created before that point is the schedulable
+frontier. ``TASKSRUNNER_WORKFLOW_REPLAY_BATCH`` bounds how many
+activities one turn executes — the knob that trades turn length
+against replayed work after a crash.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from typing import Any, Callable
+
+from tasksrunner.errors import (
+    ActivityError,
+    WorkflowError,
+    WorkflowNondeterminismError,
+    WorkflowNotFound,
+)
+from tasksrunner.observability.metrics import metrics
+from tasksrunner.resiliency.policy import RetrySpec
+from tasksrunner.workflows.context import (
+    CHILD_EVENT_PREFIX,
+    ActivityContext,
+    WorkflowContext,
+    _WorkflowTask,
+)
+
+logger = logging.getLogger(__name__)
+
+#: the actor type every workflow instance lives under
+WORKFLOW_ACTOR_TYPE = "_Workflow"
+#: periodic reminder that keeps a running instance adoptable + driven
+DRIVE_REMINDER = "__wfdrive"
+#: one-shot reminder that truncates a terminal instance's history
+GC_REMINDER = "__wfgc"
+
+_TERMINAL = ("completed", "failed", "terminated")
+
+DEFAULT_RETRY = RetrySpec(policy="exponential", duration=0.2,
+                          max_interval=5.0, max_retries=3)
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        logger.warning("ignoring non-numeric %s=%r; using %s",
+                       name, raw, default)
+        return default
+
+
+class _WorkflowCrashed(BaseException):
+    """A crash-mode chaos fault fell this replica mid-activity. A
+    BaseException on purpose: it must sail past every except-Exception
+    net (retry loops, the actor turn handler) so the turn dies WITHOUT
+    committing — exactly what SIGKILL would have done."""
+
+
+class WorkflowEngine:
+    """App-side registries plus the turn handler for ``_Workflow``."""
+
+    def __init__(self, app: Any):
+        self.app = app
+        self.workflows: dict[str, Callable] = {}
+        #: name → (fn, RetrySpec, per-attempt timeout seconds)
+        self.activities: dict[str, tuple] = {}
+        #: runtime-side wiring, pushed in by Runtime._start_workflows
+        self.chaos = None
+        self.crash_on_chaos = False
+        self.crash_hook: Callable[[], None] | None = None
+        self.drive_period = 2.0
+        self.replay_batch = max(1, int(_env_float(
+            "TASKSRUNNER_WORKFLOW_REPLAY_BATCH", 16)))
+        self.default_timeout = _env_float(
+            "TASKSRUNNER_WORKFLOW_ACTIVITY_TIMEOUT_SECONDS", 30.0)
+        self.retain_seconds = _env_float(
+            "TASKSRUNNER_WORKFLOW_HISTORY_RETAIN_SECONDS", 3600.0)
+
+    # -- registration ------------------------------------------------------
+
+    def register_workflow(self, name: str, fn: Callable) -> None:
+        if name in self.workflows:
+            raise WorkflowError(f"workflow {name!r} is already registered")
+        self.workflows[name] = fn
+
+    def register_activity(self, name: str, fn: Callable, *,
+                          retry: RetrySpec | None = None,
+                          timeout: float | None = None) -> None:
+        if name in self.activities:
+            raise WorkflowError(f"activity {name!r} is already registered")
+        self.activities[name] = (fn, retry or DEFAULT_RETRY,
+                                 timeout or self.default_timeout)
+
+    # -- the actor turn handler --------------------------------------------
+
+    async def handle_turn(self, turn: Any) -> dict:
+        """Every workflow operation is an actor turn on the instance —
+        serialized by the actor lock, committed atomically, fenced."""
+        method = turn.method
+        if turn.is_reminder:
+            if method == GC_REMINDER:
+                return self._gc(turn)
+            return await self._advance(turn)  # DRIVE_REMINDER
+        if method == "start":
+            return await self._start(turn)
+        if method == "step":
+            return await self._advance(turn)
+        if method == "raise":
+            return await self._raise(turn)
+        if method == "terminate":
+            return await self._terminate(turn)
+        raise WorkflowError(f"unknown workflow method {method!r}")
+
+    # -- operations --------------------------------------------------------
+
+    async def _start(self, turn: Any) -> dict:
+        data = turn.data or {}
+        name = str(data.get("wf") or "")
+        if name not in self.workflows:
+            raise WorkflowNotFound(
+                f"no workflow named {name!r} is registered "
+                f"(known: {sorted(self.workflows) or 'none'})")
+        if turn.state.get("wf"):
+            # idempotent restart: crash-retried starts and replayed
+            # child starts land here — report, don't reinitialize
+            return self._doc(turn, outcome=self._outcome_of(turn.state))
+        ts = time.time()
+        turn.state.update({
+            "wf": name,
+            "input": data.get("input"),
+            "status": "running",
+            "history": [{"t": "started", "ts": ts}],
+            "created": ts,
+            "updated": ts,
+            "parent": data.get("parent"),
+            "result": None,
+            "error": None,
+        })
+        turn.set_reminder(DRIVE_REMINDER, self.drive_period,
+                          period_seconds=self.drive_period)
+        metrics.inc("workflow_started_total", workflow=name)
+        return await self._advance(turn)
+
+    async def _raise(self, turn: Any) -> dict:
+        state = turn.state
+        if not state.get("wf"):
+            raise WorkflowNotFound(
+                f"workflow instance {turn.actor_id!r} was never started")
+        if state.get("status") in _TERMINAL:
+            return self._doc(turn, outcome=state["status"])
+        data = turn.data or {}
+        name = str(data.get("name") or "")
+        event_id = data.get("id")
+        if event_id is not None:
+            for event in state["history"]:
+                if (event.get("t") == "event_raised"
+                        and event.get("id") == event_id):
+                    # duplicate delivery (a retried child notification):
+                    # drop it, then still advance — idempotent
+                    return await self._advance(turn)
+        state["history"].append({
+            "t": "event_raised", "ts": time.time(), "name": name,
+            "data": data.get("data"), "id": event_id,
+        })
+        return await self._advance(turn)
+
+    async def _terminate(self, turn: Any) -> dict:
+        state = turn.state
+        if not state.get("wf"):
+            raise WorkflowNotFound(
+                f"workflow instance {turn.actor_id!r} was never started")
+        if state.get("status") in _TERMINAL:
+            return self._doc(turn, outcome=state["status"])
+        reason = str((turn.data or {}).get("reason") or "terminated")
+        state["history"].append(
+            {"t": "terminated", "ts": time.time(), "reason": reason})
+        self._finalize(turn, "terminated", error=reason)
+        return self._doc(turn, outcome="terminated")
+
+    def _gc(self, turn: Any) -> dict:
+        """Truncate a terminal instance's history to its last event (a
+        summary stub). The GC reminder is one-shot: the runtime already
+        popped it when it fired."""
+        state = turn.state
+        if state.get("status") in _TERMINAL and state.get("history"):
+            dropped = len(state["history"]) - 1
+            state["history"] = state["history"][-1:]
+            state["gc_dropped_events"] = dropped
+        return self._doc(turn, outcome=self._outcome_of(state))
+
+    # -- the scheduler -----------------------------------------------------
+
+    async def _advance(self, turn: Any) -> dict:
+        state = turn.state
+        if not state.get("wf"):
+            # adopted before start committed, or a stray reminder after
+            # GC of an unstarted record — nothing to do
+            return self._doc(turn, outcome="noop")
+        if state.get("status") in _TERMINAL:
+            turn.clear_reminder(DRIVE_REMINDER)
+            return self._doc(turn, outcome=state["status"])
+        wf_name = state["wf"]
+        orchestrator = self.workflows.get(wf_name)
+        if orchestrator is None:
+            # host rolled forward without this workflow registered:
+            # leave the instance intact for a replica that has it
+            logger.warning("instance %s references unregistered workflow %r",
+                           turn.actor_id, wf_name)
+            return self._doc(turn, outcome="blocked")
+
+        while True:
+            metrics.inc("workflow_replays_total", workflow=wf_name)
+            try:
+                kind, payload, ctx = self._replay(turn.actor_id, wf_name,
+                                                  state, orchestrator)
+            except WorkflowNondeterminismError as exc:
+                state["history"].append(
+                    {"t": "failed", "ts": time.time(), "error": str(exc)})
+                self._finalize(turn, "failed", error=str(exc))
+                return self._doc(turn, outcome="failed")
+
+            if kind == "done":
+                state["history"].append(
+                    {"t": "completed", "ts": time.time(), "result": payload})
+                self._finalize(turn, "completed", result=payload)
+                return self._doc(turn, outcome="completed")
+
+            if kind == "wf_failed":
+                return await self._compensate(turn, ctx, payload)
+
+            # suspended: fire due timers first — they only append
+            # events, so looping here is cheap and side-effect-free
+            pending = [t for t in ctx.tasks
+                       if not t.resolved and t.seq is not None]
+            now = time.time()
+            due = [t for t in pending
+                   if t.kind == "timer" and t.fire_at <= now]
+            if due:
+                for t in sorted(due, key=lambda t: t.seq):
+                    state["history"].append(
+                        {"t": "timer_fired", "ts": now, "seq": t.seq})
+                continue
+
+            runnable = [t for t in pending
+                        if t.kind == "activity"][:self.replay_batch]
+            if runnable:
+                await self._run_batch(turn, ctx, runnable)
+                self._touch(turn)
+                return self._doc(turn, outcome="running",
+                                 children=self._children(state, pending))
+            timers = [t for t in pending if t.kind == "timer"]
+            if timers:
+                # pull the drive reminder forward to the next timer
+                # fire — a 200ms durable timer must not wait for the
+                # periodic drive cadence to come around
+                delta = max(0.0, min(t.fire_at for t in timers) - now)
+                turn.set_reminder(DRIVE_REMINDER, delta,
+                                  period_seconds=self.drive_period)
+            self._touch(turn)
+            return self._doc(turn, outcome="blocked",
+                             children=self._children(state, pending))
+
+    def _replay(self, instance: str, wf_name: str, state: dict,
+                orchestrator: Callable):
+        """One replay pass: run the orchestrator against history, up to
+        its first unresolved await (or to the end)."""
+        ctx = WorkflowContext(instance=instance, workflow=wf_name,
+                              history=state["history"],
+                              input=state.get("input"))
+        coro = orchestrator(ctx, state.get("input"))
+        try:
+            yielded = coro.send(None)
+        except StopIteration as stop:
+            return "done", stop.value, ctx
+        except ActivityError as exc:
+            return "wf_failed", str(exc), ctx
+        except WorkflowNondeterminismError:
+            raise
+        except Exception as exc:  # tasklint: disable=error-taxonomy (orchestrator)
+            return "wf_failed", f"{type(exc).__name__}: {exc}", ctx
+        if not isinstance(yielded, _WorkflowTask):
+            raise WorkflowNondeterminismError(
+                f"workflow {wf_name!r} awaited a foreign awaitable "
+                f"({type(yielded).__name__}); orchestrators may only await "
+                "ctx.* tasks — do I/O inside activities")
+        # the coroutine is intentionally abandoned (not closed): replay
+        # rebuilds it from scratch next turn, and close() would inject
+        # GeneratorExit into orchestrator try/finally blocks mid-flight
+        return "suspended", yielded, ctx
+
+    # -- activity execution ------------------------------------------------
+
+    async def _run_batch(self, turn: Any, ctx: WorkflowContext,
+                         runnable: list[_WorkflowTask]) -> None:
+        """Execute up to one batch of activities concurrently; append
+        their outcome events and stage their effects onto this turn —
+        one commit for the whole batch."""
+        outcomes = await asyncio.gather(
+            *(self._run_activity(ctx, t.name, t.payload, seq=t.seq)
+              for t in runnable))
+        now = time.time()
+        for task, (ok, value, effects) in zip(runnable, outcomes):
+            if ok:
+                turn.state["history"].append({
+                    "t": "activity_completed", "ts": now,
+                    "seq": task.seq, "name": task.name, "result": value})
+            else:
+                turn.state["history"].append({
+                    "t": "activity_failed", "ts": now,
+                    "seq": task.seq, "name": task.name, "error": value})
+            turn.effects.extend(effects)
+
+    async def _run_activity(self, ctx: WorkflowContext, name: str,
+                            input: Any, *, seq: int,
+                            is_compensation: bool = False):
+        """One activity to completion under its retry policy. Never
+        raises (outcomes are data the scheduler records) — except
+        :class:`_WorkflowCrashed`, which must abort the whole turn."""
+        entry = self.activities.get(name)
+        if entry is None:
+            metrics.inc("workflow_activity_total", activity=name,
+                        status="unregistered")
+            return (False, f"no activity named {name!r} is registered", [])
+        fn, retry, timeout = entry
+        policy = (self.chaos.for_workflow(ctx.workflow, name)
+                  if self.chaos is not None else None)
+        delays = retry.delays()
+        attempt = 0
+        while True:
+            attempt += 1
+            actx = ActivityContext(
+                instance=ctx.instance, workflow=ctx.workflow, name=name,
+                seq=seq, attempt=attempt, is_compensation=is_compensation)
+            started = time.perf_counter()
+            try:
+                if policy is not None:
+                    # the fault fires on the OWNING replica, inside the
+                    # activity attempt — so a crashEveryN rule on
+                    # workflows.<wf>/<activity> deterministically fells
+                    # whoever is executing that step right now
+                    try:
+                        status = await policy.before_call()
+                    except BaseException as exc:
+                        if self.crash_on_chaos and self.crash_hook is not None:
+                            self.crash_hook()
+                            raise _WorkflowCrashed(
+                                f"chaos crash inside activity {name!r} "
+                                f"(instance {ctx.instance})") from exc
+                        raise
+                    if status is not None:
+                        policy.raise_for_status(status)
+                result = await asyncio.wait_for(fn(actx, input),
+                                                timeout=timeout)
+            except _WorkflowCrashed:
+                raise
+            except Exception as exc:  # tasklint: disable=error-taxonomy (activity)
+                error = f"{type(exc).__name__}: {exc}"
+                try:
+                    delay = next(delays)
+                except StopIteration:
+                    metrics.inc("workflow_activity_total", activity=name,
+                                status="error")
+                    logger.warning(
+                        "activity %s (instance %s, attempt %d) exhausted "
+                        "retries: %s", name, ctx.instance, attempt, error)
+                    return (False, error, [])
+                metrics.inc("workflow_activity_total", activity=name,
+                            status="retry")
+                await asyncio.sleep(delay)
+                continue
+            metrics.observe("workflow_activity_latency_seconds",
+                            time.perf_counter() - started, activity=name)
+            metrics.inc("workflow_activity_total", activity=name, status="ok")
+            return (True, result, actx.effects)
+
+    # -- sagas -------------------------------------------------------------
+
+    async def _compensate(self, turn: Any, ctx: WorkflowContext,
+                          error: str) -> dict:
+        """The orchestrator failed: run registered compensations in
+        reverse registration order. Each completed compensation appends
+        a ``compensated`` event — replay skips it forever after, which
+        is the exactly-once half; reverse order falls out of walking
+        the (replay-stable) registration list backwards."""
+        state = turn.state
+        done = {int(e["idx"]) for e in state["history"]
+                if e.get("t") == "compensated"}
+        remaining = [i for i in range(len(ctx.compensations) - 1, -1, -1)
+                     if i not in done]
+        ran = 0
+        for idx in remaining:
+            if ran >= self.replay_batch:
+                # bound the commit like a normal turn; the next drive
+                # turn replays, fails at the same point, and continues
+                self._touch(turn)
+                return self._doc(turn, outcome="running")
+            name, cinput = ctx.compensations[idx]
+            ok, value, effects = await self._run_activity(
+                ctx, name, cinput, seq=-(idx + 1), is_compensation=True)
+            event = {"t": "compensated", "ts": time.time(), "idx": idx,
+                     "name": name}
+            if not ok:
+                # a compensation out of retries is recorded (with its
+                # error) rather than wedging the saga forever — the
+                # history keeps the evidence for the operator
+                event["error"] = value
+            state["history"].append(event)
+            turn.effects.extend(effects)
+            metrics.inc("workflow_compensation_total", workflow=state["wf"])
+            ran += 1
+        state["history"].append(
+            {"t": "failed", "ts": time.time(), "error": error})
+        self._finalize(turn, "failed", error=error)
+        return self._doc(turn, outcome="failed")
+
+    # -- terminal & docs ---------------------------------------------------
+
+    def _finalize(self, turn: Any, status: str, *, result: Any = None,
+                  error: str | None = None) -> None:
+        state = turn.state
+        state["status"] = status
+        state["result"] = result
+        state["error"] = error
+        self._touch(turn)
+        turn.clear_reminder(DRIVE_REMINDER)
+        if self.retain_seconds > 0:
+            turn.set_reminder(GC_REMINDER, self.retain_seconds)
+        metrics.inc("workflow_completed_total", workflow=state["wf"],
+                    status=status)
+
+    def _touch(self, turn: Any) -> None:
+        turn.state["updated"] = time.time()
+        metrics.observe("workflow_history_events",
+                        len(turn.state.get("history") or ()),
+                        workflow=turn.state.get("wf") or "")
+
+    @staticmethod
+    def _outcome_of(state: dict) -> str:
+        status = state.get("status")
+        return status if status in _TERMINAL else "running"
+
+    def _children(self, state: dict,
+                  pending: list[_WorkflowTask]) -> tuple[list, list]:
+        """(start_children, pending_children) for the result doc. Both
+        are recomputed every turn — starts are idempotent on the child,
+        and the pending list lets the pump reconcile a lost completion
+        notification by polling the child's terminal state."""
+        start, waiting = [], []
+        for t in pending:
+            if t.kind != "child":
+                continue
+            child_instance = t.payload["instance"]
+            event = f"{CHILD_EVENT_PREFIX}{t.seq}"
+            start.append({
+                "instance": child_instance, "wf": t.name,
+                "input": t.payload.get("input"),
+                "parent": {"instance": None, "event": event},
+            })
+            waiting.append({"instance": child_instance, "event": event})
+        return start, waiting
+
+    def _doc(self, turn: Any, *, outcome: str,
+             children: tuple[list, list] | None = None) -> dict:
+        state = turn.state
+        start_children, pending_children = children or ([], [])
+        for child in start_children:
+            # the parent pointer needs OUR instance id, known here
+            child["parent"]["instance"] = turn.actor_id
+        doc: dict[str, Any] = {
+            "instance": turn.actor_id,
+            "workflow": state.get("wf"),
+            "status": state.get("status"),
+            "outcome": outcome,
+            "result": state.get("result"),
+            "error": state.get("error"),
+            "events": len(state.get("history") or ()),
+        }
+        if start_children:
+            doc["start_children"] = start_children
+        if pending_children:
+            doc["pending_children"] = pending_children
+        parent = state.get("parent")
+        if outcome in _TERMINAL and parent and parent.get("instance"):
+            doc["notify_parent"] = {
+                "instance": parent["instance"],
+                "event": parent["event"],
+                "data": ({"error": state.get("error")}
+                         if outcome in ("failed", "terminated")
+                         else {"result": state.get("result")}),
+                "id": f"{turn.actor_id}::done",
+            }
+        return doc

@@ -272,6 +272,52 @@ async def test_crash_every_n_is_exact():
     assert outcomes == ["ok", "ok", "boom"] * 3
 
 
+def test_workflow_targets_parse_roundtrip_and_validate():
+    """``targets.workflows`` keys are ``<workflow>`` or
+    ``<workflow>/<activity>``; single rule names normalize to tuples
+    and dangling refs fail at load time like every other target kind."""
+    spec = parse_chaos(chaos_doc(
+        seed=3,
+        faults={
+            "slow": {"latency": {"duration": "10ms"}},
+            "fell": {"crashEveryN": {"n": 2, "raise": "OSError"}},
+        },
+        targets={"workflows": {"checkout": "slow",
+                               "checkout/charge": ["fell"]}},
+    ))
+    assert spec.workflow_targets == {"checkout": ("slow",),
+                                     "checkout/charge": ("fell",)}
+    with pytest.raises(ComponentError, match="unknown fault rule"):
+        parse_chaos(chaos_doc(
+            faults={"f": {"error": {"raise": "OSError"}}},
+            targets={"workflows": {"checkout": ["typo"]}}))
+
+
+def test_for_workflow_resolves_most_specific_first():
+    spec = parse_chaos(chaos_doc(
+        faults={
+            "wide": {"latency": {"duration": "10ms"}},
+            "narrow": {"crashEveryN": {"n": 2, "raise": "OSError"}},
+        },
+        targets={"workflows": {"checkout": ["wide"],
+                               "checkout/charge": ["narrow"]}},
+    ))
+    policies = ChaosPolicies([spec])
+    # exact <workflow>/<activity> binding beats the workflow-wide one
+    charge = policies.for_workflow("checkout", "charge")
+    assert [i.rule.name for i in charge.injectors] == ["narrow"]
+    # other activities of the workflow fall back to the wide binding
+    ship = policies.for_workflow("checkout", "ship")
+    assert [i.rule.name for i in ship.injectors] == ["wide"]
+    # no-activity resolution (compensations use the workflow key too)
+    assert [i.rule.name
+            for i in policies.for_workflow("checkout").injectors] == ["wide"]
+    assert policies.for_workflow("other", "charge") is None
+    bound = {t for d in policies.describe() for t in d["targets"]}
+    assert bound == {"workflows/checkout/activity",
+                     "workflows/checkout/charge/activity"}
+
+
 def test_scoping_filters_specs():
     spec = _flaky_spec()
     spec.scopes = ["backend"]

@@ -225,6 +225,75 @@ def test_metric_names_rule_fires_on_typo_and_kind_mismatch(tmp_path):
     assert "different" in findings[1].message  # counter used as histogram
 
 
+def test_workflow_determinism_rule_fires_on_ambient_and_effect_calls(
+        tmp_path):
+    findings, _ = _lint_source(tmp_path, """\
+        import os
+        import random
+        import time
+        import uuid
+        from datetime import datetime
+
+        def build(app, client):
+            @app.workflow("checkout")
+            async def checkout(ctx, order):
+                started = time.time()
+                when = datetime.now()
+                pick = random.choice(order["items"])
+                order_id = uuid.uuid4()
+                region = os.environ["REGION"]
+                fallback = os.getenv("REGION")
+                await client.publish("pubsub", "orders", order)
+                await client.save_state("store", "k", order)
+                return started
+        """, rules=("workflow-determinism",))
+    assert _rules_fired(findings) == {"workflow-determinism"}
+    assert len(findings) == 8
+    messages = " ".join(f.message for f in findings)
+    assert "ctx.now()" in messages
+    assert "ctx.random()" in messages
+    assert "ctx.uuid4()" in messages
+    assert "activity" in messages
+
+
+def test_workflow_determinism_rule_allows_ctx_and_activities(tmp_path):
+    findings, _ = _lint_source(tmp_path, """\
+        import time
+
+        def build(app, client):
+            @app.workflow("checkout")
+            async def checkout(ctx, order):
+                paid = await ctx.call_activity("charge", order)
+                ctx.register_compensation("refund", paid)
+                await ctx.sleep(ctx.random())
+                return {"id": ctx.uuid4(), "at": ctx.now()}
+
+            @app.activity("charge")
+            async def charge(actx, order):
+                # the effectful half may do anything a turn may do
+                actx.stage_effect(f"charge||{actx.instance}", order)
+                await client.publish("pubsub", "charged", order)
+                return time.time()
+
+            async def helper():  # undecorated: out of the rule's scope
+                return time.time()
+        """, rules=("workflow-determinism",))
+    assert findings == []
+
+
+def test_workflow_determinism_rule_honors_suppression(tmp_path):
+    findings, suppressed = _lint_source(tmp_path, """\
+        import time
+
+        def build(app):
+            @app.workflow("w")
+            async def w(ctx, inp):
+                return time.time()  # tasklint: disable=workflow-determinism
+        """, rules=("workflow-determinism",))
+    assert findings == []
+    assert suppressed == 1
+
+
 # -- engine mechanics ---------------------------------------------------
 
 

@@ -1,0 +1,910 @@
+"""Durable replay-based workflows (docs module 21).
+
+The multi-replica tests mirror tests/test_actors.py: several
+``Runtime`` objects built by hand around ONE shared durable store, so
+N replicas of the same app are modeled without OS processes, and
+failover is deterministic (``simulate_crash`` + short leases + explicit
+``sweep()`` calls). The two acceptance drills are at the bottom:
+
+* ``crashEveryN`` chaos felling the workflow owner mid-activity on an
+  RF≥2 replicated store — replay converges on the adopting replica,
+  every activity effect lands exactly once, compensations fire exactly
+  once in reverse order, and no acked effect is lost even after the
+  store's shard leader is itself crashed (``lost_acked_keys == []``).
+* a cross-process ``kill -9`` of the workflow owner's OS process on a
+  shared sqlite store, with history continuity proven on the replica
+  that adopts the instance.
+"""
+
+import asyncio
+import os
+import random as random_mod
+import sys
+import time
+import uuid as uuid_mod
+
+import pytest
+
+from tasksrunner.app import App
+from tasksrunner.chaos.engine import ChaosPolicies
+from tasksrunner.chaos.spec import parse_chaos
+from tasksrunner.component.registry import ComponentRegistry
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.errors import (
+    ValidationError,
+    WorkflowError,
+    WorkflowNotFound,
+)
+from tasksrunner.observability.metrics import metrics
+from tasksrunner.resiliency.policy import RetrySpec
+from tasksrunner.runtime import InProcAppChannel, Runtime
+from tasksrunner.state.memory import InMemoryStateStore
+from tasksrunner.state.replication import build_replicated_store
+from tasksrunner.workflows import WORKFLOW_ACTOR_TYPE
+
+LEASE = 0.25
+#: fast cadence for tests — the production default (2 s) would make
+#: every adoption-driven step crawl
+DRIVE = 0.1
+
+
+@pytest.fixture
+def wf_env(monkeypatch):
+    monkeypatch.setenv("TASKSRUNNER_WORKFLOWS", "1")
+    monkeypatch.setenv("TASKSRUNNER_ACTOR_LEASE_SECONDS", "5")
+    # background sweep effectively disabled: every sweep in a test is
+    # an explicit, deterministic sweep() call
+    monkeypatch.setenv("TASKSRUNNER_ACTOR_REMINDER_POLL_SECONDS", "30")
+
+
+def build_app(app_id="svc", log=None):
+    """One app with every scenario workflow; ``log`` collects activity
+    body executions as (kind, payload) tuples across ALL replicas."""
+    app = App(app_id)
+    log = log if log is not None else []
+
+    @app.workflow("simple")
+    async def simple(ctx, inp):
+        a = await ctx.call_activity("add", {"x": inp, "y": 1})
+        b = await ctx.call_activity("add", {"x": a, "y": 10})
+        return b
+
+    @app.activity("add")
+    async def add(actx, data):
+        log.append(("add", actx.seq))
+        actx.stage_effect(f"eff||{actx.instance}||{actx.seq}", data)
+        return data["x"] + data["y"]
+
+    @app.workflow("fanout")
+    async def fanout(ctx, n):
+        tasks = [ctx.call_activity("add", {"x": i, "y": 0})
+                 for i in range(n)]
+        return sum(await ctx.when_all(tasks))
+
+    @app.workflow("order")
+    async def order(ctx, n):
+        for i in range(n):
+            await ctx.call_activity("reserve", {"i": i})
+            ctx.register_compensation("release", {"i": i})
+        await ctx.call_activity("charge", None)
+        return "paid"
+
+    @app.activity("reserve")
+    async def reserve(actx, data):
+        log.append(("reserve", data["i"]))
+        actx.stage_effect(f"res||{actx.instance}||{data['i']}", data)
+        return data["i"]
+
+    @app.activity("release")
+    async def release(actx, data):
+        log.append(("release", data["i"]))
+        actx.stage_effect(f"rel||{actx.instance}||{data['i']}", data)
+        return data["i"]
+
+    @app.activity("charge", retry=RetrySpec(duration=0.01, max_retries=1))
+    async def charge(actx, data):
+        log.append(("charge", actx.attempt))
+        raise RuntimeError("card declined")
+
+    @app.workflow("fallback")
+    async def fallback(ctx, inp):
+        from tasksrunner.errors import ActivityError
+        try:
+            return await ctx.call_activity("charge", None)
+        except ActivityError as exc:
+            return {"fallback": True, "cause": str(exc)}
+
+    @app.workflow("parent")
+    async def parent(ctx, inp):
+        c1 = ctx.call_child("simple", 5)
+        c2 = ctx.call_child("simple", 50)
+        return await ctx.when_all([c1, c2])
+
+    @app.workflow("waiter")
+    async def waiter(ctx, inp):
+        data = await ctx.wait_event("go")
+        return {"got": data}
+
+    @app.workflow("timed")
+    async def timed(ctx, inp):
+        log.append(("orchestrate", "timed"))
+        u1 = ctx.uuid4()
+        await ctx.sleep(0.15)
+        return [u1, ctx.uuid4(), ctx.now()]
+
+    @app.workflow("racer")
+    async def racer(ctx, inp):
+        winner = await ctx.when_any(
+            [ctx.wait_event("a"), ctx.wait_event("b")])
+        return winner.value
+
+    @app.workflow("rogue")
+    async def rogue(ctx, inp):
+        await asyncio.sleep(0.01)  # forbidden: a foreign awaitable
+        return "never"
+
+    @app.workflow("lost")
+    async def lost(ctx, inp):
+        return await ctx.call_activity("no-such-activity", None)
+
+    app.state["log"] = log
+    return app
+
+
+def make_runtime(shared, *, app_id="svc", chaos=None, crash_on_chaos=False,
+                 lease=LEASE, log=None):
+    spec = ComponentSpec(name="statestore", type="state.in-memory")
+    reg = ComponentRegistry([spec], app_id=app_id)
+    reg._instances["statestore"] = shared
+    rt = Runtime(app_id, reg,
+                 app_channel=InProcAppChannel(build_app(app_id, log)),
+                 chaos=chaos)
+    if crash_on_chaos:
+        rt._actor_crash_on_chaos = True
+    rt._test_lease = lease
+    return rt
+
+
+async def start_all(*rts):
+    for rt in rts:
+        await rt.start()
+        assert rt.actors is not None and rt.workflows is not None
+        if rt._test_lease is not None:
+            rt.actors.lease_seconds = rt._test_lease
+        rt.app_channel.app.workflow_engine.drive_period = DRIVE
+
+
+async def shutdown(*rts):
+    for rt in rts:
+        if rt.actors is not None:
+            if rt.workflows is not None:
+                rt.workflows.detach()
+                rt.workflows = None
+            await rt.actors.stop()
+            rt.actors = None
+    for rt in rts:
+        await rt.stop()
+
+
+async def adopt_until(rt, instance, *, timeout=8.0):
+    """Sweep-driven convergence: what a real cluster's periodic sweep
+    does, compressed into an explicit loop."""
+    deadline = time.monotonic() + timeout
+    while True:
+        await rt.actors.sweep()
+        status = await rt.workflows.status(instance)
+        if status["status"] in ("completed", "failed", "terminated"):
+            return status
+        assert time.monotonic() < deadline, \
+            f"instance {instance} never converged: {status}"
+        await asyncio.sleep(0.05)
+
+
+# -- registration ----------------------------------------------------------
+
+
+def test_workflow_decorator_rejects_sync_orchestrators():
+    app = App("svc")
+    with pytest.raises(ValidationError):
+        @app.workflow("bad")
+        def bad(ctx, inp):  # noqa: ARG001 - shape under test
+            return None
+
+
+def test_activity_decorator_rejects_sync_handlers():
+    app = App("svc")
+    with pytest.raises(ValidationError):
+        @app.activity("bad")
+        def bad(actx, data):  # noqa: ARG001 - shape under test
+            return None
+
+
+def test_duplicate_registration_rejected():
+    app = App("svc")
+
+    @app.workflow("dup")
+    async def one(ctx, inp):
+        return None
+
+    with pytest.raises(WorkflowError):
+        @app.workflow("dup")
+        async def two(ctx, inp):
+            return None
+
+    @app.activity("dup-act")
+    async def act_one(actx, data):
+        return None
+
+    with pytest.raises(WorkflowError):
+        @app.activity("dup-act")
+        async def act_two(actx, data):
+            return None
+
+
+# -- the basic scenarios ---------------------------------------------------
+
+
+async def test_sequential_workflow_exact_once_effects(wf_env):
+    shared = InMemoryStateStore("statestore")
+    log = []
+    rt = make_runtime(shared, log=log)
+    await start_all(rt)
+    try:
+        inst = await rt.workflows.start("simple", 100)
+        status = await rt.workflows.wait(inst, timeout=5)
+        assert status["status"] == "completed" and status["result"] == 111
+        # each activity body ran once; each staged effect landed once
+        assert log == [("add", 1), ("add", 2)]
+        for seq in (1, 2):
+            item = await shared.get(f"svc||eff||{inst}||{seq}")
+            assert item is not None, f"missing effect for seq {seq}"
+        history = await rt.workflows.history(inst)
+        assert [e["t"] for e in history] == [
+            "started", "activity_completed", "activity_completed",
+            "completed"]
+    finally:
+        await shutdown(rt)
+
+
+async def test_fanout_fanin(wf_env):
+    shared = InMemoryStateStore("statestore")
+    rt = make_runtime(shared)
+    await start_all(rt)
+    try:
+        inst = await rt.workflows.start("fanout", 7)
+        status = await rt.workflows.wait(inst, timeout=5)
+        assert status["status"] == "completed" and status["result"] == 21
+    finally:
+        await shutdown(rt)
+
+
+async def test_saga_compensates_reverse_order(wf_env):
+    shared = InMemoryStateStore("statestore")
+    log = []
+    rt = make_runtime(shared, log=log)
+    await start_all(rt)
+    try:
+        inst = await rt.workflows.start("order", 3)
+        status = await rt.workflows.wait(inst, timeout=5)
+        assert status["status"] == "failed"
+        assert "card declined" in status["error"]
+        # compensations: exactly once each, reverse registration order
+        releases = [p for kind, p in log if kind == "release"]
+        assert releases == [2, 1, 0]
+        history = await rt.workflows.history(inst)
+        comp = [e for e in history if e["t"] == "compensated"]
+        assert [e["idx"] for e in comp] == [2, 1, 0]
+        assert all("error" not in e for e in comp)
+    finally:
+        await shutdown(rt)
+
+
+async def test_orchestrator_can_catch_activity_error(wf_env):
+    shared = InMemoryStateStore("statestore")
+    rt = make_runtime(shared)
+    await start_all(rt)
+    try:
+        inst = await rt.workflows.start("fallback", None)
+        status = await rt.workflows.wait(inst, timeout=5)
+        assert status["status"] == "completed"
+        assert status["result"]["fallback"] is True
+        assert "card declined" in status["result"]["cause"]
+    finally:
+        await shutdown(rt)
+
+
+async def test_child_workflows_fan_out(wf_env):
+    shared = InMemoryStateStore("statestore")
+    rt = make_runtime(shared)
+    await start_all(rt)
+    try:
+        inst = await rt.workflows.start("parent", None)
+        status = await rt.workflows.wait(inst, timeout=10)
+        assert status["status"] == "completed"
+        assert status["result"] == [16, 61]
+        # deterministic child ids: idempotent restarts re-find them
+        child = await rt.workflows.status(f"{inst}::c1")
+        assert child["status"] == "completed" and child["parent"] == inst
+    finally:
+        await shutdown(rt)
+
+
+async def test_external_event_and_duplicate_delivery(wf_env):
+    shared = InMemoryStateStore("statestore")
+    rt = make_runtime(shared)
+    await start_all(rt)
+    try:
+        inst = await rt.workflows.start("waiter", None)
+        assert (await rt.workflows.status(inst))["status"] == "running"
+        await rt.workflows.raise_event(inst, "go", data={"n": 1}, id="e-1")
+        # duplicate delivery by id: dropped, not buffered twice
+        await rt.workflows.raise_event(inst, "go", data={"n": 1}, id="e-1")
+        status = await rt.workflows.wait(inst, timeout=5)
+        assert status["status"] == "completed"
+        assert status["result"] == {"got": {"n": 1}}
+        history = await rt.workflows.history(inst)
+        assert len([e for e in history if e["t"] == "event_raised"]) == 1
+    finally:
+        await shutdown(rt)
+
+
+async def test_when_any_winner_is_replay_stable(wf_env):
+    shared = InMemoryStateStore("statestore")
+    rt = make_runtime(shared)
+    await start_all(rt)
+    try:
+        inst = await rt.workflows.start("racer", None)
+        await rt.workflows.raise_event(inst, "b", data="b wins")
+        status = await rt.workflows.wait(inst, timeout=5)
+        assert status["status"] == "completed"
+        assert status["result"] == "b wins"
+        # the loser landing later must not flip the recorded verdict
+        await rt.workflows.raise_event(inst, "a", data="a late")
+        assert (await rt.workflows.status(inst))["result"] == "b wins"
+    finally:
+        await shutdown(rt)
+
+
+async def test_durable_timer_and_deterministic_randomness(wf_env):
+    """A timer suspends the instance across turns, so the orchestrator
+    provably replays (it runs more than once) — yet the pre-timer
+    uuid survives replay unchanged because ctx randomness is seeded
+    from the instance identity, and ctx.now() comes from history."""
+    shared = InMemoryStateStore("statestore")
+    log = []
+    rt = make_runtime(shared, log=log)
+    await start_all(rt)
+    try:
+        t0 = time.time()
+        inst = await rt.workflows.start("timed", "x")
+        assert (await rt.workflows.status(inst))["status"] == "running"
+        status = await adopt_until(rt, inst)
+        assert status["status"] == "completed"
+        u1, u2, wf_now = status["result"]
+        rng = random_mod.Random(f"wf:timed:{inst}")
+        assert u1 == str(uuid_mod.UUID(int=rng.getrandbits(128), version=4))
+        assert u2 == str(uuid_mod.UUID(int=rng.getrandbits(128), version=4))
+        assert t0 <= wf_now <= time.time()
+        # replay happened: the orchestrator body ran at least twice
+        replays = [p for kind, p in log if kind == "orchestrate"]
+        assert len(replays) >= 2
+        # and the durable timer left exactly one fired event
+        history = await rt.workflows.history(inst)
+        assert len([e for e in history if e["t"] == "timer_fired"]) == 1
+    finally:
+        await shutdown(rt)
+
+
+async def test_nondeterminism_foreign_await_fails_cleanly(wf_env):
+    shared = InMemoryStateStore("statestore")
+    log = []
+    rt = make_runtime(shared, log=log)
+    await start_all(rt)
+    try:
+        inst = await rt.workflows.start("rogue", None)
+        status = await rt.workflows.wait(inst, timeout=5)
+        assert status["status"] == "failed"
+        assert "foreign awaitable" in status["error"]
+        # fail-fast, not compensate: no activity ever ran
+        assert log == []
+    finally:
+        await shutdown(rt)
+
+
+async def test_unregistered_activity_fails_workflow(wf_env):
+    shared = InMemoryStateStore("statestore")
+    rt = make_runtime(shared)
+    await start_all(rt)
+    try:
+        inst = await rt.workflows.start("lost", None)
+        status = await rt.workflows.wait(inst, timeout=5)
+        assert status["status"] == "failed"
+        assert "no-such-activity" in status["error"]
+    finally:
+        await shutdown(rt)
+
+
+async def test_terminate_and_listing(wf_env):
+    shared = InMemoryStateStore("statestore")
+    rt = make_runtime(shared)
+    await start_all(rt)
+    try:
+        inst = await rt.workflows.start("waiter", None)
+        await rt.workflows.terminate(inst, reason="operator said no")
+        status = await rt.workflows.wait(inst, timeout=5)
+        assert status["status"] == "terminated"
+        assert status["error"] == "operator said no"
+        rows = await rt.workflows.list()
+        assert [r["instance"] for r in rows] == [inst]
+        with pytest.raises(WorkflowNotFound):
+            await rt.workflows.status("no-such-instance")
+    finally:
+        await shutdown(rt)
+
+
+async def test_history_gc_truncates_terminal_instances(wf_env):
+    shared = InMemoryStateStore("statestore")
+    rt = make_runtime(shared)
+    await start_all(rt)
+    try:
+        rt.app_channel.app.workflow_engine.retain_seconds = 0.1
+        inst = await rt.workflows.start("simple", 1)
+        status = await rt.workflows.wait(inst, timeout=5)
+        assert status["status"] == "completed" and status["events"] == 4
+        await asyncio.sleep(0.15)
+        await rt.actors.sweep()  # fires the one-shot GC reminder
+        status = await rt.workflows.status(inst)
+        assert status["events"] == 1  # only the terminal stub remains
+        assert status["status"] == "completed" and status["result"] == 12
+        history = await rt.workflows.history(inst)
+        assert history[0]["t"] == "completed"
+    finally:
+        await shutdown(rt)
+
+
+# -- surfacing: sidecar routes ---------------------------------------------
+
+
+async def test_sidecar_workflow_routes_gated_off(monkeypatch):
+    monkeypatch.delenv("TASKSRUNNER_WORKFLOWS", raising=False)
+    from tasksrunner.sidecar import build_sidecar_app
+
+    app = build_sidecar_app(make_runtime(InMemoryStateStore("statestore")),
+                            api_token=None, peer_tokens=set())
+    assert not any("/v1.0/workflows" in str(r.resource.canonical)
+                   for r in app.router.routes() if r.resource is not None)
+
+
+async def test_sidecar_registers_actor_routes_under_workflows_flag(wf_env):
+    """Workflow instances are actors, and a non-owning replica forwards
+    turns to the owner through the /v1.0/actors routes — so the
+    workflows flag alone must open the actor route gate, or every
+    cross-replica workflow operation 404s at the owner's sidecar."""
+    from tasksrunner.sidecar import build_sidecar_app
+
+    app = build_sidecar_app(make_runtime(InMemoryStateStore("statestore")),
+                            api_token=None, peer_tokens=set())
+    assert any("/v1.0/actors" in str(r.resource.canonical)
+               for r in app.router.routes() if r.resource is not None)
+
+
+async def test_sidecar_workflow_api_end_to_end(wf_env):
+    import aiohttp
+
+    from tasksrunner.sidecar import Sidecar
+
+    rt = make_runtime(InMemoryStateStore("statestore"))
+    sc = Sidecar(rt, port=0)
+    await sc.start()
+    rt.app_channel.app.workflow_engine.drive_period = DRIVE
+    try:
+        base = f"http://127.0.0.1:{sc.port}"
+        async with aiohttp.ClientSession() as session:
+            resp = await session.post(
+                f"{base}/v1.0/workflows/engine/simple/start",
+                params={"instanceID": "http-1"}, json=100)
+            assert resp.status == 200
+            assert (await resp.json())["instanceID"] == "http-1"
+            await rt.workflows.wait("http-1", timeout=5)
+            resp = await session.get(f"{base}/v1.0/workflows/engine/http-1")
+            doc = await resp.json()
+            assert doc["status"] == "completed" and doc["result"] == 111
+            resp = await session.get(
+                f"{base}/v1.0/workflows/engine/http-1/history")
+            assert [e["t"] for e in (await resp.json())["history"]][0] \
+                == "started"
+
+            resp = await session.post(
+                f"{base}/v1.0/workflows/engine/waiter/start",
+                params={"instanceID": "http-2"}, json=None)
+            assert resp.status == 200
+            resp = await session.post(
+                f"{base}/v1.0/workflows/engine/http-2/raiseEvent/go",
+                params={"eventID": "e1"}, json={"n": 2})
+            assert resp.status == 202
+            status = await rt.workflows.wait("http-2", timeout=5)
+            assert status["result"] == {"got": {"n": 2}}
+
+            resp = await session.post(
+                f"{base}/v1.0/workflows/engine/waiter/start",
+                params={"instanceID": "http-3"}, json=None)
+            resp = await session.post(
+                f"{base}/v1.0/workflows/engine/http-3/terminate",
+                json={"reason": "done testing"})
+            assert resp.status == 202
+            status = await rt.workflows.wait("http-3", timeout=5)
+            assert status["status"] == "terminated"
+
+            resp = await session.get(f"{base}/v1.0/workflows")
+            rows = (await resp.json())["instances"]
+            assert {r["instance"] for r in rows} == \
+                {"http-1", "http-2", "http-3"}
+
+            resp = await session.get(f"{base}/v1.0/workflows/engine/ghost")
+            assert resp.status == 404
+    finally:
+        await sc.stop()
+
+
+# -- failover (in-proc replicas) -------------------------------------------
+
+
+async def test_owner_crash_mid_run_replica_adopts(wf_env):
+    """Plain simulate_crash (no chaos): the owner dies between turns,
+    the survivor adopts via sweep and finishes the run — effects from
+    the committed prefix are not re-applied."""
+    shared = InMemoryStateStore("statestore")
+    log = []
+    r1 = make_runtime(shared, log=log)
+    r2 = make_runtime(shared, log=log)
+    await start_all(r1, r2)
+    try:
+        inst = await r1.workflows.start("fanout", 5)
+        # fanout completes within the start pump — use a timer-blocked
+        # one instead for a genuine mid-run crash
+        inst2 = await r1.workflows.start("timed", None)
+        assert (await r1.workflows.status(inst2))["status"] == "running"
+        r1.actors.simulate_crash()
+        await asyncio.sleep(LEASE + 0.1)
+        status = await adopt_until(r2, inst2)
+        assert status["status"] == "completed"
+        assert (await r2.workflows.status(inst))["status"] == "completed"
+    finally:
+        await shutdown(r2, r1)
+
+
+# -- THE chaos acceptance drill --------------------------------------------
+
+CHAOS_YAML_DOC = {
+    "apiVersion": "tasksrunner/v1alpha1",
+    "kind": "Chaos",
+    "metadata": {"name": "wf-drill"},
+    "spec": {
+        "seed": 7,
+        "faults": {"fell-owner": {"crashEveryN": {"n": 2,
+                                                  "raise": "OSError"}}},
+        "targets": {"workflows": {"order/reserve": ["fell-owner"]}},
+    },
+}
+
+
+async def test_chaos_crash_mid_activity_rf2_exactly_once(wf_env, tmp_path):
+    """THE acceptance drill: a declarative ``crashEveryN`` rule on
+    ``workflows.order/reserve`` fells the owning replica mid-activity,
+    on an RF=2 replicated store. The surviving replica adopts the
+    instance, replay converges from the committed prefix, every forward
+    effect lands exactly once, compensations fire exactly once in
+    reverse order — and after the store's own shard leader is crashed,
+    every acked effect is still present (lost_acked_keys == [])."""
+    store = build_replicated_store(
+        "statestore", tmp_path / "wf.db", replicas=2, ack_quorum=2,
+        lease_seconds=0.4)
+    log = []
+    chaos = ChaosPolicies([parse_chaos(CHAOS_YAML_DOC)], app_id="svc")
+    r1 = make_runtime(store, chaos=chaos, crash_on_chaos=True, log=log)
+    r2 = make_runtime(store, log=log)
+    await start_all(r1, r2)
+    started0 = metrics.get("workflow_started_total", workflow="order")
+    comp0 = metrics.get("workflow_compensation_total", workflow="order")
+    inst = "drill-1"
+    try:
+        # reserve attempt #2 crashes the owner: the start call dies
+        # mid-pump with the turn uncommitted, like SIGKILL would
+        with pytest.raises(BaseException) as crashed:
+            await r1.workflows.start("order", 3, instance=inst)
+        assert "chaos crash" in str(crashed.value)
+        assert r1.actors.crashed
+
+        # the committed prefix survived: exactly one reserve completed
+        status = await r2.workflows.status(inst)
+        assert status["status"] == "running"
+        history = await r2.workflows.history(inst)
+        assert [e["seq"] for e in history
+                if e["t"] == "activity_completed"] == [1]
+
+        # survivor adopts after lease expiry and converges the saga
+        await asyncio.sleep(LEASE + 0.1)
+        status = await adopt_until(r2, inst)
+        assert status["status"] == "failed"
+        assert "card declined" in status["error"]
+
+        # every forward effect exactly once (bodies too: the chaos
+        # fault fires before the body, so no reserve double-ran)
+        assert sorted(p for k, p in log if k == "reserve") == [0, 1, 2]
+        # compensations exactly once, reverse order
+        assert [p for k, p in log if k == "release"] == [2, 1, 0]
+        history = await r2.workflows.history(inst)
+        comp = [e for e in history if e["t"] == "compensated"]
+        assert [e["idx"] for e in comp] == [2, 1, 0]
+
+        # workflow_* metrics moved
+        assert metrics.get("workflow_started_total",
+                           workflow="order") == started0 + 1
+        assert metrics.get("workflow_compensation_total",
+                           workflow="order") == comp0 + 3
+
+        # host loss on the store itself: crash the shard leader; RF=2
+        # with quorum acks means the follower has every committed write
+        leader = next(n for n in store.nodes
+                      if n.node_id == store.leader_member())
+        leader.crash()
+        lost = []
+        for i in range(3):
+            for prefix in ("res", "rel"):
+                if await store.get(f"svc||{prefix}||{inst}||{i}") is None:
+                    lost.append(f"{prefix}||{i}")
+        assert lost == [], f"acked effects lost after leader crash: {lost}"
+        # history is intact on the promoted follower too
+        history = await r2.workflows.history(inst)
+        assert [e["seq"] for e in history
+                if e["t"] == "activity_completed"] == [1, 2, 3]
+        assert history[-1]["t"] == "failed"
+    finally:
+        await shutdown(r2, r1)
+
+
+# -- cross-process kill -9 drill -------------------------------------------
+
+_KILL9_CHILD = '''
+import asyncio, os, sys
+
+os.environ["TASKSRUNNER_WORKFLOWS"] = "1"
+os.environ["TASKSRUNNER_ACTOR_LEASE_SECONDS"] = "0.5"
+os.environ["TASKSRUNNER_ACTOR_REMINDER_POLL_SECONDS"] = "30"
+
+from tasksrunner.app import App
+from tasksrunner.component.registry import ComponentRegistry
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.runtime import InProcAppChannel, Runtime
+
+
+def build():
+    app = App("svc")
+
+    @app.workflow("steps")
+    async def steps(ctx, n):
+        total = 0
+        for i in range(n):
+            total += await ctx.call_activity("slowstep", {"i": i})
+        return total
+
+    @app.activity("slowstep")
+    async def slowstep(actx, data):
+        actx.stage_effect(f"eff||{actx.instance}||{actx.seq}", data)
+        print(f"STEP {actx.seq}", flush=True)
+        await asyncio.sleep(0.05)
+        return 1
+
+    return app
+
+
+async def main():
+    spec = ComponentSpec(name="statestore", type="state.sqlite",
+                         metadata={"databasePath": sys.argv[1]})
+    reg = ComponentRegistry([spec], app_id="svc")
+    rt = Runtime("svc", reg, app_channel=InProcAppChannel(build()))
+    await rt.start()
+    rt.actors.lease_seconds = 0.5
+    rt.app_channel.app.workflow_engine.drive_period = 0.2
+    print("READY", flush=True)
+    await rt.workflows.start("steps", 12, instance="xproc-1")
+    await asyncio.sleep(60)  # the parent kills us long before this
+
+
+asyncio.run(main())
+'''
+
+
+async def test_kill9_workflow_owner_history_continuity(wf_env, tmp_path):
+    """Cross-process acceptance drill: ``kill -9`` the OS process that
+    owns a running workflow, mid-activity, on a shared sqlite store.
+    This replica adopts the instance and finishes it; the history shows
+    one contiguous, duplicate-free run — the committed prefix from the
+    dead process plus this replica's continuation."""
+    db = tmp_path / "wf.db"
+    script = tmp_path / "owner_child.py"
+    script.write_text(_KILL9_CHILD)
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo, env.get("PYTHONPATH")) if p)
+    child = await asyncio.create_subprocess_exec(
+        sys.executable, str(script), str(db),
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT,
+        env=env)
+    try:
+        # let the child commit a few steps, then SIGKILL it mid-run
+        steps_seen = 0
+        deadline = asyncio.get_running_loop().time() + 30
+        while steps_seen < 3:
+            assert asyncio.get_running_loop().time() < deadline, \
+                f"child never progressed (saw {steps_seen} steps)"
+            line = (await asyncio.wait_for(child.stdout.readline(), 30)
+                    ).decode().strip()
+            if line.startswith("STEP "):
+                steps_seen = int(line.split()[1])
+        child.kill()
+        await child.wait()
+
+        spec = ComponentSpec(name="statestore", type="state.sqlite",
+                             metadata={"databasePath": str(db)})
+        reg = ComponentRegistry([spec], app_id="svc")
+        rt = Runtime("svc", reg, app_channel=InProcAppChannel(build_app()))
+        await rt.start()
+        rt.actors.lease_seconds = LEASE
+        rt.app_channel.app.workflow_engine.drive_period = DRIVE
+
+        # the adopting replica doesn't know "steps"/"slowstep" — prove
+        # continuity with the same app shape instead
+        @rt.app_channel.app.workflow("steps")
+        async def steps(ctx, n):
+            total = 0
+            for i in range(n):
+                total += await ctx.call_activity("slowstep", {"i": i})
+            return total
+
+        @rt.app_channel.app.activity("slowstep")
+        async def slowstep(actx, data):
+            actx.stage_effect(f"eff||{actx.instance}||{actx.seq}", data)
+            return 1
+
+        try:
+            status = await adopt_until(rt, "xproc-1", timeout=15.0)
+            assert status["status"] == "completed"
+            assert status["result"] == 12
+
+            history = await rt.workflows.history("xproc-1")
+            seqs = [e["seq"] for e in history
+                    if e["t"] == "activity_completed"]
+            # continuity: one contiguous run, no duplicates, no gaps —
+            # the dead owner's committed prefix flowed straight into
+            # the adopter's continuation
+            assert seqs == list(range(1, 13)), seqs
+            assert len([e for e in history if e["t"] == "started"]) == 1
+            store = reg.get("statestore")
+            for seq in range(1, 13):
+                item = await store.get(f"svc||eff||xproc-1||{seq}")
+                assert item is not None, f"missing effect for seq {seq}"
+            # the adopter fenced above the dead owner's epoch
+            record = await store.get(
+                f"svc||actor-rec||{WORKFLOW_ACTOR_TYPE}||xproc-1")
+            assert int(record.value["epoch"]) >= 2
+        finally:
+            await shutdown(rt)
+    finally:
+        if child.returncode is None:
+            child.kill()
+            await child.wait()
+
+
+# -- the tasks-tracker sample scenarios ------------------------------------
+
+
+async def test_sample_tasks_tracker_scenarios(wf_env):
+    """The three shipped sample workflows, end to end on the fake
+    manager: checkout saga (success and declined-with-compensation),
+    reminder-driven overdue escalation, and the fan-out/fan-in sweep."""
+    import datetime as dt
+
+    from samples.tasks_tracker.backend_api.app import APP_ID, make_app
+    from samples.tasks_tracker.backend_api.managers import FakeTasksManager
+    from samples.tasks_tracker.backend_api.models import format_dt
+
+    manager = FakeTasksManager(seed_count=0)
+    app = make_app(manager=manager)
+    await app.startup()
+    assert app.state["tasks"] is manager
+
+    shared = InMemoryStateStore("statestore")
+    spec = ComponentSpec(name="statestore", type="state.in-memory")
+    reg = ComponentRegistry([spec], app_id=APP_ID)
+    reg._instances["statestore"] = shared
+    rt = Runtime(APP_ID, reg, app_channel=InProcAppChannel(app))
+    await rt.start()
+    rt.actors.lease_seconds = LEASE
+    app.workflow_engine.drive_period = DRIVE
+    try:
+        # 1. checkout saga, happy path: every stage_effect landed
+        inst = await rt.workflows.start(
+            "checkout", {"items": ["tea", "mug"], "amount": 42.0},
+            instance="ord-ok")
+        status = await rt.workflows.wait(inst, timeout=5)
+        assert status["status"] == "completed"
+        order_id = status["result"]["orderId"]
+        assert status["result"]["receipt"]["amount"] == 42.0
+        for item in ("tea", "mug"):
+            key = f"{APP_ID}||checkout||{order_id}||reserved||{item}"
+            assert await shared.get(key) is not None
+        assert await shared.get(
+            f"{APP_ID}||checkout||{order_id}||charge") is not None
+        assert await shared.get(
+            f"{APP_ID}||checkout||{order_id}||confirmation") is not None
+
+        # 2. checkout saga, declined card: reservations compensated
+        # away (staged deletes), no charge, no confirmation
+        inst = await rt.workflows.start(
+            "checkout", {"items": ["tv"], "amount": 9000.0,
+                         "orderId": "bigspender"},
+            instance="ord-declined")
+        status = await rt.workflows.wait(inst, timeout=5)
+        assert status["status"] == "failed"
+        assert "card declined" in status["error"]
+        assert await shared.get(
+            f"{APP_ID}||checkout||bigspender||reserved||tv") is None
+        assert await shared.get(
+            f"{APP_ID}||checkout||bigspender||charge") is None
+        history = await rt.workflows.history(inst)
+        comp = [e for e in history if e["t"] == "compensated"]
+        assert [c["name"] for c in comp] == ["release-stock"]
+
+        # 3. overdue escalation: never completed -> nags then overdue
+        task_id = await manager.create_new_task(
+            {"taskName": "file taxes", "taskCreatedBy": "sam@tasks.dev"})
+        inst = await rt.workflows.start(
+            "overdue-escalation",
+            {"taskId": task_id, "intervalSeconds": 0.05, "maxLevels": 2},
+            instance="esc-1")
+        status = await adopt_until(rt, inst)
+        assert status["status"] == "completed"
+        assert status["result"] == {"taskId": task_id,
+                                    "outcome": "overdue", "nags": 2}
+        task = await manager.get_task_by_id(task_id)
+        assert task.is_over_due
+        for level in (1, 2):
+            assert await shared.get(
+                f"{APP_ID}||escalation||{task_id}||{level}") is not None
+
+        # 4. escalation stands down when the task completes in time
+        task2 = await manager.create_new_task(
+            {"taskName": "water plants", "taskCreatedBy": "sam@tasks.dev"})
+        await manager.mark_task_completed(task2)
+        inst = await rt.workflows.start(
+            "overdue-escalation",
+            {"taskId": task2, "intervalSeconds": 0.05, "maxLevels": 3},
+            instance="esc-2")
+        status = await adopt_until(rt, inst)
+        assert status["status"] == "completed"
+        assert status["result"]["outcome"] == "completed"
+        assert status["result"]["nags"] == 0
+
+        # 5. fan-out/fan-in sweep over yesterday's due tasks
+        yesterday = format_dt(
+            (dt.datetime.now() - dt.timedelta(days=1)).replace(
+                hour=0, minute=0, second=0, microsecond=0))
+        due_ids = []
+        for i in range(3):
+            due_ids.append(await manager.create_new_task(
+                {"taskName": f"due-{i}", "taskCreatedBy": "sam@tasks.dev",
+                 "taskDueDate": yesterday}))
+        inst = await rt.workflows.start("overdue-sweep", None,
+                                        instance="sweep-1")
+        status = await rt.workflows.wait(inst, timeout=8)
+        assert status["status"] == "completed"
+        assert status["result"]["swept"] == 3
+        assert sorted(status["result"]["taskIds"]) == sorted(due_ids)
+        for tid in due_ids:
+            task = await manager.get_task_by_id(tid)
+            assert task.is_over_due
+            assert await shared.get(f"{APP_ID}||overdue||{tid}") is not None
+    finally:
+        await shutdown(rt)
