@@ -1,6 +1,6 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test lint lint-program lint-dataflow lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-overload bench-actors bench-workflows bench-repl bench-mesh bench-ml-serve chaos sweep-flash run validate docs-serve docs-build clean
+.PHONY: test lint lint-program lint-dataflow lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-trace bench-overload bench-actors bench-workflows bench-repl bench-mesh bench-ml-serve chaos sweep-flash run validate docs-serve docs-build clean
 
 test: lint lint-program lint-dataflow
 	python -m pytest tests/ -q
@@ -56,6 +56,13 @@ bench-shard:
 # state path and the publish/deliver path (must stay < 3%)
 bench-hist:
 	python bench.py --hist-bench
+
+# causal-tracing hot-path cost: span recorder on vs off (the
+# TASKSRUNNER_TRACE_DB-unset default) on the state-write,
+# publish/deliver, and actor-turn paths (<3% on, ~0% off), plus the
+# flight-recorder ring-append cost vs its disabled one-if path
+bench-trace:
+	python bench.py --trace-bench
 
 # overload protection: the drill test (shed -> scale out -> recover,
 # zero lost acks), then the bench section — admission-gate overhead on

@@ -60,6 +60,15 @@ from tasksrunner.errors import (
 )
 from tasksrunner.invoke.resolver import NameResolver
 from tasksrunner.observability.metrics import metrics
+from tasksrunner.observability.spans import active as spans_active, record_span
+from tasksrunner.observability.tracing import (
+    BAGGAGE_HEADER,
+    TRACEPARENT_HEADER,
+    TraceContext,
+    current_or_new,
+    serialize_baggage,
+    trace_scope,
+)
 from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER
 
 logger = logging.getLogger(__name__)
@@ -479,8 +488,13 @@ class ActorRuntime:
         if rec_latency is None:
             rec_latency = self._rec_turn[actor_type] = metrics.recorder(
                 "actor_turn_latency_seconds", type=actor_type)
+        # the turn gets its own span as a child of the caller's context
+        # (the sidecar ingress span, a forward hop, or a reminder root);
+        # with recording off this whole lane costs one bool test
+        turn_ctx = current_or_new().child() if spans_active() else None
         async with act.lock:
             started = time.perf_counter()
+            wall_started = time.time()
             policy = self._chaos_policy(actor_type)
             if policy is not None:
                 # the fault fires HERE, on the owning replica, inside
@@ -500,57 +514,83 @@ class ActorRuntime:
                 "data": data, "state": act.data, "kind": kind,
                 "reminder": reminder_name,
             }).encode()
+            headers = {"content-type": "application/json"}
+            scope = contextlib.nullcontext()
+            if turn_ctx is not None:
+                # the app channel adopts this header in _handle_actor,
+                # so the handler's ACTOR span nests under the turn span
+                headers[TRACEPARENT_HEADER] = turn_ctx.header
+                bag = serialize_baggage(turn_ctx.baggage)
+                if bag:
+                    headers[BAGGAGE_HEADER] = bag
+                scope = trace_scope(turn_ctx)
+            turn_status = 500
             try:
-                status, _, body = await asyncio.wait_for(
-                    self.runtime.app_channel.request(
-                        "PUT",
-                        f"/tasksrunner/actors/{actor_type}/{actor_id}/{method}",
-                        headers={"content-type": "application/json"},
-                        body=payload),
-                    timeout=self.turn_timeout)
-            except asyncio.TimeoutError:
-                metrics.inc("actor_turns_total", type=actor_type,
-                            status="timeout")
-                raise ActorError(
-                    f"actor {actor_type}/{actor_id}.{method} exceeded the "
-                    f"{self.turn_timeout}s turn timeout "
-                    "(TASKSRUNNER_ACTOR_TURN_TIMEOUT_SECONDS)") from None
-            if status >= 300:
-                metrics.inc("actor_turns_total", type=actor_type,
-                            status="error")
-                detail = body[:200].decode("utf-8", "replace")
-                raise ActorError(
-                    f"actor {actor_type}/{actor_id}.{method} failed "
-                    f"({status}): {detail}")
-            doc = json.loads(body) if body else {}
-            new_state = doc.get("state")
-            if not isinstance(new_state, dict):
-                new_state = {}
-            reminders = dict(act.reminders)
-            if kind == "reminder" and reminder_name is not None:
-                rem = reminders.get(reminder_name)
-                if rem is not None:
-                    if rem.get("period"):
-                        rem = dict(rem)
-                        rem["due"] = time.time() + float(rem["period"])
-                        reminders[reminder_name] = rem
-                    else:
-                        reminders.pop(reminder_name)
-            # staged reminder changes land AFTER the fired-reminder
-            # re-arm/pop above, so a handler re-setting (or clearing)
-            # the very reminder that fired wins over the default
-            now = time.time()
-            for rname, spec in (doc.get("reminders_set") or {}).items():
-                reminders[rname] = {
-                    "due": now + max(0.0, float(spec.get("dueSeconds", 0.0))),
-                    "period": spec.get("periodSeconds"),
-                    "data": spec.get("data"),
-                }
-            for rname in doc.get("reminders_clear") or []:
-                reminders.pop(rname, None)
-            await self._commit(act, actor_type, actor_id,
-                               new_data=new_state, new_reminders=reminders,
-                               effects=doc.get("effects") or None)
+                with scope:
+                    try:
+                        status, _, body = await asyncio.wait_for(
+                            self.runtime.app_channel.request(
+                                "PUT",
+                                f"/tasksrunner/actors/{actor_type}/{actor_id}/{method}",
+                                headers=headers,
+                                body=payload),
+                            timeout=self.turn_timeout)
+                    except asyncio.TimeoutError:
+                        metrics.inc("actor_turns_total", type=actor_type,
+                                    status="timeout")
+                        raise ActorError(
+                            f"actor {actor_type}/{actor_id}.{method} exceeded the "
+                            f"{self.turn_timeout}s turn timeout "
+                            "(TASKSRUNNER_ACTOR_TURN_TIMEOUT_SECONDS)") from None
+                    if status >= 300:
+                        metrics.inc("actor_turns_total", type=actor_type,
+                                    status="error")
+                        detail = body[:200].decode("utf-8", "replace")
+                        turn_status = status
+                        raise ActorError(
+                            f"actor {actor_type}/{actor_id}.{method} failed "
+                            f"({status}): {detail}")
+                    doc = json.loads(body) if body else {}
+                    new_state = doc.get("state")
+                    if not isinstance(new_state, dict):
+                        new_state = {}
+                    reminders = dict(act.reminders)
+                    if kind == "reminder" and reminder_name is not None:
+                        rem = reminders.get(reminder_name)
+                        if rem is not None:
+                            if rem.get("period"):
+                                rem = dict(rem)
+                                rem["due"] = time.time() + float(rem["period"])
+                                reminders[reminder_name] = rem
+                            else:
+                                reminders.pop(reminder_name)
+                    # staged reminder changes land AFTER the fired-reminder
+                    # re-arm/pop above, so a handler re-setting (or clearing)
+                    # the very reminder that fired wins over the default
+                    now = time.time()
+                    for rname, spec in (doc.get("reminders_set") or {}).items():
+                        reminders[rname] = {
+                            "due": now + max(0.0, float(spec.get("dueSeconds", 0.0))),
+                            "period": spec.get("periodSeconds"),
+                            "data": spec.get("data"),
+                        }
+                    for rname in doc.get("reminders_clear") or []:
+                        reminders.pop(rname, None)
+                    await self._commit(act, actor_type, actor_id,
+                                       new_data=new_state, new_reminders=reminders,
+                                       effects=doc.get("effects") or None)
+                    turn_status = 200
+            finally:
+                if turn_ctx is not None:
+                    record_span(
+                        kind="server",
+                        name=f"actor-turn {actor_type}/{method}",
+                        status=turn_status, start=wall_started,
+                        duration=time.perf_counter() - started,
+                        attrs={"actor": f"{actor_type}/{actor_id}",
+                               "turn_kind": kind},
+                        span_id=turn_ctx.span_id,
+                        parent_id=turn_ctx.parent_id)
             rec_latency(time.perf_counter() - started)
             metrics.inc("actor_turns_total", type=actor_type, status="ok")
             if kind == "reminder":
@@ -618,28 +658,53 @@ class ActorRuntime:
                             actor_id: str, method: str, data: Any) -> Any:
         peer = _LOCAL_REPLICAS.get((owner.get("owner") or {}).get("replica"))
         odoc = owner.get("owner") or {}
-        if peer is not None:
-            return await peer.invoke_turn(actor_type, actor_id, method, data,
-                                          forwarded=True)
-        if odoc.get("sidecar_port"):
-            path = (f"/v1.0/actors/{actor_type}/{actor_id}"
-                    f"/method/{method}")
-            status, body = await self._http_forward(
-                odoc, "PUT", path, None if data is None else data)
-            if status == 409:
-                raise ActorFencedError(
-                    f"actor {actor_type}/{actor_id}: owner fenced the "
-                    "forwarded turn; retry")
-            if status >= 300:
+        # the forward hop is a client span; the owner's turn span (and
+        # its ACTOR handler span) nest under it — in-proc via the
+        # ambient scope, cross-process via the traceparent header on
+        # the x-tasksrunner-actor-forward request
+        fwd_ctx = current_or_new().child() if spans_active() else None
+        scope = (trace_scope(fwd_ctx) if fwd_ctx is not None
+                 else contextlib.nullcontext())
+        started = time.time()
+        fwd_status = 500
+        try:
+            with scope:
+                if peer is not None:
+                    result = await peer.invoke_turn(
+                        actor_type, actor_id, method, data, forwarded=True)
+                    fwd_status = 200
+                    return result
+                if odoc.get("sidecar_port"):
+                    path = (f"/v1.0/actors/{actor_type}/{actor_id}"
+                            f"/method/{method}")
+                    status, body = await self._http_forward(
+                        odoc, "PUT", path, None if data is None else data,
+                        trace_ctx=fwd_ctx)
+                    fwd_status = status
+                    if status == 409:
+                        raise ActorFencedError(
+                            f"actor {actor_type}/{actor_id}: owner fenced the "
+                            "forwarded turn; retry")
+                    if status >= 300:
+                        raise ActorError(
+                            f"forwarded turn to {odoc.get('replica')} failed "
+                            f"({status}): {body[:200].decode('utf-8', 'replace')}")
+                    doc = json.loads(body) if body else {}
+                    return doc.get("result")
                 raise ActorError(
-                    f"forwarded turn to {odoc.get('replica')} failed "
-                    f"({status}): {body[:200].decode('utf-8', 'replace')}")
-            doc = json.loads(body) if body else {}
-            return doc.get("result")
-        raise ActorError(
-            f"actor {actor_type}/{actor_id} is owned by "
-            f"{odoc.get('replica')!r} which is unreachable from here; "
-            "retry (ownership moves when its lease expires)")
+                    f"actor {actor_type}/{actor_id} is owned by "
+                    f"{odoc.get('replica')!r} which is unreachable from here; "
+                    "retry (ownership moves when its lease expires)")
+        finally:
+            if fwd_ctx is not None:
+                record_span(
+                    kind="client",
+                    name=f"actor-forward {actor_type}/{method}",
+                    status=fwd_status, start=started,
+                    duration=time.time() - started,
+                    attrs={"target": odoc.get("replica"),
+                           "actor": f"{actor_type}/{actor_id}"},
+                    span_id=fwd_ctx.span_id, parent_id=fwd_ctx.parent_id)
 
     async def _forward_reminder(self, owner: dict, actor_type: str,
                                 actor_id: str, name: str, http_method: str,
@@ -670,12 +735,19 @@ class ActorRuntime:
             f"{odoc.get('replica')!r} which is unreachable from here; retry")
 
     async def _http_forward(self, owner: dict, http_method: str, path: str,
-                            body: Any) -> tuple[int, bytes]:
+                            body: Any, *,
+                            trace_ctx: TraceContext | None = None,
+                            ) -> tuple[int, bytes]:
         if self._session is None:
             import aiohttp
             self._session = aiohttp.ClientSession()
         headers = {"content-type": "application/json",
                    "x-tasksrunner-actor-forward": "1"}
+        if trace_ctx is not None:
+            headers[TRACEPARENT_HEADER] = trace_ctx.header
+            bag = serialize_baggage(trace_ctx.baggage)
+            if bag:
+                headers[BAGGAGE_HEADER] = bag
         token = os.environ.get(TOKEN_ENV)
         if token:
             headers[TOKEN_HEADER] = token
@@ -768,10 +840,16 @@ class ActorRuntime:
             if float(rem.get("due", 0.0)) > now:
                 continue
             try:
-                result = await self._execute_turn(
-                    act, actor_type, actor_id, method=name,
-                    data=rem.get("data"), kind="reminder",
-                    reminder_name=name)
+                # a reminder turn has no caller — it roots a fresh
+                # trace (workflow drive turns re-attach to the durable
+                # instance trace inside the engine)
+                scope = (trace_scope(TraceContext.new()) if spans_active()
+                         else contextlib.nullcontext())
+                with scope:
+                    result = await self._execute_turn(
+                        act, actor_type, actor_id, method=name,
+                        data=rem.get("data"), kind="reminder",
+                        reminder_name=name)
                 fired += 1
                 for observer in self.turn_observers:
                     try:

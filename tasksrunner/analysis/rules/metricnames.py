@@ -7,6 +7,14 @@ views then disagree about which series is real. Every
 ``recorder(...)`` call with a literal name must use a name declared in
 ``tasksrunner/observability/names.py`` under the matching kind.
 
+Span identity gets the same discipline: a ``record_span(...)`` call's
+``name=`` first token must appear in ``names.SPAN_NAMES`` and its
+``kind=`` in ``names.SPAN_KINDS`` — a typo'd span name fractures the
+service map and the critical-path blame chains exactly the way a
+typo'd metric name forks a series. Names whose *leading* text is
+dynamic (the HTTP server span's ``f"{method} {path}"``) are exempt by
+design: their vocabulary is the app's routes, not ours.
+
 This is the AST successor of ``scripts/check_metrics.py`` (the script
 survives as a thin alias); being a registered rule it now shares
 suppressions, the baseline, JSON output, and the cache with every
@@ -32,16 +40,70 @@ def _kind_table() -> dict[str, tuple[str, dict]]:
     }
 
 
+def _span_name_first_token(node: ast.expr) -> str | None:
+    """The static first token of a span ``name=`` argument, or None
+    when the leading text is dynamic (exempt)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        text = node.value
+    elif (isinstance(node, ast.JoinedStr) and node.values
+            and isinstance(node.values[0], ast.Constant)
+            and isinstance(node.values[0].value, str)):
+        text = node.values[0].value
+    else:
+        return None
+    tokens = text.split()
+    return tokens[0] if tokens else None
+
+
+def _is_record_span(node: ast.Call) -> bool:
+    if isinstance(node.func, ast.Name):
+        return node.func.id == "record_span"
+    return (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "record_span")
+
+
 @register
 class MetricNames(Rule):
     id = "metric-names"
     doc = ("every instrumentation site uses a name declared in "
-           "observability/names.py, under the right instrument kind")
+           "observability/names.py, under the right instrument kind "
+           "(span names/kinds included)")
+
+    def _check_span(self, ctx: FileContext, node: ast.Call,
+                    names) -> Iterable[Finding]:
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        token = _span_name_first_token(kwargs.get("name"))
+        if token is not None and token not in names.SPAN_NAMES:
+            yield ctx.finding(
+                self.id, node,
+                f"span name {token!r} is not declared in "
+                "observability/names.py SPAN_NAMES — declare it (with a "
+                "doc line) or fix the typo before it fractures the "
+                "service map")
+        kind_node = kwargs.get("kind")
+        kind_literals = []
+        if isinstance(kind_node, ast.Constant):
+            kind_literals = [kind_node.value]
+        elif isinstance(kind_node, ast.IfExp):
+            # the app server's conditional kind= ("consumer" if ... else
+            # "server"): both arms must be valid
+            for arm in (kind_node.body, kind_node.orelse):
+                if isinstance(arm, ast.Constant):
+                    kind_literals.append(arm.value)
+        for kind in kind_literals:
+            if kind not in names.SPAN_KINDS:
+                yield ctx.finding(
+                    self.id, node,
+                    f"span kind {kind!r} is not one of "
+                    "observability/names.py SPAN_KINDS")
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         from tasksrunner.observability import names
         table = _kind_table()
         for node in self.walk(ctx):
+            if isinstance(node, ast.Call) and _is_record_span(node):
+                yield from self._check_span(ctx, node, names)
+                continue
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
                     and node.func.attr in table):

@@ -22,6 +22,7 @@ str | bytes | int | None | (status, body)``.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import inspect
 import json
 import logging
@@ -34,6 +35,7 @@ from tasksrunner import cloudevents
 from tasksrunner.errors import TasksRunnerError, ValidationError
 from tasksrunner.observability.spans import record_span
 from tasksrunner.observability.tracing import (
+    BAGGAGE_HEADER,
     TRACEPARENT_HEADER,
     ensure_trace,
     trace_scope,
@@ -447,7 +449,9 @@ class App:
             self.inflight -= 1
 
     async def _handle_actor(self, method: str, clean_path: str,
-                            body: bytes) -> Response:
+                            body: bytes,
+                            headers: dict[str, str] | None = None,
+                            ) -> Response:
         """The sidecar-facing actor channel (reserved, like
         /tasksrunner/subscribe): GET /tasksrunner/actors advertises the
         hosted types; PUT /tasksrunner/actors/{type}/{id}/{method} runs
@@ -474,30 +478,44 @@ class App:
             data=doc.get("data"), state=doc.get("state") or {},
             kind=doc.get("kind") or "turn", reminder=doc.get("reminder"),
         )
+        # The owning runtime's _execute_turn sends its turn span's
+        # traceparent over the app channel; adopting it here nests the
+        # handler's ACTOR span under the turn span. Without the header
+        # (older runtime, direct call) the ambient context still flows.
+        headers = headers or {}
+        traceparent = headers.get(TRACEPARENT_HEADER)
+        if traceparent:
+            scope = trace_scope(ensure_trace(
+                traceparent, headers.get(BAGGAGE_HEADER)))
+        else:
+            scope = contextlib.nullcontext()
         started = time.time()
-        try:
-            result = await handler(turn)
-            out = {"state": turn.state, "result": result}
-            # staged atomics ride the response only when used, keeping
-            # the wire doc identical to the pre-workflow protocol for
-            # plain actors (old sidecars ignore unknown keys anyway)
-            if turn.effects:
-                out["effects"] = turn.effects
-            if turn.reminder_sets:
-                out["reminders_set"] = turn.reminder_sets
-            if turn.reminder_clears:
-                out["reminders_clear"] = turn.reminder_clears
-            resp = Response(body=out)
-        except TasksRunnerError as exc:
-            resp = Response(status=exc.http_status, body={"error": str(exc)})
-        except Exception:
-            logger.exception("actor turn %s/%s.%s failed",
-                             actor_type, actor_id, turn_method)
-            resp = Response(status=500, body={"error": "internal error"})
-        record_span(
-            kind="server", name=f"ACTOR {actor_type}/{actor_id}.{turn_method}",
-            status=resp.status, start=started, duration=time.time() - started,
-        )
+        with scope:
+            try:
+                result = await handler(turn)
+                out = {"state": turn.state, "result": result}
+                # staged atomics ride the response only when used, keeping
+                # the wire doc identical to the pre-workflow protocol for
+                # plain actors (old sidecars ignore unknown keys anyway)
+                if turn.effects:
+                    out["effects"] = turn.effects
+                if turn.reminder_sets:
+                    out["reminders_set"] = turn.reminder_sets
+                if turn.reminder_clears:
+                    out["reminders_clear"] = turn.reminder_clears
+                resp = Response(body=out)
+            except TasksRunnerError as exc:
+                resp = Response(status=exc.http_status, body={"error": str(exc)})
+            except Exception:
+                logger.exception("actor turn %s/%s.%s failed",
+                                 actor_type, actor_id, turn_method)
+                resp = Response(status=500, body={"error": "internal error"})
+            record_span(
+                kind="server",
+                name=f"ACTOR {actor_type}/{actor_id}.{turn_method}",
+                status=resp.status, start=started,
+                duration=time.time() - started,
+            )
         return resp
 
     async def _handle(self, method: str, path: str, *, query: str = "",
@@ -522,7 +540,7 @@ class App:
         if method.upper() == "GET" and clean_path == "/openapi.json":
             return Response(body=self.openapi())
         if clean_path.startswith("/tasksrunner/actors"):
-            return await self._handle_actor(method, clean_path, body)
+            return await self._handle_actor(method, clean_path, body, headers)
 
         if method.upper() in ("GET", "HEAD"):
             for mount_prefix, read_file in self._static_mounts:
@@ -552,7 +570,8 @@ class App:
             # Adopt the caller's trace context (same move the HTTP app
             # server makes at ingress — in-proc and sidecar modes must
             # trace identically).
-            ctx = ensure_trace(headers.get(TRACEPARENT_HEADER))
+            ctx = ensure_trace(headers.get(TRACEPARENT_HEADER),
+                               headers.get(BAGGAGE_HEADER))
             with trace_scope(ctx):
                 started = time.time()
                 try:

@@ -35,6 +35,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import importlib
+import json
 import logging
 import os
 import sys
@@ -233,20 +234,44 @@ def _cmd_deploy(args) -> None:
             print(f"environment {manifest.name!r} had no recorded state")
 
 
+def _span_split(span: dict) -> str:
+    """Render a span's queue-wait/service split when the lane recorded
+    one (batched hops: state writes, ML batches)."""
+    attrs = span.get("attrs")
+    if isinstance(attrs, str):
+        try:
+            attrs = json.loads(attrs)
+        except ValueError:
+            return ""
+    if not isinstance(attrs, dict) or "queue_wait" not in attrs:
+        return ""
+    try:
+        return (f"  [wait {float(attrs['queue_wait']) * 1000:.1f}ms"
+                f" / svc {float(attrs.get('service', 0.0)) * 1000:.1f}ms]")
+    except (TypeError, ValueError):
+        return ""
+
+
 def _cmd_traces(args) -> None:
     import pathlib
     import sys
 
-    from tasksrunner.observability.spans import list_traces, service_map, trace_spans
+    from tasksrunner.observability.spans import (
+        assemble_trace, critical_path, list_traces, service_map,
+    )
 
-    db = args.db
-    if not db or not pathlib.Path(db).is_file():
+    # --db accepts a comma-separated list: each replica records into
+    # its own span DB, and show/critical assemble across all of them
+    dbs = [p.strip() for p in (args.db or "").split(",") if p.strip()]
+    existing = [p for p in dbs if pathlib.Path(p).is_file()]
+    if not existing:
         # exit 2 = "nothing to inspect", distinct from a failed query
         # against a real database (and never a raw sqlite traceback)
-        print(f"no trace database at {db or '(unset)'} "
+        print(f"no trace database at {args.db or '(unset)'} "
               "(services record to .tasksrunner/traces.db by default)",
               file=sys.stderr)
         raise SystemExit(2)
+    db = existing[0]
 
     if args.action == "list":
         rows = list_traces(db, limit=args.limit)
@@ -261,7 +286,7 @@ def _cmd_traces(args) -> None:
     elif args.action == "show":
         if not args.trace_id:
             raise SystemExit("show needs a trace id (prefix ok)")
-        spans = trace_spans(db, args.trace_id)
+        spans = assemble_trace(existing, args.trace_id)
         if not spans:
             raise SystemExit(f"no spans for trace {args.trace_id!r}")
         t0 = spans[0]["start"]
@@ -280,7 +305,38 @@ def _cmd_traces(args) -> None:
             indent = "  " * depth(s)
             print(f"{offset:8.1f}ms {s['duration']*1000:7.1f}ms  "
                   f"{indent}[{s['role']}] {s['kind']:<8} {s['name']} "
-                  f"({s['status']})")
+                  f"({s['status']}){_span_split(s)}")
+    elif args.action == "critical":
+        if not args.trace_id:
+            raise SystemExit("critical needs a trace id (prefix ok)")
+        spans = assemble_trace(existing, args.trace_id)
+        if not spans:
+            raise SystemExit(f"no spans for trace {args.trace_id!r}")
+        hops = critical_path(spans)
+        if not hops:
+            raise SystemExit(f"no rooted path in trace {args.trace_id!r}")
+        # blame denominator is the CHAIN's wall time, not the root
+        # span's duration: an async tail (a consumer hop that starts
+        # after the root responded) legitimately extends the chain past
+        # the root, and broker transit shows up as unaccounted time
+        # instead of pushing the ledger over 100%
+        total = (max(h["start"] + h["duration"] for h in hops)
+                 - hops[0]["start"])
+        print(f"critical path: {len(hops)} hops over {len(spans)} spans, "
+              f"root {hops[0]['name']!r}, wall {total * 1000:.1f} ms")
+        t0 = hops[0]["start"]
+        for hop in hops:
+            split = ""
+            if "queue_wait" in hop:
+                split = (f"  (wait {hop['queue_wait'] * 1000:.1f}ms"
+                         f" / svc {hop.get('service', 0.0) * 1000:.1f}ms)")
+            print(f"{(hop['start'] - t0) * 1000:8.1f}ms "
+                  f"self {hop['self_time'] * 1000:7.1f}ms  "
+                  f"[{hop['role']}] {hop['kind']:<8} {hop['name']}{split}")
+        accounted = sum(h["self_time"] for h in hops)
+        pct = (accounted / total * 100.0) if total > 0 else 100.0
+        print(f"blame accounted: {accounted * 1000:.1f} ms of "
+              f"{total * 1000:.1f} ms ({pct:.0f}%)")
     elif args.action == "query":
         # the local Log-Analytics pane (≙ the reference's Kusto queries
         # over App Insights tables, docs module 8): read-only SQL
@@ -343,6 +399,49 @@ def _cmd_traces(args) -> None:
         for e in edges:
             print(f"{e['from']:<36} --{e['kind']}--> {e['to']:<42} "
                   f"{e['calls']:>5} calls  avg {e['avg_ms']} ms")
+
+
+def _cmd_flightrec(args) -> None:
+    """Inspect black-box flight-recorder dumps (the post-mortem ring
+    each process writes on shed entry, slow exemplars, and unclean
+    shutdown)."""
+    import datetime as dt
+
+    from tasksrunner.observability import flightrec
+
+    if args.dump:
+        try:
+            payload = flightrec.read_dump(args.dump)
+        except (OSError, ValueError) as exc:
+            raise SystemExit(f"cannot read dump {args.dump!r}: {exc}")
+        ts = dt.datetime.fromtimestamp(payload.get("ts") or 0)
+        print(f"{payload.get('role')} pid {payload.get('pid')} — "
+              f"{payload.get('reason')} at {ts:%H:%M:%S}  "
+              f"detail={payload.get('detail')}")
+        gauges = payload.get("gauges") or {}
+        if gauges:
+            print("gauges at dump: " + "  ".join(
+                f"{k}={v:.3f}" for k, v in sorted(gauges.items())))
+        entries = payload.get("entries") or []
+        for e in entries[-args.limit:]:
+            ets = dt.datetime.fromtimestamp(e.get("ts") or 0)
+            trace = (e.get("trace") or "")[:16] or "-"
+            print(f"{ets:%H:%M:%S}.{ets.microsecond // 1000:03d}  "
+                  f"{(e.get('dur') or 0) * 1000:7.1f}ms  "
+                  f"({e.get('status')}) {e.get('name')}  trace {trace}"
+                  + (f"  gauges {e['gauges']}" if e.get("gauges") else ""))
+        return
+    rows = flightrec.list_dumps(args.dir)
+    if not rows:
+        print(f"no flight-recorder dumps in {args.dir} "
+              "(dumps appear on shed entry, slow exemplars, and "
+              "unclean shutdown)")
+        return
+    for r in rows:
+        ts = dt.datetime.fromtimestamp(r.get("ts") or 0)
+        print(f"{ts:%H:%M:%S}  {r['reason']:<18} {r['role']} "
+              f"pid {r['pid']}  {r['entries']:>4} entries  {r['path']}")
+    print(f"# inspect one: tasksrunner flightrec --dump {rows[0]['path']}")
 
 
 def _cmd_ps(args) -> None:
@@ -958,6 +1057,8 @@ def _metrics_slow(args) -> None:
         print(f"{h['seconds'] * 1000:9.1f} ms  {h['name']}"
               f"{'{' + tag + '}' if tag else ''}  trace {h['trace_id']}")
     print(f"# drill down: tasksrunner traces show {hits[0]['trace_id']}")
+    print("# blame chain: tasksrunner traces critical "
+          f"{hits[0]['trace_id']}")
 
 
 def _cmd_metrics(args) -> None:
@@ -1487,16 +1588,31 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser(
         "traces",
-        help="inspect recorded traces (transaction search + service map)")
-    p.add_argument("action", choices=["list", "show", "map", "query"])
+        help="inspect recorded traces (transaction search, span tree, "
+             "critical path, service map)")
+    p.add_argument("action",
+                   choices=["list", "show", "critical", "map", "query"])
     p.add_argument("trace_id", nargs="?", default=None,
-                   help="trace id for `show`; the SQL text for `query`")
-    p.add_argument("--db", default=".tasksrunner/traces.db")
+                   help="trace id for `show`/`critical`; SQL for `query`")
+    p.add_argument("--db", default=".tasksrunner/traces.db",
+                   help="span DB path; comma-separate several to "
+                        "assemble one trace across replicas")
     p.add_argument("--limit", type=int, default=20)
     p.add_argument("--mermaid", action="store_true",
                    help="emit the service map as a mermaid graph "
                         "(paste into any mkdocs/mermaid renderer)")
     p.set_defaults(fn=_cmd_traces)
+
+    p = sub.add_parser(
+        "flightrec",
+        help="inspect black-box flight-recorder dumps")
+    p.add_argument("--dir", default=".tasksrunner/flightrec",
+                   help="dump directory (TASKSRUNNER_FLIGHTREC_DIR)")
+    p.add_argument("--dump", default=None,
+                   help="render one dump file instead of listing")
+    p.add_argument("--limit", type=int, default=40,
+                   help="ring entries shown from the end of a dump")
+    p.set_defaults(fn=_cmd_flightrec)
 
     p = sub.add_parser(
         "ps", help="live status of registered apps (health, ports, components)")

@@ -83,6 +83,13 @@ FLAGS: dict[str, Flag] = dict([
        "head-block size override for the flash forward kernel"),
     _f("TASKSRUNNER_FLASH_HBLK_RING", "int", "auto",
        "head-block size override for the ring-attention kernel"),
+    _f("TASKSRUNNER_FLIGHTREC", "bool", "on",
+       "black-box flight recorder (ring of recent request timelines, "
+       "dumped on shed entry, slow exemplars, and unclean shutdown)"),
+    _f("TASKSRUNNER_FLIGHTREC_DIR", "path", ".tasksrunner/flightrec",
+       "directory flight-recorder dumps are written to"),
+    _f("TASKSRUNNER_FLIGHTREC_RING", "int", "256",
+       "request timelines the flight-recorder ring retains per process"),
     _f("TASKSRUNNER_GRANTS", "json", "unset",
        "JSON grants document applied to the app (orchestrator-injected)"),
     _f("TASKSRUNNER_HISTOGRAMS", "bool", "on",

@@ -19,15 +19,29 @@ import threading
 
 _local = threading.local()
 
+#: bumped in the child after every fork; a cached rng from another
+#: generation is discarded, so a forked worker never replays the
+#: parent's stream. Cheaper than the old per-call getpid() syscall —
+#: ids are minted several times per request and the syscall dominated.
+_generation = 0
+
+
+def _on_fork() -> None:
+    global _generation
+    _generation += 1
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_on_fork)
+
 
 def _rng() -> random.Random:
     rng = getattr(_local, "rng", None)
-    if rng is None or getattr(_local, "pid", -1) != os.getpid():
-        # (re)seed from the OS: fresh per thread and per fork, so an
-        # orchestrator-forked worker never replays the parent's stream
+    if rng is None or getattr(_local, "gen", -1) != _generation:
+        # (re)seed from the OS: fresh per thread and per fork
         rng = random.Random(os.urandom(16))
         _local.rng = rng
-        _local.pid = os.getpid()
+        _local.gen = _generation
     return rng
 
 

@@ -272,12 +272,19 @@ class BinaryHeaderCodec:
             if op is None:
                 raise MeshProtocolError(f"unknown replication op {header['op']!r}")
             store = str(header.get("store", "")).encode()
-            if len(store) > 0xFFFF:
+            # trace context rides the frame as an optional length-
+            # prefixed tail — absent, the frame is byte-identical to
+            # the original v2 shape (old v2 decoders parse it fine)
+            tp = str(header.get("tp") or "").encode()
+            if len(store) > 0xFFFF or len(tp) > 0xFFFF:
                 raise MeshProtocolError(
                     "mesh header field exceeds the v2 field limit")
-            return _RREQ_FIXED.pack(
+            frame = _RREQ_FIXED.pack(
                 _BIN_MAGIC, _K_RREQ, op,
                 int(header.get("shard", 0)), len(store)) + store
+            if tp:
+                frame += _U16.pack(len(tp)) + tp
+            return frame
         if "ok" in header:
             flags = ((1 if header.get("ok") else 0)
                      | (2 if header.get("diverged") else 0))
@@ -323,11 +330,22 @@ class BinaryHeaderCodec:
                 return {("ping" if kind == _K_PING else "pong"): rid}
             if kind == _K_RREQ:
                 (_, _, op, shard, ls) = _RREQ_FIXED.unpack_from(raw)
-                store = raw[_RREQ_FIXED.size:_RREQ_FIXED.size + ls].decode()
-                if _RREQ_FIXED.size + ls != len(raw):
-                    raise MeshProtocolError("length mismatch")
-                return {"op": _REPL_OP_NAMES.get(op, "?"),
-                        "store": store, "shard": shard}
+                off = _RREQ_FIXED.size
+                store = raw[off:off + ls].decode()
+                off += ls
+                out = {"op": _REPL_OP_NAMES.get(op, "?"),
+                       "store": store, "shard": shard}
+                if off != len(raw):
+                    # optional trace-context tail (see encode)
+                    (ltp,) = _U16.unpack_from(raw, off)
+                    off += 2
+                    tp = raw[off:off + ltp].decode()
+                    off += ltp
+                    if off != len(raw):
+                        raise MeshProtocolError("length mismatch")
+                    if tp:
+                        out["tp"] = tp
+                return out
             if kind == _K_RREP:
                 (_, _, flags, rkind, hwm,
                  epoch, le) = _RREP_FIXED.unpack_from(raw)

@@ -38,6 +38,7 @@ event loop keeps serving while a batch runs).
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import logging
 import os
 import time
@@ -45,8 +46,15 @@ from dataclasses import dataclass, replace
 from typing import Any, Callable, Sequence
 
 from tasksrunner.errors import SaturatedError
+from tasksrunner.ids import hex8
 from tasksrunner.observability.metrics import (
     MetricsRegistry, metrics as default_metrics,
+)
+from tasksrunner.observability.spans import active as spans_active, record_span
+from tasksrunner.observability.tracing import (
+    TraceContext,
+    current_trace,
+    trace_scope,
 )
 
 logger = logging.getLogger(__name__)
@@ -124,14 +132,18 @@ class BatcherConfig:
 
 
 class _Pending:
-    __slots__ = ("item", "tokens", "enqueued", "future")
+    __slots__ = ("item", "tokens", "enqueued", "future", "ctx")
 
     def __init__(self, item: Any, tokens: int, enqueued: float,
-                 future: asyncio.Future) -> None:
+                 future: asyncio.Future,
+                 ctx: TraceContext | None = None) -> None:
         self.item = item
         self.tokens = tokens
         self.enqueued = enqueued
         self.future = future
+        #: the submitter's trace context — the batch worker runs on its
+        #: own task, so the ambient context is gone by execution time
+        self.ctx = ctx
 
 
 class MicroBatcher:
@@ -207,7 +219,8 @@ class MicroBatcher:
             raise exc
         pending = _Pending(item, max(1, int(self._tokens_of(item))),
                            time.monotonic(),
-                           asyncio.get_running_loop().create_future())
+                           asyncio.get_running_loop().create_future(),
+                           ctx=current_trace() if spans_active() else None)
         self._submitted += 1
         self._tokens_in_flight += pending.tokens
         self._queue.put_nowait(pending)
@@ -277,24 +290,41 @@ class MicroBatcher:
         bucket = self.bucket_for(len(batch))
         label = str(bucket)
         now = time.monotonic()
-        self._registry.observe("ml_batch_size", float(len(batch)))
-        self._registry.observe_many(
-            "ml_queue_wait_seconds", [now - p.enqueued for p in batch],
-            bucket=label)
-        started = time.monotonic()
-        try:
-            results = await asyncio.to_thread(
-                self._run_batch, [p.item for p in batch], bucket)
-        except Exception as exc:
-            logger.exception("inference batch of %d (bucket %d) failed",
-                             len(batch), bucket)
-            self._account_done(batch)
-            for p in batch:
-                if not p.future.done():
-                    p.future.set_exception(exc)
-            return
-        self._registry.observe("ml_infer_latency_seconds",
-                               time.monotonic() - started, bucket=label)
+        wall = time.time()
+        waits = [now - p.enqueued for p in batch]
+        # the batch execution is its own trace root — N request traces
+        # converge on it, so it can't live inside any one of them; each
+        # request's ml-request span carries the batch trace id instead
+        batch_ctx = TraceContext.new() if spans_active() else None
+        scope = (trace_scope(batch_ctx) if batch_ctx is not None
+                 else contextlib.nullcontext())
+        with scope:
+            self._registry.observe("ml_batch_size", float(len(batch)))
+            self._registry.observe_many(
+                "ml_queue_wait_seconds", waits, bucket=label,
+                traces=[p.ctx.trace_id if p.ctx is not None else None
+                        for p in batch])
+            started = time.monotonic()
+            try:
+                results = await asyncio.to_thread(
+                    self._run_batch, [p.item for p in batch], bucket)
+            except Exception as exc:
+                logger.exception("inference batch of %d (bucket %d) failed",
+                                 len(batch), bucket)
+                self._record_spans(batch, waits, bucket, batch_ctx,
+                                   wall, time.monotonic() - started, 500)
+                self._account_done(batch)
+                for p in batch:
+                    if not p.future.done():
+                        p.future.set_exception(exc)
+                return
+            service = time.monotonic() - started
+            # observed inside the batch scope: a slow batch's exemplar
+            # resolves to the ml-batch trace
+            self._registry.observe("ml_infer_latency_seconds",
+                                   service, bucket=label)
+            self._record_spans(batch, waits, bucket, batch_ctx,
+                               wall, service, 200)
         self._registry.inc("ml_batches_total", bucket=label)
         self._batch_counts[bucket] = self._batch_counts.get(bucket, 0) + 1
         self._account_done(batch)
@@ -313,6 +343,30 @@ class MicroBatcher:
                 p.future.set_exception(result)
             else:
                 p.future.set_result(result)
+
+    def _record_spans(self, batch: list[_Pending], waits: list[float],
+                      bucket: int, batch_ctx: TraceContext | None,
+                      wall: float, service: float, status: int) -> None:
+        """One ml-batch span (its own trace) plus one ml-request span in
+        each submitter's trace, splitting queue wait from device
+        occupancy. Explicit trace ids throughout — the worker task has
+        no submitter context, and N traces converge on one batch."""
+        if batch_ctx is None:
+            return
+        record_span(
+            kind="internal", name="ml-batch", status=status, start=wall,
+            duration=service, attrs={"bucket": bucket, "size": len(batch)},
+            trace_id=batch_ctx.trace_id, span_id=batch_ctx.span_id)
+        for p, wait in zip(batch, waits):
+            if p.ctx is None:
+                continue
+            record_span(
+                kind="internal", name="ml-request", status=status,
+                start=wall - wait, duration=wait + service,
+                attrs={"queue_wait": wait, "service": service,
+                       "bucket": bucket, "batch_trace": batch_ctx.trace_id},
+                trace_id=p.ctx.trace_id, span_id=hex8(),
+                parent_id=p.ctx.span_id)
 
     def _account_done(self, batch: list[_Pending]) -> None:
         self._completed += len(batch)

@@ -46,6 +46,7 @@ import os
 from typing import Callable
 
 from tasksrunner.envflag import env_flag
+from tasksrunner.observability import flightrec
 from tasksrunner.observability.metrics import MetricsRegistry, metrics as default_metrics
 
 logger = logging.getLogger(__name__)
@@ -186,6 +187,9 @@ class AdmissionController:
             logger.warning(
                 "admission: shedding (saturation %.2f >= 1.0; "
                 "Retry-After %ds)", score, self.retry_after_seconds())
+            # shed entry is a black-box moment: dump the flight
+            # recorder's ring so the lead-up to the trip survives
+            flightrec.dump("admission-shed", {"score": score})
         elif self.shedding and score < self.exit_ratio:
             self.shedding = False
             logger.info(

@@ -1,0 +1,235 @@
+"""Black-box flight recorder: the last N request timelines, always on.
+
+Traces answer "what happened to this request"; the flight recorder
+answers "what was this process doing right before it went wrong" when
+nobody was watching — the aviation black box, not the radar track. A
+bounded per-process ring holds span skeletons (name, trace id, status,
+duration) for recent requests plus periodic saturation-gauge samples,
+at O(1) cost per request (one deque append; the gauge sample is
+rate-limited to once a second). The ring is dumped to disk as JSON on
+the three events worth a post-mortem:
+
+* admission-shed entry (the controller tripped — what led up to it),
+* a slow-threshold exemplar (via :data:`metrics.on_slow_exemplar`),
+* unclean shutdown (atexit without :func:`mark_clean`; a ``kill -9``
+  loses the ring, which is the accepted black-box trade — the crash
+  you *can* hook is the one you dump).
+
+Dumps land in ``TASKSRUNNER_FLIGHTREC_DIR`` and are rendered by
+``tasksrunner flightrec``. ``TASKSRUNNER_FLIGHTREC=0`` disables the
+whole plane; the per-request cost of the disabled path is one ``if``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import atexit
+import collections
+import json
+import logging
+import os
+import pathlib
+import time
+
+from tasksrunner.envflag import env_flag
+from tasksrunner.observability.metrics import metrics, set_on_slow_exemplar
+
+logger = logging.getLogger(__name__)
+
+ENV_ENABLED = "TASKSRUNNER_FLIGHTREC"
+ENV_RING = "TASKSRUNNER_FLIGHTREC_RING"
+ENV_DIR = "TASKSRUNNER_FLIGHTREC_DIR"
+
+DEFAULT_RING = 256
+DEFAULT_DIR = ".tasksrunner/flightrec"
+
+#: the saturation signals sampled into the ring — the same probes the
+#: admission controller scores, so a dump shows the shed decision's
+#: inputs alongside the requests that preceded it
+SAMPLED_GAUGES = (
+    "admission_saturation",
+    "event_loop_lag_seconds",
+    "state_write_queue_depth",
+    "broker_publish_queue_depth",
+    "ml_queue_depth",
+)
+
+#: gauge-sample cadence inside the ring (seconds)
+_SAMPLE_EVERY = 1.0
+#: per-reason dump rate limit — a shed storm or a burst of slow
+#: requests must not turn the recorder into a disk-filling loop
+_MIN_DUMP_INTERVAL = 5.0
+
+
+class FlightRecorder:
+    """Bounded ring of request skeletons + gauge samples for one process."""
+
+    def __init__(self, role: str, *, ring_size: int | None = None,
+                 out_dir: str | pathlib.Path | None = None):
+        self.role = role
+        if ring_size is None:
+            raw = os.environ.get(ENV_RING)
+            try:
+                ring_size = int(raw) if raw else DEFAULT_RING
+            except ValueError:
+                logger.warning("ignoring bad %s=%r (want an integer)",
+                               ENV_RING, raw)
+                ring_size = DEFAULT_RING
+        self.out_dir = str(out_dir or os.environ.get(ENV_DIR) or DEFAULT_DIR)
+        #: deque appends are atomic under the GIL — note() takes no lock
+        self._ring: collections.deque = collections.deque(maxlen=ring_size)
+        self._last_sample = 0.0
+        self._last_dump: dict[str, float] = {}
+        self._clean = False
+
+    # -- recording (hot path) ---------------------------------------------
+
+    def note(self, *, name: str, trace_id: str | None,
+             status: int | None, duration: float) -> None:
+        """Append one request skeleton — O(1), no I/O, no lock."""
+        now = time.time()
+        entry = {"ts": now, "name": name, "trace": trace_id,
+                 "status": status, "dur": duration}
+        if now - self._last_sample >= _SAMPLE_EVERY:
+            self._last_sample = now
+            entry["gauges"] = self._sample_gauges()
+        self._ring.append(entry)
+
+    @staticmethod
+    def _sample_gauges() -> dict[str, float]:
+        out = {}
+        for name in SAMPLED_GAUGES:
+            values = metrics.gauge_values(name)
+            if values:
+                # worst series: one saturated shard is the story
+                out[name] = max(values)
+        return out
+
+    # -- dumping ----------------------------------------------------------
+
+    def dump(self, reason: str, detail: dict | None = None) -> str | None:
+        """Snapshot the ring and write it to disk; returns the dump
+        path, or None when the per-reason rate limit suppressed it.
+
+        The ring snapshot is taken synchronously (in-memory, O(ring));
+        the disk write is dispatched to an executor when a running
+        event loop is present (the admission sampler's case) and done
+        inline otherwise (atexit, sync hooks) — on-loop callers get
+        the path back before the write lands."""
+        now = time.time()
+        if now - self._last_dump.get(reason, 0.0) < _MIN_DUMP_INTERVAL:
+            return None
+        self._last_dump[reason] = now
+        payload = {
+            "role": self.role, "pid": os.getpid(), "reason": reason,
+            "detail": detail or {}, "ts": now,
+            "gauges": self._sample_gauges(),
+            "entries": list(self._ring),
+        }
+        path = pathlib.Path(self.out_dir) / (
+            f"{self.role}-{os.getpid()}-{int(now)}-{reason}.json")
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return self._write_dump(path, payload, reason)
+        loop.run_in_executor(None, self._write_dump, path, payload, reason)
+        return str(path)
+
+    # executor-dispatched when a loop is running; the inline (no-loop)
+    # caller has no loop to block
+    def _write_dump(self, path, payload, reason):  # tasklint: off-loop
+        try:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text(json.dumps(payload, default=str))
+        except OSError as exc:
+            # a full disk must not take the process down with it
+            logger.warning("flight-recorder dump to %s failed: %s", path, exc)
+            return None
+        logger.warning("flight recorder dumped %d entries to %s (%s)",
+                       len(payload["entries"]), path, reason)
+        return str(path)
+
+    def mark_clean(self) -> None:
+        """A deliberate shutdown — suppress the atexit black-box dump."""
+        self._clean = True
+
+    def _atexit(self) -> None:
+        if not self._clean and self._ring:
+            self.dump("unclean-shutdown")
+
+
+#: process-global recorder; None = flight recording disabled
+_flightrec: FlightRecorder | None = None
+
+
+def configure_flightrec(role: str, *, ring_size: int | None = None,
+                        out_dir: str | pathlib.Path | None = None,
+                        ) -> FlightRecorder | None:
+    """Enable the flight recorder for this process (the host path calls
+    this at sidecar start). Always on unless TASKSRUNNER_FLIGHTREC=0."""
+    global _flightrec
+    if not env_flag(ENV_ENABLED, default=True):
+        return None
+    if _flightrec is None:
+        _flightrec = FlightRecorder(role, ring_size=ring_size,
+                                    out_dir=out_dir)
+        atexit.register(_flightrec._atexit)
+        # a slow exemplar is also a black-box moment: snapshot the ring
+        set_on_slow_exemplar(_on_slow)
+    return _flightrec
+
+
+def flight_recorder() -> FlightRecorder | None:
+    return _flightrec
+
+
+def note_request(*, name: str, trace_id: str | None,
+                 status: int | None, duration: float) -> None:
+    """The one-``if`` hot-path entry point the sidecar calls per request."""
+    if _flightrec is not None:
+        _flightrec.note(name=name, trace_id=trace_id, status=status,
+                        duration=duration)
+
+
+def mark_clean() -> None:
+    if _flightrec is not None:
+        _flightrec.mark_clean()
+
+
+def dump(reason: str, detail: dict | None = None) -> str | None:
+    if _flightrec is not None:
+        return _flightrec.dump(reason, detail)
+    return None
+
+
+def _on_slow(metric: str, trace_id: str, value: float) -> None:
+    if _flightrec is not None:
+        _flightrec.dump("slow-exemplar",
+                        {"metric": metric, "trace_id": trace_id,
+                         "value": value})
+
+
+# -- reading (the `tasksrunner flightrec` CLI) ----------------------------
+
+def list_dumps(out_dir: str | pathlib.Path | None = None) -> list[dict]:
+    """Summaries of every dump file, newest first."""
+    root = pathlib.Path(out_dir or os.environ.get(ENV_DIR) or DEFAULT_DIR)
+    rows = []
+    if not root.is_dir():
+        return rows
+    for path in sorted(root.glob("*.json"), reverse=True):
+        try:
+            payload = json.loads(path.read_text())
+        except (OSError, ValueError):
+            continue
+        rows.append({
+            "path": str(path), "role": payload.get("role"),
+            "pid": payload.get("pid"), "reason": payload.get("reason"),
+            "ts": payload.get("ts"),
+            "entries": len(payload.get("entries") or ()),
+        })
+    return rows
+
+
+def read_dump(path: str | pathlib.Path) -> dict:
+    return json.loads(pathlib.Path(path).read_text())

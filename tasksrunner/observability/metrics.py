@@ -60,6 +60,28 @@ MAX_EXEMPLARS = 8
 
 DEFAULT_SLOW_THRESHOLD = 0.25
 
+#: optional observer for slow-threshold crossings, set by the flight
+#: recorder (``observability/flightrec.py``) so a slow exemplar also
+#: snapshots the black-box ring. A module global (not an import) keeps
+#: metrics free of a flightrec dependency and costs one ``is None``
+#: test on the already-rare slow branch.
+on_slow_exemplar = None
+
+
+def set_on_slow_exemplar(hook) -> None:
+    """Install (or clear) the slow-exemplar observer.
+
+    Callers must use this instead of assigning the global through an
+    imported module object: the observability package ``__init__``
+    rebinds the name ``metrics`` to the registry singleton, so both
+    ``from tasksrunner.observability import metrics`` AND
+    ``import tasksrunner.observability.metrics as m`` hand back the
+    *instance* (PEP 328 submodule-attribute precedence) — an
+    assignment there lands on the registry, and exemplar capture,
+    which reads this module's global, never sees it."""
+    global on_slow_exemplar
+    on_slow_exemplar = hook
+
 #: fold a series' pending buffer into its bucket array once it holds
 #: this many raw values (snapshots fold whatever is left). Sized to
 #: keep the resident cost of an un-scraped series small — ~512 floats
@@ -249,11 +271,22 @@ class MetricsRegistry:
         ctx = current_trace()
         if ctx is None:
             return
-        exemplar = (ctx.trace_id, value, time.time())
+        self._record_exemplar(hist, series, ctx.trace_id, value)
+
+    def _record_exemplar(
+        self, hist: Histogram, series: _HistogramSeries,
+        trace_id: str, value: float,
+    ) -> None:
+        exemplar = (trace_id, value, time.time())
         with hist._lock:
             if len(series.exemplars) >= MAX_EXEMPLARS:
                 del series.exemplars[0]
             series.exemplars.append(exemplar)
+        if on_slow_exemplar is not None:
+            try:
+                on_slow_exemplar(hist.name, trace_id, value)
+            except Exception:  # noqa: BLE001 - telemetry must not fail the op
+                pass
 
     def observe(self, name: str, value: float, **labels: str) -> None:
         if not self.histograms_enabled:
@@ -265,16 +298,25 @@ class MetricsRegistry:
         if len(series.pending) >= FOLD_AT:
             hist._fold(series)
 
-    def observe_many(self, name: str, values: list[float], **labels: str) -> None:
+    def observe_many(self, name: str, values: list[float], *,
+                     traces: list | None = None, **labels: str) -> None:
         """Bulk observe: one series resolution + one C-speed extend for
         a whole batch. Used by the group-commit writer for per-row
         queue-wait — a 64-row batch would otherwise pay per-call
         overhead 64 times on the writer thread (which still contends
-        for the GIL). Exemplars are not captured here; batch work runs
-        off the request's trace."""
+        for the GIL). Batch work runs off the request's trace, so the
+        ambient-context exemplar path can't apply; callers that carried
+        each value's trace id by hand (the batched lanes do) pass them
+        via ``traces`` (aligned with ``values``, ``None`` entries
+        skipped) and slow observations still get exemplars."""
         if not self.histograms_enabled or not values:
             return
         hist, series = self._series_for(name, labels)
+        if traces is not None:
+            threshold = self.slow_threshold
+            for value, trace_id in zip(values, traces):
+                if value >= threshold and trace_id:
+                    self._record_exemplar(hist, series, trace_id, value)
         series.pending.extend(values)
         if len(series.pending) >= FOLD_AT:
             hist._fold(series)

@@ -93,3 +93,33 @@ HISTOGRAMS: dict[str, str] = {
 }
 
 ALL: dict[str, str] = {**COUNTERS, **GAUGES, **HISTOGRAMS}
+
+#: allowed ``kind=`` values on ``record_span`` — the OpenTelemetry
+#: span-kind vocabulary plus "internal" for in-process stages
+SPAN_KINDS: frozenset[str] = frozenset(
+    {"server", "client", "producer", "consumer", "internal"})
+
+#: canonical span-name prefixes. Span names are "<prefix> <target>"
+#: (the dynamic suffix names the app/topic/actor/store); the lint in
+#: ``analysis/rules/metricnames.py`` checks the literal first token of
+#: every ``record_span(name=...)`` site against this table so trace
+#: trees don't fork on a typo'd lane name. HTTP server spans whose
+#: whole name is dynamic ("GET /api/tasks") are exempt — no literal to
+#: check.
+SPAN_NAMES: dict[str, str] = {
+    "invoke": "service invocation client span, per target app + path",
+    "publish": "pub/sub producer span, per pubsub/topic",
+    "ACTOR": "app-channel actor turn handler (app-side server span)",
+    "actor-turn": "owner-side actor turn execution (server span)",
+    "actor-forward": "caller-to-owner forward hop (client span)",
+    "workflow-turn": "workflow scheduling turn on the instance trace",
+    "workflow-activity": "workflow activity attempt, per activity",
+    "workflow-compensation": "saga compensation execution",
+    "workflow-timer": "durable workflow timer wait",
+    "state-write": "group-commit state write (queue-wait/service split)",
+    "repl-ship": "leader-to-follower record batch ship (producer span)",
+    "repl-apply": "follower apply of a shipped record batch (consumer span)",
+    "repl-ack": "ack-quorum completion for a committed record batch",
+    "ml-batch": "micro-batch device execution, per padding bucket",
+    "ml-request": "one queued inference request (queue-wait/occupancy split)",
+}

@@ -25,6 +25,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from tasksrunner.ids import hex8
 from tasksrunner.observability.metrics import metrics
 from tasksrunner.observability.tracing import current_trace
 
@@ -75,8 +76,12 @@ class SpanRecorder:
         self.role = role
         self.path = str(path)
         pathlib.Path(self.path).parent.mkdir(parents=True, exist_ok=True)
-        self._buffer: list[Span] = []
-        self._lock = threading.Lock()
+        #: raw column tuples, attrs still a dict (serialized at flush,
+        #: off the event loop); appenders never take a lock — append is
+        #: one C call under the GIL, and flush drains the head with the
+        #: copy + del-slice pair (the histogram _fold discipline), so
+        #: appends racing a flush land at the tail and survive
+        self._buffer: list[tuple] = []
         self._io_lock = threading.Lock()
         self._conn: sqlite3.Connection | None = None
         self.flush_interval = flush_interval
@@ -121,39 +126,52 @@ class SpanRecorder:
     def record(self, *, kind: str, name: str, status: int | None,
                start: float, duration: float, attrs: dict | None = None,
                span_id: str | None = None,
-               parent_id: str | None = None) -> None:
+               parent_id: str | None = None,
+               trace_id: str | None = None) -> None:
         """Append a span (no I/O here — the background timer flushes).
 
         Defaults: server/consumer spans ARE the current context's span
         (parented to the wire parent); callers recording an outbound
-        child (client/producer) pass explicit ids.
+        child (client/producer) pass explicit ids. An explicit
+        ``trace_id`` bypasses the ambient context entirely — the lanes
+        that run off the request task (writer thread commits, the
+        replication ship loop, micro-batch execution) carry the
+        committing request's ids by hand.
         """
-        ctx = current_trace()
-        if ctx is None:
-            return
-        span = Span(
-            trace_id=ctx.trace_id,
-            span_id=span_id or ctx.span_id,
-            parent_id=parent_id if (parent_id or span_id) else ctx.parent_id,
-            role=self.role, kind=kind, name=name,
-            status=status, start=start, duration=duration,
-            attrs=attrs or {},
-        )
-        with self._lock:
-            self._buffer.append(span)
-            depth = len(self._buffer)
-            # no inline flush: record() runs on the event loop and must
-            # never pay sqlite I/O; the timer thread drains the buffer
-        metrics.set_gauge("span_buffer_depth", depth)
+        self._append(trace_id, span_id, parent_id, kind, name, status,
+                     start, duration, attrs)
+
+    def _append(self, trace_id, span_id, parent_id, kind, name, status,
+                start, duration, attrs) -> None:
+        # hot path: one tuple + one lock-free append; no inline flush —
+        # this runs on the event loop and must never pay sqlite I/O
+        # (the timer thread drains the buffer). The depth gauge is
+        # refreshed every 64th span, not every span — a set_gauge is
+        # ~4x the cost of the append it would be measuring.
+        if trace_id is None:
+            ctx = current_trace()
+            if ctx is None:
+                return
+            trace_id = ctx.trace_id
+            if span_id is None:
+                span_id = ctx.span_id
+                if parent_id is None:
+                    parent_id = ctx.parent_id
+        buf = self._buffer
+        buf.append((trace_id, span_id or hex8(), parent_id, self.role,
+                    kind, name, status, start, duration, attrs))
+        if not len(buf) & 63:
+            metrics.set_gauge("span_buffer_depth", len(buf))
 
     def flush(self) -> None:
-        with self._lock:
-            batch, self._buffer = self._buffer, []
-        if not batch:
+        buf = self._buffer
+        raw = buf[:]
+        if not raw:
             return
-        metrics.set_gauge("span_buffer_depth", 0)
-        # I/O outside the buffer lock so record() never waits on sqlite;
-        # _io_lock serialises the writers (timer thread + close)
+        del buf[:len(raw)]
+        metrics.set_gauge("span_buffer_depth", len(buf))
+        # I/O off the appenders' path; _io_lock serialises the writers
+        # (timer thread + close)
         with self._io_lock:
             if self._conn is None:
                 self._conn = sqlite3.connect(self.path, check_same_thread=False)
@@ -163,9 +181,8 @@ class SpanRecorder:
                 self._conn.executescript(_SCHEMA)
             self._conn.executemany(
                 "INSERT INTO spans VALUES (?,?,?,?,?,?,?,?,?,?)",
-                [(s.trace_id, s.span_id, s.parent_id, s.role, s.kind, s.name,
-                  s.status, s.start, s.duration,
-                  json.dumps(s.attrs, default=str)) for s in batch],
+                [row[:9] + ((json.dumps(row[9], default=str)
+                             if row[9] else "{}"),) for row in raw],
             )
             now = time.time()
             if self.retention_seconds > 0 and now - self._last_prune > 60:
@@ -213,15 +230,24 @@ def recorder() -> SpanRecorder | None:
     return _recorder
 
 
+def active() -> bool:
+    """True when this process records spans — THE one-``if`` gate the
+    hot paths test before doing any per-span bookkeeping."""
+    return _recorder is not None
+
+
 def record_span(*, kind: str, name: str, status: int | None,
                 start: float, duration: float,
                 attrs: dict | None = None,
                 span_id: str | None = None,
-                parent_id: str | None = None) -> None:
-    if _recorder is not None:
-        _recorder.record(kind=kind, name=name, status=status, start=start,
-                         duration=duration, attrs=attrs,
-                         span_id=span_id, parent_id=parent_id)
+                parent_id: str | None = None,
+                trace_id: str | None = None) -> None:
+    rec = _recorder
+    if rec is not None:
+        # positional into _append: this is THE per-span call site and a
+        # second 9-kwarg parse would double its interpreter cost
+        rec._append(trace_id, span_id, parent_id, kind, name, status,
+                    start, duration, attrs)
 
 
 # -- query side ----------------------------------------------------------
@@ -274,6 +300,79 @@ def trace_spans(path: str, trace_id: str) -> list[dict]:
         return [dict(r) for r in rows]
     finally:
         conn.close()
+
+
+def assemble_trace(sources: list, trace_id: str) -> list[dict]:
+    """Merge one trace's spans from several sources — local span-db
+    paths and/or already-fetched span-row lists (what the orchestrator
+    pulls from each replica's sidecar). Deduplicates on span_id (a span
+    flushed on two hosts counts once), returns rows ordered by start —
+    the multi-host analog of the shared-file assumption the query
+    helpers above make."""
+    merged: dict[str, dict] = {}
+    for source in sources:
+        if isinstance(source, (str, pathlib.Path)):
+            try:
+                rows = trace_spans(str(source), trace_id)
+            except sqlite3.Error:
+                continue  # a replica with no span db yet is not an error
+        else:
+            rows = [r for r in source
+                    if str(r.get("trace_id", "")).startswith(trace_id)]
+        for row in rows:
+            merged.setdefault(row["span_id"], dict(row))
+    return sorted(merged.values(), key=lambda r: r["start"])
+
+
+def critical_path(spans: list[dict]) -> list[dict]:
+    """Extract the blame chain: from the root span, repeatedly descend
+    into the child whose end time is latest — the longest pole holding
+    the parent open. Each hop reports ``self_time`` (its duration minus
+    the chosen child's overlap) plus the queue-wait/service split when
+    the span recorded one (group-commit writes, ML batch requests), so
+    the chain's self-times reconstruct the root's wall time."""
+    if not spans:
+        return []
+    by_id = {s["span_id"]: s for s in spans}
+    children: dict[str, list[dict]] = {}
+    for s in spans:
+        parent = s.get("parent_id")
+        if parent and parent in by_id and parent != s["span_id"]:
+            children.setdefault(parent, []).append(s)
+    roots = [s for s in spans
+             if not s.get("parent_id") or s["parent_id"] not in by_id]
+    node = min(roots or spans, key=lambda s: s["start"])
+    chain: list[dict] = []
+    seen: set[str] = set()
+    while node is not None and node["span_id"] not in seen:
+        seen.add(node["span_id"])
+        kids = children.get(node["span_id"], [])
+        nxt = max(kids, key=lambda s: s["start"] + s["duration"],
+                  default=None)
+        attrs = node.get("attrs") or {}
+        if isinstance(attrs, str):
+            try:
+                attrs = json.loads(attrs)
+            except ValueError:
+                attrs = {}
+        hop = {
+            "span_id": node["span_id"], "name": node["name"],
+            "role": node["role"], "kind": node["kind"],
+            "start": node["start"], "duration": node["duration"],
+            # overlap, not the child's full duration: an async child
+            # outliving its parent must not produce negative self-time
+            "self_time": node["duration"] - (
+                max(0.0, min(node["start"] + node["duration"],
+                             nxt["start"] + nxt["duration"]) - nxt["start"])
+                if nxt is not None else 0.0),
+        }
+        if "queue_wait" in attrs:
+            hop["queue_wait"] = attrs["queue_wait"]
+            hop["service"] = attrs.get(
+                "service", node["duration"] - attrs["queue_wait"])
+        chain.append(hop)
+        node = nxt
+    return chain
 
 
 def service_map(path: str) -> list[dict]:

@@ -12,16 +12,30 @@ attached to structured logs so logs from all services correlate.
 from __future__ import annotations
 
 import contextvars
-from contextlib import contextmanager
 from dataclasses import dataclass, field
+from urllib.parse import quote, unquote
 
 from tasksrunner.ids import hex8, hex16
 
 TRACEPARENT_HEADER = "traceparent"
+BAGGAGE_HEADER = "baggage"
+
+#: W3C baggage caps — the header must not grow hop over hop, so both
+#: the item count and the serialized size are bounded; excess entries
+#: are dropped oldest-insertion-first at serialization time
+MAX_BAGGAGE_ITEMS = 16
+MAX_BAGGAGE_BYTES = 1024
 
 
-@dataclass(frozen=True)
+@dataclass(slots=True, eq=False)
 class TraceContext:
+    """Treat as immutable: contexts are shared across tasks (the
+    ambient contextvar, span buffers, message metadata), so never
+    assign to a field — construct a new context (see ``set_baggage``).
+    Not ``frozen=True``: one of these is built on EVERY traced hop and
+    the frozen init's object.__setattr__ round-trips double its cost.
+    """
+
     trace_id: str  # 32 hex chars
     span_id: str   # 16 hex chars
     flags: str = "01"
@@ -29,7 +43,9 @@ class TraceContext:
     #: ensure_trace, or the local parent after child()) — what lets the
     #: span viewer reassemble the tree
     parent_id: str | None = None
-    #: spans recorded locally under this trace (exported via /v1.0/metadata)
+    #: cross-cutting key/values that ride the trace (serialized as the
+    #: W3C ``baggage`` header on outbound hops, capped — see
+    #: serialize_baggage)
     baggage: dict = field(default_factory=dict)
 
     @classmethod
@@ -37,13 +53,15 @@ class TraceContext:
         return cls(trace_id=hex16(), span_id=hex8())
 
     @classmethod
-    def parse(cls, header: str | None) -> "TraceContext | None":
+    def parse(cls, header: str | None,
+              baggage: dict | None = None) -> "TraceContext | None":
         if not header:
             return None
         parts = header.split("-")
         if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
             return None
-        return cls(trace_id=parts[1], span_id=parts[2], flags=parts[3])
+        return cls(trace_id=parts[1], span_id=parts[2], flags=parts[3],
+                   baggage=baggage or {})
 
     def child(self) -> "TraceContext":
         # hot path (2-3 children per handled request): explicit
@@ -69,21 +87,93 @@ def current_trace() -> TraceContext | None:
     return _current.get()
 
 
-def ensure_trace(incoming_header: str | None = None) -> TraceContext:
+def parse_baggage(header: str | None) -> dict:
+    """Decode a W3C ``baggage`` header (``k=v,k2=v2``) into a dict.
+
+    Malformed items are skipped, never raised — a peer's bad header
+    must not fail the request it rode in on. Item count is capped on
+    the way in so a hostile header cannot grow the context."""
+    if not header:
+        return {}
+    out: dict = {}
+    for item in header.split(","):
+        key, sep, value = item.partition("=")
+        key = key.strip()
+        if not sep or not key:
+            continue
+        out[key] = unquote(value.strip())
+        if len(out) >= MAX_BAGGAGE_ITEMS:
+            break
+    return out
+
+
+def serialize_baggage(baggage: dict) -> str | None:
+    """Encode baggage for the wire, dropping entries past the size cap."""
+    if not baggage:
+        return None
+    parts: list[str] = []
+    size = 0
+    for key, value in baggage.items():
+        item = f"{key}={quote(str(value), safe='')}"
+        if size + len(item) + 1 > MAX_BAGGAGE_BYTES:
+            break
+        parts.append(item)
+        size += len(item) + 1
+    return ",".join(parts) or None
+
+
+def ensure_trace(incoming_header: str | None = None,
+                 baggage_header: str | None = None) -> TraceContext:
     """Adopt the incoming context (new child span) or start a new trace."""
-    ctx = TraceContext.parse(incoming_header)
-    ctx = ctx.child() if ctx else TraceContext.new()
+    bag = parse_baggage(baggage_header) if baggage_header else {}
+    if incoming_header:
+        # inline parse + child in ONE construction — this runs on every
+        # traced hop, and a frozen-dataclass init is the dominant cost
+        parts = incoming_header.split("-")
+        if len(parts) == 4 and len(parts[1]) == 32 and len(parts[2]) == 16:
+            ctx = TraceContext(trace_id=parts[1], span_id=hex8(),
+                               flags=parts[3], parent_id=parts[2],
+                               baggage=bag)
+            _current.set(ctx)
+            return ctx
+    if bag:
+        ctx = TraceContext(trace_id=hex16(), span_id=hex8(), baggage=bag)
+    else:
+        ctx = TraceContext.new()
     _current.set(ctx)
     return ctx
 
 
-@contextmanager
-def trace_scope(ctx: TraceContext):
-    token = _current.set(ctx)
-    try:
-        yield ctx
-    finally:
-        _current.reset(token)
+def set_baggage(key: str, value: str) -> TraceContext:
+    """Attach one baggage entry to the active context (installing a
+    root context first when none is active)."""
+    ctx = current_or_new()
+    bag = dict(ctx.baggage)
+    bag[key] = value
+    ctx = TraceContext(trace_id=ctx.trace_id, span_id=ctx.span_id,
+                       flags=ctx.flags, parent_id=ctx.parent_id, baggage=bag)
+    _current.set(ctx)
+    return ctx
+
+
+class trace_scope:
+    """Install ``ctx`` as the ambient trace for the with-block.
+
+    A ``__slots__`` class, not a ``@contextmanager`` — this wraps every
+    traced hop and the generator-based protocol costs ~4x as much as
+    the set/reset it would be guarding."""
+
+    __slots__ = ("_ctx", "_token")
+
+    def __init__(self, ctx: TraceContext):
+        self._ctx = ctx
+
+    def __enter__(self) -> TraceContext:
+        self._token = _current.set(self._ctx)
+        return self._ctx
+
+    def __exit__(self, *exc) -> None:
+        _current.reset(self._token)
 
 
 def current_or_new() -> TraceContext:
@@ -97,4 +187,9 @@ def current_or_new() -> TraceContext:
 
 def outgoing_headers() -> dict[str, str]:
     """Headers to attach to an outbound hop (child span of current)."""
-    return {TRACEPARENT_HEADER: current_or_new().child().header}
+    ctx = current_or_new()
+    headers = {TRACEPARENT_HEADER: ctx.child().header}
+    bag = serialize_baggage(ctx.baggage)
+    if bag:
+        headers[BAGGAGE_HEADER] = bag
+    return headers

@@ -71,6 +71,7 @@ class AdminServer:
         app.router.add_post("/admin/apps/{app_id}/scale", self._scale)
         app.router.add_get("/admin/apps/{app_id}/metrics", self._metrics)
         app.router.add_get("/admin/actors", self._actors)
+        app.router.add_get("/admin/traces/{trace_id}", self._traces)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         self._site = web.TCPSite(self._runner, self.host, self.port)
@@ -280,6 +281,49 @@ class AdminServer:
         placement.sort(key=lambda r: (r.get("type") or "", r.get("id") or ""))
         return web.json_response(
             {"placement": placement, "replicas": replicas})
+
+    async def _traces(self, request):
+        """Cross-replica trace assembly: every replica records spans
+        into its own local span DB, so one logical request's trace is
+        scattered across processes. Fan out to every sidecar's
+        ``/v1.0/traces/{id}``, merge and dedup by span id, and hand
+        back the whole tree — the raw material for ``traces show`` /
+        ``traces critical`` against a multi-replica app."""
+        import aiohttp
+        from aiohttp import web
+
+        from tasksrunner.observability.spans import assemble_trace
+
+        trace_id = request.match_info["trace_id"]
+        token = os.environ.get(TOKEN_ENV)
+        headers = {TOKEN_HEADER: token} if token else {}
+        sources: list[list[dict]] = []
+        replicas = 0
+        async with aiohttp.ClientSession() as session:
+            for app_id, app_replicas in sorted(self.orch.replicas.items()):
+                for replica in app_replicas:
+                    if not replica.ports:
+                        continue
+                    url = (f"http://127.0.0.1:{replica.ports[1]}"
+                           f"/v1.0/traces/{trace_id}")
+                    try:
+                        async with session.get(
+                                url, headers=headers,
+                                timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                            if resp.status != 200:
+                                continue
+                            doc = await resp.json()
+                    except (aiohttp.ClientError, asyncio.TimeoutError):
+                        continue  # a dead replica must not fail the view
+                    replicas += 1
+                    if doc.get("spans"):
+                        sources.append(doc["spans"])
+        spans = await asyncio.to_thread(assemble_trace, sources, trace_id)
+        return web.json_response({
+            "trace_id": trace_id,
+            "replicas": replicas,
+            "spans": spans,
+        })
 
     async def _scale(self, request):
         from aiohttp import web

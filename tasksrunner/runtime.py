@@ -47,9 +47,11 @@ from tasksrunner.invoke.resolver import NameResolver
 from tasksrunner.observability.metrics import metrics
 from tasksrunner.observability.spans import record_span
 from tasksrunner.observability.tracing import (
+    BAGGAGE_HEADER,
     TRACEPARENT_HEADER,
     current_or_new,
     ensure_trace,
+    serialize_baggage,
     trace_scope,
 )
 from tasksrunner.pubsub.base import (
@@ -415,6 +417,9 @@ class Runtime:
         ctx = current_or_new()
         child = ctx.child()
         meta[TRACEPARENT_HEADER] = child.header
+        bag = serialize_baggage(child.baggage)
+        if bag:
+            meta[BAGGAGE_HEADER] = bag
         started = time.time()
         msg_id = await self._guarded(
             pubsub_name, lambda: broker.publish(topic, envelope, metadata=meta))
@@ -457,12 +462,15 @@ class Runtime:
         incoming = headers.get(TRACEPARENT_HEADER)
         if incoming:
             # caller supplied an explicit trace context: continue it
-            base_ctx = ensure_trace(incoming)
+            base_ctx = ensure_trace(incoming, headers.get(BAGGAGE_HEADER))
         else:
             base_ctx = current_or_new()
         # one child context is both the wire header and the client span
         child = base_ctx.child()
         headers[TRACEPARENT_HEADER] = child.header
+        bag = serialize_baggage(child.baggage)
+        if bag:
+            headers[BAGGAGE_HEADER] = bag
         path = "/" + method_path.lstrip("/")
         metrics.inc("invoke", target=target_app_id)
 
@@ -820,14 +828,22 @@ class Runtime:
         log_deliveries = _delivery_logs()
 
         async def deliver(msg: Message) -> bool:
-            ctx = ensure_trace(msg.metadata.get(TRACEPARENT_HEADER))
+            wire_tp = msg.metadata.get(TRACEPARENT_HEADER)
+            wire_bag = msg.metadata.get(BAGGAGE_HEADER)
+            ctx = ensure_trace(wire_tp, wire_bag)
             with trace_scope(ctx):
                 body = json.dumps(msg.data).encode()
+                # hand the app the WIRE context, not this loop's child
+                # of it: the app makes its own child for the consumer
+                # span, and that span must parent directly under the
+                # recorded producer span (the loop hop records nothing)
                 headers = {
                     "content-type": msg.metadata.get(
                         "content-type", cloudevents.CONTENT_TYPE),
-                    TRACEPARENT_HEADER: ctx.header,
+                    TRACEPARENT_HEADER: wire_tp or ctx.header,
                 }
+                if wire_bag:
+                    headers[BAGGAGE_HEADER] = wire_bag
 
                 async def _deliver_once():
                     return await self.app_channel.request(

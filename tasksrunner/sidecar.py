@@ -19,6 +19,7 @@ via the orchestrator) exactly as ``dapr run`` does
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 import os
@@ -28,10 +29,12 @@ from aiohttp import web
 
 from tasksrunner.errors import TasksRunnerError, ValidationError
 from tasksrunner.invoke.headers import inward_headers, outward_headers
+from tasksrunner.observability import flightrec
 from tasksrunner.observability.admission import AdmissionController
 from tasksrunner.observability.metrics import metrics, render_prometheus
 from tasksrunner.observability.probes import EventLoopLagProbe
 from tasksrunner.observability.tracing import (
+    BAGGAGE_HEADER,
     TRACEPARENT_HEADER,
     ensure_trace,
     trace_scope,
@@ -122,15 +125,26 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
                 if sheddable and admission.shedding:
                     metrics.inc("admission_shed_total", route=route_label)
                     return shed_response(admission)
-                ctx = ensure_trace(request.headers.get(TRACEPARENT_HEADER))
+                ctx = ensure_trace(request.headers.get(TRACEPARENT_HEADER),
+                                   request.headers.get(BAGGAGE_HEADER))
                 started = time.perf_counter()
+                status = 500
                 with trace_scope(ctx):
                     try:
-                        return await handler(request)
+                        resp = await handler(request)
+                        status = resp.status
+                        return resp
                     except Exception as exc:  # noqa: BLE001 - mapped to status
-                        return _json_error(exc)
+                        resp = _json_error(exc)
+                        status = resp.status
+                        return resp
                     finally:
-                        record_latency(time.perf_counter() - started)
+                        elapsed = time.perf_counter() - started
+                        record_latency(elapsed)
+                        # black-box skeleton: one if + one deque append
+                        flightrec.note_request(
+                            name=route_label, trace_id=ctx.trace_id,
+                            status=status, duration=elapsed)
             return wrapped
         return deco if handler is None else deco(handler)
 
@@ -401,6 +415,26 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
             return web.json_response(
                 {"instances": await runtime.workflows.list()})
 
+    # -- traces ----------------------------------------------------------
+
+    @routes.get("/v1.0/traces/{trace_id}")
+    @_traced(exempt=True)
+    async def get_trace(request: web.Request):
+        # this replica's slice of one trace, served from the local span
+        # db — what the orchestrator's /admin/traces/{id} fans out to
+        # for cross-host assembly. Admission-exempt: an operator pulls
+        # traces exactly when the replica is in trouble.
+        from tasksrunner.observability import spans as spans_mod
+        rec = spans_mod.recorder()
+        path = rec.path if rec is not None else os.environ.get(spans_mod.ENV_VAR)
+        if not path or not os.path.exists(path):
+            return web.json_response({"spans": []})
+        if rec is not None:
+            await asyncio.to_thread(rec.flush)  # serve the buffered tail too
+        rows = await asyncio.to_thread(
+            spans_mod.trace_spans, path, request.match_info["trace_id"])
+        return web.json_response({"spans": rows})
+
     # -- meta ------------------------------------------------------------
 
     @routes.get("/v1.0/healthz")
@@ -484,10 +518,14 @@ class Sidecar:
         self._lag_probe.start()
         if self.admission is not None:
             self.admission.start()
+        # always-on black box (TASKSRUNNER_FLIGHTREC=0 opts out); a
+        # clean stop() suppresses the atexit dump via mark_clean
+        flightrec.configure_flightrec(self.runtime.app_id)
         logger.info("sidecar for %s listening on %s:%d (mesh :%s)",
                     self.runtime.app_id, self.host, self.port, self.mesh_port)
 
     async def stop(self) -> None:
+        flightrec.mark_clean()
         if self.admission is not None:
             await self.admission.stop()
         await self._lag_probe.stop()

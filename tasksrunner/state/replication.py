@@ -53,13 +53,41 @@ from tasksrunner.errors import (
     ComponentError, EtagMismatch, NotLeaderError, ReplicaFencedError,
     ReplicationGapError, ReplicationQuorumError, StaleReadError, StateError,
 )
+from tasksrunner.ids import hex8
 from tasksrunner.observability.metrics import metrics
+from tasksrunner.observability.spans import active as spans_active, record_span
+from tasksrunner.observability.tracing import TraceContext
 from tasksrunner.state.base import (
     QueryResponse, StateItem, StateStore, TransactionOp,
 )
 from tasksrunner.state.sqlite import SqliteStateStore, _shard_path
 
 logger = logging.getLogger(__name__)
+
+
+def _batch_tp(records: list[dict]) -> str | None:
+    """The traceparent keying a shipped batch: the first record that
+    carries one (records without a captured context stay quiet)."""
+    for rec in records:
+        tp = rec.get("tp")
+        if tp:
+            return tp
+    return None
+
+
+def _tp_span(tp: str | None, *, name: str, kind: str, status: int,
+             start: float, duration: float, attrs: dict) -> None:
+    """Ship/apply/ack spans join the committing write's trace via the
+    traceparent the record carries — the replication loops run nowhere
+    near the write's ambient context."""
+    if tp is None or not spans_active():
+        return
+    ctx = TraceContext.parse(tp)
+    if ctx is None:
+        return
+    record_span(name=name, kind=kind, status=status, start=start,
+                duration=duration, attrs=attrs, trace_id=ctx.trace_id,
+                span_id=hex8(), parent_id=ctx.span_id)
 
 #: hard ceiling on replication factor — each member is a full engine
 #: (file + threads + connections); past RF 5 the write amplification
@@ -252,7 +280,7 @@ class LocalLink:
 class _Pending:
     """One committed-on-leader record awaiting its ack quorum."""
 
-    __slots__ = ("record", "resolve", "fail", "acks", "deadline")
+    __slots__ = ("record", "resolve", "fail", "acks", "deadline", "admitted")
 
     def __init__(self, record: dict, resolve: Callable[[], None],
                  fail: Callable[[BaseException], None], first_ack: str,
@@ -262,6 +290,9 @@ class _Pending:
         self.fail = fail
         self.acks = {first_ack}
         self.deadline = deadline
+        # wall-clock admit time: the repl-ack span measures commit →
+        # quorum, the durability tail the caller actually waited out
+        self.admitted = time.time()
 
 
 class ShardReplicator:
@@ -357,8 +388,16 @@ class ShardReplicator:
             p.acks.add(member)
             if len(p.acks) >= self.ack_quorum:
                 done.append(seq)
+        now = time.time()
         for seq in done:
-            self._pending.pop(seq).resolve()
+            p = self._pending.pop(seq)
+            p.resolve()
+            _tp_span(p.record.get("tp"), name="repl-ack", kind="internal",
+                     status=200, start=p.admitted,
+                     duration=now - p.admitted,
+                     attrs={"seq": seq, "acks": len(p.acks),
+                            "quorum": self.ack_quorum,
+                            "store": self._store.name})
 
     async def _ship_loop(self, member: str, link) -> None:
         labels = self._node.metric_labels
@@ -402,9 +441,16 @@ class ShardReplicator:
                     acked = int(snap["hwm"])
                     force_snapshot = False
                 else:
+                    ship_wall = time.time()
+                    ship_t0 = time.monotonic()
                     acked = await link.append(records)
                     metrics.inc("repl_records_total", len(records),
                                 member=member, **labels)
+                    _tp_span(_batch_tp(records), name="repl-ship",
+                             kind="producer", status=200, start=ship_wall,
+                             duration=time.monotonic() - ship_t0,
+                             attrs={"member": member,
+                                    "records": len(records), **labels})
                 self._member_hwm[member] = acked
                 self._on_ack(member, acked)
                 backoff = 0.05
@@ -652,8 +698,15 @@ class ReplicationNode:
             raise OSError(f"replica member {self.node_id} is down")
         loop = asyncio.get_running_loop()
         _, prev_epoch = self.store.repl_position()
+        apply_wall = time.time()
+        apply_t0 = time.monotonic()
         hwm = await loop.run_in_executor(
             self.store._write_exec, self.store.apply_repl_records, records)
+        _tp_span(_batch_tp(records), name="repl-apply", kind="consumer",
+                 status=200, start=apply_wall,
+                 duration=time.monotonic() - apply_t0,
+                 attrs={"member": self.node_id, "records": len(records),
+                        **self.metric_labels})
         _, epoch = self.store.repl_position()
         if epoch > prev_epoch:
             # a new leader's records applied cleanly: our log is a

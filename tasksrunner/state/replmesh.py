@@ -46,7 +46,7 @@ from tasksrunner.invoke.mesh import (
     negotiate_server,
     pack_frame,
 )
-from tasksrunner.state.replication import ReplicationNode
+from tasksrunner.state.replication import ReplicationNode, _batch_tp
 
 logger = logging.getLogger(__name__)
 
@@ -182,7 +182,7 @@ class MeshFollowerLink:
             except (ConnectionError, OSError):
                 pass
 
-    async def _request(self, op: str, payload) -> dict:
+    async def _request(self, op: str, payload, tp: str | None = None) -> dict:
         async with self._lock:
             if self._writer is None:
                 self._reader, self._writer = await asyncio.wait_for(
@@ -198,6 +198,11 @@ class MeshFollowerLink:
                     await self._teardown()
                     raise
             header = {"op": op, "store": self.store, "shard": self.shard}
+            if tp is not None:
+                # the shipment's trace context: struct-packed by the v2
+                # codec, a plain extra key under JSON v1 (legacy peers
+                # ignore it — they degrade to no-context, not to error)
+                header["tp"] = tp
             body = (b"" if payload is None
                     else json.dumps(payload, separators=(",", ":")).encode())
             try:
@@ -225,7 +230,8 @@ class MeshFollowerLink:
 
     async def append(self, records: list[dict]) -> int:
         await self._chaos_gate()
-        return int((await self._request("append", records))["hwm"])
+        return int((await self._request(
+            "append", records, tp=_batch_tp(records)))["hwm"])
 
     async def install(self, snapshot: dict) -> None:
         await self._chaos_gate()

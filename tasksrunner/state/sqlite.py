@@ -52,7 +52,10 @@ from tasksrunner.errors import (
     ComponentError, EtagMismatch, QueryError, ReplicaFencedError,
     ReplicationGapError, StateError,
 )
+from tasksrunner.ids import hex8
 from tasksrunner.observability.metrics import metrics
+from tasksrunner.observability.spans import active as spans_active, record_span
+from tasksrunner.observability.tracing import current_trace
 from tasksrunner.state.base import QueryResponse, StateItem, StateStore, TransactionOp
 from tasksrunner.state.query import validate_filter
 
@@ -167,7 +170,7 @@ def _encode(key: str, value: Any) -> str:
 class _PendingWrite:
     """One enqueued write op + the caller's loop/future to resolve."""
 
-    __slots__ = ("op", "loop", "future", "enqueued")
+    __slots__ = ("op", "loop", "future", "enqueued", "ctx")
 
     def __init__(self, op: tuple, loop: asyncio.AbstractEventLoop,
                  future: asyncio.Future):
@@ -177,6 +180,10 @@ class _PendingWrite:
         # monotonic enqueue time: the queue-wait half of the
         # state_queue_wait_seconds / state_commit_seconds latency split
         self.enqueued = time.monotonic()
+        # the caller's trace context, captured on the event loop — the
+        # writer thread records the state-write span with an explicit
+        # trace_id since it has no ambient context of its own
+        self.ctx = current_trace() if spans_active() else None
 
 
 def _resolve(row: _PendingWrite, value: Any, exc: BaseException | None) -> None:
@@ -564,13 +571,17 @@ class SqliteStateStore(StateStore):
     # -- replication record stream (leader side, writer thread) -----------
 
     def _repl_append(self, cur: sqlite3.Cursor,
-                     mutations: list[tuple]) -> dict | None:
+                     mutations: list[tuple],
+                     tp: str | None = None) -> dict | None:
         """Append one logical record covering ``mutations`` to the
         write-ahead stream, INSIDE the data transaction — the record
         and the rows it describes commit or roll back together. The
         record carries the post-batch ``etag_seq`` value so followers
         keep allocating fresh etags after a failover, and the leader's
-        epoch so stale-epoch zombies are refused downstream."""
+        epoch so stale-epoch zombies are refused downstream. ``tp`` is
+        the committing write's traceparent: ship/apply/ack spans
+        downstream key off it, tying replication work back to the
+        request that caused it."""
         if not self.replication or not mutations:
             return None
         seq = self._repl_hwm + 1
@@ -578,6 +589,8 @@ class SqliteStateStore(StateStore):
             "SELECT n FROM etag_seq WHERE id = 1").fetchone()
         record = {"seq": seq, "epoch": self._repl_epoch,
                   "ops": mutations, "etag_n": etag_n, "ts": time.time()}
+        if tp is not None:
+            record["tp"] = tp
         cur.execute(
             "INSERT INTO repl_log(seq, epoch, record) VALUES (?, ?, ?)",
             (seq, self._repl_epoch,
@@ -700,7 +713,12 @@ class SqliteStateStore(StateStore):
                     except EtagMismatch as exc:
                         results[i] = (None, exc)
                     i += 1
-                rec = self._repl_append(cur, mutations)
+                # the first op that arrived with a trace keys the whole
+                # record — records coalesce many writes, one traceparent
+                rec = self._repl_append(
+                    cur, mutations,
+                    tp=next((row.ctx.header for row in batch
+                             if row.ctx is not None), None))
                 self._conn.commit()
             except BaseException:
                 self._conn.rollback()
@@ -715,8 +733,27 @@ class SqliteStateStore(StateStore):
         self._dirty = True
         self._cache_apply(mutations)
         self._repl_committed(rec)
+        mono_end = time.monotonic()
         metrics.observe("state_commit_seconds",
-                        time.monotonic() - batch_start, store=self.name)
+                        mono_end - batch_start, store=self.name)
+        if spans_active():
+            # per-caller state-write spans, recorded from the writer
+            # thread with the queue-wait vs commit-service split the
+            # critical-path extractor reads
+            wall_end = time.time()
+            service = mono_end - batch_start
+            for row, (_value, exc) in zip(batch, results):
+                if row.ctx is None:
+                    continue
+                record_span(
+                    kind="internal", name=f"state-write {self.name}",
+                    status=200 if exc is None else 409,
+                    start=wall_end - (mono_end - row.enqueued),
+                    duration=mono_end - row.enqueued,
+                    attrs={"queue_wait": batch_start - row.enqueued,
+                           "service": service, "store": self.name},
+                    trace_id=row.ctx.trace_id, span_id=hex8(),
+                    parent_id=row.ctx.span_id)
         pairs = [(row, value, exc)
                  for row, (value, exc) in zip(batch, results)]
         repl = self._repl

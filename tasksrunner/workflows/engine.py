@@ -41,7 +41,14 @@ from tasksrunner.errors import (
     WorkflowNondeterminismError,
     WorkflowNotFound,
 )
+from tasksrunner.ids import hex8, hex16
 from tasksrunner.observability.metrics import metrics
+from tasksrunner.observability.spans import active as spans_active, record_span
+from tasksrunner.observability.tracing import (
+    TraceContext,
+    current_trace,
+    trace_scope,
+)
 from tasksrunner.resiliency.policy import RetrySpec
 from tasksrunner.workflows.context import (
     CHILD_EVENT_PREFIX,
@@ -218,6 +225,38 @@ class WorkflowEngine:
 
     # -- the scheduler -----------------------------------------------------
 
+    def _instance_trace(self, state: dict) -> dict | None:
+        """The instance's durable trace identity. Created on the first
+        traced turn (normally the start turn, whose caller context it
+        joins) and carried in actor state like the history, so the
+        replica that adopts the instance after its owner dies keeps
+        appending to the SAME logical trace — replays and crashes
+        stitch instead of fragmenting into per-owner traces."""
+        if not spans_active():
+            return None
+        trace = state.get("trace")
+        if trace is None:
+            ctx = current_trace()
+            trace = {"id": ctx.trace_id if ctx is not None else hex16(),
+                     "root": hex8(),
+                     "parent": ctx.span_id if ctx is not None else None}
+            state["trace"] = trace
+        return trace
+
+    def _child_span(self, *, name: str, status: int, start: float,
+                    duration: float, attrs: dict) -> None:
+        """A span nested under the current workflow-turn span. Explicit
+        ids on purpose: the ambient span IS the turn span, so letting
+        record_span default would collide with it."""
+        if not spans_active():
+            return
+        ctx = current_trace()
+        if ctx is None:
+            return
+        record_span(kind="internal", name=name, status=status, start=start,
+                    duration=duration, attrs=attrs, trace_id=ctx.trace_id,
+                    span_id=hex8(), parent_id=ctx.span_id)
+
     async def _advance(self, turn: Any) -> dict:
         state = turn.state
         if not state.get("wf"):
@@ -227,6 +266,44 @@ class WorkflowEngine:
         if state.get("status") in _TERMINAL:
             turn.clear_reminder(DRIVE_REMINDER)
             return self._doc(turn, outcome=state["status"])
+        trace = self._instance_trace(state)
+        if trace is None:
+            return await self._drive(turn)
+        # One span per scheduling turn, recorded with an explicit
+        # trace_id: the ambient context belongs to whichever caller or
+        # reminder drove this turn, but the span belongs to the
+        # instance's own trace. Replay passes inside the turn are NOT
+        # separate spans — history replay re-executes nothing, so the
+        # turn span just carries the event count it replayed over.
+        if trace.get("rooted"):
+            turn_ctx = TraceContext(trace_id=trace["id"], span_id=hex8(),
+                                    parent_id=trace["root"])
+        else:
+            # the first traced turn IS the instance's root span
+            turn_ctx = TraceContext(trace_id=trace["id"],
+                                    span_id=trace["root"],
+                                    parent_id=trace.get("parent"))
+            trace["rooted"] = True
+        started = time.time()
+        perf = time.perf_counter()
+        outcome = "error"
+        try:
+            with trace_scope(turn_ctx):
+                doc = await self._drive(turn)
+                outcome = doc.get("outcome") or "ok"
+                return doc
+        finally:
+            record_span(
+                kind="internal", name=f"workflow-turn {state['wf']}",
+                status=200 if outcome != "error" else 500,
+                start=started, duration=time.perf_counter() - perf,
+                attrs={"instance": turn.actor_id, "outcome": outcome,
+                       "events": len(state.get("history") or ())},
+                trace_id=trace["id"], span_id=turn_ctx.span_id,
+                parent_id=turn_ctx.parent_id)
+
+    async def _drive(self, turn: Any) -> dict:
+        state = turn.state
         wf_name = state["wf"]
         orchestrator = self.workflows.get(wf_name)
         if orchestrator is None:
@@ -267,6 +344,9 @@ class WorkflowEngine:
                 for t in sorted(due, key=lambda t: t.seq):
                     state["history"].append(
                         {"t": "timer_fired", "ts": now, "seq": t.seq})
+                    self._child_span(name="workflow-timer", status=200,
+                                     start=now, duration=0.0,
+                                     attrs={"seq": t.seq})
                 continue
 
             runnable = [t for t in pending
@@ -359,6 +439,9 @@ class WorkflowEngine:
             actx = ActivityContext(
                 instance=ctx.instance, workflow=ctx.workflow, name=name,
                 seq=seq, attempt=attempt, is_compensation=is_compensation)
+            span_name = (f"workflow-compensation {name}" if is_compensation
+                         else f"workflow-activity {name}")
+            wall = time.time()
             started = time.perf_counter()
             try:
                 if policy is not None:
@@ -383,6 +466,11 @@ class WorkflowEngine:
                 raise
             except Exception as exc:  # tasklint: disable=error-taxonomy (activity)
                 error = f"{type(exc).__name__}: {exc}"
+                self._child_span(
+                    name=span_name, status=500, start=wall,
+                    duration=time.perf_counter() - started,
+                    attrs={"activity": name, "attempt": attempt, "seq": seq,
+                           "error": error})
                 try:
                     delay = next(delays)
                 except StopIteration:
@@ -396,9 +484,15 @@ class WorkflowEngine:
                             status="retry")
                 await asyncio.sleep(delay)
                 continue
+            elapsed = time.perf_counter() - started
+            # observed inside the turn's trace scope, so a slow attempt
+            # captures the instance trace_id as its exemplar
             metrics.observe("workflow_activity_latency_seconds",
-                            time.perf_counter() - started, activity=name)
+                            elapsed, activity=name)
             metrics.inc("workflow_activity_total", activity=name, status="ok")
+            self._child_span(
+                name=span_name, status=200, start=wall, duration=elapsed,
+                attrs={"activity": name, "attempt": attempt, "seq": seq})
             return (True, result, actx.effects)
 
     # -- sagas -------------------------------------------------------------

@@ -1,0 +1,796 @@
+"""Full-lane causal tracing: the propagation matrix and its drills.
+
+One traceparent arriving at ingress must flow — as ONE trace id with
+unbroken parent links — through every lane the runtime owns: invoke,
+actor forward, the actor turn itself, workflow start/activity, pub/sub
+publish and delivery, and the group-committed state write. On top of
+the matrix:
+
+* a cross-process ``kill -9`` of a workflow owner proving the adopter
+  continues the SAME logical instance trace (the trace identity rides
+  workflow state, not the process),
+* a cross-process replication shipment (mesh binary AND forced-JSON
+  codecs) proving ship → apply spans land in two different span DBs
+  under the committing write's trace,
+* unit coverage for the mesh RREQ trace-context tail, W3C baggage,
+  critical-path extraction, per-request ML batch spans, trace
+  exemplars on the new lanes, and the black-box flight recorder.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from tasksrunner.app import App
+from tasksrunner.component.registry import ComponentRegistry
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.observability import spans as spans_mod
+from tasksrunner.observability.tracing import (
+    current_trace,
+    ensure_trace,
+    outgoing_headers,
+    parse_baggage,
+    serialize_baggage,
+    trace_scope,
+)
+from tasksrunner.runtime import InProcAppChannel, Runtime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRACE = "ab" * 16
+PARENT_SPAN = "12" * 8
+ROOT_TRACEPARENT = f"00-{TRACE}-{PARENT_SPAN}-01"
+
+LEASE = 0.25
+DRIVE = 0.1
+
+
+@pytest.fixture
+def trace_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("TASKSRUNNER_ACTORS", "1")
+    monkeypatch.setenv("TASKSRUNNER_WORKFLOWS", "1")
+    monkeypatch.setenv("TASKSRUNNER_ACTOR_LEASE_SECONDS", "5")
+    monkeypatch.setenv("TASKSRUNNER_ACTOR_REMINDER_POLL_SECONDS", "30")
+    db = tmp_path / "local-traces.db"
+    rec = spans_mod.configure_spans("matrix-proc", db)
+    yield str(db)
+    rec.close()
+    spans_mod._recorder = None
+
+
+def _child_env(extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (REPO, env.get("PYTHONPATH")) if p)
+    env.update(extra or {})
+    return env
+
+
+def _by_prefix(spans, prefix):
+    return [s for s in spans if s["name"].startswith(prefix)]
+
+
+# -- the in-process propagation matrix -------------------------------------
+
+
+def _matrix_app(app_id, holder, got):
+    app = App(app_id)
+
+    @app.actor("Box")
+    async def box(turn):
+        if turn.method == "bump":
+            holder["actor_ctx"] = current_trace()
+        turn.state["n"] = turn.state.get("n", 0) + 1
+        return turn.state["n"]
+
+    @app.workflow("simple")
+    async def simple(ctx, inp):
+        return await ctx.call_activity("add", {"x": inp, "y": 1})
+
+    @app.activity("add")
+    async def add(actx, data):
+        actx.stage_effect(f"eff||{actx.instance}||{actx.seq}", data)
+        return data["x"] + data["y"]
+
+    @app.subscribe("ps", "saved", route="/on-saved")
+    async def on_saved(req):
+        holder["deliver_ctx"] = current_trace()
+        got.set()
+        return 200
+
+    @app.post("/go")
+    async def go(req):
+        holder["ingress_ctx"] = current_trace()
+        rt = holder["rt2"]
+        await rt.invoke_actor("Box", "b1", "bump")  # owner: rt1 → forward
+        await rt.publish("ps", "saved", {"n": 1})
+        await rt.workflows.start("simple", 1, instance="matrix-1")
+        return 200, {"ok": True}
+
+    return app
+
+
+def _matrix_runtime(app, state_db, broker_db):
+    specs = [
+        ComponentSpec(name="statestore", type="state.sqlite",
+                      metadata={"databasePath": str(state_db)}),
+        ComponentSpec(name="ps", type="pubsub.sqlite",
+                      metadata={"brokerPath": str(broker_db),
+                                "pollIntervalSeconds": "0.01"}),
+    ]
+    reg = ComponentRegistry(specs, app_id="svc")
+    return Runtime("svc", reg, app_channel=InProcAppChannel(app))
+
+
+@pytest.mark.asyncio
+async def test_propagation_matrix_one_trace_end_to_end(trace_env, tmp_path):
+    """Ingress → actor forward → actor turn → workflow start → activity
+    → publish → delivery → state write: one trace id, linked parents,
+    baggage intact at every hop."""
+    holder, got = {}, asyncio.Event()
+    state_db, broker_db = tmp_path / "state.db", tmp_path / "broker.db"
+    rt1 = _matrix_runtime(_matrix_app("svc", holder, got),
+                          state_db, broker_db)
+    rt2 = _matrix_runtime(_matrix_app("svc", holder, got),
+                          state_db, broker_db)
+    await rt1.start()
+    await rt2.start()
+    for rt in (rt1, rt2):
+        rt.actors.lease_seconds = LEASE
+        rt.app_channel.app.workflow_engine.drive_period = DRIVE
+    holder["rt2"] = rt2
+    try:
+        # plant ownership of Box/b1 on rt1 so rt2's turn must forward
+        await rt1.invoke_actor("Box", "b1", "warm")
+
+        resp = await rt2.app_channel.app.handle(
+            "POST", "/go", body=b"{}",
+            headers={"traceparent": ROOT_TRACEPARENT,
+                     "baggage": "tenant=acme"})
+        assert resp.status == 200
+        await asyncio.wait_for(got.wait(), timeout=5)
+        deadline = time.monotonic() + 8
+        while True:
+            status = await rt2.workflows.status("matrix-1")
+            if status["status"] == "completed":
+                break
+            assert time.monotonic() < deadline, status
+            await asyncio.sleep(0.05)
+        assert status["result"] == 2
+    finally:
+        for rt in (rt2, rt1):
+            if rt.workflows is not None:
+                rt.workflows.detach()
+                rt.workflows = None
+            if rt.actors is not None:
+                await rt.actors.stop()
+                rt.actors = None
+        await rt2.stop()
+        await rt1.stop()
+
+    spans_mod.recorder().flush()
+    spans = spans_mod.trace_spans(trace_env, TRACE)
+    assert spans and all(s["trace_id"] == TRACE for s in spans)
+    by_id = {s["span_id"]: s for s in spans}
+
+    # every lane produced its span under the one trace
+    for prefix, kind in [("POST /go", "server"),
+                         ("actor-forward Box/bump", "client"),
+                         ("actor-turn Box/bump", "server"),
+                         ("ACTOR Box/b1.bump", "server"),
+                         ("publish ps/saved", "producer"),
+                         ("POST /on-saved", "consumer"),
+                         ("workflow-turn simple", "internal"),
+                         ("workflow-activity add", "internal"),
+                         ("state-write statestore", "internal")]:
+        hits = [s for s in _by_prefix(spans, prefix) if s["kind"] == kind]
+        assert hits, f"missing {kind} span {prefix!r} in {sorted(s['name'] for s in spans)}"
+
+    # linked parents, not nine parallel orphans: apart from the ingress
+    # span (whose parent is the test's synthetic caller), every span's
+    # parent is another span of this trace
+    ingress = _by_prefix(spans, "POST /go")[0]
+    assert ingress["parent_id"] == PARENT_SPAN
+    orphans = [s["name"] for s in spans
+               if s["span_id"] != ingress["span_id"]
+               and s["parent_id"] not in by_id]
+    assert orphans == [], orphans
+
+    # the forward hop parents the owner's turn
+    fwd = _by_prefix(spans, "actor-forward Box/bump")[0]
+    turn = _by_prefix(spans, "actor-turn Box/bump")[0]
+    assert turn["parent_id"] == fwd["span_id"]
+
+    # the activity nests under a workflow turn of the instance trace
+    act = _by_prefix(spans, "workflow-activity add")[0]
+    assert by_id[act["parent_id"]]["name"].startswith("workflow-turn")
+
+    # the write span carries the queue-wait/service split
+    wr_attrs = json.loads(_by_prefix(spans, "state-write")[0]["attrs"])
+    assert "queue_wait" in wr_attrs and "service" in wr_attrs
+
+    # baggage crossed the actor and delivery hops
+    assert holder["ingress_ctx"].baggage == {"tenant": "acme"}
+    assert holder["actor_ctx"].baggage == {"tenant": "acme"}
+    assert holder["deliver_ctx"].trace_id == TRACE
+    assert holder["deliver_ctx"].baggage == {"tenant": "acme"}
+
+
+# -- cross-process: kill -9 the workflow owner -----------------------------
+
+_KILL9_TRACE_CHILD = '''
+import asyncio, os, sys
+
+os.environ["TASKSRUNNER_WORKFLOWS"] = "1"
+os.environ["TASKSRUNNER_ACTOR_LEASE_SECONDS"] = "0.5"
+os.environ["TASKSRUNNER_ACTOR_REMINDER_POLL_SECONDS"] = "30"
+
+from tasksrunner.app import App
+from tasksrunner.component.registry import ComponentRegistry
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.observability import spans as spans_mod
+from tasksrunner.observability.tracing import ensure_trace, trace_scope
+from tasksrunner.runtime import InProcAppChannel, Runtime
+
+
+def build():
+    app = App("svc")
+
+    @app.workflow("steps")
+    async def steps(ctx, n):
+        total = 0
+        for i in range(n):
+            total += await ctx.call_activity("slowstep", {"i": i})
+        return total
+
+    @app.activity("slowstep")
+    async def slowstep(actx, data):
+        print(f"STEP {actx.seq}", flush=True)
+        await asyncio.sleep(0.12)
+        return 1
+
+    return app
+
+
+async def main():
+    spans_mod.configure_spans("owner", sys.argv[2])
+    spec = ComponentSpec(name="statestore", type="state.sqlite",
+                         metadata={"databasePath": sys.argv[1]})
+    reg = ComponentRegistry([spec], app_id="svc")
+    rt = Runtime("svc", reg, app_channel=InProcAppChannel(build()))
+    await rt.start()
+    rt.actors.lease_seconds = 0.5
+    rt.app_channel.app.workflow_engine.drive_period = 0.2
+    print("READY", flush=True)
+    with trace_scope(ensure_trace(sys.argv[3])):
+        await rt.workflows.start("steps", 12, instance="xtrace-1")
+    await asyncio.sleep(60)  # the parent kills us long before this
+
+
+asyncio.run(main())
+'''
+
+
+@pytest.mark.asyncio
+async def test_kill9_owner_instance_trace_contiguity(trace_env, tmp_path):
+    """``kill -9`` the process that owns a running workflow. The trace
+    identity is committed in workflow state, so the replica that adopts
+    the instance keeps appending to the SAME logical trace the dead
+    owner started — one trace id, the adopter's turns parented under
+    the root span the dead process created, no replayed-duplicate
+    activity spans."""
+    db = tmp_path / "wf.db"
+    owner_traces = tmp_path / "owner-traces.db"
+    script = tmp_path / "owner_child.py"
+    script.write_text(_KILL9_TRACE_CHILD)
+    child = await asyncio.create_subprocess_exec(
+        sys.executable, str(script), str(db), str(owner_traces),
+        ROOT_TRACEPARENT,
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT,
+        env=_child_env())
+    try:
+        # kill mid-run: late enough that the child's 0.5 s flush timer
+        # has landed its early spans, early enough that the adopter
+        # still has real work left
+        steps_seen = 0
+        deadline = asyncio.get_running_loop().time() + 30
+        while steps_seen < 5:
+            assert asyncio.get_running_loop().time() < deadline, \
+                f"child never progressed (saw {steps_seen} steps)"
+            line = (await asyncio.wait_for(child.stdout.readline(), 30)
+                    ).decode().strip()
+            if line.startswith("STEP "):
+                steps_seen = int(line.split()[1])
+        child.kill()
+        await child.wait()
+
+        app = App("svc")
+
+        @app.workflow("steps")
+        async def steps(ctx, n):
+            total = 0
+            for i in range(n):
+                total += await ctx.call_activity("slowstep", {"i": i})
+            return total
+
+        @app.activity("slowstep")
+        async def slowstep(actx, data):
+            return 1
+
+        spec = ComponentSpec(name="statestore", type="state.sqlite",
+                             metadata={"databasePath": str(db)})
+        reg = ComponentRegistry([spec], app_id="svc")
+        rt = Runtime("svc", reg, app_channel=InProcAppChannel(app))
+        await rt.start()
+        rt.actors.lease_seconds = LEASE
+        rt.app_channel.app.workflow_engine.drive_period = DRIVE
+        try:
+            deadline = time.monotonic() + 15
+            while True:
+                await rt.actors.sweep()
+                status = await rt.workflows.status("xtrace-1")
+                if status["status"] == "completed":
+                    break
+                assert time.monotonic() < deadline, status
+                await asyncio.sleep(0.05)
+            assert status["result"] == 12
+        finally:
+            rt.workflows.detach()
+            rt.workflows = None
+            await rt.actors.stop()
+            rt.actors = None
+            await rt.stop()
+    finally:
+        if child.returncode is None:
+            child.kill()
+            await child.wait()
+
+    spans_mod.recorder().flush()
+    merged = spans_mod.assemble_trace([str(owner_traces), trace_env], TRACE)
+    assert merged, "no spans joined the instance trace"
+    roles = {s["role"] for s in merged}
+    assert {"owner", "matrix-proc"} <= roles, roles
+
+    # the dead owner's first traced turn minted the instance's root
+    # span id and committed it in workflow state; SIGKILL lost the
+    # in-flight turn span itself, but the durable id is the anchor:
+    # the owner's activity spans AND every adopter turn hang off it
+    acts = [s for s in merged if s["name"] == "workflow-activity slowstep"]
+    owner_acts = sorted((s for s in acts if s["role"] == "owner"),
+                        key=lambda s: json.loads(s["attrs"])["seq"])
+    assert owner_acts, "owner's pre-kill activity spans never flushed"
+    # the first activity ran inside the instance's root turn, so its
+    # parent IS the root span id the dead owner minted and committed
+    root_id = owner_acts[0]["parent_id"]
+    turns = [s for s in merged if s["name"] == "workflow-turn steps"
+             and s["role"] == "matrix-proc"]
+    assert turns, "adopter recorded no turn spans"
+    assert all(s["parent_id"] == root_id for s in turns), turns
+
+    # replay re-records nothing: each activity seq has at most one
+    # span across both processes, and the adopter only recorded the
+    # continuation, not the replayed prefix
+    seqs = [json.loads(s["attrs"])["seq"] for s in acts]
+    assert len(seqs) == len(set(seqs)), sorted(seqs)
+    adopter_seqs = {json.loads(s["attrs"])["seq"] for s in acts
+                    if s["role"] == "matrix-proc"}
+    owner_seqs = {json.loads(s["attrs"])["seq"] for s in owner_acts}
+    assert 12 in adopter_seqs and adopter_seqs.isdisjoint(owner_seqs)
+    assert min(adopter_seqs) > max(owner_seqs)
+
+
+# -- cross-process: replication ship → apply -------------------------------
+
+_REPL_TRACE_CHILD = '''
+import asyncio, sys
+
+from tasksrunner.observability import spans as spans_mod
+from tasksrunner.observability.tracing import ensure_trace, trace_scope
+from tasksrunner.state.replication import ReplicationNode
+from tasksrunner.state.replmesh import MeshFollowerLink
+from tasksrunner.state.sqlite import SqliteStateStore
+
+
+async def main():
+    tmp, parent_port, trace_db, tp = (sys.argv[1], int(sys.argv[2]),
+                                      sys.argv[3], sys.argv[4])
+    spans_mod.configure_spans("leader", trace_db)
+    meta = SqliteStateStore("drill.repl-meta", f"{tmp}/meta.db")
+    node = ReplicationNode("drill", f"{tmp}/leader.db", member=0,
+                           shard=0, meta_store=meta, lease_seconds=5.0,
+                           ack_quorum=2, ack_timeout=10.0)
+    node.links["r1"] = MeshFollowerLink(
+        "drill", 0, "r1", "127.0.0.1", parent_port)
+    await node.start()
+    while not node.is_leader:
+        await asyncio.sleep(0.02)
+    with trace_scope(ensure_trace(tp)):
+        for i in range(5):
+            await node.store.set(f"k-{i}", {"v": i})
+    spans_mod.recorder().flush()
+    print("SHIPPED", flush=True)
+    await asyncio.sleep(60)
+
+
+asyncio.run(main())
+'''
+
+
+@pytest.mark.parametrize("codec_env", ["", "json"])
+@pytest.mark.asyncio
+async def test_cross_process_replication_trace(trace_env, tmp_path,
+                                               codec_env):
+    """A quorum-acked write's trace context crosses the process
+    boundary with the replicated record: the leader process records
+    ``repl-ship``/``repl-ack`` into ITS span DB, the follower (this
+    process) records ``repl-apply`` into OURS, all under the committing
+    write's trace — over the v2 binary codec and, forced via
+    ``TASKSRUNNER_MESH_CODEC=json``, over the legacy v1 JSON frames."""
+    from tasksrunner.state.replication import ReplicationNode
+    from tasksrunner.state.replmesh import ReplicationServer
+    from tasksrunner.state.sqlite import SqliteStateStore
+
+    meta = SqliteStateStore("drill.repl-meta", tmp_path / "fmeta.db")
+    follower = ReplicationNode("drill", tmp_path / "follower.db", member=1,
+                               shard=0, meta_store=meta, lease_seconds=5.0,
+                               ack_quorum=2, ack_timeout=5.0)
+    server = ReplicationServer()
+    server.register(follower)
+    await server.start()
+
+    leader_traces = tmp_path / "leader-traces.db"
+    script = tmp_path / "leader_child.py"
+    script.write_text(_REPL_TRACE_CHILD)
+    extra = {"TASKSRUNNER_MESH_CODEC": codec_env} if codec_env else {}
+    child = await asyncio.create_subprocess_exec(
+        sys.executable, str(script), str(tmp_path), str(server.port),
+        str(leader_traces), ROOT_TRACEPARENT,
+        stdout=asyncio.subprocess.PIPE, stderr=asyncio.subprocess.STDOUT,
+        env=_child_env(extra))
+    try:
+        line = ""
+        deadline = asyncio.get_running_loop().time() + 30
+        while line != "SHIPPED":
+            assert asyncio.get_running_loop().time() < deadline, \
+                f"leader child never shipped (last line: {line!r})"
+            line = (await asyncio.wait_for(child.stdout.readline(), 30)
+                    ).decode().strip()
+    finally:
+        if child.returncode is None:
+            child.kill()
+            await child.wait()
+    try:
+        spans_mod.recorder().flush()
+        applies = [s for s in spans_mod.trace_spans(trace_env, TRACE)
+                   if s["name"] == "repl-apply"]
+        assert applies, "follower recorded no repl-apply span"
+        assert applies[0]["kind"] == "consumer"
+
+        leader_spans = spans_mod.trace_spans(str(leader_traces), TRACE)
+        names = {s["name"] for s in leader_spans}
+        assert "repl-ship" in names, names
+        assert "repl-ack" in names, names
+        writes = [s for s in leader_spans
+                  if s["name"].startswith("state-write")]
+        assert writes
+        # the committing write's ambient span (a child of the synthetic
+        # root) is the shared parent: the leader's state-write span and
+        # the follower's repl-apply span — in two different span DBs,
+        # two different processes — hang off the SAME span id
+        assert applies[0]["parent_id"] == writes[0]["parent_id"]
+    finally:
+        await follower.stop()
+        await server.aclose()
+        follower.store.close()
+        await meta.aclose()
+
+
+# -- mesh codec: RREQ trace-context tail -----------------------------------
+
+
+def test_rreq_binary_codec_tp_tail_roundtrip():
+    """The trace context rides the v2 RREQ frame as an optional tail:
+    with no context the frame is byte-identical to the original v2
+    shape (old decoders keep working), with context it round-trips."""
+    from tasksrunner.invoke.mesh import BinaryHeaderCodec
+
+    bare = {"op": "append", "store": "orders", "shard": 3}
+    raw = BinaryHeaderCodec.encode(bare)
+    assert BinaryHeaderCodec.decode(raw) == bare
+
+    with_tp = dict(bare, tp=ROOT_TRACEPARENT)
+    raw_tp = BinaryHeaderCodec.encode(with_tp)
+    assert raw_tp[:len(raw)] == raw  # tail is strictly additive
+    assert BinaryHeaderCodec.decode(raw_tp) == with_tp
+
+
+def test_rreq_json_codec_carries_tp_as_plain_key():
+    from tasksrunner.invoke.mesh import JsonHeaderCodec
+
+    header = {"op": "append", "store": "orders", "shard": 0,
+              "tp": ROOT_TRACEPARENT}
+    assert JsonHeaderCodec.decode(JsonHeaderCodec.encode(header)) == header
+
+
+# -- W3C baggage -----------------------------------------------------------
+
+
+def test_baggage_roundtrip_and_caps():
+    assert parse_baggage("a=1, b=two%2Cthree") == {"a": "1", "b": "two,three"}
+    assert parse_baggage(None) == {}
+    assert parse_baggage("garbage-no-equals,,") == {}
+    bag = {"k": "v v", "n": "1"}
+    assert parse_baggage(serialize_baggage(bag)) == bag
+    # caps: item count and total bytes both bound the header
+    many = {f"k{i}": "x" for i in range(64)}
+    assert len(parse_baggage(serialize_baggage(many))) <= 16
+    huge = {"k": "x" * 4096}
+    assert not serialize_baggage(huge)
+
+
+def test_ensure_trace_adopts_incoming_baggage():
+    ctx = ensure_trace(ROOT_TRACEPARENT, "tenant=acme,tier=gold")
+    assert ctx.trace_id == TRACE
+    assert ctx.baggage == {"tenant": "acme", "tier": "gold"}
+    with trace_scope(ctx):
+        hdrs = outgoing_headers()
+    assert hdrs["traceparent"].split("-")[1] == TRACE
+    assert parse_baggage(hdrs["baggage"]) == ctx.baggage
+
+
+# -- critical path ---------------------------------------------------------
+
+
+def _span(name, span_id, parent, start, dur, **attrs):
+    return {"trace_id": TRACE, "span_id": span_id, "parent_id": parent,
+            "role": "r", "kind": "internal", "name": name, "status": 200,
+            "start": start, "duration": dur, "attrs": json.dumps(attrs)}
+
+
+def test_critical_path_descends_into_latest_ending_child():
+    spans = [
+        _span("root", "r0", None, 0.0, 1.0),
+        _span("fast", "c1", "r0", 0.1, 0.2),
+        _span("slow", "c2", "r0", 0.2, 0.75,
+              queue_wait=0.5, service=0.25),
+        _span("leaf", "g1", "c2", 0.6, 0.3),
+    ]
+    hops = spans_mod.critical_path(spans)
+    assert [h["name"] for h in hops] == ["root", "slow", "leaf"]
+    # hop self-times reconstruct the root's wall time
+    assert sum(h["self_time"] for h in hops) == pytest.approx(1.0, rel=0.1)
+    # the batched hop surfaces its queue-wait/service split
+    slow = hops[1]
+    assert slow["queue_wait"] == pytest.approx(0.5)
+    assert slow["service"] == pytest.approx(0.25)
+
+
+def test_critical_path_empty_and_orphan_inputs():
+    assert spans_mod.critical_path([]) == []
+    lone = [_span("only", "s1", "dead-parent", 0.0, 0.5)]
+    hops = spans_mod.critical_path(lone)
+    assert [h["name"] for h in hops] == ["only"]
+
+
+def test_assemble_trace_dedups_across_sources(tmp_path):
+    row = _span("shared", "s1", None, 0.0, 0.1)
+    other = _span("mine", "s2", "s1", 0.01, 0.05)
+    merged = spans_mod.assemble_trace([[row], [dict(row), other]], TRACE)
+    assert [s["span_id"] for s in merged] == ["s1", "s2"]
+    # a missing DB path is a replica with no spans yet, not an error
+    merged = spans_mod.assemble_trace(
+        [str(tmp_path / "nope.db"), [row]], TRACE)
+    assert [s["span_id"] for s in merged] == ["s1"]
+
+
+# -- ML micro-batch spans --------------------------------------------------
+
+
+@pytest.mark.asyncio
+async def test_ml_batch_spans_split_queue_wait_from_service(trace_env):
+    from tasksrunner.ml.batching import BatcherConfig, MicroBatcher
+    from tasksrunner.observability.metrics import MetricsRegistry
+
+    def run_batch(items, bucket):
+        time.sleep(0.01)
+        return [i * 2 for i in items]
+
+    mb = MicroBatcher(run_batch, config=BatcherConfig(max_delay_ms=5),
+                      registry=MetricsRegistry())
+    mb.start()
+    try:
+        with trace_scope(ensure_trace(ROOT_TRACEPARENT)):
+            submitter = current_trace()
+            assert await mb.submit(21) == 42
+    finally:
+        await mb.stop()
+
+    spans_mod.recorder().flush()
+    spans = spans_mod.trace_spans(trace_env, TRACE)
+    reqs = [s for s in spans if s["name"] == "ml-request"]
+    assert len(reqs) == 1
+    req = reqs[0]
+    # the request span joins the SUBMITTER's trace, under its span
+    assert req["parent_id"] == submitter.span_id
+    attrs = json.loads(req["attrs"])
+    assert attrs["queue_wait"] >= 0 and attrs["service"] > 0
+    assert req["duration"] == pytest.approx(
+        attrs["queue_wait"] + attrs["service"], rel=0.2)
+    # ...and points at the batch-execution span, which roots its own
+    # trace (N request traces converge on one batch)
+    batch_trace = attrs["batch_trace"]
+    assert batch_trace != TRACE
+    batch = [s for s in spans_mod.trace_spans(trace_env, batch_trace)
+             if s["name"] == "ml-batch"]
+    assert len(batch) == 1
+    assert json.loads(batch[0]["attrs"])["size"] == 1
+
+
+# -- trace exemplars on the new lanes --------------------------------------
+
+
+def test_observe_many_records_exemplars_per_request(monkeypatch):
+    from tasksrunner.observability.metrics import MetricsRegistry
+
+    monkeypatch.setenv("TASKSRUNNER_SLOW_THRESHOLD_SECONDS", "0.1")
+    reg = MetricsRegistry()
+    reg.observe_many("ml_infer_latency_seconds", [0.01, 0.5, 0.7],
+                     traces=["t-fast", "t-slow", None], bucket=8)
+    snap = reg.snapshot_histograms()
+    series = snap["ml_infer_latency_seconds"]["series"]
+    exemplars = [e for s in series for e in s["exemplars"]]
+    # only the slow value WITH a trace id became an exemplar: the fast
+    # one is under threshold, the None-trace one has nothing to link
+    assert [e[0] for e in exemplars] == ["t-slow"]
+    assert exemplars[0][1] == pytest.approx(0.5)
+
+
+def test_workflow_activity_latency_captures_instance_trace(monkeypatch):
+    from tasksrunner.observability.metrics import MetricsRegistry
+    from tasksrunner.observability.tracing import TraceContext
+
+    monkeypatch.setenv("TASKSRUNNER_SLOW_THRESHOLD_SECONDS", "0.05")
+    reg = MetricsRegistry()
+    ctx = TraceContext.new()
+    with trace_scope(ctx):
+        reg.observe("workflow_activity_latency_seconds", 0.2,
+                    workflow="order", activity="charge")
+    snap = reg.snapshot_histograms()
+    series = snap["workflow_activity_latency_seconds"]["series"]
+    exemplars = [e for s in series for e in s["exemplars"]]
+    assert [e[0] for e in exemplars] == [ctx.trace_id]
+
+
+# -- flight recorder -------------------------------------------------------
+
+
+def test_flightrec_ring_is_bounded_and_dump_reads_back(tmp_path):
+    from tasksrunner.observability.flightrec import FlightRecorder, read_dump
+
+    rec = FlightRecorder("api", ring_size=4, out_dir=tmp_path)
+    for i in range(10):
+        rec.note(name=f"POST /n{i}", trace_id=f"t{i}", status=200,
+                 duration=0.01)
+    path = rec.dump("slow-exemplar", {"metric": "m"})
+    assert path is not None
+    doc = read_dump(path)
+    assert doc["reason"] == "slow-exemplar"
+    assert [e["name"] for e in doc["entries"]] == \
+        ["POST /n6", "POST /n7", "POST /n8", "POST /n9"]
+
+
+def test_flightrec_per_reason_dump_rate_limit(tmp_path):
+    from tasksrunner.observability.flightrec import FlightRecorder
+
+    rec = FlightRecorder("api", out_dir=tmp_path)
+    rec.note(name="GET /x", trace_id=None, status=200, duration=0.0)
+    assert rec.dump("admission-shed") is not None
+    # same reason inside the window: suppressed; different reason: not
+    assert rec.dump("admission-shed") is None
+    assert rec.dump("unclean-shutdown") is not None
+
+
+def test_flightrec_list_dumps_newest_first(tmp_path):
+    from tasksrunner.observability.flightrec import (
+        FlightRecorder,
+        list_dumps,
+    )
+
+    rec = FlightRecorder("api", out_dir=tmp_path)
+    rec.note(name="GET /x", trace_id="t1", status=200, duration=0.0)
+    rec._last_dump.clear()
+    first = rec.dump("admission-shed")
+    rec._last_dump.clear()
+    second = rec.dump("slow-exemplar")
+    assert first and second
+    listing = list_dumps(tmp_path)
+    assert [d["reason"] for d in listing] == \
+        ["slow-exemplar", "admission-shed"]
+    assert all(d["entries"] == 1 for d in listing)
+
+
+def test_admission_shed_entry_dumps_the_flight_recorder(tmp_path):
+    """The acceptance drill's observable: crossing into shedding
+    writes a black-box dump with the saturation score that tripped."""
+    from tasksrunner.observability import flightrec as flightrec_mod
+    from tasksrunner.observability.admission import AdmissionController
+    from tasksrunner.observability.flightrec import (
+        FlightRecorder,
+        list_dumps,
+    )
+    from tasksrunner.observability.metrics import MetricsRegistry
+
+    flightrec_mod._flightrec = FlightRecorder("api", out_dir=tmp_path)
+    try:
+        flightrec_mod._flightrec.note(name="POST /slow", trace_id="t1",
+                                      status=200, duration=2.0)
+        reg = MetricsRegistry()
+        reg.set_gauge("event_loop_lag_seconds", 1.0)
+        ctl = AdmissionController(max_lag_seconds=0.5, registry=reg)
+        assert ctl.sample() >= 1.0 and ctl.shedding
+        dumps = list_dumps(tmp_path)
+        assert [d["reason"] for d in dumps] == ["admission-shed"]
+        doc = flightrec_mod.read_dump(dumps[0]["path"])
+        assert doc["detail"]["score"] >= 1.0
+        assert doc["entries"][0]["name"] == "POST /slow"
+        # re-entering shed later re-dumps, but not inside the window
+        ctl.shedding = False
+        assert ctl.sample() >= 1.0 and ctl.shedding
+        assert len(list_dumps(tmp_path)) == 1
+    finally:
+        flightrec_mod._flightrec = None
+
+
+def test_slow_exemplar_dumps_through_the_real_hook(tmp_path, monkeypatch):
+    """End-to-end wire, not dump() called by hand: configure_flightrec
+    must install the hook where Histogram exemplar capture actually
+    reads it — the metrics MODULE global. Both package-attribute
+    import spellings hand back the registry singleton (the package
+    __init__ shadows the submodule name), which is exactly the miss
+    this test exists to catch, so reach the true module via
+    sys.modules."""
+    real_metrics_mod = sys.modules["tasksrunner.observability.metrics"]
+    from tasksrunner.observability import flightrec as flightrec_mod
+    from tasksrunner.observability.flightrec import list_dumps
+    from tasksrunner.observability.metrics import MetricsRegistry
+
+    monkeypatch.setenv("TASKSRUNNER_SLOW_THRESHOLD_SECONDS", "0.05")
+    monkeypatch.setattr(flightrec_mod, "_flightrec", None)
+    monkeypatch.setattr(real_metrics_mod, "on_slow_exemplar", None)
+    monkeypatch.setenv("TASKSRUNNER_FLIGHTREC_DIR", str(tmp_path))
+    rec = flightrec_mod.configure_flightrec("api")
+    assert rec is not None
+    assert real_metrics_mod.on_slow_exemplar is not None
+    rec.note(name="POST /api/tasks", trace_id="t1", status=201,
+             duration=0.2)
+    reg = MetricsRegistry()
+    with trace_scope(ensure_trace()):
+        reg.observe("invoke_latency_seconds", 0.2, target="api")
+    # the atexit handler keeps this recorder alive past monkeypatch's
+    # restore; mark it clean so it can't dump at interpreter exit
+    rec.mark_clean()
+    dumps = list_dumps(tmp_path)
+    assert [d["reason"] for d in dumps] == ["slow-exemplar"]
+    doc = flightrec_mod.read_dump(dumps[0]["path"])
+    assert doc["detail"]["metric"] == "invoke_latency_seconds"
+    assert doc["entries"][0]["name"] == "POST /api/tasks"
+
+
+def test_flightrec_unclean_shutdown_dump_suppressed_by_mark_clean(tmp_path):
+    from tasksrunner.observability.flightrec import FlightRecorder
+
+    rec = FlightRecorder("api", out_dir=tmp_path)
+    rec.note(name="GET /x", trace_id=None, status=200, duration=0.0)
+    rec.mark_clean()
+    rec._atexit()
+    assert list(tmp_path.iterdir()) == []
+    dirty = FlightRecorder("api2", out_dir=tmp_path)
+    dirty.note(name="GET /y", trace_id=None, status=500, duration=0.0)
+    dirty._atexit()
+    dumped = list(tmp_path.iterdir())
+    assert len(dumped) == 1
+    assert dumped[0].name.endswith("-unclean-shutdown.json")
