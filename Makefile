@@ -1,8 +1,8 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test lint lint-program lint-dataflow lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-trace bench-overload bench-actors bench-workflows bench-repl bench-mesh bench-ml-serve chaos sweep-flash run validate docs-serve docs-build clean
+.PHONY: test lint lint-program lint-dataflow lint-interleave verify lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-trace bench-overload bench-actors bench-workflows bench-repl bench-mesh bench-ml-serve chaos sweep-flash run validate docs-serve docs-build clean
 
-test: lint lint-program lint-dataflow
+test: lint lint-program lint-dataflow lint-interleave
 	python -m pytest tests/ -q
 
 # tasklint: AST enforcement of the runtime's invariants — no blocking
@@ -23,6 +23,18 @@ lint-program:
 # package (tree-digest cached like the program phase)
 lint-dataflow:
 	python -m tasksrunner.analysis --rules secret-taint,resource-lifetime,cancellation-safety,exception-flow
+
+# interleave phase only: atomic-section check-then-act windows and
+# fenced-lane etag/epoch discipline over the full package
+# (tree-digest cached like the program phase)
+lint-interleave:
+	python -m tasksrunner.analysis --rules interleave-check-act,fenced-etag-origin,fenced-epoch-monotone
+
+# protocol kernels under exhaustive interleavings with crash points:
+# lease takeover + epoch fence, quorum append + resync ladder,
+# workflow turn commit — plus the seeded-bug self-test
+verify:
+	python -m tasksrunner.cli verify
 
 # fast pre-commit loop: per-file phase on the git delta vs main; the
 # program and dataflow phases still cover the whole tree

@@ -597,8 +597,8 @@ class ActorRuntime:
                 metrics.inc("actor_reminder_fired_total", type=actor_type)
             return doc.get("result")
 
-    async def _commit(self, act: _Activation, actor_type: str,
-                      actor_id: str, *, new_data: dict,
+    async def _commit(self, act: _Activation,  # tasklint: fenced-lane
+                      actor_type: str, actor_id: str, *, new_data: dict,
                       new_reminders: dict,
                       effects: list | None = None) -> None:
         """The only writer of the actor record — etag-guarded, called
@@ -642,7 +642,13 @@ class ActorRuntime:
             # means a new owner fenced in between and the etag we'd
             # adopt is theirs, not ours.
             check = await self.runtime.get_state(self.store, rkey)
-            if check is None or int(check.value.get("epoch", -1)) != act.epoch:
+            # monotone fence: epochs only grow (every takeover bumps
+            # through the etag CAS in _fence_record), so a record that
+            # no longer carries OUR epoch can only carry a HIGHER one —
+            # ``>`` is the exact fencedness test, and unlike ``!=`` it
+            # cannot misread a lower epoch (impossible on a consistent
+            # read) as a fence
+            if check is None or int(check.value.get("epoch", -1)) > act.epoch:
                 self._deactivate(actor_type, actor_id)
                 raise ActorFencedError(
                     f"actor {actor_type}/{actor_id}: fenced right after an "
@@ -816,6 +822,11 @@ class ActorRuntime:
                 rec = await self.runtime.get_state(
                     self.store, record_key(atype, aid))
                 if rec is None or not rec.value.get("reminders"):
+                    continue
+                if (atype, aid) in self._activations:
+                    # a concurrent invoke activated it while the state
+                    # reads above suspended — adopting now would
+                    # double-activate on top of the live turn
                     continue
                 try:
                     adopted = await self._activate(atype, aid, forwarded=False)

@@ -14,10 +14,12 @@ itself* plus the selected rule ids, so editing any rule — or selecting
 a different subset — invalidates every entry without a manual version
 bump.
 
-The whole-program phase stores one extra entry under ``__program__``
-keyed on a digest of the sorted (path, mtime, size, content-hash) set:
-any file appearing, vanishing, or changing rebuilds the graph; an
-untouched tree makes warm program-phase runs free.
+The whole-tree phases each store one extra entry (``__program__``,
+``__dataflow__``, ``__interleave__``) keyed on a digest of the sorted
+(path, content-hash) set: any file appearing, vanishing, or changing
+its *bytes* rebuilds the graph; an untouched tree — including one
+whose mtimes churned under ``touch`` or a branch switch — makes warm
+whole-tree runs free.
 
 Suppression comments live in the linted files, so cached findings are
 post-suppression; the baseline is applied after the cache by the
@@ -38,7 +40,8 @@ _PKG = pathlib.Path(__file__).resolve().parent
 #: reserved table keys for the whole-tree phase entries — not paths
 PROGRAM_KEY = "__program__"
 DATAFLOW_KEY = "__dataflow__"
-_RESERVED_KEYS = frozenset({PROGRAM_KEY, DATAFLOW_KEY})
+INTERLEAVE_KEY = "__interleave__"
+_RESERVED_KEYS = frozenset({PROGRAM_KEY, DATAFLOW_KEY, INTERLEAVE_KEY})
 
 #: (path, mtime_ns, size) → sha1, memoised per process. The proxy key
 #: is safe *within* one run (nothing restores mtimes mid-lint); the
@@ -62,15 +65,17 @@ def file_digest(path: pathlib.Path) -> str | None:
 
 
 def tree_digest(files: Iterable[pathlib.Path]) -> str:
-    """Identity of a file *set* for the program-phase cache."""
+    """Identity of a file *set* for the whole-tree phase caches.
+
+    Content-only, matching the per-file cache's contract above: a
+    ``touch`` (or ``git checkout`` restoring identical bytes) must not
+    rebuild the ProgramGraph — ``tasksrunner lint --changed`` with an
+    empty delta short-circuits to the cached ``__program__`` /
+    ``__dataflow__`` / ``__interleave__`` entries only if mtime churn
+    is invisible here."""
     h = hashlib.sha1()
     for path in sorted(files):
-        try:
-            stat = path.stat()
-        except OSError:
-            continue
-        h.update(f"{path}|{stat.st_mtime_ns}|{stat.st_size}"
-                 f"|{file_digest(path)}\n".encode())
+        h.update(f"{path}|{file_digest(path)}\n".encode())
     return h.hexdigest()[:16]
 
 

@@ -27,6 +27,13 @@ SUPPRESS_RE = re.compile(
 #: dedicated-thread entrypoint: blocking calls inside it are expected.
 OFF_LOOP_RE = re.compile(r"#\s*tasklint:\s*off-loop\b")
 
+#: ``# tasklint: fenced-lane`` on a ``def`` line declares the function
+#: a fenced protocol lane (actor turn commit, replication leader
+#: append, workflow history append): every state-plane write inside it
+#: must thread an etag obtained in the same atomic scope, and every
+#: epoch comparison must be >=-monotone.
+FENCED_LANE_RE = re.compile(r"#\s*tasklint:\s*fenced-lane\b")
+
 
 @dataclasses.dataclass(frozen=True, order=True)
 class Finding:
@@ -219,6 +226,22 @@ class DataflowRule:
         raise NotImplementedError
 
 
+class InterleaveRule:
+    """Base class for interleaving rules: ``check`` sees an
+    :class:`~tasksrunner.analysis.interleave.InterleaveAnalysis` —
+    every async function partitioned into atomic sections (maximal
+    await-free regions) with per-section shared-state footprints.
+    Findings carry *labelled* chain frames (``file:line [label]``); the
+    label names the frame's role in the interleaving window (check /
+    await boundary / write / rival writer)."""
+
+    id: str = ""
+    doc: str = ""
+
+    def check(self, ia) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+
 #: rule id → singleton instance; populated at import of ``.rules``
 RULES: dict[str, Rule] = {}
 
@@ -229,16 +252,20 @@ PROGRAM_RULES: dict[str, ProgramRule] = {}
 #: dataflow rule id → singleton; same shared id namespace
 DATAFLOW_RULES: dict[str, DataflowRule] = {}
 
+#: interleave rule id → singleton; same shared id namespace
+INTERLEAVE_RULES: dict[str, InterleaveRule] = {}
+
 
 def known_rule_ids() -> set[str]:
-    return set(RULES) | set(PROGRAM_RULES) | set(DATAFLOW_RULES)
+    return set(RULES) | set(PROGRAM_RULES) | set(DATAFLOW_RULES) \
+        | set(INTERLEAVE_RULES)
 
 
 def _register_into(table: dict, inst) -> None:
     if not inst.id:
         raise ValueError(f"{type(inst).__name__} has no rule id")
     if inst.id in RULES or inst.id in PROGRAM_RULES or \
-            inst.id in DATAFLOW_RULES:
+            inst.id in DATAFLOW_RULES or inst.id in INTERLEAVE_RULES:
         raise ValueError(f"duplicate rule id {inst.id!r}")
     table[inst.id] = inst
 
@@ -255,4 +282,9 @@ def register_program(cls: type[ProgramRule]) -> type[ProgramRule]:
 
 def register_dataflow(cls: type[DataflowRule]) -> type[DataflowRule]:
     _register_into(DATAFLOW_RULES, cls())
+    return cls
+
+
+def register_interleave(cls: type[InterleaveRule]) -> type[InterleaveRule]:
+    _register_into(INTERLEAVE_RULES, cls())
     return cls

@@ -1,6 +1,6 @@
 """File walking, suppression handling, baseline plumbing, and the CLI.
 
-Three phases per run. The **per-file phase** parses each target file
+Four phases per run. The **per-file phase** parses each target file
 and runs the ``RULES`` table against its AST, exactly as in PR 4. The
 **whole-program phase** builds one
 :class:`~tasksrunner.analysis.program.ProgramGraph` over the full lint
@@ -9,16 +9,23 @@ lock-graph, and thread-boundary rules that no single file can express.
 The **dataflow phase** reuses the same graph, adds per-function CFGs
 and interprocedural taint/escape summaries
 (:mod:`~tasksrunner.analysis.dataflow`), and runs the
-``DATAFLOW_RULES`` table. Program and dataflow findings flow through
-the same suppression, baseline, and ``--json`` machinery; their extra
-``chain`` field lists the source→sink path as ``file:line`` frames.
-Both whole-tree phases cache under the tree digest, independently, so
-editing nothing makes warm runs near-free.
+``DATAFLOW_RULES`` table. The **interleave phase**
+(:mod:`~tasksrunner.analysis.interleave`) partitions every async
+function into atomic sections and runs the ``INTERLEAVE_RULES`` table
+— check-then-act-across-await and fencing-discipline rules over the
+section footprints. Whole-tree findings flow through the same
+suppression, baseline, and ``--json`` machinery; their extra ``chain``
+field lists the path as ``file:line`` frames, optionally labelled
+``file:line [role]`` (schema v4) — the suppression matcher and the
+SARIF emitter strip the label before parsing the location, so a
+``tasklint: disable`` comment on any frame of a labelled chain still
+opts out. All whole-tree phases cache under the (content-only) tree
+digest, independently, so editing nothing makes warm runs near-free.
 
 Exit codes: 0 clean, 1 findings, 2 usage error. ``--json`` emits one
 machine-readable document::
 
-    {"version": 3,
+    {"version": 4,
      "findings": [{"rule", "path", "line", "col", "message",
                    "chain", "fingerprint"}, ...],
      "files": N, "suppressed": N, "baselined": N,
@@ -26,7 +33,8 @@ machine-readable document::
 
 ``--sarif PATH`` additionally writes the post-baseline findings as a
 SARIF 2.1.0 document (:mod:`~tasksrunner.analysis.sarif`) for CI
-annotation upload.
+annotation upload; labelled chains become codeFlow steps whose message
+carries the label.
 """
 
 from __future__ import annotations
@@ -43,12 +51,14 @@ from tasksrunner.analysis import baseline as baseline_mod
 from tasksrunner.analysis import rules  # noqa: F401 - populates the tables
 from tasksrunner.analysis.cache import (
     DATAFLOW_KEY,
+    INTERLEAVE_KEY,
     ResultCache,
     ruleset_signature,
     tree_digest,
 )
 from tasksrunner.analysis.core import (
     DATAFLOW_RULES,
+    INTERLEAVE_RULES,
     PROGRAM_RULES,
     RULES,
     SUPPRESS_RE,
@@ -56,6 +66,7 @@ from tasksrunner.analysis.core import (
     known_rule_ids,
 )
 from tasksrunner.analysis.dataflow import DataflowAnalysis
+from tasksrunner.analysis.interleave import InterleaveAnalysis
 from tasksrunner.analysis.program import ProgramGraph
 
 #: repo root = parent of the tasksrunner package
@@ -64,7 +75,7 @@ DEFAULT_TARGET = REPO_ROOT / "tasksrunner"
 DEFAULT_BASELINE = REPO_ROOT / "tasklint-baseline.json"
 DEFAULT_CACHE = REPO_ROOT / ".tasksrunner" / "tasklint-cache.json"
 
-JSON_VERSION = 3
+JSON_VERSION = 4
 
 
 def relpath(path: pathlib.Path) -> str:
@@ -147,6 +158,16 @@ def lint_file(path: pathlib.Path, rule_ids: tuple[str, ...],
     return sorted(findings), suppressed
 
 
+def _frame_location(frame: str) -> tuple[str, int] | None:
+    """Parse a chain frame — plain ``file:line`` or the labelled v4
+    form ``file:line [role]`` — into (relpath, line)."""
+    site = frame.split(" [", 1)[0]
+    rel, _, line = site.rpartition(":")
+    if rel and line.isdigit():
+        return rel, int(line)
+    return None
+
+
 def _program_suppressed(graph: ProgramGraph, finding: Finding) -> bool:
     """A program finding spans locations: honouring a suppression
     comment on the reported line *or on any chain frame* lets either
@@ -154,9 +175,9 @@ def _program_suppressed(graph: ProgramGraph, finding: Finding) -> bool:
     if graph.suppressed(finding.path, finding.line, finding.rule):
         return True
     for frame in finding.chain:
-        rel, _, line = frame.rpartition(":")
-        if rel and line.isdigit() and \
-                graph.suppressed(rel, int(line), finding.rule):
+        loc = _frame_location(frame)
+        if loc is not None and \
+                graph.suppressed(loc[0], loc[1], finding.rule):
             return True
     return False
 
@@ -207,6 +228,30 @@ def lint_dataflow(files: list[pathlib.Path], rule_ids: tuple[str, ...],
     return sorted(findings), suppressed
 
 
+def lint_interleave(files: list[pathlib.Path], rule_ids: tuple[str, ...],
+                    graph: ProgramGraph | None = None,
+                    ) -> tuple[list[Finding], int]:
+    """Run the interleave rules over one InterleaveAnalysis (atomic
+    sections + shared footprints over the same ProgramGraph).
+    Suppression is chain-aware and label-tolerant: a disable comment on
+    the check, the await, the write, or the rival-writer frame all
+    count."""
+    if graph is None:
+        graph = build_graph(files)
+    ia = InterleaveAnalysis(graph)
+    raw: list[Finding] = []
+    for rid in rule_ids:
+        raw.extend(INTERLEAVE_RULES[rid].check(ia))
+    findings: list[Finding] = []
+    suppressed = 0
+    for f in raw:
+        if _program_suppressed(graph, f):
+            suppressed += 1
+        else:
+            findings.append(f)
+    return sorted(findings), suppressed
+
+
 def run(paths: list[pathlib.Path], rule_ids: tuple[str, ...], *,
         baseline_path: pathlib.Path | None = None,
         update_baseline: bool = False,
@@ -225,6 +270,7 @@ def run(paths: list[pathlib.Path], rule_ids: tuple[str, ...], *,
     file_rules = tuple(r for r in rule_ids if r in RULES)
     program_rules = tuple(r for r in rule_ids if r in PROGRAM_RULES)
     dataflow_rules = tuple(r for r in rule_ids if r in DATAFLOW_RULES)
+    interleave_rules = tuple(r for r in rule_ids if r in INTERLEAVE_RULES)
     cache = ResultCache(cache_path, ruleset_signature(rule_ids))
     all_findings: list[Finding] = []
     suppressed = 0
@@ -240,11 +286,11 @@ def run(paths: list[pathlib.Path], rule_ids: tuple[str, ...], *,
         cache.put(path, findings, nsup)
         all_findings.extend(findings)
 
-    if program_rules or dataflow_rules:
+    if program_rules or dataflow_rules or interleave_rules:
         pfiles = iter_py_files(program_paths) if program_paths is not None \
             else files
         tree_hash = tree_digest(pfiles)
-        graph: ProgramGraph | None = None  # built once, shared by both
+        graph: ProgramGraph | None = None  # built once, shared by all
 
         if program_rules:
             cached_prog = cache.get_program(tree_hash)
@@ -270,6 +316,19 @@ def run(paths: list[pathlib.Path], rule_ids: tuple[str, ...], *,
             all_findings.extend(dfindings)
             suppressed += dsup
 
+        if interleave_rules:
+            cached_il = cache.get_program(tree_hash, key=INTERLEAVE_KEY)
+            if cached_il is not None:
+                ifindings, isup = cached_il
+            else:
+                graph = graph or build_graph(pfiles)
+                ifindings, isup = lint_interleave(pfiles, interleave_rules,
+                                                  graph)
+                cache.put_program(tree_hash, ifindings, isup,
+                                  key=INTERLEAVE_KEY)
+            all_findings.extend(ifindings)
+            suppressed += isup
+
     cache.save()
     all_findings.sort()
 
@@ -290,6 +349,7 @@ def run(paths: list[pathlib.Path], rule_ids: tuple[str, ...], *,
         table.update(RULES)
         table.update(PROGRAM_RULES)
         table.update(DATAFLOW_RULES)
+        table.update(INTERLEAVE_RULES)
         docs = {rid: table[rid].doc for rid in rule_ids if rid in table}
         sarif_path.parent.mkdir(parents=True, exist_ok=True)
         sarif_path.write_text(
@@ -414,10 +474,12 @@ def main(argv: list[str] | None = None) -> int:
         table = dict(RULES)
         table.update(PROGRAM_RULES)
         table.update(DATAFLOW_RULES)
+        table.update(INTERLEAVE_RULES)
         width = max(len(r) for r in table)
         for rid in sorted(table):
             kind = "program" if rid in PROGRAM_RULES else \
-                "dataflow" if rid in DATAFLOW_RULES else "file"
+                "dataflow" if rid in DATAFLOW_RULES else \
+                "interleave" if rid in INTERLEAVE_RULES else "file"
             print(f"{rid:<{width}}  [{kind}] {table[rid].doc}")
         return 0
     if args.rules:

@@ -1,0 +1,437 @@
+"""Schedule exploration — executable protocol kernels under every
+interleaving.
+
+The interleave phase (:mod:`tasksrunner.analysis.interleave`) reasons
+about the *code*; this module checks the *protocols* the code
+implements. Each kernel is a small executable model of one fenced lane
+— lease takeover with an epoch fence, quorum append with the resync
+ladder, workflow turn commit — written as cooperative processes that
+``yield`` at every point where the real implementation suspends. A
+deterministic scheduler then runs the model under **exhaustive
+interleavings**, including crash points, and asserts the lane's
+invariant at quiescence: no two owners commit at the same epoch, no
+acked write is lost, replay converges on one contiguous history.
+
+The search is stateless-model-checking style: a *schedule* is the
+sequence of choice indices the scheduler took (which process steps
+next, or which process crashes); replaying a schedule from a fresh
+model is cheap, so the explorer enumerates the choice tree by
+replaying prefixes (the classic systematic-testing loop) rather than
+snapshotting state. Choice 0 always means "continue the first runnable
+process", so the number of non-zero choices in a schedule counts its
+*preemptions* — :func:`shortest_repro` iterates a preemption bound
+upward and therefore prints the simplest schedule that breaks a seeded
+bug, which is the repro a human can actually read.
+
+Every kernel ships a ``buggy=True`` twin with the fencing discipline
+removed (a blind acquire, a premature ack, an unguarded commit).
+``tasksrunner verify`` runs both: the correct kernels must survive
+every schedule, and the seeded twins must be *caught* — the buggy
+variants are the explorer's own regression test.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterator
+
+#: hard ceiling on schedules per exploration — the kernels sit around
+#: a few thousand; hitting this means a model diverged
+MAX_RUNS = 200_000
+
+
+class InvariantViolation(Exception):
+    """A protocol invariant failed under some schedule."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Run:
+    """One executed schedule: the choices taken, the branching factor
+    at each choice (for sibling enumeration), the human-readable step
+    trace, and the invariant violation if any."""
+
+    schedule: tuple[int, ...]
+    options: tuple[int, ...]
+    trace: tuple[str, ...]
+    violation: str | None
+
+    def preemptions(self) -> int:
+        return sum(1 for c in self.schedule if c)
+
+
+@dataclasses.dataclass
+class ExploreResult:
+    runs: int
+    crash_runs: int
+    violation: Run | None
+
+
+class Model:
+    """One protocol kernel. ``procs()`` returns the initial processes
+    as ``(name, generator)`` pairs; each generator yields a step label
+    (or ``(label, True)`` for a crashable point) *before* the atomic
+    block the label names — resuming the generator executes that block
+    up to the next yield. ``on_crash`` may return recovery processes;
+    ``check()`` raises :class:`InvariantViolation` at quiescence."""
+
+    name = "model"
+    max_crashes = 1
+
+    def procs(self) -> list[tuple[str, Iterator]]:
+        raise NotImplementedError
+
+    def on_crash(self, name: str) -> list[tuple[str, Iterator]]:
+        return []
+
+    def check(self) -> None:
+        pass
+
+
+class _Proc:
+    __slots__ = ("name", "gen", "pending", "crashable", "alive")
+
+    def __init__(self, name: str, gen: Iterator):
+        self.name = name
+        self.gen = gen
+        self.pending = ""
+        self.crashable = False
+        self.alive = True
+        self._advance()
+
+    def _advance(self) -> None:
+        try:
+            label = next(self.gen)
+        except StopIteration:
+            self.alive = False
+            return
+        if isinstance(label, tuple):
+            self.pending, self.crashable = label[0], bool(label[1])
+        else:
+            self.pending, self.crashable = str(label), False
+
+
+def _execute(factory: Callable[[], Model],
+             schedule: tuple[int, ...]) -> Run:
+    """Replay ``schedule`` against a fresh model, extending greedily
+    with choice 0 once the schedule runs out."""
+    model = factory()
+    procs = [_Proc(name, gen) for name, gen in model.procs()]
+    choices: list[int] = []
+    options: list[int] = []
+    trace: list[str] = []
+    crashes = 0
+    violation: str | None = None
+    step = 0
+    while violation is None:
+        opts: list[tuple[str, _Proc]] = [
+            ("step", p) for p in procs if p.alive]
+        if crashes < model.max_crashes:
+            opts.extend(("crash", p) for p in procs
+                        if p.alive and p.crashable)
+        if not opts:
+            break
+        pick = schedule[step] if step < len(schedule) else 0
+        pick = min(pick, len(opts) - 1)
+        step += 1
+        choices.append(pick)
+        options.append(len(opts))
+        kind, proc = opts[pick]
+        if kind == "step":
+            trace.append(f"{proc.name}: {proc.pending}")
+            try:
+                proc._advance()
+            except InvariantViolation as exc:
+                violation = str(exc)
+        else:
+            trace.append(f"{proc.name}: CRASH before [{proc.pending}]")
+            proc.alive = False
+            proc.gen.close()
+            crashes += 1
+            procs.extend(_Proc(n, g) for n, g in model.on_crash(proc.name))
+    if violation is None:
+        try:
+            model.check()
+        except InvariantViolation as exc:
+            violation = str(exc)
+    return Run(schedule=tuple(choices), options=tuple(options),
+               trace=tuple(trace), violation=violation)
+
+
+def explore(factory: Callable[[], Model], *,
+            max_preemptions: int | None = None,
+            stop_on_violation: bool = True) -> ExploreResult:
+    """Enumerate every schedule of the model (bounded by
+    ``max_preemptions`` non-zero choices when given). Each executed
+    prefix enqueues the unexplored siblings of every choice it made
+    past the prefix — the standard replay-based systematic search."""
+    stack: list[tuple[int, ...]] = [()]
+    runs = 0
+    crash_runs = 0
+    violation: Run | None = None
+    while stack:
+        prefix = stack.pop()
+        run = _execute(factory, prefix)
+        runs += 1
+        if any("CRASH" in t for t in run.trace):
+            crash_runs += 1
+        if run.violation is not None and violation is None:
+            violation = run
+            if stop_on_violation:
+                break
+        if runs >= MAX_RUNS:
+            raise RuntimeError(
+                f"{factory().name}: exceeded {MAX_RUNS} schedules — "
+                f"the model does not quiesce")
+        for pos in range(len(prefix), len(run.schedule)):
+            base = run.schedule[:pos]
+            for alt in range(1, run.options[pos]):
+                cand = base + (alt,)
+                if max_preemptions is not None and \
+                        sum(1 for c in cand if c) > max_preemptions:
+                    continue
+                stack.append(cand)
+    return ExploreResult(runs=runs, crash_runs=crash_runs,
+                         violation=violation)
+
+
+def shortest_repro(factory: Callable[[], Model]) -> Run | None:
+    """Minimal-preemption failing schedule, or None when every
+    schedule upholds the invariants. Iterating the preemption bound
+    upward makes the first hit the simplest repro."""
+    for bound in range(0, 33):
+        found = explore(factory, max_preemptions=bound).violation
+        if found is not None:
+            return found
+    return None
+
+
+def format_repro(run: Run) -> str:
+    lines = [f"schedule {list(run.schedule)} "
+             f"({run.preemptions()} preemption(s)):"]
+    lines += [f"  {i:2d}. {step}" for i, step in enumerate(run.trace, 1)]
+    lines.append(f"  => {run.violation}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# kernel 1: lease takeover + epoch fence
+# ---------------------------------------------------------------------------
+
+class LeaseTakeoverModel(Model):
+    """Two nodes race for an expired lease. Acquisition is an etag CAS
+    that bumps the epoch (state/replication.py ``Lease.acquire``); the
+    data commit is fenced by the highest epoch the store has seen.
+    Invariant: no two owners ever commit at the same epoch.
+
+    ``buggy=True`` drops the CAS — both racers adopt the same bumped
+    epoch, exactly the blind takeover the etag chain exists to stop."""
+
+    name = "lease-takeover"
+
+    def __init__(self, buggy: bool = False):
+        self.buggy = buggy
+        self.lease = {"owner": "dead", "epoch": 1, "etag": 7,
+                      "expired": True}
+        self.fence = 1          # highest epoch the store has committed
+        self.commits: list[tuple[str, int]] = []
+
+    def procs(self):
+        return [("node-a", self._node("node-a")),
+                ("node-b", self._node("node-b"))]
+
+    def on_crash(self, name: str):
+        # the crashed owner's lease runs out; a successor contends
+        if self.lease["owner"] == name:
+            self.lease["expired"] = True
+        return [(f"{name}'", self._node(f"{name}'"))]
+
+    def _node(self, me: str):
+        yield "peek lease"
+        snap = dict(self.lease)
+        if not snap["expired"]:
+            return
+        yield ("acquire (etag CAS, bump epoch)", True)
+        if not self.buggy and self.lease["etag"] != snap["etag"]:
+            return  # lost the takeover race — stand down
+        epoch = snap["epoch"] + 1
+        self.lease = {"owner": me, "epoch": epoch,
+                      "etag": snap["etag"] + 1, "expired": False}
+        yield ("commit at acquired epoch", True)
+        if epoch < self.fence:
+            return  # fenced by a newer owner — commit rejected
+        self.fence = epoch
+        self.commits.append((me, epoch))
+
+    def check(self):
+        seen: dict[int, str] = {}
+        for owner, epoch in self.commits:
+            if epoch in seen and seen[epoch] != owner:
+                raise InvariantViolation(
+                    f"two owners committed at epoch {epoch}: "
+                    f"{seen[epoch]} and {owner}")
+            seen[epoch] = owner
+
+
+# ---------------------------------------------------------------------------
+# kernel 2: quorum append + resync ladder
+# ---------------------------------------------------------------------------
+
+class QuorumAppendModel(Model):
+    """A leader appends two records, ships each to its follower, and
+    acks only at quorum (both copies durable). On a leader crash the
+    follower promotes at the next epoch, writes the leadership
+    barrier, and resyncs the ex-leader from its own log — the ladder
+    truncates any divergent (necessarily unacked) suffix. Invariants:
+    every acked record survives in the new leader's log, and the logs
+    converge at quiescence.
+
+    ``buggy=True`` acks at quorum 1 (local append only) — a crash
+    before shipping then loses an acked record."""
+
+    name = "quorum-append"
+
+    def __init__(self, buggy: bool = False):
+        self.buggy = buggy
+        self.logs: dict[str, list[tuple[int, str]]] = {"A": [], "B": []}
+        self.acked: list[str] = []
+        self.leader = "A"
+        self.epoch = 1
+
+    def procs(self):
+        return [("leader-A", self._leader())]
+
+    def on_crash(self, name: str):
+        return [("takeover-B", self._takeover())]
+
+    def _leader(self):
+        for rec in ("r1", "r2"):
+            yield (f"append {rec} to local log", True)
+            self.logs["A"].append((1, rec))
+            if self.buggy:
+                yield f"ack {rec} at quorum=1 (SEEDED BUG)"
+                self.acked.append(rec)
+            yield (f"ship {rec} to B", True)
+            self.logs["B"].append((1, rec))
+            if not self.buggy:
+                yield f"ack {rec} at quorum=2"
+                self.acked.append(rec)
+
+    def _takeover(self):
+        yield "B acquires lease at epoch 2"
+        self.epoch = 2
+        self.leader = "B"
+        yield "B writes leadership barrier"
+        self.logs["B"].append((2, "barrier"))
+        yield "resync ladder: A adopts B's log"
+        self.logs["A"] = list(self.logs["B"])
+
+    def check(self):
+        authoritative = [rec for _, rec in self.logs[self.leader]]
+        for rec in self.acked:
+            if rec not in authoritative:
+                raise InvariantViolation(
+                    f"acked record {rec!r} lost: leader {self.leader} "
+                    f"log is {authoritative}")
+        if self.logs["A"] != self.logs["B"]:
+            raise InvariantViolation(
+                f"logs diverged at quiescence: A={self.logs['A']} "
+                f"B={self.logs['B']}")
+
+
+# ---------------------------------------------------------------------------
+# kernel 3: workflow turn commit
+# ---------------------------------------------------------------------------
+
+class TurnCommitModel(Model):
+    """Two drivers race to advance one workflow instance. A turn is
+    replay (read the history, compute the next event from its length)
+    plus one etag-guarded commit; a fenced driver replays and retries.
+    A crashed driver is replaced by a recovery driver that replays
+    from the committed prefix. Invariants: every acked event is in the
+    history exactly once, and the history is one contiguous replay
+    order (no gaps, no forks).
+
+    ``buggy=True`` commits blind (no etag guard) — the last writer
+    clobbers the other driver's acked event."""
+
+    name = "turn-commit"
+
+    def __init__(self, buggy: bool = False):
+        self.buggy = buggy
+        self.record = {"history": ("started",), "etag": 0}
+        self.acked: list[str] = []
+
+    def procs(self):
+        return [("driver-0", self._driver("d0")),
+                ("driver-1", self._driver("d1"))]
+
+    def on_crash(self, name: str):
+        return [("recovery", self._driver("rc"))]
+
+    def _driver(self, me: str):
+        for _attempt in (1, 2):
+            yield "read record (replay history)"
+            hist = self.record["history"]
+            etag = self.record["etag"]
+            event = f"e{len(hist)}.{me}"
+            yield ("commit turn (append event)", True)
+            if not self.buggy and self.record["etag"] != etag:
+                continue  # fenced: replay from the new history, retry
+            self.record = {"history": hist + (event,), "etag": etag + 1}
+            self.acked.append(event)
+            return
+
+    def check(self):
+        hist = self.record["history"][1:]
+        for ev in self.acked:
+            n = hist.count(ev)
+            if n != 1:
+                raise InvariantViolation(
+                    f"acked event {ev!r} appears {n} times in history "
+                    f"{list(hist)}")
+        for i, ev in enumerate(hist, start=1):
+            if not ev.startswith(f"e{i}."):
+                raise InvariantViolation(
+                    f"history diverged from replay order at index {i}: "
+                    f"{list(hist)}")
+
+
+KERNELS: dict[str, Callable[[bool], Model]] = {
+    LeaseTakeoverModel.name: LeaseTakeoverModel,
+    QuorumAppendModel.name: QuorumAppendModel,
+    TurnCommitModel.name: TurnCommitModel,
+}
+
+
+def verify(kernels: list[str] | None = None, *,
+           out=None) -> int:
+    """Run the selected kernels (default: all) exhaustively — correct
+    variants must pass every schedule, seeded-bug twins must be caught
+    and get their minimal repro printed. Returns a process exit code."""
+    import sys
+    out = out or sys.stdout
+    names = kernels or sorted(KERNELS)
+    failed = False
+    for name in names:
+        kernel = KERNELS[name]
+        res = explore(lambda: kernel(False), stop_on_violation=True)
+        if res.violation is not None:
+            failed = True
+            out.write(f"FAIL {name}: invariant violated under a "
+                      f"legal schedule\n")
+            out.write(format_repro(res.violation) + "\n")
+        else:
+            out.write(f"ok   {name}: {res.runs} schedules "
+                      f"({res.crash_runs} with a crash), "
+                      f"invariants hold\n")
+        repro = shortest_repro(lambda: kernel(True))
+        if repro is None:
+            failed = True
+            out.write(f"FAIL {name}: seeded bug NOT caught — the "
+                      f"explorer lost its teeth\n")
+        else:
+            out.write(f"ok   {name}: seeded bug caught; minimal "
+                      f"repro:\n")
+            for line in format_repro(repro).splitlines():
+                out.write(f"       {line}\n")
+    return 1 if failed else 0

@@ -1,0 +1,820 @@
+"""Interleave phase — atomic sections and shared-state footprints.
+
+The dataflow phase (PR 11) reasons within one control flow; the bugs
+that lose acked writes live *between* awaits, where another task runs
+in a check-then-act window. This module partitions every async
+function into **atomic sections** — maximal await-free regions: code
+between two suspension points runs without any other task interleaving
+— and computes the **shared-state footprint** of each section: which
+``self`` attributes, module globals, and dict/list elements reachable
+from them the section reads in branch conditions and writes. A
+location counts as *shared* when some **other** function in the lint
+target also writes it (the cross-function writer index reuses the
+ProgramGraph's attribute-write records and this module's module-global
+scan); single-writer state cannot race and is never reported.
+
+Sections are delimited by ``await`` expressions, ``async for`` loops,
+and ``async with`` entries, numbered in the order the walker meets
+them; the boundary records which await opened the window, so findings
+can say exactly where the interleaving becomes possible. The walk is
+source-order — a syntactic under-approximation of execution order —
+which keeps it conservative the same way the program phase is: a
+reported window is a real pair of a guard and a later write separated
+by a real suspension point; absence of a finding is not a proof.
+
+Three *guards* close a window and are recognised here so the rules
+don't re-derive them:
+
+* **held asyncio lock** — check and write both execute under the same
+  ``async with self._lock:`` (lock attributes are detected exactly
+  like the program phase detects ``threading`` locks, from
+  ``self.x = asyncio.Lock()`` and module-level assignments);
+* **etag threaded** — the write is a call carrying an ``etag``-family
+  keyword whose value data-flows from a read in the same function
+  invocation (the store re-validates, so the window is benign: the
+  stale writer loses the CAS instead of clobbering);
+* **epoch compare** — the branch itself is a ``>=``-monotone fence
+  comparison; losing the race produces a fenced error, not a lost
+  write.
+
+:class:`InterleaveAnalysis` is the facade handed to
+``InterleaveRule.check`` — it exposes the per-function
+:class:`SectionModel` plus the writer index and the fenced-lane
+marker table (``# tasklint: fenced-lane`` on a ``def`` line, scanned
+like ``off-loop``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Iterator
+
+from tasksrunner.analysis.core import FENCED_LANE_RE
+from tasksrunner.analysis.program import (
+    FunctionInfo,
+    ModuleInfo,
+    ProgramGraph,
+    _resolve_dotted,
+    _self_attr,
+)
+
+#: asyncio primitives whose instances serialise coroutines — the async
+#: twin of program.py's ``_LOCK_FACTORIES``
+_ASYNC_LOCK_FACTORIES = {"asyncio.Lock", "asyncio.Condition",
+                         "asyncio.Semaphore", "asyncio.BoundedSemaphore"}
+
+#: keyword names that thread a compare-and-swap token into a write
+ETAG_KWARGS = frozenset({"etag", "expected_etag", "if_match", "expected"})
+
+#: operand name fragments that identify a fencing counter
+EPOCH_NAMES = ("epoch", "term", "generation", "fence")
+
+#: method names that mutate a container in place
+_MUTATORS = frozenset({"append", "add", "remove", "discard", "pop",
+                       "popleft", "clear", "update", "setdefault",
+                       "insert", "extend"})
+
+
+@dataclasses.dataclass(frozen=True)
+class Location:
+    """One shared-state location: an attribute of a class (``owner`` =
+    class key) or a module global (``owner`` = relpath). Element
+    accesses (``self.x[k]``) collapse onto the container — two tasks
+    racing on different keys of one dict still race on the dict."""
+
+    kind: str    # "attr" | "global"
+    owner: str   # class key ("path::Class") or module relpath
+    name: str
+
+    def render(self) -> str:
+        if self.kind == "attr":
+            return f"self.{self.name}"
+        return self.name
+
+
+@dataclasses.dataclass
+class Check:
+    """A branch condition reading shared state."""
+
+    loc: Location
+    lineno: int
+    section: int
+    held_locks: frozenset
+    monotone_epoch: bool  # the test is a >=-monotone epoch fence
+
+
+@dataclasses.dataclass
+class WriteAccess:
+    """A write to shared state (assign, augassign, del, subscript
+    store, in-place mutator call, or — for windows only — a call into
+    a method that performs the write, recorded in ``via`` as the
+    callee's ``file:line``)."""
+
+    loc: Location
+    lineno: int
+    section: int
+    held_locks: frozenset
+    etag_threaded: bool  # CAS token from this scope rides the write
+    via: str | None = None  # "relpath:line" of the write inside a callee
+    #: the write sits in an ``except`` body: it acts on the just-caught
+    #: exception (fresh information), not on the stale check
+    in_handler: bool = False
+
+
+@dataclasses.dataclass
+class EtagUse:
+    """One ``etag=``-family keyword on a call: where the token came
+    from. ``origin`` is "read" (awaited read / parameter / fresh
+    commit result in this scope), "constant", or "stale" (an attribute
+    cached across turns, or an untraceable name)."""
+
+    lineno: int
+    section: int
+    kwarg: str
+    origin: str
+    detail: str
+
+
+@dataclasses.dataclass
+class EpochCompare:
+    """One comparison whose operand names a fencing counter."""
+
+    lineno: int
+    section: int
+    monotone: bool
+    op: str
+
+
+@dataclasses.dataclass
+class Window:
+    """One check-then-act pair: a branch on shared state whose guarded
+    region contains a write to the same location in a *later* atomic
+    section — at ``open_await`` the function suspended and every other
+    task got a chance to invalidate the check."""
+
+    check: Check
+    write: WriteAccess
+    open_await: int  # lineno of the await that opened the window
+
+
+class SectionModel:
+    """One async function, partitioned. ``boundaries[i]`` is the line
+    of the await that *opened* section ``i`` (section 0 has no
+    boundary: it starts at the def); ``boundary_reads[i]`` holds the
+    shared locations that await's own expression reads."""
+
+    __slots__ = ("fn", "boundaries", "boundary_reads", "checks", "writes",
+                 "windows", "etag_uses", "epoch_compares")
+
+    def __init__(self, fn: FunctionInfo):
+        self.fn = fn
+        self.boundaries: dict[int, int] = {}
+        self.boundary_reads: dict[int, frozenset] = {}
+        self.checks: list[Check] = []
+        self.writes: list[WriteAccess] = []
+        self.windows: list[Window] = []
+        self.etag_uses: list[EtagUse] = []
+        self.epoch_compares: list[EpochCompare] = []
+
+    def opening_await(self, section: int) -> int | None:
+        return self.boundaries.get(section)
+
+    def window_joins_checked(self, win: Window) -> bool:
+        """True when some await inside the window reads the checked
+        location itself — the ``if self._task: ...; await self._task;
+        self._task = None`` teardown/join idiom, where the write is the
+        release half of joining the object the branch tested, not an
+        unrelated act on stale state."""
+        for sec in range(win.check.section + 1, win.write.section + 1):
+            if win.check.loc in self.boundary_reads.get(sec, ()):
+                return True
+        return False
+
+
+#: ``_epoch``, ``f_epoch``, ``leaderTerm`` — an EPOCH_NAMES word at an
+#: identifier-token boundary (plain substring would drag in
+#: ``terminate`` via ``term``)
+_EPOCH_WORD_RE = re.compile(
+    r"(?:^|_)(?:%s)(?:_|$)" % "|".join(EPOCH_NAMES))
+
+
+def _is_epoch_operand(node: ast.AST) -> bool:
+    """Does this expression name a fencing counter? Matches attribute /
+    name tokens and ``x.get("epoch")``-style dict reads."""
+    for sub in ast.walk(node):
+        name = None
+        if isinstance(sub, ast.Name):
+            name = sub.id
+        elif isinstance(sub, ast.Attribute):
+            name = sub.attr
+        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            name = sub.value
+        if name and _EPOCH_WORD_RE.search(
+                re.sub(r"(?<=[a-z0-9])(?=[A-Z])", "_", name).lower()):
+            return True
+    return False
+
+
+def _early_exit(body: list[ast.stmt]) -> bool:
+    """Does this branch body unconditionally leave the enclosing
+    suite? ``if seen: return`` / ``continue`` — the *negation* of the
+    test dominates everything after the If."""
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _monotone_epoch_test(test: ast.AST) -> bool:
+    """True when the test contains a >=/<=/>/< comparison over an
+    epoch-named operand: the branch is a monotone fence, losing the
+    race is detected, not ignored. Equality tests are *not* monotone —
+    they reject legitimately newer epochs and pass corrupt older ones
+    symmetrically, so the fencing rules treat them as violations."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Compare) and \
+                all(isinstance(op, (ast.Gt, ast.GtE, ast.Lt, ast.LtE))
+                    for op in sub.ops):
+            operands = [sub.left] + list(sub.comparators)
+            if any(_is_epoch_operand(o) for o in operands):
+                return True
+    return False
+
+
+class _SectionWalker:
+    """Source-order walk of one async function body, tracking the
+    section counter, held asyncio locks, and etag-origin bindings."""
+
+    def __init__(self, analysis: "InterleaveAnalysis", mod: ModuleInfo,
+                 fn: FunctionInfo):
+        self.analysis = analysis
+        self.mod = mod
+        self.fn = fn
+        self.model = SectionModel(fn)
+        self.section = 0
+        #: allocation counter for section ids — ``section`` rewinds at
+        #: branch joins, but every boundary keeps a unique id
+        self.next_section = 0
+        self.held: list[str] = []
+        #: checks whose guarded region the walk is currently inside —
+        #: branch bodies, plus (for early-exit guards like ``if k in
+        #: self.x: return``) the remainder of the enclosing suite
+        self.active_checks: list[Check] = []
+        #: nesting depth of ``except`` bodies at the current statement
+        self.handler_depth = 0
+        #: names whose current value data-flows from a read in this
+        #: scope: awaited results, parameters, and projections of both
+        self.read_names: set[str] = set()
+        args = fn.node.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            self.read_names.add(a.arg)
+        if args.vararg:
+            self.read_names.add(args.vararg.arg)
+        if args.kwarg:
+            self.read_names.add(args.kwarg.arg)
+        #: call site line → resolved in-package callees (one level
+        #: deep), from the ProgramGraph's edges — lets a window's "act"
+        #: live inside a helper the guarded region calls
+        self.callees: dict[int, list[FunctionInfo]] = {}
+        for edge in fn.edges:
+            if edge.dispatch:
+                continue
+            callee = analysis.graph.functions.get(edge.callee)
+            if callee is not None and callee.key != fn.key:
+                self.callees.setdefault(edge.lineno, []).append(callee)
+
+    # -- location extraction ----------------------------------------------
+
+    def _loc_of(self, expr: ast.AST) -> Location | None:
+        """Shared-state location an expression designates, collapsing
+        subscripts and method receivers onto the container."""
+        node = expr
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        attr = _self_attr(node)
+        if attr is not None and self.fn.cls_key is not None:
+            return Location("attr", self.fn.cls_key, attr)
+        if isinstance(node, ast.Name) and \
+                node.id in self.analysis.module_global_writers(self.mod):
+            return Location("global", self.mod.relpath, node.id)
+        return None
+
+    def _locs_read(self, test: ast.AST) -> set[Location]:
+        """Every shared location a branch condition reads: bare loads,
+        ``in`` / ``not in`` membership, ``.get(...)`` reads, and
+        comparisons on them."""
+        out: set[Location] = set()
+        for sub in ast.walk(test):
+            if isinstance(sub, (ast.Attribute, ast.Name, ast.Subscript)) \
+                    and isinstance(getattr(sub, "ctx", None), ast.Load):
+                loc = self._loc_of(sub)
+                if loc is not None:
+                    out.add(loc)
+        return out
+
+    # -- etag origin tracking ---------------------------------------------
+
+    def _value_is_read(self, value: ast.AST | None) -> bool:
+        """Does this expression data-flow from a read in this scope?"""
+        if value is None:
+            return False
+        if isinstance(value, ast.Await):
+            return True
+        if isinstance(value, ast.Name):
+            return value.id in self.read_names
+        if isinstance(value, ast.Attribute):
+            # rec.etag where rec came from a read — but NOT self.x,
+            # which is state cached across turns
+            if _self_attr(value) is not None:
+                return False
+            return self._value_is_read(value.value)
+        if isinstance(value, ast.Subscript):
+            return self._value_is_read(value.value)
+        if isinstance(value, ast.Call):
+            # item.get("etag"), str(etag), ... — a projection of a read
+            func = value.func
+            if isinstance(func, ast.Attribute) and \
+                    self._value_is_read(func.value):
+                return True
+            return any(self._value_is_read(a) for a in value.args)
+        if isinstance(value, ast.IfExp):
+            return self._value_is_read(value.body) or \
+                self._value_is_read(value.orelse)
+        if isinstance(value, ast.BoolOp):
+            return any(self._value_is_read(v) for v in value.values)
+        if isinstance(value, ast.Constant) and value.value is None:
+            # ``etag = None`` then rebound from the record on the other
+            # branch is the unguarded-create idiom — treat the None arm
+            # as neutral, the BoolOp/IfExp cases above carry the read
+            return False
+        return False
+
+    def _bind(self, target: ast.AST, from_read: bool) -> None:
+        if isinstance(target, ast.Name):
+            if from_read:
+                self.read_names.add(target.id)
+            else:
+                self.read_names.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(elt, from_read)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, from_read)
+
+    # -- the walk -----------------------------------------------------------
+
+    def walk(self) -> SectionModel:
+        self._suite(self.fn.node.body)
+        return self.model
+
+    def _suite(self, stmts: list[ast.stmt]) -> None:
+        """Walk one suite; early-exit guards opened inside it expire
+        when it ends (they only dominate the rest of this suite)."""
+        mark = len(self.active_checks)
+        for child in stmts:
+            self._stmt(child)
+        del self.active_checks[mark:]
+
+    def _advance(self, lineno: int,
+                 reads: ast.AST | list[ast.AST] | None = None) -> None:
+        self.next_section += 1
+        self.section = self.next_section
+        self.model.boundaries[self.section] = lineno
+        if reads is not None:
+            nodes = reads if isinstance(reads, list) else [reads]
+            locs: set[Location] = set()
+            for n in nodes:
+                locs |= self._locs_read(n)
+            self.model.boundary_reads[self.section] = frozenset(locs)
+
+    def _expr(self, node: ast.AST) -> None:
+        """Visit an expression: awaits advance the section *after*
+        their operand (the operand evaluates before suspending), calls
+        get etag/mutator handling."""
+        if isinstance(node, ast.Await):
+            self._expr(node.value)
+            self._advance(node.lineno, reads=node.value)
+            return
+        if isinstance(node, ast.Call):
+            for child in ast.iter_child_nodes(node):
+                self._expr(child)
+            self._call(node)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return  # nested scopes partition themselves
+        for child in ast.iter_child_nodes(node):
+            self._expr(child)
+        if isinstance(node, ast.Compare) and \
+                any(_is_epoch_operand(o)
+                    for o in [node.left] + list(node.comparators)):
+            mono = all(isinstance(op, (ast.Gt, ast.GtE, ast.Lt, ast.LtE))
+                       for op in node.ops)
+            op_name = type(node.ops[0]).__name__ if node.ops else "?"
+            self.model.epoch_compares.append(EpochCompare(
+                lineno=node.lineno, section=self.section,
+                monotone=mono, op=op_name))
+
+    def _call(self, call: ast.Call) -> None:
+        held = frozenset(self.held)
+        for kw in call.keywords:
+            if kw.arg in ETAG_KWARGS:
+                if isinstance(kw.value, ast.Constant):
+                    origin, detail = "constant", repr(kw.value.value)
+                elif self._value_is_read(kw.value):
+                    origin, detail = "read", ""
+                else:
+                    origin = "stale"
+                    detail = ast.unparse(kw.value) \
+                        if hasattr(ast, "unparse") else ""
+                self.model.etag_uses.append(EtagUse(
+                    lineno=call.lineno, section=self.section,
+                    kwarg=kw.arg, origin=origin, detail=detail))
+        # in-place mutation of a shared container: self.x.append(...)
+        func = call.func
+        if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+            loc = self._loc_of(func.value)
+            if loc is not None:
+                self._add_write(WriteAccess(
+                    loc=loc, lineno=call.lineno, section=self.section,
+                    held_locks=held, etag_threaded=False,
+                    in_handler=self.handler_depth > 0))
+        # cross-function act: the guarded region calls a method that
+        # writes the checked location (one level deep, via the call
+        # graph). A call threading an etag token is CAS-revalidated
+        # and closes its own window.
+        if self.active_checks and not self._etag_call(call):
+            for callee in self.callees.get(call.lineno, ()):
+                if callee.cls_key is None:
+                    continue
+                for w in callee.writes:
+                    loc = Location("attr", callee.cls_key, w.attr)
+                    if any(chk.loc == loc and chk.section < self.section
+                           for chk in self.active_checks):
+                        self._pair_windows(WriteAccess(
+                            loc=loc, lineno=call.lineno,
+                            section=self.section, held_locks=held,
+                            etag_threaded=False,
+                            via=f"{callee.relpath}:{w.lineno}",
+                            in_handler=self.handler_depth > 0))
+                        break  # one window per callee is enough
+
+    def _record_write(self, target: ast.AST, lineno: int,
+                      etag_threaded: bool) -> None:
+        loc = self._loc_of(target)
+        if loc is not None:
+            self._add_write(WriteAccess(
+                loc=loc, lineno=lineno, section=self.section,
+                held_locks=frozenset(self.held),
+                etag_threaded=etag_threaded,
+                in_handler=self.handler_depth > 0))
+
+    def _add_write(self, write: WriteAccess) -> None:
+        self.model.writes.append(write)
+        self._pair_windows(write)
+
+    def _pair_windows(self, write: WriteAccess) -> None:
+        for chk in self.active_checks:
+            if chk.loc == write.loc and chk.section < write.section:
+                self.model.windows.append(Window(
+                    check=chk, write=write,
+                    open_await=self.model.boundaries.get(
+                        chk.section + 1, write.lineno)))
+
+    def _etag_call(self, value: ast.AST | None) -> bool:
+        """Is the (possibly awaited) RHS a call threading an etag
+        token? Such a write is CAS-revalidated at the store — a stale
+        token loses the swap instead of clobbering, which closes the
+        check-then-act window regardless of where the token came from
+        (the fenced-lane rules separately police the token's origin)."""
+        node = value.value if isinstance(value, ast.Await) else value
+        if not isinstance(node, ast.Call):
+            return False
+        for kw in node.keywords:
+            if kw.arg in ETAG_KWARGS:
+                if isinstance(kw.value, ast.Constant) and \
+                        kw.value.value is None:
+                    continue  # etag=None is the unguarded-create form
+                return True
+        return False
+
+    def _stmt(self, node: ast.stmt) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(node, ast.Assign):
+            etag = self._etag_call(node.value)
+            self._expr(node.value)
+            from_read = self._value_is_read(node.value)
+            for tgt in node.targets:
+                self._bind(tgt, from_read)
+                self._record_write(tgt, node.lineno, etag)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._expr(node.value)
+            self._record_write(node.target, node.lineno, False)
+            return
+        if isinstance(node, ast.AnnAssign):
+            if node.value is not None:
+                etag = self._etag_call(node.value)
+                self._expr(node.value)
+                self._bind(node.target, self._value_is_read(node.value))
+                self._record_write(node.target, node.lineno, etag)
+            return
+        if isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                self._record_write(tgt, node.lineno, False)
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            self._expr(node.test)
+            locs = self._locs_read(node.test)
+            checks: list[Check] = []
+            if locs:
+                mono = _monotone_epoch_test(node.test)
+                held = frozenset(self.held)
+                for loc in sorted(locs, key=lambda l: (l.owner, l.name)):
+                    checks.append(Check(
+                        loc=loc, lineno=node.lineno, section=self.section,
+                        held_locks=held, monotone_epoch=mono))
+                self.model.checks.extend(checks)
+            mark = len(self.active_checks)
+            self.active_checks.extend(checks)
+            saved = self.section
+            self._suite(node.body)
+            after_body = self.section
+            if isinstance(node, ast.If):
+                # the orelse runs when the body does not — it continues
+                # from the test's section, not the body's; and an await
+                # on an *exiting* branch never suspends the fall-through
+                # path, so the join continues from whichever branch
+                # falls through (both plain: either may have run and
+                # suspended — take the later section, conservative)
+                self.section = saved
+                self._suite(node.orelse)
+                after_orelse = self.section
+                body_exits = _early_exit(node.body)
+                orelse_exits = bool(node.orelse) and _early_exit(node.orelse)
+                if body_exits and not orelse_exits:
+                    self.section = after_orelse
+                elif orelse_exits and not body_exits:
+                    self.section = after_body
+                else:
+                    self.section = max(after_body, after_orelse)
+            else:
+                self._suite(node.orelse)
+            if not _early_exit(node.body):
+                # plain branch: the guard only dominated its own body;
+                # an early-exit body (``if seen: return``) dominates
+                # the rest of the enclosing suite, so stays active
+                del self.active_checks[mark:]
+            return
+        if isinstance(node, ast.For):
+            self._expr(node.iter)
+            self._bind(node.target, self._value_is_read(node.iter))
+            self._suite(node.body + node.orelse)
+            return
+        if isinstance(node, ast.AsyncFor):
+            self._expr(node.iter)
+            self._advance(node.lineno, reads=node.iter)
+            self._bind(node.target, True)
+            self._suite(node.body + node.orelse)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: list[str] = []
+            for item in node.items:
+                self._expr(item.context_expr)
+                lock = self.analysis.async_lock_id(
+                    self.mod, self.fn, item.context_expr)
+                if lock is not None:
+                    self.held.append(lock)
+                    acquired.append(lock)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars,
+                               isinstance(node, ast.AsyncWith))
+            if isinstance(node, ast.AsyncWith):
+                # __aenter__ suspends: entering the block is a boundary
+                self._advance(node.lineno,
+                              reads=[i.context_expr for i in node.items])
+            self._suite(node.body)
+            for lock in acquired:
+                self.held.remove(lock)
+            return
+        if isinstance(node, ast.Try):
+            self._suite(node.body)
+            self.handler_depth += 1
+            for handler in node.handlers:
+                if handler.name:
+                    self.read_names.add(handler.name)
+                self._suite(handler.body)
+            self.handler_depth -= 1
+            self._suite(node.orelse)
+            self._suite(node.finalbody)
+            return
+        if isinstance(node, (ast.Return, ast.Expr, ast.Raise, ast.Assert)):
+            for child in ast.iter_child_nodes(node):
+                self._expr(child)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._expr(child)
+
+
+class InterleaveAnalysis:
+    """What an interleave rule sees: the ProgramGraph plus per-async-
+    function section models, the cross-function writer index, async
+    lock detection, and the fenced-lane marker table."""
+
+    def __init__(self, graph: ProgramGraph):
+        self.graph = graph
+        self._models: dict[str, SectionModel] = {}
+        self._async_lock_attrs: dict[str, set[str]] | None = None
+        self._module_async_locks: dict[str, set[str]] | None = None
+        self._global_writers: dict[str, dict[str, set[str]]] = {}
+        self._attr_writers: dict[Location, set[str]] | None = None
+        self._fenced: dict[str, bool] = {}
+
+    # -- section models -----------------------------------------------------
+
+    def model(self, fn: FunctionInfo) -> SectionModel:
+        hit = self._models.get(fn.key)
+        if hit is None:
+            mod = self.graph.modules[fn.relpath]
+            hit = _SectionWalker(self, mod, fn).walk()
+            self._models[fn.key] = hit
+        return hit
+
+    def iter_async_functions(self) -> Iterator[FunctionInfo]:
+        for fn in self.graph.iter_functions():
+            if fn.is_async:
+                yield fn
+
+    def module(self, fn: FunctionInfo) -> ModuleInfo:
+        return self.graph.modules[fn.relpath]
+
+    # -- asyncio locks ------------------------------------------------------
+
+    def _scan_async_locks(self) -> None:
+        self._async_lock_attrs = {}
+        self._module_async_locks = {}
+        for mod in self.graph.modules.values():
+            mod_locks: set[str] = set()
+            for node in mod.tree.body:
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call):
+                    target = _resolve_dotted(mod.imports, node.value.func)
+                    if target in _ASYNC_LOCK_FACTORIES:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                mod_locks.add(tgt.id)
+            self._module_async_locks[mod.relpath] = mod_locks
+            for cinfo in mod.classes.values():
+                attrs: set[str] = set()
+                for node in ast.walk(cinfo.node):
+                    if isinstance(node, ast.Assign) and \
+                            isinstance(node.value, ast.Call):
+                        target = _resolve_dotted(mod.imports,
+                                                 node.value.func)
+                        if target in _ASYNC_LOCK_FACTORIES:
+                            for tgt in node.targets:
+                                attr = _self_attr(tgt)
+                                if attr:
+                                    attrs.add(attr)
+                self._async_lock_attrs[cinfo.key] = attrs
+
+    def async_lock_id(self, mod: ModuleInfo, fn: FunctionInfo,
+                      expr: ast.AST) -> str | None:
+        """Canonical id of the asyncio lock an ``async with`` context
+        expression designates, or None. ``self._lock.acquire()``-style
+        wrappers are not recognised — only the ``async with`` idiom."""
+        if self._async_lock_attrs is None:
+            self._scan_async_locks()
+        # unwrap self.locks[key]-style per-entity locks: the container
+        # attribute is the identity (same container → same discipline)
+        node = expr
+        while isinstance(node, ast.Subscript):
+            node = node.value
+        attr = _self_attr(node)
+        if attr is not None and fn.cls_key is not None:
+            if attr in self._async_lock_attrs.get(fn.cls_key, ()):
+                return f"{fn.cls_key}.{attr}"
+            # self.x.lock where x is a typed attribute of a class with
+            # a lock attr — resolve one level through attr_types
+            return None
+        if isinstance(node, ast.Attribute):
+            inner = _self_attr(node.value)
+            if inner is not None and fn.cls_key is not None:
+                ckey = self.graph._attr_type(
+                    self.graph.classes[fn.cls_key], inner)
+                if ckey is not None and node.attr in \
+                        self._async_lock_attrs.get(ckey, ()):
+                    return f"{ckey}.{node.attr}"
+        if isinstance(node, ast.Name) and \
+                node.id in self._module_async_locks.get(mod.relpath, ()):
+            return f"{mod.relpath}::{node.id}"
+        return None
+
+    # -- writer indexes -----------------------------------------------------
+
+    def module_global_writers(self, mod: ModuleInfo) -> dict[str, set[str]]:
+        """global name → keys of functions that write it (via a
+        ``global`` declaration), for one module."""
+        hit = self._global_writers.get(mod.relpath)
+        if hit is not None:
+            return hit
+        table: dict[str, set[str]] = {}
+        for fn in self.graph.functions.values():
+            if fn.relpath != mod.relpath:
+                continue
+            declared: set[str] = set()
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Global):
+                    declared.update(node.names)
+            if not declared:
+                continue
+            for node in ast.walk(fn.node):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for tgt in targets:
+                        base = tgt
+                        while isinstance(base, ast.Subscript):
+                            base = base.value
+                        if isinstance(base, ast.Name) and \
+                                base.id in declared:
+                            table.setdefault(base.id, set()).add(fn.key)
+        self._global_writers[mod.relpath] = table
+        return table
+
+    def writers(self, loc: Location) -> set[str]:
+        """Keys of every function that writes ``loc`` — the rules'
+        shared/mutable classifier: a location nobody else writes
+        cannot race."""
+        if loc.kind == "global":
+            mod = self.graph.modules.get(loc.owner)
+            if mod is None:
+                return set()
+            return set(self.module_global_writers(mod).get(loc.name, ()))
+        if self._attr_writers is None:
+            self._attr_writers = {}
+            for fn in self.graph.functions.values():
+                if fn.cls_key is None:
+                    continue
+                for w in fn.writes:
+                    key = Location("attr", fn.cls_key, w.attr)
+                    self._attr_writers.setdefault(key, set()).add(fn.key)
+        return set(self._attr_writers.get(loc, ()))
+
+    def rival_writers(self, fn: FunctionInfo, loc: Location) -> set[str]:
+        """Writers of ``loc`` that can actually race with ``fn``:
+        everyone but ``fn`` itself and constructors — ``__init__`` /
+        ``__post_init__`` writes happen-before any method call on the
+        instance, so they never interleave with a window."""
+        out = set()
+        for key in self.writers(loc) - {fn.key}:
+            writer = self.graph.functions.get(key)
+            if writer is not None and \
+                    writer.name in ("__init__", "__post_init__"):
+                continue
+            out.add(key)
+        return out
+
+    def writer_site(self, fn_key: str, loc: Location) -> int | None:
+        """Line of one write to ``loc`` inside ``fn_key``, for chain
+        frames."""
+        fn = self.graph.functions.get(fn_key)
+        if fn is None:
+            return None
+        for w in fn.writes:
+            if w.attr == loc.name:
+                return w.lineno
+        return None
+
+    # -- fenced lanes -------------------------------------------------------
+
+    def fenced_lane(self, fn: FunctionInfo) -> bool:
+        """``# tasklint: fenced-lane`` on the def (or decorator) line —
+        scanned like the ``off-loop`` marker."""
+        hit = self._fenced.get(fn.key)
+        if hit is not None:
+            return hit
+        mod = self.graph.modules[fn.relpath]
+        node = fn.node
+        first = min(getattr(node, "lineno", 1),
+                    *[d.lineno for d in getattr(node, "decorator_list", [])]
+                    or [getattr(node, "lineno", 1)])
+        found = False
+        for lineno in range(first, getattr(node, "lineno", first) + 1):
+            if 0 < lineno <= len(mod.lines) and \
+                    FENCED_LANE_RE.search(mod.lines[lineno - 1]):
+                found = True
+                break
+        self._fenced[fn.key] = found
+        return found
+
+    # -- chain helpers ------------------------------------------------------
+
+    def frame(self, relpath: str, lineno: int, label: str) -> str:
+        """One v4 chain frame: ``file:line [label]``. The suppression
+        matcher and the SARIF emitter both strip the trailing label
+        before parsing the location."""
+        return f"{relpath}:{lineno} [{label}]"

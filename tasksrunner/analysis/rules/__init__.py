@@ -1,11 +1,13 @@
 """Rule modules — importing this package populates ``core.RULES``,
-``core.PROGRAM_RULES``, and ``core.DATAFLOW_RULES``.
+``core.PROGRAM_RULES``, ``core.DATAFLOW_RULES``, and
+``core.INTERLEAVE_RULES``.
 
 Import order note: the whole-program modules (transitive, lockgraph,
 threadshared, routes) import :mod:`tasksrunner.analysis.program`,
 which reuses the blocking-call tables from :mod:`.blocking`; the
 dataflow modules (secrettaint, lifetime, cancelsafety, exflow) import
-:mod:`tasksrunner.analysis.dataflow` on top of that.
+:mod:`tasksrunner.analysis.dataflow` on top of that, and the
+interleave module builds on :mod:`tasksrunner.analysis.interleave`.
 """
 
 from __future__ import annotations
@@ -17,6 +19,7 @@ from tasksrunner.analysis.rules import (  # noqa: F401
     coroutines,
     envflags,
     exflow,
+    interleaving,
     lifetime,
     lockgraph,
     locks,

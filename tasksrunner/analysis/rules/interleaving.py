@@ -1,0 +1,172 @@
+"""Interleave-phase rules: await-atomicity and fencing discipline.
+
+``interleave-check-act`` flags the classic TOCTOU shape of cooperative
+concurrency: a branch tests shared state, the coroutine suspends, and
+the guarded region then writes the same location — by which time any
+other task may have invalidated the test. Only locations some *other*
+function also writes are reported (single-writer state cannot race),
+and the three guards that make the window benign — both ends under the
+same asyncio lock, an etag-threaded CAS write, a ``>=``-monotone epoch
+fence — suppress the finding, so what remains is an unguarded window
+over genuinely contested state.
+
+``fenced-etag-origin`` and ``fenced-epoch-monotone`` police the
+protocol lanes marked ``# tasklint: fenced-lane`` (actor turn commit,
+replication leader append, workflow history append). On those lanes
+the *only* thing standing between a zombie owner and a lost write is
+the fencing discipline itself: every state-plane write must thread an
+etag obtained by a read or commit in the same atomic scope (a constant
+or a token cached on ``self`` across turns defeats the fence), and
+every epoch comparison must be monotone (equality fences reject
+legitimately newer epochs and accept replayed older ones
+symmetrically).
+
+Findings carry labelled v4 chain frames — ``file:line [label]`` — that
+step through the window: the check, the await that opens it, the
+write, and one rival writer of the same footprint.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from tasksrunner.analysis.core import (
+    Finding,
+    InterleaveRule,
+    register_interleave,
+)
+
+
+@register_interleave
+class CheckThenActAcrossAwait(InterleaveRule):
+    id = "interleave-check-act"
+    doc = ("branch on shared state guarding a write to the same "
+           "location across an await, with no lock/etag/epoch guard")
+
+    def check(self, ia) -> Iterable[Finding]:
+        for fn in ia.iter_async_functions():
+            model = ia.model(fn)
+            seen: set[tuple] = set()
+            for win in model.windows:
+                chk, wr = win.check, win.write
+                if chk.held_locks & wr.held_locks:
+                    continue  # same asyncio lock spans both sections
+                if wr.etag_threaded:
+                    continue  # CAS re-validates; stale writer loses
+                if chk.monotone_epoch:
+                    continue  # the branch is itself a monotone fence
+                if model.window_joins_checked(win):
+                    continue  # teardown/join idiom: awaiting the
+                    # checked object, then clearing it
+                if wr.in_handler:
+                    continue  # except-body write: acts on the fresh
+                    # exception, not the stale check
+                if any(c2.loc == chk.loc and c2.section == wr.section
+                       and c2.lineno <= wr.lineno and c2 is not chk
+                       for c2 in model.checks):
+                    continue  # re-checked in the write's own atomic
+                    # section — the recommended fix
+                rivals = ia.rival_writers(fn, chk.loc)
+                if not rivals:
+                    continue  # nobody else writes it: cannot race
+                dedup = (chk.lineno, wr.lineno, wr.via, chk.loc)
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                rival_key = sorted(rivals)[0]
+                rival = ia.graph.functions[rival_key]
+                chain = [
+                    ia.frame(fn.relpath, chk.lineno,
+                             f"checks {chk.loc.render()}"),
+                    ia.frame(fn.relpath, win.open_await,
+                             "await opens window"),
+                    ia.frame(fn.relpath, wr.lineno,
+                             f"writes {chk.loc.render()}"),
+                ]
+                if wr.via is not None:
+                    rel, _, line = wr.via.rpartition(":")
+                    chain.append(ia.frame(rel, int(line),
+                                          "write inside callee"))
+                rline = ia.writer_site(rival_key, chk.loc)
+                if rline is not None:
+                    chain.append(ia.frame(rival.relpath, rline,
+                                          f"also written by "
+                                          f"{rival.qualname}"))
+                yield Finding(
+                    path=fn.relpath, line=chk.lineno, col=1, rule=self.id,
+                    message=(
+                        f"check-then-act across await in {fn.qualname}: "
+                        f"{chk.loc.render()} is tested in one atomic "
+                        f"section and written in a later one with no "
+                        f"interposed guard; {rival.qualname} also writes "
+                        f"it and can interleave at the await — re-check "
+                        f"after the suspension, hold one asyncio lock "
+                        f"across both, or thread an etag"),
+                    chain=tuple(chain))
+
+
+@register_interleave
+class FencedEtagOrigin(InterleaveRule):
+    id = "fenced-etag-origin"
+    doc = ("state-plane write on a fenced lane whose etag does not "
+           "data-flow from a read in the same atomic scope")
+
+    def check(self, ia) -> Iterable[Finding]:
+        for fn in ia.iter_async_functions():
+            if not ia.fenced_lane(fn):
+                continue
+            model = ia.model(fn)
+            for use in model.etag_uses:
+                if use.origin == "read":
+                    continue
+                if use.origin == "constant":
+                    why = (f"the token is the constant {use.detail} — "
+                           f"the store cannot reject a stale owner")
+                else:
+                    why = (f"the token ({use.detail or use.kwarg}) is "
+                           f"not derived from a read or commit in this "
+                           f"atomic scope — a value cached across turns "
+                           f"lets a fenced zombie win the CAS")
+                chain = (
+                    ia.frame(fn.relpath, fn.lineno, "fenced lane"),
+                    ia.frame(fn.relpath, use.lineno,
+                             f"{use.kwarg} not from a same-scope read"),
+                )
+                yield Finding(
+                    path=fn.relpath, line=use.lineno, col=1, rule=self.id,
+                    message=(
+                        f"fenced-lane etag discipline in {fn.qualname}: "
+                        f"{why}; thread the etag returned by the read "
+                        f"or previous commit of the same turn"),
+                    chain=chain)
+
+
+@register_interleave
+class FencedEpochMonotone(InterleaveRule):
+    id = "fenced-epoch-monotone"
+    doc = ("epoch comparison on a fenced lane that is not "
+           ">=-monotone (equality fences break on takeover)")
+
+    def check(self, ia) -> Iterable[Finding]:
+        for fn in ia.iter_async_functions():
+            if not ia.fenced_lane(fn):
+                continue
+            model = ia.model(fn)
+            for cmp in model.epoch_compares:
+                if cmp.monotone:
+                    continue
+                chain = (
+                    ia.frame(fn.relpath, fn.lineno, "fenced lane"),
+                    ia.frame(fn.relpath, cmp.lineno,
+                             f"non-monotone {cmp.op} epoch compare"),
+                )
+                yield Finding(
+                    path=fn.relpath, line=cmp.lineno, col=1, rule=self.id,
+                    message=(
+                        f"fenced-lane epoch discipline in {fn.qualname}: "
+                        f"comparison uses {cmp.op} where the fence must "
+                        f"be >=-monotone — an equality fence rejects a "
+                        f"legitimately newer epoch and passes a replayed "
+                        f"older one symmetrically; compare with >=/<= "
+                        f"against the stored epoch"),
+                    chain=chain)

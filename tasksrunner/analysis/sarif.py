@@ -33,7 +33,10 @@ def _location(path: str, line: int, col: int = 1) -> dict:
 def _code_flow(finding: Finding) -> dict:
     locations = []
     for frame in finding.chain:
-        rel, _, line = frame.rpartition(":")
+        # v4 labelled frame: "file:line [role]" — the label becomes the
+        # step message; the location parses from the prefix
+        site = frame.split(" [", 1)[0]
+        rel, _, line = site.rpartition(":")
         if not rel or not line.isdigit():
             continue
         locations.append({

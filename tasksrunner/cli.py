@@ -740,6 +740,19 @@ def _cmd_lint(args) -> None:
     raise SystemExit(tasklint_main(lint_args))
 
 
+def _cmd_verify(args) -> None:
+    from tasksrunner.analysis.explore import KERNELS, verify
+    kernels = None
+    if args.kernel:
+        unknown = [k for k in args.kernel if k not in KERNELS]
+        if unknown:
+            raise SystemExit(
+                f"unknown kernel(s) {', '.join(unknown)}; "
+                f"available: {', '.join(sorted(KERNELS))}")
+        kernels = args.kernel
+    raise SystemExit(verify(kernels))
+
+
 def _cmd_components(args) -> None:
     from tasksrunner.component.loader import load_components
     from tasksrunner.component.registry import registered_types
@@ -1630,6 +1643,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("lint_args", nargs=argparse.REMAINDER, metavar="...",
                    help="tasklint arguments; try `tasksrunner lint -- --help`")
     p.set_defaults(fn=_cmd_lint)
+
+    p = sub.add_parser(
+        "verify",
+        help="run the protocol kernels (lease takeover, quorum append, "
+             "workflow turn commit) under exhaustive interleavings with "
+             "crash points and check their invariants")
+    p.add_argument("--kernel", action="append", metavar="NAME",
+                   help="verify only this kernel (repeatable); default all")
+    p.set_defaults(fn=_cmd_verify)
 
     p = sub.add_parser("components", help="validate a components directory")
     p.add_argument("path")

@@ -722,6 +722,10 @@ class Runtime:
         if self._started or self.app_channel is None:
             self._started = True
             return
+        # claim the flag before the first suspension: two concurrent
+        # start() calls would otherwise both pass the gate and
+        # double-subscribe every topic / double-start every binding
+        self._started = True
         await self._wait_for_app()
 
         # 1. topic subscriptions (≙ sidecar GET /dapr/subscribe)
@@ -769,7 +773,6 @@ class Runtime:
         if (env_flag("TASKSRUNNER_ACTORS", default=False)
                 or env_flag("TASKSRUNNER_WORKFLOWS", default=False)):
             await self._start_actors()
-        self._started = True
 
     async def _start_actors(self) -> None:
         """Ask the app which actor types it hosts (≙ the Dapr sidecar's

@@ -665,8 +665,21 @@ class ReplicationNode:
             if hwm > my_hwm:
                 return
         epoch = await self.lease.acquire(self.node_id)
-        if epoch is not None:
-            await self._become_leader(epoch)
+        if epoch is None:
+            return
+        promoted = False
+        try:
+            if not self._needs_resync:
+                await self._become_leader(epoch)
+                promoted = True
+        finally:
+            if not promoted:
+                # fenced while the position probes / acquire were in
+                # flight (_on_fenced): our log may now be behind — hand
+                # the lease back instead of leading with a stale
+                # stream (shielded so a cancellation mid-promotion
+                # still surrenders instead of squatting on the lease)
+                await asyncio.shield(self.lease.release(self.node_id))
 
     async def _become_leader(self, epoch: int) -> None:
         if self.replicator is not None:
@@ -693,7 +706,7 @@ class ReplicationNode:
 
     # -- follower protocol (called via links / mesh server) ----------------
 
-    async def apply_records(self, records: list[dict]) -> int:
+    async def apply_records(self, records: list[dict]) -> int:  # tasklint: fenced-lane
         if self.crashed:
             raise OSError(f"replica member {self.node_id} is down")
         loop = asyncio.get_running_loop()

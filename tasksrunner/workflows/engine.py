@@ -128,7 +128,7 @@ class WorkflowEngine:
 
     # -- the actor turn handler --------------------------------------------
 
-    async def handle_turn(self, turn: Any) -> dict:
+    async def handle_turn(self, turn: Any) -> dict:  # tasklint: fenced-lane
         """Every workflow operation is an actor turn on the instance —
         serialized by the actor lock, committed atomically, fenced."""
         method = turn.method

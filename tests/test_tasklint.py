@@ -412,7 +412,7 @@ def test_json_output_schema(tmp_path):
     rc = run([target], ALL_RULES, json_out=True, out=out)
     assert rc == 1
     doc = json.loads(out.getvalue())
-    assert doc["version"] == 3
+    assert doc["version"] == 4
     assert doc["files"] == 1
     assert isinstance(doc["suppressed"], int)
     assert isinstance(doc["baselined"], int)

@@ -1,0 +1,568 @@
+"""Interleave tasklint: atomic sections, fenced lanes + mechanics.
+
+Same two-layer shape as the program/dataflow test files: seeded-bad
+fixtures prove each interleave rule fires, healthy twins prove the
+guards and precision filters stay quiet — the asyncio-lock guard, the
+etag-threaded CAS write, the monotone epoch fence, the re-check-after-
+await fix, the teardown/join idiom, except-handler writes, constructor
+rivals, and awaits inside early-exit branches (the shape that
+originally false-positived on ``_maybe_promote``). Mechanics tests pin
+the v4 labelled-chain contracts (chain-aware suppression, the SARIF
+codeFlow round trip), the mtime-proof tree digest behind the
+``--changed`` empty-delta short-circuit, the zero-findings regression
+over the real tree, and the four-phase wall-time budget.
+"""
+
+import io
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import textwrap
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tasksrunner.analysis import engine
+from tasksrunner.analysis.cache import _digest_memo, tree_digest
+from tasksrunner.analysis.core import INTERLEAVE_RULES, Finding
+from tasksrunner.analysis.engine import (
+    DEFAULT_TARGET, _program_suppressed, known_rule_ids, run,
+)
+from tasksrunner.analysis.interleave import InterleaveAnalysis
+from tasksrunner.analysis.program import ProgramGraph
+
+INTERLEAVE_ONLY = tuple(sorted(INTERLEAVE_RULES))
+
+
+def _interleave(tmp_path, sources, rules=INTERLEAVE_ONLY):
+    """Run the interleave rules over ``sources`` ({relpath: code})
+    with controlled relpaths, through the real suppression filter."""
+    files = []
+    for name, src in sources.items():
+        path = tmp_path / name
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(src))
+        files.append((path, name))
+    graph = ProgramGraph.build(files)
+    ia = InterleaveAnalysis(graph)
+    raw = []
+    for rid in rules:
+        raw.extend(INTERLEAVE_RULES[rid].check(ia))
+    findings = sorted(f for f in raw if not _program_suppressed(graph, f))
+    return findings, len(raw) - len(findings)
+
+
+# -- interleave-check-act -----------------------------------------------
+
+
+CHECK_ACT_BAD = """\
+class Cache:
+    def __init__(self):
+        self._items = None
+
+    async def refresh(self):
+        if self._items is None:
+            fresh = await load()
+            self._items = fresh
+
+    async def invalidate(self):
+        self._items = None
+
+
+async def load():
+    return {}
+"""
+
+
+def test_check_act_across_await_fires(tmp_path):
+    findings, _ = _interleave(tmp_path, {"mod.py": CHECK_ACT_BAD},
+                              rules=("interleave-check-act",))
+    (f,) = findings
+    assert f.rule == "interleave-check-act"
+    assert (f.path, f.line) == ("mod.py", 6)  # the stale check
+    assert "self._items" in f.message
+    assert "Cache.invalidate" in f.message  # the rival writer
+    # v4 labelled chain: check -> await -> write -> rival
+    assert f.chain[0].startswith("mod.py:6 [checks")
+    assert "[await opens window]" in f.chain[1]
+    assert f.chain[2].startswith("mod.py:8 [writes")
+    assert any("Cache.invalidate" in fr for fr in f.chain)
+
+
+def test_check_act_no_rival_writer_is_quiet(tmp_path):
+    # drop the rival: only __init__ and the checker itself write it —
+    # constructor writes happen-before any method call, cannot race
+    src = CHECK_ACT_BAD.replace(
+        "    async def invalidate(self):\n"
+        "        self._items = None\n", "")
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("interleave-check-act",))
+    assert findings == []
+
+
+def test_check_act_guarded_by_asyncio_lock(tmp_path):
+    src = """\
+    import asyncio
+
+
+    class Cache:
+        def __init__(self):
+            self._items = None
+            self._lock = asyncio.Lock()
+
+        async def refresh(self):
+            async with self._lock:
+                if self._items is None:
+                    fresh = await load()
+                    self._items = fresh
+
+        async def invalidate(self):
+            self._items = None
+
+
+    async def load():
+        return {}
+    """
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("interleave-check-act",))
+    assert findings == []
+
+
+def test_check_act_etag_threaded_write_is_quiet(tmp_path):
+    src = """\
+    class Doc:
+        def __init__(self, store):
+            self.store = store
+            self._cached = None
+
+        async def refresh(self):
+            item = await self.store.get("k")
+            if self._cached is None:
+                doc = await compute()
+                self._cached = await self.store.set(
+                    "k", doc, etag=item.etag)
+
+        async def drop(self):
+            self._cached = None
+
+
+    async def compute():
+        return {}
+    """
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("interleave-check-act",))
+    assert findings == []
+
+
+def test_check_act_monotone_epoch_check_is_quiet(tmp_path):
+    src = """\
+    class Log:
+        def __init__(self):
+            self._epoch = 0
+
+        async def fence(self, epoch):
+            if epoch >= self._epoch:
+                await persist(epoch)
+                self._epoch = epoch
+
+        async def reset(self):
+            self._epoch = 0
+
+
+    async def persist(epoch):
+        pass
+    """
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("interleave-check-act",))
+    assert findings == []
+
+
+def test_check_act_recheck_after_await_is_the_fix(tmp_path):
+    # re-testing the location in the write's own atomic section is the
+    # fix the rule recommends — it must recognise it
+    src = CHECK_ACT_BAD.replace(
+        "            fresh = await load()\n"
+        "            self._items = fresh\n",
+        "            fresh = await load()\n"
+        "            if self._items is None:\n"
+        "                self._items = fresh\n")
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("interleave-check-act",))
+    assert findings == []
+
+
+def test_check_act_join_teardown_idiom_is_quiet(tmp_path):
+    src = """\
+    class Worker:
+        def __init__(self):
+            self._task = None
+
+        async def stop(self):
+            if self._task is not None:
+                await self._task
+                self._task = None
+
+        async def start(self):
+            self._task = spawn()
+
+
+    def spawn():
+        return None
+    """
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("interleave-check-act",))
+    assert findings == []
+
+
+def test_check_act_except_handler_write_is_quiet(tmp_path):
+    # the except-body write acts on the just-caught exception (fresh
+    # information), not on the stale branch test
+    src = """\
+    class Link:
+        def __init__(self):
+            self._open = True
+
+        async def ship(self, rec):
+            if self._open:
+                try:
+                    await send(rec)
+                except OSError:
+                    self._open = False
+
+        async def close(self):
+            self._open = False
+
+
+    async def send(rec):
+        pass
+    """
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("interleave-check-act",))
+    assert findings == []
+
+
+def test_check_act_await_in_early_exit_branch_is_quiet(tmp_path):
+    # the _maybe_promote shape: the re-check's early-exit body itself
+    # awaits (surrendering a lease) — that await is NOT a suspension on
+    # the fall-through path, so the write right after stays guarded
+    src = """\
+    class Node:
+        def __init__(self):
+            self._busy = False
+
+        async def promote(self):
+            if self._busy:
+                return
+            token = await acquire()
+            if self._busy:
+                await release(token)
+                return
+            self._busy = True
+
+        async def fence(self):
+            self._busy = True
+
+
+    async def acquire():
+        return 1
+
+
+    async def release(token):
+        pass
+    """
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("interleave-check-act",))
+    assert findings == []
+
+
+def test_check_act_cross_function_write_via_callee(tmp_path):
+    src = """\
+    class Pool:
+        def __init__(self):
+            self._conn = None
+
+        async def ensure(self):
+            if self._conn is None:
+                await probe()
+                await self._connect()
+
+        async def _connect(self):
+            self._conn = await dial()
+
+        async def reset(self):
+            self._conn = None
+
+
+    async def probe():
+        pass
+
+
+    async def dial():
+        return object()
+    """
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("interleave-check-act",))
+    (f,) = findings
+    assert (f.path, f.line) == ("mod.py", 6)
+    assert any("[write inside callee]" in fr for fr in f.chain)
+    assert "also writes" in f.message  # a rival (reset or the callee)
+
+
+def test_check_act_suppression_on_chain_frame(tmp_path):
+    # labelled v4 frames must still resolve for chain-aware
+    # suppression — disable on the WRITE line, report is on the check
+    src = CHECK_ACT_BAD.replace(
+        "            self._items = fresh",
+        "            self._items = fresh"
+        "  # tasklint: disable=interleave-check-act")
+    findings, suppressed = _interleave(tmp_path, {"mod.py": src},
+                                       rules=("interleave-check-act",))
+    assert findings == [] and suppressed == 1
+
+
+# -- fenced-etag-origin -------------------------------------------------
+
+
+def test_fenced_etag_cached_token_fires(tmp_path):
+    src = """\
+    class Lane:
+        def __init__(self, store):
+            self.store = store
+            self._etag = None
+
+        async def commit(self, doc):  # tasklint: fenced-lane
+            await self.store.set("k", doc, etag=self._etag)
+    """
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("fenced-etag-origin",))
+    (f,) = findings
+    assert f.rule == "fenced-etag-origin"
+    assert "same atomic scope" in f.message or "cached" in f.message
+    assert any("[fenced lane]" in fr for fr in f.chain)
+
+
+def test_fenced_etag_constant_token_fires(tmp_path):
+    src = """\
+    class Lane:
+        def __init__(self, store):
+            self.store = store
+
+        async def commit(self, doc):  # tasklint: fenced-lane
+            await self.store.set("k", doc, etag="42")
+    """
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("fenced-etag-origin",))
+    (f,) = findings
+    assert "constant" in f.message
+
+
+def test_fenced_etag_threaded_from_read_is_quiet(tmp_path):
+    src = """\
+    class Lane:
+        def __init__(self, store):
+            self.store = store
+
+        async def commit(self, doc):  # tasklint: fenced-lane
+            item = await self.store.get("k")
+            await self.store.set("k", doc, etag=item.etag)
+    """
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("fenced-etag-origin",))
+    assert findings == []
+
+
+def test_fenced_etag_unmarked_lane_is_out_of_scope(tmp_path):
+    src = """\
+    class Lane:
+        def __init__(self, store):
+            self.store = store
+
+        async def commit(self, doc):
+            await self.store.set("k", doc, etag=None)
+    """
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("fenced-etag-origin",))
+    assert findings == []
+
+
+# -- fenced-epoch-monotone ----------------------------------------------
+
+
+def test_fenced_epoch_equality_fires(tmp_path):
+    src = """\
+    class Lane:
+        def __init__(self):
+            self._epoch = 0
+
+        async def append(self, rec, epoch):  # tasklint: fenced-lane
+            if epoch == self._epoch:
+                await write(rec)
+
+
+    async def write(rec):
+        pass
+    """
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("fenced-epoch-monotone",))
+    (f,) = findings
+    assert f.rule == "fenced-epoch-monotone"
+    assert "Eq" in f.message
+    assert any("non-monotone" in fr for fr in f.chain)
+
+
+def test_fenced_epoch_monotone_is_quiet(tmp_path):
+    src = """\
+    class Lane:
+        def __init__(self):
+            self._epoch = 0
+
+        async def append(self, rec, epoch):  # tasklint: fenced-lane
+            if epoch >= self._epoch:
+                await write(rec)
+
+
+    async def write(rec):
+        pass
+    """
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("fenced-epoch-monotone",))
+    assert findings == []
+
+
+def test_fenced_epoch_word_boundary(tmp_path):
+    # "terminate" contains "term"; a method-name dispatch compare is
+    # not an epoch fence
+    src = """\
+    class Lane:
+        async def handle(self, method):  # tasklint: fenced-lane
+            if method == "terminate":
+                await stop()
+
+
+    async def stop():
+        pass
+    """
+    findings, _ = _interleave(tmp_path, {"mod.py": src},
+                              rules=("fenced-epoch-monotone",))
+    assert findings == []
+
+
+# -- mechanics ----------------------------------------------------------
+
+
+def test_sarif_codeflow_parses_labelled_frames():
+    from tasksrunner.analysis.sarif import to_sarif
+    f = Finding(path="a.py", line=4, col=1, rule="interleave-check-act",
+                message="m",
+                chain=("a.py:4 [checks self._x]",
+                       "a.py:5 [await opens window]",
+                       "b.py:9 [also written by C.w]"))
+    doc = to_sarif([f], {"interleave-check-act": "doc"})
+    (result,) = doc["runs"][0]["results"]
+    steps = result["codeFlows"][0]["threadFlows"][0]["locations"]
+    locs = [(s["location"]["physicalLocation"]["artifactLocation"]["uri"],
+             s["location"]["physicalLocation"]["region"]["startLine"])
+            for s in steps]
+    assert locs == [("a.py", 4), ("a.py", 5), ("b.py", 9)]
+    # the label survives as the step message
+    assert steps[0]["location"]["message"]["text"].endswith(
+        "[checks self._x]")
+
+
+def test_tree_digest_is_mtime_proof(tmp_path):
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("x = 1\n")
+    b.write_text("y = 2\n")
+    before = tree_digest([a, b])
+    os.utime(a, ns=(1, 1))  # touch: mtime churn, identical bytes
+    os.utime(b, ns=(2, 2))
+    _digest_memo.clear()  # a fresh process has no per-run memo
+    assert tree_digest([a, b]) == before
+    a.write_text("x = 3\n")
+    _digest_memo.clear()
+    assert tree_digest([a, b]) != before
+
+
+def test_changed_empty_delta_short_circuits_to_cache(
+        tmp_path, monkeypatch, capfd):
+    """`lint --changed` with an empty git delta must not rebuild the
+    whole-tree phases: the content-only tree digest survives the mtime
+    churn of a branch switch, so the second run is pure cache."""
+    repo = tmp_path / "repo"
+    repo.mkdir()
+
+    def git(*args):
+        subprocess.run(["git", "-C", str(repo), *args],
+                       check=True, capture_output=True)
+
+    git("init", "-q")
+    git("symbolic-ref", "HEAD", "refs/heads/main")
+    git("config", "user.email", "t@example.com")
+    git("config", "user.name", "t")
+    (repo / "mod.py").write_text(textwrap.dedent(CHECK_ACT_BAD))
+    git("add", ".")
+    git("commit", "-qm", "seed")
+
+    monkeypatch.setattr(engine, "REPO_ROOT", repo)
+    cache = tmp_path / "cache.json"
+    base = tmp_path / "baseline.json"
+    argv = ["--changed", "--cache", str(cache), "--baseline", str(base),
+            str(repo)]
+    rc = engine.main(argv)
+    capfd.readouterr()
+    assert rc == 1  # the seeded window is a real finding
+
+    # branch-switch simulation: every mtime churns, bytes identical
+    for p in repo.rglob("*.py"):
+        os.utime(p, ns=(7, 7))
+    _digest_memo.clear()
+
+    def bomb(files):
+        raise AssertionError("whole-tree phase rebuilt on empty delta")
+
+    monkeypatch.setattr(engine, "build_graph", bomb)
+    rc = engine.main(argv)
+    text = capfd.readouterr().out
+    assert rc == 1
+    assert "cached" in text
+
+
+def test_real_tree_has_zero_interleave_findings(tmp_path):
+    """The runtime itself must satisfy its own interleaving rules with
+    an empty baseline — genuine windows get fixed, not suppressed."""
+    rc = run([DEFAULT_TARGET], INTERLEAVE_ONLY,
+             cache_path=None, out=io.StringIO())
+    assert rc == 0
+
+
+def test_four_phase_wall_time_budget(tmp_path):
+    """`make test`'s lint leg must stay usable interactively with the
+    interleave phase aboard: cold under 40s, warm (tree digest
+    unchanged) under 6s for all four phases over the whole package."""
+    all_rules = tuple(sorted(known_rule_ids()))
+    cache_file = tmp_path / "cache.json"
+    t0 = time.perf_counter()
+    rc = run([DEFAULT_TARGET], all_rules, cache_path=cache_file,
+             out=io.StringIO())
+    cold = time.perf_counter() - t0
+    assert rc == 0
+    t0 = time.perf_counter()
+    rc = run([DEFAULT_TARGET], all_rules, cache_path=cache_file,
+             out=io.StringIO())
+    warm = time.perf_counter() - t0
+    assert rc == 0
+    assert cold < 40.0, f"cold four-phase lint took {cold:.1f}s"
+    assert warm < 6.0, f"warm four-phase lint took {warm:.1f}s"
+
+
+def test_json_schema_v4(tmp_path):
+    out = io.StringIO()
+    rc = run([DEFAULT_TARGET], INTERLEAVE_ONLY, cache_path=None,
+             json_out=True, out=out)
+    assert rc == 0
+    doc = json.loads(out.getvalue())
+    assert doc["version"] == 4
+    assert doc["findings"] == []

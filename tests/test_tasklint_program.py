@@ -453,7 +453,7 @@ def test_run_emits_program_findings_with_chain_in_json(tmp_path):
     rc = run([target], ("transitive-blocking",), json_out=True, out=out)
     assert rc == 1
     doc = json.loads(out.getvalue())
-    assert doc["version"] == 3
+    assert doc["version"] == 4
     (finding,) = doc["findings"]
     assert finding["rule"] == "transitive-blocking"
     assert len(finding["chain"]) == 3
@@ -475,8 +475,8 @@ def test_program_phase_uses_tree_digest_cache(tmp_path):
     rc2, text2 = _run()
     assert (rc1, rc2) == (1, 1)
     assert "cached" not in text1
-    # one per-file hit + the program entry + the dataflow entry
-    assert "3 cached" in text2
+    # one per-file hit + the program, dataflow, and interleave entries
+    assert "4 cached" in text2
 
     # any content change invalidates the tree digest
     target.write_text(PROG_BAD + "# trailing comment\n")
