@@ -1,6 +1,6 @@
 # ≙ /root/reference/Makefile:1-13 (docs build/serve glue) plus the
 # local dev workflow targets.
-.PHONY: test lint lint-program lint-dataflow lint-interleave verify lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-trace bench-overload bench-actors bench-workflows bench-repl bench-mesh bench-ml-serve chaos sweep-flash run validate docs-serve docs-build clean
+.PHONY: test lint lint-program lint-dataflow lint-interleave verify lint-changed lint-metrics soak bench bench-state bench-shard bench-hist bench-trace bench-overload bench-actors bench-workflows bench-repl bench-reshard bench-mesh bench-ml-serve chaos sweep-flash run validate docs-serve docs-build clean
 
 test: lint lint-program lint-dataflow lint-interleave
 	python -m pytest tests/ -q
@@ -106,6 +106,14 @@ bench-workflows:
 bench-repl:
 	python -m pytest tests/test_replication.py -q -m "not slow"
 	python bench.py --replication-bench
+
+# elastic placement: the epoch-fence/migration test suite, then the
+# live-split-under-load drill — steady vs during-migration p99 (within
+# 2x), zero lost acked writes across the flip, and the hot-key-storm
+# detection knee
+bench-reshard:
+	python -m pytest tests/test_placement.py -q -m "not slow"
+	python bench.py --reshard-bench
 
 # mesh fast lane: the transport test matrix (codec negotiation, legacy
 # interop, coalescing, prewarm, condemnation), then the per-lever

@@ -2116,6 +2116,138 @@ async def run_replication_bench(n_ops: int = 3000, *, concurrency: int = 64,
     }
 
 
+async def run_reshard_bench(n_keys: int = 2000, *,
+                            steady_seconds: float = 1.5) -> dict:
+    """``reshard_bench``: elastic placement's three numbers.
+
+    * **p99 during migration vs steady** — a writer hammers a 4-shard
+      sqlite store recording per-op latency; first over a steady
+      window, then with a live ``split_shard`` streaming ~1/5 of the
+      keyspace to a fresh shard underneath it. The fenced flip's
+      write-pause is the only stop-the-world moment, so the during/
+      steady p99 ratio IS the cost of live resharding (acceptance:
+      within 2x).
+    * **time-to-rebalance after a hot-key storm** — a zipfian writer
+      storms one shard; reported: time from storm start until the heat
+      tracker's hysteresis window elapses and ``plan_rebalance``
+      proposes an action (the control loop's detection knee).
+    * **zero lost acked writes** — the migration-window writer banks
+      every acked key; after the flip each must read back.
+      ``lost_acked_keys`` must be empty — an acceptance bar, not a
+      statistic.
+    """
+    from tasksrunner.state.placement import plan_rebalance
+    from tasksrunner.state.sqlite import build_sharded_store
+
+    tmp = tempfile.mkdtemp(prefix="tasksrunner-bench-reshard-")
+
+    def _p99_ms(lat: list[float]) -> float:
+        lat = sorted(lat)
+        return round(lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1000, 3)
+
+    store = build_sharded_store("bench-reshard", f"{tmp}/state.db", shards=4)
+    acked: list[str] = []
+    try:
+        for i in range(n_keys):
+            await store.set(f"task-{i}", {"v": i})
+
+        async def writer(lat: list[float], stop: asyncio.Event,
+                         bank: bool) -> None:
+            i = 0
+            while not stop.is_set():
+                key = f"live-{i % n_keys}"
+                t0 = time.perf_counter()
+                await store.set(key, {"v": i})
+                lat.append(time.perf_counter() - t0)
+                if bank:
+                    acked.append(key)
+                i += 1
+
+        # steady window
+        steady_lat: list[float] = []
+        stop = asyncio.Event()
+        task = asyncio.create_task(writer(steady_lat, stop, bank=False))
+        await asyncio.sleep(steady_seconds)
+        stop.set()
+        await task
+
+        # migration window: the same writer runs while a split streams
+        # ~1/(N+1) of the keyspace out and flips routing underneath it
+        during_lat: list[float] = []
+        stop = asyncio.Event()
+        task = asyncio.create_task(writer(during_lat, stop, bank=True))
+        await asyncio.sleep(0.1)  # writer in flight before the split
+        t0 = time.perf_counter()
+        split = await store.split_shard()
+        migration_s = time.perf_counter() - t0
+        await asyncio.sleep(0.2)  # post-flip writes through the new map
+        stop.set()
+        await task
+
+        lost = [k for k in set(acked) if await store.get(k) is None]
+        epoch = store.placement.epoch
+    finally:
+        await store.aclose()
+
+    steady_p99 = _p99_ms(steady_lat)
+    during_p99 = _p99_ms(during_lat)
+
+    # hot-key storm → detection knee, on a fresh store with a tight
+    # hysteresis window so the bench stays fast (the knob operators
+    # turn: TASKSRUNNER_RESHARD_HYSTERESIS_SECONDS)
+    saved = {k: os.environ.get(k) for k in
+             ("TASKSRUNNER_RESHARD_HEAT_THRESHOLD",
+              "TASKSRUNNER_RESHARD_HYSTERESIS_SECONDS")}
+    os.environ["TASKSRUNNER_RESHARD_HEAT_THRESHOLD"] = "50"
+    os.environ["TASKSRUNNER_RESHARD_HYSTERESIS_SECONDS"] = "0.4"
+    try:
+        hot_store = build_sharded_store(
+            "bench-reshard-hot", f"{tmp}/hot.db", shards=4)
+        try:
+            t0 = time.perf_counter()
+            plan = None
+            deadline = t0 + 15.0
+            i = 0
+            while plan is None and time.perf_counter() < deadline:
+                # zipf-ish: 80% of writes land on one hot key's shard
+                key = "hot-key" if i % 5 else f"cold-{i}"
+                await hot_store.set(key, {"v": i})
+                i += 1
+                if i % 200 == 0:
+                    plan = plan_rebalance(hot_store.placement_doc())
+            time_to_plan_s = (round(time.perf_counter() - t0, 3)
+                              if plan is not None else None)
+        finally:
+            await hot_store.aclose()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    return {
+        "steady": {"writes": len(steady_lat), "p99_ms": steady_p99},
+        "during_migration": {
+            "writes": len(during_lat),
+            "p99_ms": during_p99,
+            "p99_ratio": (round(during_p99 / steady_p99, 2)
+                          if steady_p99 else None),
+            "pause_ms": round(split["pause_seconds"] * 1000, 2),
+            "keys_moved": split["keys_moved"],
+            "migration_seconds": round(migration_s, 3),
+            "epoch_after": epoch,
+            "within_2x": during_p99 <= 2 * steady_p99,
+        },
+        "lost_acked_keys": lost,
+        "acked_writes": len(set(acked)),
+        "hot_key_storm": {
+            "time_to_plan_s": time_to_plan_s,
+            "plan": plan,
+        },
+    }
+
+
 async def _mesh_combo(codec: str, coalesce: bool, *, rtt_n: int = 300,
                       n_ops: int = 3000, concurrency: int = 64) -> dict:
     """One rung of the fast-lane ladder: the framed mesh transport
@@ -2636,6 +2768,13 @@ def main() -> None:
                              "ratios for RF {1,2,3} and the leader-"
                              "crash failover drill (zero lost acked "
                              "writes at RF 2, failover time)")
+    parser.add_argument("--reshard-bench", action="store_true",
+                        help="run ONLY the elastic-placement section "
+                             "(`make bench-reshard`): p99 during a "
+                             "live shard split vs steady state (within "
+                             "2x), zero lost acked writes across the "
+                             "fenced flip, and time-to-plan after a "
+                             "zipfian hot-key storm")
     parser.add_argument("--mesh-bench", action="store_true",
                         help="run ONLY the mesh fast-lane ladder "
                              "(`make bench-mesh`): JSON vs binary "
@@ -2780,6 +2919,23 @@ def main() -> None:
              f"leader {fo['new_leader']}, lost acked keys "
              f"{len(fo['lost_acked_keys'])} of {fo['acked_writes']}")
         print(json.dumps({"replication_bench": replication_bench}))
+        return
+
+    if args.reshard_bench:
+        _log("elastic placement: live split under load + hot-key storm ...")
+        reshard_bench = asyncio.run(run_reshard_bench())
+        d, s = reshard_bench["during_migration"], reshard_bench["steady"]
+        _log(f"  -> steady p99 {s['p99_ms']} ms, during-split p99 "
+             f"{d['p99_ms']} ms (x{d['p99_ratio']}, within_2x="
+             f"{d['within_2x']}), pause {d['pause_ms']} ms, "
+             f"{d['keys_moved']} keys moved in {d['migration_seconds']}s")
+        _log(f"  -> lost acked keys {len(reshard_bench['lost_acked_keys'])} "
+             f"of {reshard_bench['acked_writes']}")
+        storm = reshard_bench["hot_key_storm"]
+        plan = storm["plan"] or {}
+        _log(f"  -> hot-key storm: plan {plan.get('action')!r} for shard "
+             f"{plan.get('shard')} after {storm['time_to_plan_s']}s")
+        print(json.dumps({"reshard_bench": reshard_bench}))
         return
 
     if args.mesh_bench:

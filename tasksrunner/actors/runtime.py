@@ -343,11 +343,32 @@ class ActorRuntime:
             self._deactivate(actor_type, actor_id)
         return await self._activate(actor_type, actor_id, forwarded=forwarded)
 
+    def _locality_rank(self, actor_type: str, actor_id: str) -> float:
+        """Affinity of THIS replica for the actor's backing shard
+        (elastic placement, PR 20): 1.0 when the local member leads the
+        shard holding the actor's record (or the store has no placement
+        map at all), 0.0 when another host owns it. Used only to bias
+        placement races — never to refuse an activation."""
+        try:
+            store, prefixer = self.runtime._state_store(self.store)
+        except Exception:
+            return 1.0
+        rank_of = getattr(store, "locality_rank", None)
+        if rank_of is None:
+            return 1.0
+        return float(rank_of(prefixer.apply(record_key(actor_type, actor_id))))
+
     async def _activate(self, actor_type: str, actor_id: str, *,
                         forwarded: bool):
         """Walk the placement table: forward to a live owner, or take
         (or retake) ownership — bumping the fencing epoch — when the
-        record is free, released, or its owner is dead."""
+        record is free, released, or its owner is dead.
+
+        Placement races are locality-biased: a replica that does NOT
+        host the actor's backing shard yields a beat before claiming,
+        so the shard-local replica usually wins the CAS and actor turns
+        commit without a cross-host state hop."""
+        deferred = False
         for _ in range(4):
             now = time.time()
             place = await self.runtime.get_state(
@@ -372,6 +393,16 @@ class ActorRuntime:
             else:
                 epoch = 1
                 place_etag = None
+            if not deferred and not forwarded and (
+                    place is None or takeover):
+                deferred = True  # one yield per activation, not per loop
+                rank = self._locality_rank(actor_type, actor_id)
+                if rank < 1.0:
+                    # lose the race on purpose: if the shard-local
+                    # replica claims during this nap our CAS below
+                    # fails and the next pass forwards to it
+                    await asyncio.sleep(0.05 * (1.0 - rank))
+                    continue
             lease_expires = now + self.lease_seconds
             new_place = {"owner": self._identity(), "epoch": epoch,
                          "lease_expires": lease_expires, "granted_at": now}

@@ -183,6 +183,24 @@ class ChaosPolicies:
                 return policy
         return None
 
+    def for_placement(self, store: str,
+                      shard: int | None = None) -> ChaosPolicy | None:
+        """Faults applied to a live migration's catch-up stream for
+        ``store`` (elastic placement, PR 20). Resolution is
+        most-specific first — ``store/shard`` beats ``store`` — so a
+        drill can blackhole one shard's migration while another
+        reshards normally. The store consults this ONLY on the pre-flip
+        path (lag polls, bulk copies): an injected hang aborts the
+        migration with routing untouched and can never extend the
+        fenced write-pause."""
+        keys = ((f"{store}/{shard}", store)
+                if shard is not None else (store,))
+        for key in keys:
+            policy = self._resolve("placement", key, "migration")
+            if policy is not None:
+                return policy
+        return None
+
     def for_workflow(self, workflow: str,
                      activity: str | None = None) -> ChaosPolicy | None:
         """Faults applied inside workflow activity attempts. Resolution
@@ -214,6 +232,8 @@ class ChaosPolicies:
                 refs = spec.replication_targets.get(name)
             elif kind == "workflows":
                 refs = spec.workflow_targets.get(name)
+            elif kind == "placement":
+                refs = spec.placement_targets.get(name)
             else:
                 refs = (spec.component_targets.get(name) or {}).get(direction)
             if not refs:
@@ -257,6 +277,10 @@ class ChaosPolicies:
                 ] + [
                     f"workflows/{key}/activity"
                     for key, refs in spec.workflow_targets.items()
+                    if rule.name in refs
+                ] + [
+                    f"placement/{key}/migration"
+                    for key, refs in spec.placement_targets.items()
                     if rule.name in refs
                 ]
                 out.append({

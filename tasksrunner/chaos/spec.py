@@ -41,6 +41,9 @@ are inert unless the host runs with ``TASKSRUNNER_CHAOS=1``.
         workflows:
           checkout/charge: [poison]            # one activity of one workflow
           checkout: [slowStore]                # every activity of the workflow
+        placement:
+          statestore/2: [deadPeer]             # migrations of one shard
+          statestore: [slowStore]              # any migration of the store
 
 Replication targets address the record stream between a shard's leader
 and a follower (state/replication.py): the key is ``<store>``,
@@ -54,6 +57,14 @@ it on the OWNING replica inside each activity attempt — so a
 ``crashEveryN`` rule on ``checkout/charge`` deterministically fells
 whichever replica is executing that saga step, wherever placement
 moved the instance (the workflow recovery drill's primitive).
+
+Placement targets (``<store>`` or ``<store>/<shard>``, most specific
+wins) bind to a live migration's catch-up stream
+(state/sharding.py) — the lag polls and bulk key copies that run
+BEFORE the fenced routing flip. A blackhole here must abort the
+migration cleanly with routing untouched; it must never be able to
+wedge the write-pause itself, which is why the gate is consulted only
+on the pre-flip path.
 
 Each named fault carries exactly one fault kind:
 
@@ -178,6 +189,11 @@ class ChaosSpec:
     #: ``workflow/activity`` (most specific wins).
     workflow_targets: dict[str, tuple[str, ...]] = field(
         default_factory=dict)
+    #: placement key → rule names, injected on a live migration's
+    #: catch-up stream before the fenced flip. Keys are ``store`` or
+    #: ``store/shard`` (most specific wins).
+    placement_targets: dict[str, tuple[str, ...]] = field(
+        default_factory=dict)
 
     def in_scope(self, app_id: str | None) -> bool:
         if not self.scopes or app_id is None:
@@ -299,6 +315,10 @@ def parse_chaos(doc: Mapping[str, Any], *, source: str | None = None) -> ChaosSp
         str(key): _parse_rule_refs(raw, where=where, target=str(key))
         for key, raw in (targets.get("workflows") or {}).items()
     }
+    placement_targets = {
+        str(key): _parse_rule_refs(raw, where=where, target=str(key))
+        for key, raw in (targets.get("placement") or {}).items()
+    }
 
     scopes = doc.get("scopes") or []
     if not isinstance(scopes, list) or not all(isinstance(s, str) for s in scopes):
@@ -308,7 +328,8 @@ def parse_chaos(doc: Mapping[str, Any], *, source: str | None = None) -> ChaosSp
     # loader: a typo must fail startup, not silently inject nothing
     all_refs = (list(app_targets.items()) + list(actor_targets.items())
                 + list(replication_targets.items())
-                + list(workflow_targets.items())) + [
+                + list(workflow_targets.items())
+                + list(placement_targets.items())) + [
         (comp, ref)
         for comp, dirs in component_targets.items()
         for ref in dirs.values()
@@ -330,6 +351,7 @@ def parse_chaos(doc: Mapping[str, Any], *, source: str | None = None) -> ChaosSp
         actor_targets=actor_targets,
         replication_targets=replication_targets,
         workflow_targets=workflow_targets,
+        placement_targets=placement_targets,
     )
 
 

@@ -1260,6 +1260,54 @@ def _cmd_repl(args) -> None:
             print(f"  {m['member']}: hwm {m['hwm']} epoch {m['epoch']}")
 
 
+def _cmd_shards(args) -> None:
+    """Elastic-placement view from the orchestrator admin plane: per
+    sharded store — routing epoch, shard→host assignment, hot/cold
+    ranking, in-flight migration, and the control loop's rebalance
+    plan. The live-cluster complement to `tasksrunner repl`, which
+    reads the sqlite files."""
+    import json as json_mod
+
+    doc = _admin_request(args.registry_file, "GET", "/admin/placement")
+    if args.json:
+        print(json_mod.dumps(doc, indent=2))
+        return
+    apps = doc.get("apps") or {}
+    if not apps:
+        print("no running apps")
+        return
+    if not doc.get("reshard"):
+        print("NOTE: TASKSRUNNER_RESHARD is off — this is a one-shot "
+              "sweep, not a live control loop")
+    shown = 0
+    for app_id, snap in sorted(apps.items()):
+        for store, entry in sorted((snap.get("stores") or {}).items()):
+            shown += 1
+            migration = entry.get("migration")
+            status = (f", migrating ({migration.get('phase')})"
+                      if isinstance(migration, dict) else "")
+            print(f"{app_id}/{store}: epoch {entry.get('epoch')}, "
+                  f"{entry.get('shards')} shards, "
+                  f"{entry.get('replicas_reporting')} replica(s) "
+                  f"reporting{status}")
+            assignment = entry.get("assignment") or {}
+            leaders = entry.get("leaders") or {}
+            for row in entry.get("ranking") or []:
+                shard = row.get("shard")
+                host = (assignment.get(str(shard))
+                        or leaders.get(str(shard)) or "local")
+                heat = "HOT" if row.get("hot") else "ok"
+                print(f"  shard {shard}: rank {row.get('rank')}, "
+                      f"{row.get('rate')} ops/s [{heat}] @ {host}")
+            plan = entry.get("plan")
+            if plan:
+                print(f"  plan: {plan.get('action')} shard "
+                      f"{plan.get('shard')} — {plan.get('reason')}")
+    if not shown:
+        print("no sharded stores reporting placement (stores with "
+              "shards > 1 publish it via sidecar metadata)")
+
+
 def _admin_request(registry_file: str, method: str, path: str,
                    body: dict | None = None) -> dict:
     """Talk to the orchestrator's control plane (the `az containerapp`
@@ -1591,6 +1639,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true",
                    help="machine-readable output")
     p.set_defaults(fn=_cmd_repl)
+
+    p = sub.add_parser(
+        "shards",
+        help="elastic-placement status of sharded stores (routing "
+             "epoch, heat ranking, migrations, rebalance plan) from "
+             "the orchestrator admin plane")
+    p.add_argument("--registry-file", default=".tasksrunner/apps.json")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output")
+    p.set_defaults(fn=_cmd_shards)
 
     p = sub.add_parser(
         "deploy",

@@ -30,6 +30,7 @@ from tasksrunner.errors import (
     EtagMismatch,
     InvocationError,
     InvocationStatusError,
+    PlacementEpochError,
     QueryError,
     SaturatedError,
     SecretNotFound,
@@ -38,6 +39,7 @@ from tasksrunner.errors import (
 from tasksrunner.runtime import Runtime
 from tasksrunner.security import TOKEN_ENV, TOKEN_HEADER
 from tasksrunner.state.base import StateItem
+from tasksrunner.state.placement import PLACEMENT_EPOCH_HEADER
 
 DEFAULT_SIDECAR_PORT = 3500
 PORT_ENV = "TASKSRUNNER_HTTP_PORT"
@@ -194,6 +196,12 @@ class _HTTPTransport(_Transport):
     def __init__(self, base_url: str):
         self.base = base_url.rstrip("/")
         self._session = None
+        # elastic placement: last routing epoch learned per store. A
+        # flip makes the next stamped request 409 with the new epoch in
+        # the reply header; _state_request refreshes and retries once,
+        # so a live migration costs callers one extra round trip, never
+        # a failed operation.
+        self._placement_epochs: dict[str, int] = {}
 
     async def _request(self, method: str, path: str, *, json_body=None,
                        headers=None, data=None, params=None):
@@ -226,6 +234,33 @@ class _HTTPTransport(_Transport):
         except OSError as exc:
             raise InvocationError(f"sidecar unreachable at {url}: {exc}") from exc
 
+    async def _state_request(self, method: str, path: str, store: str,
+                             *, json_body=None, headers=None):
+        """State-path request with the placement-epoch handshake: stamp
+        the cached epoch, and on a 409 that carries the live epoch in
+        its reply header, refresh the cache and retry exactly once."""
+        headers = dict(headers or {})
+        known = self._placement_epochs.get(store)
+        if known is not None:
+            headers[PLACEMENT_EPOCH_HEADER] = str(known)
+        status, resp_headers, body = await self._request(
+            method, path, json_body=json_body, headers=headers)
+        fresh = resp_headers.get(PLACEMENT_EPOCH_HEADER)
+        if status == 409 and fresh is not None:
+            self._placement_epochs[store] = int(fresh)
+            headers[PLACEMENT_EPOCH_HEADER] = fresh
+            status, resp_headers, body = await self._request(
+                method, path, json_body=json_body, headers=headers)
+            fresh = resp_headers.get(PLACEMENT_EPOCH_HEADER)
+            if status == 409 and fresh is not None:
+                # flipped again mid-retry — surface the typed error so
+                # resiliency policies can decide, cache the newest epoch
+                self._placement_epochs[store] = int(fresh)
+                raise PlacementEpochError(
+                    f"store {store!r} placement epoch advanced twice "
+                    f"during one call", current_epoch=int(fresh))
+        return status, resp_headers, body
+
     @staticmethod
     def _raise(status: int, body: bytes, *, context: str,
                headers: dict[str, str] | None = None) -> None:
@@ -234,6 +269,10 @@ class _HTTPTransport(_Transport):
         except (ValueError, AttributeError):
             message = body[:200].decode("utf-8", "replace")
         exc_type: type[TasksRunnerError]
+        if status == 409 and headers and PLACEMENT_EPOCH_HEADER in headers:
+            raise PlacementEpochError(
+                f"{context}: {message or status}",
+                current_epoch=int(headers[PLACEMENT_EPOCH_HEADER]))
         if status == 409 and "actor" in context:
             exc_type = ActorFencedError
         elif status == 409:
@@ -252,13 +291,14 @@ class _HTTPTransport(_Transport):
         raise exc
 
     async def save_state(self, store, items):
-        status, headers, body = await self._request(
-            "POST", f"/v1.0/state/{store}", json_body=items)
+        status, headers, body = await self._state_request(
+            "POST", f"/v1.0/state/{store}", store, json_body=items)
         if status >= 300:
             self._raise(status, body, context=f"save state {store}", headers=headers)
 
     async def get_state(self, store, key):
-        status, headers, body = await self._request("GET", f"/v1.0/state/{store}/{key}")
+        status, headers, body = await self._state_request(
+            "GET", f"/v1.0/state/{store}/{key}", store)
         if status == 204 or (status == 200 and not body):
             return None
         if status >= 300:
@@ -267,29 +307,30 @@ class _HTTPTransport(_Transport):
                          etag=headers.get("etag", ""))
 
     async def delete_state(self, store, key, etag):
-        headers = {"if-match": etag} if etag else {}
-        status, headers, body = await self._request(
-            "DELETE", f"/v1.0/state/{store}/{key}", headers=headers)
+        req_headers = {"if-match": etag} if etag else {}
+        status, headers, body = await self._state_request(
+            "DELETE", f"/v1.0/state/{store}/{key}", store, headers=req_headers)
         if status >= 300:
             self._raise(status, body, context=f"delete state {store}", headers=headers)
 
     async def bulk_get_state(self, store, keys):
-        status, headers, body = await self._request(
-            "POST", f"/v1.0/state/{store}/bulk", json_body={"keys": keys})
+        status, headers, body = await self._state_request(
+            "POST", f"/v1.0/state/{store}/bulk", store,
+            json_body={"keys": keys})
         if status >= 300:
             self._raise(status, body, context=f"bulk get state {store}", headers=headers)
         return json.loads(body)
 
     async def query_state(self, store, query):
-        status, headers, body = await self._request(
-            "POST", f"/v1.0/state/{store}/query", json_body=query)
+        status, headers, body = await self._state_request(
+            "POST", f"/v1.0/state/{store}/query", store, json_body=query)
         if status >= 300:
             self._raise(status, body, context=f"query state {store}", headers=headers)
         return json.loads(body)
 
     async def transact_state(self, store, operations):
-        status, headers, body = await self._request(
-            "POST", f"/v1.0/state/{store}/transaction",
+        status, headers, body = await self._state_request(
+            "POST", f"/v1.0/state/{store}/transaction", store,
             json_body={"operations": operations})
         if status >= 300:
             self._raise(status, body, context=f"state transaction {store}", headers=headers)

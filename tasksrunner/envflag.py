@@ -140,6 +140,17 @@ FLAGS: dict[str, Flag] = dict([
        "replication records kept per member; gaps beyond resync via snapshot"),
     _f("TASKSRUNNER_REPL_MAX_LAG_RECORDS", "int", "256",
        "follower lag bound for stale-tolerant reads (followerReads)"),
+    _f("TASKSRUNNER_RESHARD", "bool", "off",
+       "orchestrator elastic-placement control loop (heat ranking + "
+       "rebalance planning over sharded stores)"),
+    _f("TASKSRUNNER_RESHARD_HEAT_THRESHOLD", "float", "50",
+       "EWMA write rate (ops/s) above which a shard counts as hot"),
+    _f("TASKSRUNNER_RESHARD_HYSTERESIS_SECONDS", "float", "10",
+       "how long a shard must stay above the heat threshold before it "
+       "ranks hot (spikes below this never trigger a rebalance)"),
+    _f("TASKSRUNNER_RESHARD_PAUSE_BUDGET_SECONDS", "float", "2",
+       "write-pause ceiling for the fenced routing flip; a measured "
+       "pause beyond it logs a warning with the drain time"),
     _f("TASKSRUNNER_SLOW_THRESHOLD_SECONDS", "float", "0.25",
        "latency above which histogram observations capture trace exemplars"),
     _f("TASKSRUNNER_SOAK", "bool", "off",

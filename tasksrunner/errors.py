@@ -156,6 +156,28 @@ class StaleReadError(ReplicationError):
     http_status = 503
 
 
+class PlacementEpochError(StateError):
+    """A state request carried a routing-table epoch that does not
+    match the store's current placement epoch.
+
+    Every elastic-placement flip (live migration, shard split) bumps
+    the :class:`~tasksrunner.state.placement.PlacementMap` epoch, and
+    the sidecar validates the caller's ``x-tasksrunner-placement-epoch``
+    header against it on every state request. A mismatch means the
+    caller routed with a stale (or not-yet-seen) table; nothing was
+    attempted, so nothing can be lost — the 409 response carries the
+    server's current epoch and the client refreshes its map and
+    retries. Same fail-closed contract as :class:`NotLeaderError`, one
+    layer up: routing races surface as redirects, never as writes
+    applied at the wrong shard."""
+
+    http_status = 409
+
+    def __init__(self, message: str, *, current_epoch: int):
+        super().__init__(message)
+        self.current_epoch = int(current_epoch)
+
+
 class QueryError(StateError):
     """Malformed state query or store without query support.
 

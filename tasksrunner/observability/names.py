@@ -44,6 +44,9 @@ COUNTERS: dict[str, str] = {
     "ml_shed_total": "inference submits shed because the batch queue was full",
     "repl_fenced_total": "shard-leader sessions fenced by an epoch bump",
     "repl_failover_total": "shard leadership takeovers (epoch > 1 acquisitions)",
+    "placement_flips_total": "routing-epoch flips committed by fenced handoffs, per store",
+    "placement_keys_moved_total": "keys streamed between shards by live migration/split, per store",
+    "placement_stale_routes_total": "state requests 409-redirected for a stale routing epoch, per store",
     "workflow_started_total": "workflow instances started, by workflow",
     "workflow_completed_total": "workflow instances reaching a terminal status, by workflow and status",
     "workflow_activity_total": "workflow activity executions, by activity and status",
@@ -67,6 +70,9 @@ GAUGES: dict[str, str] = {
     "actor_owned": "actor activations this replica currently owns, per type",
     "repl_epoch": "current shard leadership epoch, per store and shard",
     "repl_follower_lag_records": "records a follower trails the leader by",
+    "placement_epoch": "current routing-table epoch, per store",
+    "shard_heat": "EWMA write rate (ops/s), per store and shard",
+    "placement_pause_seconds": "write-pause length of the last fenced flip, per store",
     "ml_queue_depth": "inference requests waiting for micro-batch assembly",
     "ml_tokens_in_flight": "tokens queued or executing in the inference plane",
 }

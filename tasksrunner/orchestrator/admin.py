@@ -71,6 +71,7 @@ class AdminServer:
         app.router.add_post("/admin/apps/{app_id}/scale", self._scale)
         app.router.add_get("/admin/apps/{app_id}/metrics", self._metrics)
         app.router.add_get("/admin/actors", self._actors)
+        app.router.add_get("/admin/placement", self._placement)
         app.router.add_get("/admin/traces/{trace_id}", self._traces)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
@@ -243,6 +244,38 @@ class AdminServer:
             "percentiles": summarize_histograms(merged_hist),
             "histograms": merged_hist,
         })
+
+    async def _placement(self, request):
+        """Cluster elastic-placement view: per app, per sharded store —
+        routing epoch, shard→host assignment, hot/cold ranking, any
+        in-flight migration, and the control loop's rebalance plan.
+        With TASKSRUNNER_RESHARD on this serves the live controllers'
+        last sweep; with it off it runs one sweep on demand, so
+        ``tasksrunner shards`` always answers."""
+        from aiohttp import web
+
+        controllers = getattr(self.orch, "placement", {})
+        apps = {}
+        if controllers:
+            for app_id, controller in sorted(controllers.items()):
+                apps[app_id] = controller.snapshot()
+            return web.json_response({"reshard": True, "apps": apps})
+        from tasksrunner.orchestrator.placement import PlacementController
+
+        tokens = self.orch.config.app_tokens
+        for app_id in sorted(self.orch.replicas):
+            controller = PlacementController(
+                app_id,
+                lambda a=app_id: self.orch._replica_info(a),
+                api_token=(tokens.get(app_id) if tokens
+                           else os.environ.get(TOKEN_ENV)),
+            )
+            try:
+                await controller.step()
+                apps[app_id] = controller.snapshot()
+            finally:
+                await asyncio.shield(controller.stop())
+        return web.json_response({"reshard": False, "apps": apps})
 
     async def _actors(self, request):
         """Cluster actor view: the placement table (type → id → owner →

@@ -1,0 +1,186 @@
+"""The orchestrator's elastic-placement control loop.
+
+Closes the loop the state layer's heat telemetry opens
+(state/placement.py): every sharded store publishes per-shard write
+rates, hot-key sketches, and its routing epoch through the sidecar
+metadata endpoint; this controller sweeps those documents across an
+app's replicas, merges them into one hot/cold ranking per store, and
+keeps a rebalance *plan* — "split shard 2, it is hot across many
+keys" / "move shard 0 to the coldest host, one key dominates it".
+
+Deliberately advisory in this milestone: the controller computes and
+publishes the plan (``/admin/placement``, ``tasksrunner shards``); the
+migrations themselves run through
+:meth:`~tasksrunner.state.sharding.ShardedStateStore.migrate_shard` /
+``split_shard`` on the store's owning process, because only that
+process can hold the write-pause barrier. Auto-executing the plan is
+the same wiring the autoscaler uses for ``set_replicas`` and can be
+layered on without touching the data plane.
+
+Gated by ``TASKSRUNNER_RESHARD`` — off by default, like every control
+loop in this repo; the telemetry underneath is always on (it is a few
+counters per write).
+
+The poll cadence is deliberately lazier than the autoscaler's 0.5 s:
+heat EWMAs move on multi-second half-lives and hysteresis windows are
+~10 s, so polling faster than ~2 s buys nothing but sidecar load.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Callable
+
+from tasksrunner.state.placement import (
+    heat_threshold_default,
+    merge_heat_docs,
+    plan_rebalance,
+    rank_shards,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class PlacementController:
+    """Per-app sweep of sidecar placement telemetry → ranked plan."""
+
+    def __init__(self, app_id: str,
+                 replica_info: Callable[[], list[dict]], *,
+                 api_token: str | None = None,
+                 interval: float = 2.0):
+        self.app_id = app_id
+        self.replica_info = replica_info
+        self.api_token = api_token
+        self.interval = interval
+        #: store name → merged view (epoch, ranking, plan, migration);
+        #: replaced wholesale each sweep, read by the admin endpoint
+        self.view: dict[str, dict] = {}
+        self.last_sweep: float | None = None
+        self._task: asyncio.Task | None = None
+        self._warned_unreachable = False
+
+    # -- one sweep -------------------------------------------------------
+
+    async def _fetch_metadata(self) -> list[dict]:
+        """Collect ``/v1.0/metadata`` from every live replica sidecar
+        (the autoscaler's target-p99 sweep, reused verbatim in shape).
+        Unreachable replicas contribute nothing — a mid-restart replica
+        must not wedge the control loop."""
+        import aiohttp
+
+        from tasksrunner.security import TOKEN_HEADER
+
+        headers = {TOKEN_HEADER: self.api_token} if self.api_token else {}
+        docs: list[dict] = []
+        async with aiohttp.ClientSession() as session:
+            for info in self.replica_info():
+                port = info.get("sidecar_port")
+                if not port:
+                    continue
+                url = f"http://127.0.0.1:{port}/v1.0/metadata"
+                try:
+                    async with session.get(
+                            url, headers=headers,
+                            timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                        if resp.status == 200:
+                            docs.append(await resp.json())
+                except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                    continue
+        return docs
+
+    def _merge(self, docs: list[dict]) -> dict[str, dict]:
+        """Fold each replica's per-store placement documents into one
+        view per store: epoch/shards/assignment come from the document
+        with the HIGHEST epoch (the freshest routing truth wins — a
+        replica that missed a flip reports a stale map), heat rates are
+        summed across replicas before ranking."""
+        per_store: dict[str, list[dict]] = {}
+        for doc in docs:
+            for store, pdoc in (doc.get("placement") or {}).items():
+                if isinstance(pdoc, dict):
+                    per_store.setdefault(store, []).append(pdoc)
+        threshold = heat_threshold_default()
+        view: dict[str, dict] = {}
+        for store, pdocs in sorted(per_store.items()):
+            freshest = max(pdocs, key=lambda d: int(d.get("epoch", 0)))
+            rates = merge_heat_docs(pdocs)
+            ranking = rank_shards(rates, threshold=threshold)
+            # cluster-level planning doc: the freshest routing truth
+            # carrying the SUMMED rates, the union of past-hysteresis
+            # shards, and every replica's hot-key sketch
+            hot: set[int] = set()
+            top_keys: dict[str, list[str]] = {}
+            for d in pdocs:
+                heat = d.get("heat") or {}
+                hot.update(int(i) for i in (heat.get("hot") or []))
+                for shard, keys in (heat.get("top_keys") or {}).items():
+                    bucket = top_keys.setdefault(str(shard), [])
+                    bucket.extend(k for k in keys if k not in bucket)
+            merged_doc = dict(freshest)
+            merged_doc["heat"] = {"rates": rates, "hot": sorted(hot),
+                                  "top_keys": top_keys}
+            plan = plan_rebalance(merged_doc, threshold=threshold)
+            view[store] = {
+                "store": store,
+                "epoch": int(freshest.get("epoch", 0)),
+                "shards": int(freshest.get("shards", 0)),
+                "assignment": freshest.get("assignment") or {},
+                "leaders": freshest.get("leaders") or {},
+                "migration": freshest.get("migration"),
+                "replicas_reporting": len(pdocs),
+                "ranking": ranking,
+                "plan": plan,
+            }
+        return view
+
+    async def step(self) -> dict[str, dict]:
+        docs = await self._fetch_metadata()
+        if not docs:
+            if not self._warned_unreachable:
+                self._warned_unreachable = True
+                logger.warning("placement sweep for %s reached no replicas",
+                               self.app_id)
+            return self.view
+        self._warned_unreachable = False
+        self.view = self._merge(docs)
+        self.last_sweep = time.time()
+        for store, entry in self.view.items():
+            plan = entry.get("plan")
+            if plan and plan.get("action"):
+                logger.info(
+                    "placement plan for %s/%s: %s shard %s (%s)",
+                    self.app_id, store, plan["action"], plan.get("shard"),
+                    plan.get("reason"))
+        return self.view
+
+    def snapshot(self) -> dict:
+        """The admin endpoint's document for this app."""
+        return {
+            "app_id": self.app_id,
+            "last_sweep": self.last_sweep,
+            "stores": self.view,
+        }
+
+    # -- lifecycle (AutoscaleController's shape) -------------------------
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.step()
+            except Exception:
+                logger.exception("placement sweep failed for %s", self.app_id)
+            await asyncio.sleep(self.interval)
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self.run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None

@@ -27,6 +27,7 @@ import sys
 import time
 import uuid
 
+from tasksrunner.envflag import env_flag
 from tasksrunner.orchestrator.autoscale import AutoscaleController
 from tasksrunner.orchestrator.config import AppSpec, RunConfig
 from tasksrunner.security import TOKEN_ENV as _TOKEN_ENV
@@ -327,6 +328,9 @@ class Orchestrator:
         self.replicas: dict[str, list[Replica]] = {}
         self._supervisors: list[asyncio.Task] = []
         self._scalers: list[AutoscaleController] = []
+        #: per-app elastic-placement sweeps (TASKSRUNNER_RESHARD);
+        #: app_id → controller, read by /admin/placement
+        self.placement: dict[str, "PlacementController"] = {}
         self._components = (
             load_components(config.resources_path) if config.resources_path else []
         )
@@ -418,6 +422,19 @@ class Orchestrator:
                 )
                 scaler.start()
                 self._scalers.append(scaler)
+            if env_flag("TASKSRUNNER_RESHARD", default=False):
+                from tasksrunner.orchestrator.placement import (
+                    PlacementController,
+                )
+                controller = PlacementController(
+                    app.app_id,
+                    lambda a=app: self._replica_info(a.app_id),
+                    api_token=(self.config.app_tokens.get(app.app_id)
+                               if self.config.app_tokens
+                               else os.environ.get(_TOKEN_ENV)),
+                )
+                controller.start()
+                self.placement[app.app_id] = controller
         from tasksrunner.orchestrator.admin import AdminServer
         self._admin = AdminServer(self, port=self.config.admin_port)
         await self._admin.start()
@@ -750,6 +767,9 @@ class Orchestrator:
             self._admin = None
         for scaler in self._scalers:
             await scaler.stop()
+        for controller in self.placement.values():
+            await controller.stop()
+        self.placement.clear()
         for group in self.replicas.values():
             for replica in group:
                 await replica.stop()
@@ -796,6 +816,9 @@ class Orchestrator:
         for scaler in self._scalers:
             await scaler.stop()
         self._scalers.clear()
+        for controller in self.placement.values():
+            await controller.stop()
+        self.placement.clear()
         doomed: list[asyncio.Task] = list(self._supervisors)
         self._supervisors.clear()
         for task in doomed:

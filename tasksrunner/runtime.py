@@ -226,6 +226,19 @@ class Runtime:
         prefixer = KeyPrefixer(strategy, app_id=self.app_id, component_name=name)
         return store, prefixer
 
+    def check_placement_epoch(self, store_name: str,
+                              epoch: int | None) -> None:
+        """Validate a caller's routing epoch against the store's live
+        placement map (elastic placement, PR 20). Stores without a map
+        (unsharded engines) and callers without the header pass — only
+        a sharded store + an explicit epoch can 409-redirect."""
+        if epoch is None:
+            return
+        store = self.registry.get(store_name, block="state")
+        check = getattr(store, "check_epoch", None)
+        if check is not None:
+            check(epoch)
+
     # -- state -----------------------------------------------------------
 
     async def save_state(self, store_name: str, items: list[dict]) -> None:
@@ -941,6 +954,16 @@ class Runtime:
             out["actors"] = self.actors.summary()
         if self.workflows is not None:
             out["workflows"] = self.workflows.summary()
+        placement = {}
+        for n in self.registry.names("state"):
+            # metadata() is a read path: report stores that are already
+            # built, never instantiate one as a side effect
+            instance = self.registry._instances.get(n)
+            doc_of = getattr(instance, "placement_doc", None)
+            if doc_of is not None:
+                placement[n] = doc_of()
+        if placement:
+            out["placement"] = placement
         return out
 
     async def stop(self) -> None:

@@ -41,6 +41,7 @@ from tasksrunner.observability.tracing import (
 )
 from tasksrunner.runtime import Runtime
 from tasksrunner.state.base import StateItem
+from tasksrunner.state.placement import PLACEMENT_EPOCH_HEADER
 
 logger = logging.getLogger(__name__)
 
@@ -53,7 +54,14 @@ def _json_error(exc: Exception) -> web.Response:
     status = exc.http_status if isinstance(exc, TasksRunnerError) else 500
     if not isinstance(exc, TasksRunnerError):
         logger.exception("unhandled sidecar error")
-    return web.json_response({"error": str(exc) or type(exc).__name__}, status=status)
+    headers = None
+    current_epoch = getattr(exc, "current_epoch", None)
+    if current_epoch is not None:
+        # placement 409: carry the live epoch so the caller refreshes
+        # its routing cache from the rejection itself (no extra probe)
+        headers = {PLACEMENT_EPOCH_HEADER: str(current_epoch)}
+    return web.json_response({"error": str(exc) or type(exc).__name__},
+                             status=status, headers=headers)
 
 
 from tasksrunner.security import (  # noqa: E402 (re-export)
@@ -150,18 +158,34 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
 
     # -- state ----------------------------------------------------------
 
+    def _check_placement(request: web.Request) -> None:
+        # elastic placement: a routing-aware client stamps the epoch it
+        # routed with; mismatch → 409 with the live epoch in the reply
+        # header (_json_error), BEFORE the operation touches any shard
+        raw = request.headers.get(PLACEMENT_EPOCH_HEADER)
+        if raw is None:
+            return
+        try:
+            epoch = int(raw)
+        except ValueError:
+            raise ValidationError(
+                f"bad {PLACEMENT_EPOCH_HEADER} header: {raw!r}") from None
+        runtime.check_placement_epoch(request.match_info["store"], epoch)
+
     @routes.post("/v1.0/state/{store}")
     @_traced
     async def save_state(request: web.Request):
         items = await request.json()
         if not isinstance(items, list):
             raise ValidationError("state save body must be a list of {key, value}")
+        _check_placement(request)
         await runtime.save_state(request.match_info["store"], items)
         return web.Response(status=204)
 
     @routes.get("/v1.0/state/{store}/{key}")
     @_traced
     async def get_state(request: web.Request):
+        _check_placement(request)
         item: StateItem | None = await runtime.get_state(
             request.match_info["store"], request.match_info["key"])
         if item is None:
@@ -172,6 +196,7 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
     @_traced
     async def delete_state(request: web.Request):
         etag = request.headers.get("if-match")
+        _check_placement(request)
         await runtime.delete_state(request.match_info["store"],
                                    request.match_info["key"], etag=etag)
         return web.Response(status=204)
@@ -183,20 +208,24 @@ def build_sidecar_app(runtime: Runtime, *, api_token: str | None = None,
         keys = body.get("keys") if isinstance(body, dict) else body
         if not isinstance(keys, list):
             raise ValidationError("bulk get body must be {\"keys\": [...]}")
+        _check_placement(request)
         result = await runtime.bulk_get_state(request.match_info["store"], keys)
         return web.json_response(result)
 
     @routes.post("/v1.0/state/{store}/query")
     @_traced
     async def query_state(request: web.Request):
+        body = await request.json()
+        _check_placement(request)
         result = await runtime.query_state(
-            request.match_info["store"], await request.json())
+            request.match_info["store"], body)
         return web.json_response(result)
 
     @routes.post("/v1.0/state/{store}/transaction")
     @_traced
     async def transact_state(request: web.Request):
         body = await request.json()
+        _check_placement(request)
         await runtime.transact_state(
             request.match_info["store"], body.get("operations", []))
         return web.Response(status=204)

@@ -1,0 +1,308 @@
+"""Elastic shard placement: the epoched routing table and the load
+signals that drive it.
+
+PR 5's :class:`~tasksrunner.state.sharding.ShardRouter` answers *which
+shard* a key belongs to — a pure function of ``(key, seed, shards)``,
+frozen at component build. This module adds the mutable layer the
+control loop needs to move shards while they serve:
+
+:class:`PlacementMap`
+    version + per-shard host assignment, layered over the HRW router.
+    Every live migration or shard split commits by *replacing* the map
+    with a successor whose ``epoch`` is strictly higher — one attribute
+    store, atomic under asyncio — and every state request is validated
+    against the current epoch (``ShardedStateStore.check_epoch``). A
+    stale router therefore gets a 409-with-new-epoch redirect
+    (:class:`~tasksrunner.errors.PlacementEpochError`), never a write
+    applied at the wrong shard. Same fencing contract as the actor
+    placement table (PR 7) and the shard lease (PR 9), one layer up.
+
+:class:`ShardHeatTracker`
+    per-shard write-rate EWMA plus a bounded hot-key sketch. The
+    orchestrator's control loop (orchestrator/placement.py) merges
+    these across replicas into the hot/cold ranking; hysteresis lives
+    here too — a shard ranks hot only after staying above the
+    threshold for a full ``TASKSRUNNER_RESHARD_HYSTERESIS_SECONDS``
+    window, so a spike cannot trigger rebalance thrash.
+
+The helpers at the bottom (:func:`merge_heat_docs`,
+:func:`rank_shards`, :func:`plan_rebalance`) are the pure planning
+half of the control loop, kept here so tests exercise them without an
+orchestrator.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Iterable
+
+from tasksrunner.errors import ComponentError
+
+__all__ = [
+    "PLACEMENT_EPOCH_HEADER", "PlacementMap", "ShardHeatTracker",
+    "heat_threshold_default", "hysteresis_default",
+    "pause_budget_default", "merge_heat_docs", "rank_shards",
+    "plan_rebalance",
+]
+
+#: request header a routing-aware client sends with its cached epoch;
+#: the sidecar echoes it on a 409 carrying the CURRENT epoch, so one
+#: round trip both rejects the stale write and refreshes the cache
+PLACEMENT_EPOCH_HEADER = "x-tasksrunner-placement-epoch"
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def heat_threshold_default() -> float:
+    return _env_float("TASKSRUNNER_RESHARD_HEAT_THRESHOLD", 50.0)
+
+
+def hysteresis_default() -> float:
+    return _env_float("TASKSRUNNER_RESHARD_HYSTERESIS_SECONDS", 10.0)
+
+
+def pause_budget_default() -> float:
+    return _env_float("TASKSRUNNER_RESHARD_PAUSE_BUDGET_SECONDS", 2.0)
+
+
+class PlacementMap:
+    """The epoched routing table for one sharded store.
+
+    Immutable by convention: mutation happens by building a successor
+    via :meth:`advanced` and publishing it with a single attribute
+    store inside the fenced flip. ``assignment`` maps shard index →
+    host/member label (``None`` entries mean "wherever the component
+    was built" — the pre-elastic default); ``migration`` is the
+    in-flight session's status document or ``None``.
+    """
+
+    __slots__ = ("epoch", "shards", "assignment", "migration")
+
+    def __init__(self, *, shards: int, epoch: int = 1,
+                 assignment: dict[int, str] | None = None,
+                 migration: dict | None = None):
+        if shards < 1:
+            raise ComponentError(
+                f"placement map needs >= 1 shard, not {shards}")
+        self.epoch = int(epoch)
+        self.shards = int(shards)
+        self.assignment: dict[int, str] = dict(assignment or {})
+        self.migration = migration
+
+    def advanced(self, *, shards: int | None = None,
+                 assignment: dict[int, str] | None = None,
+                 migration: dict | None = None) -> "PlacementMap":
+        """The successor map at ``epoch + 1`` — the only way the epoch
+        moves, so it can never move backwards."""
+        merged = dict(self.assignment)
+        if assignment:
+            merged.update(assignment)
+        return PlacementMap(
+            shards=self.shards if shards is None else shards,
+            epoch=self.epoch + 1, assignment=merged, migration=migration)
+
+    def with_migration(self, migration: dict | None) -> "PlacementMap":
+        """Same epoch, updated in-flight status — status is telemetry,
+        not routing, so publishing it must NOT invalidate routers."""
+        return PlacementMap(shards=self.shards, epoch=self.epoch,
+                            assignment=self.assignment, migration=migration)
+
+    def to_doc(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "shards": self.shards,
+            "assignment": {str(k): v for k, v in self.assignment.items()},
+            "migration": self.migration,
+        }
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "PlacementMap":
+        return cls(
+            shards=int(doc.get("shards", 1)),
+            epoch=int(doc.get("epoch", 1)),
+            assignment={int(k): v
+                        for k, v in (doc.get("assignment") or {}).items()},
+            migration=doc.get("migration"))
+
+
+class ShardHeatTracker:
+    """Per-shard write-rate EWMA + hysteresis + bounded hot-key sketch.
+
+    ``note_write`` is on the facade's hot path, so it only bumps two
+    counters; the EWMA fold happens in :meth:`sample`, called from the
+    metadata/placement poll (and directly by tests). The hot-key
+    sketch is lossy counting: the per-shard table is capped, and when
+    full every count halves and zeros drop — heavy hitters survive,
+    the long tail cannot grow the table.
+    """
+
+    #: per-shard hot-key table cap (halve-and-prune beyond this)
+    KEY_CAP = 64
+
+    def __init__(self, shards: int, *, halflife: float = 5.0,
+                 threshold: float | None = None,
+                 hysteresis: float | None = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.halflife = float(halflife)
+        self.threshold = (heat_threshold_default()
+                          if threshold is None else float(threshold))
+        self.hysteresis = (hysteresis_default()
+                           if hysteresis is None else float(hysteresis))
+        self._clock = clock
+        self._counts: list[int] = [0] * shards
+        self._rates: list[float] = [0.0] * shards
+        self._hot_since: list[float | None] = [None] * shards
+        self._key_counts: list[dict[str, int]] = [{} for _ in range(shards)]
+        self._last_sample = clock()
+
+    @property
+    def shards(self) -> int:
+        return len(self._rates)
+
+    def grow(self, extra: int = 1) -> None:
+        """Ring grew (shard split): new shards start cold."""
+        self._counts.extend([0] * extra)
+        self._rates.extend([0.0] * extra)
+        self._hot_since.extend([None] * extra)
+        self._key_counts.extend({} for _ in range(extra))
+
+    def note_write(self, shard: int, key: str | None = None) -> None:
+        self._counts[shard] += 1
+        if key is not None:
+            table = self._key_counts[shard]
+            table[key] = table.get(key, 0) + 1
+            if len(table) > self.KEY_CAP:
+                self._key_counts[shard] = {
+                    k: c // 2 for k, c in table.items() if c // 2 > 0}
+
+    def sample(self, now: float | None = None) -> list[float]:
+        """Fold the counts accumulated since the last sample into the
+        EWMA rates and advance the hysteresis clocks. Idempotent at
+        zero elapsed time."""
+        if now is None:
+            now = self._clock()
+        dt = now - self._last_sample
+        if dt <= 0.0:
+            return list(self._rates)
+        self._last_sample = now
+        # alpha → 1 as dt >> halflife: stale history decays away even
+        # when the poller calls rarely
+        alpha = 1.0 - 0.5 ** (dt / self.halflife)
+        for i, count in enumerate(self._counts):
+            inst = count / dt
+            self._counts[i] = 0
+            rate = self._rates[i] + alpha * (inst - self._rates[i])
+            self._rates[i] = rate
+            if rate >= self.threshold:
+                if self._hot_since[i] is None:
+                    self._hot_since[i] = now
+            else:
+                self._hot_since[i] = None
+        return list(self._rates)
+
+    def rates(self) -> list[float]:
+        return list(self._rates)
+
+    def hot_shards(self, now: float | None = None) -> list[int]:
+        """Shards that have been above the threshold for the whole
+        hysteresis window — the only ones the planner may act on."""
+        if now is None:
+            now = self._clock()
+        return [i for i, since in enumerate(self._hot_since)
+                if since is not None and now - since >= self.hysteresis]
+
+    def hot_keys(self, shard: int, limit: int = 8) -> list[tuple[str, int]]:
+        table = self._key_counts[shard]
+        return sorted(table.items(), key=lambda kv: -kv[1])[:limit]
+
+    def snapshot(self, now: float | None = None) -> dict:
+        if now is None:
+            now = self._clock()
+        return {
+            "rates": [round(r, 3) for r in self._rates],
+            "hot": self.hot_shards(now),
+            "threshold": self.threshold,
+            "hysteresis_seconds": self.hysteresis,
+            "top_keys": {
+                str(i): [k for k, _ in self.hot_keys(i)]
+                for i in range(self.shards) if self._key_counts[i]
+            },
+        }
+
+
+# -- control-loop planning (pure functions over telemetry docs) -----------
+
+def merge_heat_docs(docs: Iterable[dict]) -> list[float]:
+    """Sum per-shard EWMA rates across replica telemetry docs (each
+    replica owns its own store instance, so cluster heat is the sum)."""
+    merged: list[float] = []
+    for doc in docs:
+        rates = (doc.get("heat") or {}).get("rates") or []
+        if len(rates) > len(merged):
+            merged.extend([0.0] * (len(rates) - len(merged)))
+        for i, r in enumerate(rates):
+            merged[i] += float(r)
+    return merged
+
+
+def rank_shards(rates: list[float], *,
+                threshold: float | None = None) -> list[dict]:
+    """Hot/cold ranking, hottest first — the admin/CLI view."""
+    if threshold is None:
+        threshold = heat_threshold_default()
+    ranked = [
+        {"shard": i, "rate": round(r, 3), "hot": r >= threshold}
+        for i, r in enumerate(rates)
+    ]
+    ranked.sort(key=lambda row: -row["rate"])
+    for rank, row in enumerate(ranked):
+        row["rank"] = rank
+    return ranked
+
+
+def plan_rebalance(store_doc: dict, *,
+                   threshold: float | None = None) -> dict | None:
+    """One proposed action for one store's merged telemetry, or None.
+
+    A shard that is hot because one key dominates cannot be cooled by
+    moving it (the key moves with it) — that's the split case; a shard
+    that is hot across many keys moves to the coldest assignment.
+    Only shards past the hysteresis window (``heat.hot``) are
+    considered, so the plan inherits the anti-thrash guarantee.
+    """
+    if threshold is None:
+        threshold = heat_threshold_default()
+    heat = store_doc.get("heat") or {}
+    rates = [float(r) for r in (heat.get("rates") or [])]
+    hot = [i for i in (heat.get("hot") or []) if i < len(rates)]
+    if not hot:
+        return None
+    hottest = max(hot, key=lambda i: rates[i])
+    top_keys = (heat.get("top_keys") or {}).get(str(hottest)) or []
+    if len(top_keys) > 1:
+        # hot *internally* — many warm keys: growing the ring streams
+        # ~1/(N+1) of them to a fresh shard (the ISSUE's split case)
+        action = "split"
+    else:
+        # one dominant key (or no sketch): splitting cannot separate
+        # it from itself — relocate the shard to the coldest host
+        action = "move"
+    coldest = min(range(len(rates)), key=lambda i: rates[i])
+    return {
+        "store": store_doc.get("store"),
+        "action": action,
+        "shard": hottest,
+        "rate": round(rates[hottest], 3),
+        "coldest_shard": coldest,
+        "reason": (f"shard {hottest} sustained "
+                   f"{rates[hottest]:.1f} ops/s >= {threshold:.1f}"),
+    }

@@ -51,7 +51,8 @@ from typing import Any, Callable
 
 from tasksrunner.errors import (
     ComponentError, EtagMismatch, NotLeaderError, ReplicaFencedError,
-    ReplicationGapError, ReplicationQuorumError, StaleReadError, StateError,
+    ReplicationError, ReplicationGapError, ReplicationQuorumError,
+    StaleReadError, StateError,
 )
 from tasksrunner.ids import hex8
 from tasksrunner.observability.metrics import metrics
@@ -812,6 +813,105 @@ class ReplicaSetStore(StateStore):
                 link.chaos = policies.for_replication(
                     self.name, self.shard, member_id)
 
+    def member_lag(self, member: str) -> int | None:
+        """Records ``member`` trails the current leader by, or None
+        when no live leader session exists to measure against. The
+        elastic-placement catch-up loop polls this before attempting a
+        handoff — shipping is continuous, so the lag converges to 0 on
+        its own once the writer quiesces."""
+        leader = next(
+            (n for n in self.nodes if n.is_leader and not n.crashed), None)
+        if leader is None or leader.replicator is None:
+            return None
+        if leader.node_id == member:
+            # the member won a takeover mid-catch-up (leader crash
+            # degraded to ordinary failover): it holds the quorum hwm,
+            # so it trails nobody — measuring it against its own
+            # follower table would read 'infinitely behind' forever
+            return 0
+        hwm, _ = leader.store.repl_position()
+        return max(0, hwm - leader.replicator._member_hwm.get(member, 0))
+
+    async def transfer_leadership(self, member: str, *,
+                                  timeout: float | None = None) -> int:
+        """Fenced leadership handoff to ``member`` — the live-migration
+        transport primitive (PR 20). The caller (the sharded facade's
+        fenced flip) has already quiesced writes, so the leader's hwm
+        is static; this method (1) waits for the target's log to reach
+        it — the ordinary snapshot+log resync ladder does the moving —
+        (2) retires the old leader's session cleanly (nothing pending:
+        the writer is quiesced and drained), (3) hands the lease over,
+        which bumps the epoch exactly like a takeover, and (4) promotes
+        the target through the normal barrier path. A leader crash in
+        the middle degrades to the ordinary failover machinery: the
+        lease expires, a caught-up member promotes, and every acked
+        write survives because it reached the quorum.
+        """
+        await self._ensure_started()
+        target = next(
+            (n for n in self.nodes if n.node_id == member), None)
+        if target is None:
+            raise ReplicationError(
+                f"state store {self.name!r} shard {self.shard}: no "
+                f"member {member!r}")
+        if target.crashed:
+            raise ReplicationError(
+                f"state store {self.name!r} shard {self.shard}: member "
+                f"{member!r} is down")
+        leader = await self._leader_node()
+        if leader is target:
+            _, epoch = leader.store.repl_position()
+            return epoch
+        deadline = time.monotonic() + (
+            float(timeout) if timeout else 2.0 * leader.ack_timeout)
+        while True:
+            if leader.crashed or not leader.is_leader:
+                raise NotLeaderError(
+                    f"state store {self.name!r} shard {self.shard}: "
+                    f"leadership moved mid-transfer — retry against the "
+                    f"new leader")
+            leader_hwm, _ = leader.store.repl_position()
+            try:
+                hwm, _ = target.position()
+            except OSError as exc:
+                raise ReplicationError(
+                    f"state store {self.name!r} shard {self.shard}: "
+                    f"transfer target {member!r} went down") from exc
+            if hwm >= leader_hwm:
+                break
+            if time.monotonic() > deadline:
+                raise ReplicationError(
+                    f"state store {self.name!r} shard {self.shard}: "
+                    f"{member!r} still trails by "
+                    f"{leader_hwm - hwm} records at the transfer deadline")
+            await asyncio.sleep(0.01)
+        # retire the old session before surrendering the lease: the
+        # writer is quiesced, so nothing is pending to fail — this is
+        # the graceful sibling of the crash path's _fence()
+        if leader.replicator is not None:
+            leader.replicator.close()
+        leader.replicator = None
+        leader.store._repl = None
+        await leader.lease.release(leader.node_id)
+        epoch = None
+        for _ in range(3):
+            epoch = await target.lease.acquire(target.node_id)
+            if epoch is not None:
+                break
+            rec = await target.lease.peek()
+            if (rec is not None and rec.get("owner") != target.node_id
+                    and not Lease.holder_gone(rec)):
+                raise NotLeaderError(
+                    f"state store {self.name!r} shard {self.shard}: "
+                    f"{rec.get('owner')!r} won the takeover race during "
+                    f"the transfer to {member!r}")
+        if epoch is None:
+            raise NotLeaderError(
+                f"state store {self.name!r} shard {self.shard}: could "
+                f"not acquire the shard lease for {member!r}")
+        await target._become_leader(epoch)
+        return epoch
+
     # -- writes ------------------------------------------------------------
 
     async def _write(self, fn) -> Any:
@@ -990,8 +1090,8 @@ def build_replicated_store(
     per_cache = (max(1, cache_size // shards)
                  if cache_size and shards > 1 else cache_size)
     meta = SqliteStateStore(f"{name}.repl-meta", _meta_path(str(path)))
-    sets: list[ReplicaSetStore] = []
-    for s in range(shards):
+
+    def _make_set(s: int, *, owns_meta: bool) -> ReplicaSetStore:
         nodes = [
             ReplicationNode(
                 name, _member_path(str(path), s, m, shards),
@@ -1007,10 +1107,17 @@ def build_replicated_store(
                 other.node_id: LocalLink(other)
                 for other in nodes if other is not node
             }
-        sets.append(ReplicaSetStore(
+        return ReplicaSetStore(
             name, nodes, shard=s, follower_reads=follower_reads,
-            max_lag=max_lag, meta_store=meta,
-            owns_meta=(s == shards - 1)))
+            max_lag=max_lag, meta_store=meta, owns_meta=owns_meta)
+
+    sets = [_make_set(s, owns_meta=(s == shards - 1))
+            for s in range(shards)]
     if shards == 1:
         return sets[0]
-    return ShardedStateStore(name, sets, hash_seed=hash_seed)
+    facade = ShardedStateStore(name, sets, hash_seed=hash_seed)
+    # online split (PR 20) mints replica set N+1 through the same
+    # assembly; meta ownership stays with the original last set, which
+    # a split never retires
+    facade._child_factory = lambda s: _make_set(s, owns_meta=False)
+    return facade

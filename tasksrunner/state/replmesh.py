@@ -132,6 +132,18 @@ class ReplicationServer:
                 hwm, epoch = node.position()
                 return ({"ok": True},
                         json.dumps({"hwm": hwm, "epoch": epoch}).encode())
+            if op == "describe":
+                # elastic placement (PR 20): the migration tooling's
+                # view of a remote member — position plus role, enough
+                # for a cross-host catch-up poll without a leader-side
+                # replicator to read _member_hwm from
+                hwm, epoch = node.position()
+                return ({"ok": True}, json.dumps({
+                    "hwm": hwm, "epoch": epoch,
+                    "member": node.node_id,
+                    "leader": node.is_leader,
+                    "needs_resync": node._needs_resync,
+                }).encode())
             return ({"ok": False, "kind": "error",
                      "error": f"unknown replication op {op!r}"}, b"")
         except ReplicationGapError as exc:
@@ -240,6 +252,11 @@ class MeshFollowerLink:
     async def position(self) -> tuple[int, int]:
         reply = await self._request("position", None)
         return int(reply["hwm"]), int(reply["epoch"])
+
+    async def describe(self) -> dict:
+        """Role + position of the remote member (elastic-placement
+        tooling; not on the shipment hot path, so no chaos gate)."""
+        return await self._request("describe", None)
 
     async def aclose(self) -> None:
         await self._teardown()

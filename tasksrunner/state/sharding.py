@@ -48,6 +48,23 @@ single ``BEGIN IMMEDIATE … COMMIT``. Ops spanning shards run two-phase:
 While a shard's transaction is staged its writer thread is parked, so
 queued group-commit flushes on that shard wait behind the decision —
 the commit slot IS the writer thread, no second lock to leak.
+
+Elastic placement — epoched routing over the frozen hash
+--------------------------------------------------------
+
+PR 20 layers a :class:`~tasksrunner.state.placement.PlacementMap` over
+the router: a version (epoch) plus per-shard host assignment, flipped
+atomically by the fenced handoff at the end of a live migration or an
+online shard split. Every facade operation passes a short barrier
+(``_op_gate``) that is open in steady state and closes only for the
+final drain of a flip; callers that present a routing epoch
+(``check_epoch``) get a 409-with-new-epoch redirect when stale. The
+migration data path reuses whatever the children provide: replica-set
+children hand leadership over the PR 9 record stream
+(``transfer_leadership``), plain children stream keys through a
+facade-level dirty-key tap. Growing the ring appends one HRW salt, so
+a split moves an expected ``1/(N+1)`` of the key space — all of it TO
+the new shard, never between survivors.
 """
 
 from __future__ import annotations
@@ -55,15 +72,24 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import heapq
-from typing import Any, Sequence
+import logging
+import time
+from typing import Any, Callable, Sequence
 
 from tasksrunner.errors import (
-    ComponentError, CrossShardAtomicityError, QueryError, StateError,
+    ComponentError, CrossShardAtomicityError, PlacementEpochError,
+    QueryError, StateError,
 )
+from tasksrunner.observability.metrics import metrics
 from tasksrunner.state.base import (
     QueryResponse, StateItem, StateStore, TransactionOp,
 )
+from tasksrunner.state.placement import (
+    PlacementMap, ShardHeatTracker, pause_budget_default,
+)
 from tasksrunner.state.query import paginate, sort_items, validate_filter
+
+logger = logging.getLogger(__name__)
 
 _MASK64 = (1 << 64) - 1
 
@@ -163,7 +189,32 @@ class ShardedStateStore(StateStore):
         if not shards:
             raise ComponentError(f"sharded store {name!r} needs >= 1 shard")
         self._shards = list(shards)
+        self.hash_seed = hash_seed
         self.router = ShardRouter(len(self._shards), hash_seed)
+        #: the epoched routing table (PR 20); replaced atomically by
+        #: the fenced flip, validated by check_epoch on every request
+        self.placement = PlacementMap(shards=len(self._shards))
+        self.heat = ShardHeatTracker(len(self._shards))
+        #: this process's member/host label for locality ranking; None
+        #: (the default) means "no locality information" → rank 1.0
+        self.local_member: str | None = None
+        #: mints child engine N for an online split (wired by the
+        #: builders that know how; None = splits need an explicit target)
+        self._child_factory: Callable[[int], StateStore] | None = None
+        # the flip barrier: open in steady state, closed only for the
+        # final drain of a fenced handoff. Ops count themselves in and
+        # out so the flip can wait for true quiescence, not just an
+        # empty gate.
+        self._op_gate = asyncio.Event()
+        self._op_gate.set()
+        self._inflight = 0
+        self._drained = asyncio.Event()
+        self._drained.set()
+        #: keys written while a migration session copies (None = no
+        #: session); drained round-by-round, finally under the pause
+        self._dirty: set[str] | None = None
+        self._reshard_lock = asyncio.Lock()
+        self._chaos = None  # ChaosPolicies | None
 
     # -- routing -----------------------------------------------------------
 
@@ -174,39 +225,80 @@ class ShardedStateStore(StateStore):
     def shard_count(self) -> int:
         return len(self._shards)
 
+    # -- op barrier / telemetry taps ---------------------------------------
+
+    async def _enter(self) -> None:
+        """Cross the flip barrier and count in. Steady state is one
+        already-set Event check — no suspension, no allocation."""
+        await self._op_gate.wait()
+        self._inflight += 1
+        self._drained.clear()
+
+    def _exit(self) -> None:
+        self._inflight -= 1
+        if self._inflight <= 0:
+            self._drained.set()
+
+    def _note_write(self, key: str) -> None:
+        self.heat.note_write(self.router.shard_of(key), key)
+        if self._dirty is not None:
+            self._dirty.add(key)
+
     # -- single-key ops: pure routing -------------------------------------
 
     async def get(self, key: str) -> StateItem | None:
-        return await self.shard_for(key).get(key)
+        await self._enter()
+        try:
+            return await self.shard_for(key).get(key)
+        finally:
+            self._exit()
 
     async def set(self, key: str, value: Any, *, etag: str | None = None) -> str:
-        return await self.shard_for(key).set(key, value, etag=etag)
+        await self._enter()
+        try:
+            self._note_write(key)
+            return await self.shard_for(key).set(key, value, etag=etag)
+        finally:
+            self._exit()
 
     async def delete(self, key: str, *, etag: str | None = None) -> bool:
-        return await self.shard_for(key).delete(key, etag=etag)
+        await self._enter()
+        try:
+            self._note_write(key)
+            return await self.shard_for(key).delete(key, etag=etag)
+        finally:
+            self._exit()
 
     # -- fan-out reads -----------------------------------------------------
 
     async def bulk_get(self, keys: list[str]) -> list[StateItem | None]:
-        out: list[StateItem | None] = [None] * len(keys)
-        by_shard: dict[int, list[int]] = {}
-        for i, key in enumerate(keys):
-            by_shard.setdefault(self.router.shard_of(key), []).append(i)
-        async def _one(shard_idx: int, idxs: list[int]) -> None:
-            items = await self._shards[shard_idx].bulk_get(
-                [keys[i] for i in idxs])
-            for i, item in zip(idxs, items):
-                out[i] = item
-        await asyncio.gather(
-            *(_one(s, idxs) for s, idxs in by_shard.items()))
-        return out
+        await self._enter()
+        try:
+            out: list[StateItem | None] = [None] * len(keys)
+            by_shard: dict[int, list[int]] = {}
+            for i, key in enumerate(keys):
+                by_shard.setdefault(self.router.shard_of(key), []).append(i)
+            async def _one(shard_idx: int, idxs: list[int]) -> None:
+                items = await self._shards[shard_idx].bulk_get(
+                    [keys[i] for i in idxs])
+                for i, item in zip(idxs, items):
+                    out[i] = item
+            await asyncio.gather(
+                *(_one(s, idxs) for s, idxs in by_shard.items()))
+            return out
+        finally:
+            self._exit()
 
     async def keys(self, *, prefix: str = "") -> list[str]:
-        per_shard = await asyncio.gather(
-            *(s.keys(prefix=prefix) for s in self._shards))
-        # children return sorted lists; k-way merge keeps the facade's
-        # answer identical to the single-file engine's ORDER BY key
-        return list(heapq.merge(*per_shard))
+        await self._enter()
+        try:
+            per_shard = await asyncio.gather(
+                *(s.keys(prefix=prefix) for s in self._shards))
+            # children return sorted lists; k-way merge keeps the facade's
+            # answer identical to the single-file engine's ORDER BY key
+            return list(heapq.merge(*per_shard))
+        finally:
+            self._exit()
 
     async def query(self, query: dict, *, key_prefix: str = "") -> QueryResponse:
         """Scatter the filter, gather + merge, then sort/page at the
@@ -218,9 +310,13 @@ class ShardedStateStore(StateStore):
             raise QueryError("query must be a JSON object")
         filt = query.get("filter")
         validate_filter(filt)
-        per_shard = await asyncio.gather(
-            *(s.query({"filter": filt}, key_prefix=key_prefix)
-              for s in self._shards))
+        await self._enter()
+        try:
+            per_shard = await asyncio.gather(
+                *(s.query({"filter": filt}, key_prefix=key_prefix)
+                  for s in self._shards))
+        finally:
+            self._exit()
         items = list(heapq.merge(
             *(r.items for r in per_shard), key=lambda it: it.key))
         items = sort_items(items, query.get("sort"))
@@ -230,16 +326,21 @@ class ShardedStateStore(StateStore):
     # -- transactions ------------------------------------------------------
 
     async def transact(self, ops: list[TransactionOp]) -> None:
-        by_shard: dict[int, list[TransactionOp]] = {}
-        for op in ops:
-            by_shard.setdefault(self.router.shard_of(op.key), []).append(op)
-        if len(by_shard) <= 1:
-            # the hot path: all keys rendezvous to one shard — exactly
-            # PR 1's single BEGIN IMMEDIATE..COMMIT, no staging at all
-            for shard_idx, shard_ops in by_shard.items():
-                await self._shards[shard_idx].transact(shard_ops)
-            return
-        await self._transact_cross_shard(by_shard)
+        await self._enter()
+        try:
+            by_shard: dict[int, list[TransactionOp]] = {}
+            for op in ops:
+                self._note_write(op.key)
+                by_shard.setdefault(self.router.shard_of(op.key), []).append(op)
+            if len(by_shard) <= 1:
+                # the hot path: all keys rendezvous to one shard — exactly
+                # PR 1's single BEGIN IMMEDIATE..COMMIT, no staging at all
+                for shard_idx, shard_ops in by_shard.items():
+                    await self._shards[shard_idx].transact(shard_ops)
+                return
+            await self._transact_cross_shard(by_shard)
+        finally:
+            self._exit()
 
     async def _transact_cross_shard(
             self, by_shard: dict[int, list[TransactionOp]]) -> None:
@@ -279,6 +380,384 @@ class ShardedStateStore(StateStore):
     async def _rollback_staged(self, staged: list) -> None:
         for _shard_idx, txn in staged:
             await txn.rollback()
+
+    # -- elastic placement: epoch validation + telemetry -------------------
+
+    def check_epoch(self, epoch: int | None) -> None:
+        """Validate a caller's routing epoch against the live map.
+
+        Any mismatch is a redirect: lower means the caller routed with
+        a stale table; higher means OURS is stale (the caller saw a
+        flip this replica hasn't). Either way nothing was attempted —
+        the 409 carries the epoch we do hold and the client refreshes.
+        ``None`` (no header) skips validation for pre-elastic callers.
+        """
+        if epoch is None:
+            return
+        current_epoch = self.placement.epoch
+        if epoch < current_epoch or current_epoch < epoch:
+            metrics.inc("placement_stale_routes_total", store=self.name)
+            raise PlacementEpochError(
+                f"state store {self.name!r}: routing epoch {epoch} does "
+                f"not match placement epoch {current_epoch} — refresh "
+                f"the placement map and retry", current_epoch=current_epoch)
+
+    def placement_doc(self) -> dict:
+        """The telemetry document the sidecar metadata exports and the
+        orchestrator's control loop merges: epoch, assignment,
+        migration status, per-shard heat, and (for replicated
+        children) the current shard leaders."""
+        self.heat.sample()
+        doc = self.placement.to_doc()
+        doc["store"] = self.name
+        doc["heat"] = self.heat.snapshot()
+        doc["local_member"] = self.local_member
+        leaders: dict[str, str | None] = {}
+        for i, child in enumerate(self._shards):
+            leader_of = getattr(child, "leader_member", None)
+            if leader_of is not None:
+                leaders[str(i)] = leader_of()
+        if leaders:
+            doc["leaders"] = leaders
+        metrics.set_gauge("placement_epoch", float(self.placement.epoch),
+                          store=self.name)
+        for i, rate in enumerate(self.heat.rates()):
+            metrics.set_gauge("shard_heat", rate, store=self.name, shard=i)
+        return doc
+
+    def locality_rank(self, key: str) -> float:
+        """1.0 when this process hosts the shard backing ``key`` (or
+        nothing is known), 0.0 when another member owns it — the hint
+        actor placement (PR 7) uses to keep an actor's turns on the
+        host that already holds its records."""
+        if self.local_member is None:
+            return 1.0
+        idx = self.router.shard_of(key)
+        child = self._shards[idx]
+        leader_of = getattr(child, "leader_member", None)
+        owner = (leader_of() if leader_of is not None
+                 else self.placement.assignment.get(idx))
+        if owner is None:
+            return 1.0
+        return 1.0 if owner == self.local_member else 0.0
+
+    def attach_chaos(self, policies) -> None:
+        """Bind ``kind:Chaos`` faults: ``targets.placement`` rules gate
+        the migration/catch-up lane at the facade, and children that
+        carry their own lanes (replication streams) get the policies
+        forwarded (called by chaos/wrappers.py at component build)."""
+        self._chaos = policies
+        for child in self._shards:
+            child_attach = getattr(child, "attach_chaos", None)
+            if child_attach is not None:
+                child_attach(policies)
+
+    async def _placement_gate(self, shard: int) -> None:
+        """Chaos seam on the catch-up stream: consulted before every
+        pre-flip copy batch and catch-up poll — never inside the
+        paused flip, so an injected fault aborts a migration cleanly
+        instead of wedging the barrier."""
+        if self._chaos is None:
+            return
+        resolver = getattr(self._chaos, "for_placement", None)
+        policy = resolver(self.name, shard) if resolver is not None else None
+        if policy is not None:
+            status = await policy.before_call()
+            if status is not None:
+                policy.raise_for_status(status)
+
+    # -- elastic placement: live migration / split -------------------------
+
+    def _publish_migration(self, status: dict | None) -> None:
+        self.placement = self.placement.with_migration(status)
+
+    def _take_dirty(self, pred) -> list[str]:
+        """Swap out the dirty tap and keep the keys the session cares
+        about (sorted for deterministic copy order)."""
+        if not self._dirty:
+            return []
+        dirty, self._dirty = self._dirty, set()
+        return sorted(k for k in dirty if pred(k))
+
+    async def _stream_keys(self, keys: list[str], target: StateStore, *,
+                           chaos_shard: int | None = None) -> int:
+        """Copy ``keys`` onto ``target``, reading straight from the
+        owning children (works under the flip pause, when the facade
+        ops are gated). A key that vanished mid-copy becomes a delete
+        on the target — deletes are writes too. Returns keys copied."""
+        moved = 0
+        for start in range(0, len(keys), 256):
+            chunk = keys[start:start + 256]
+            if chaos_shard is not None:
+                await self._placement_gate(chaos_shard)
+            by_shard: dict[int, list[str]] = {}
+            for k in chunk:
+                by_shard.setdefault(self.router.shard_of(k), []).append(k)
+            for src, ks in by_shard.items():
+                items = await self._shards[src].bulk_get(ks)
+                for k, item in zip(ks, items):
+                    if item is None:
+                        await target.delete(k)
+                    else:
+                        await target.set(k, item.value)
+                        moved += 1
+        if moved:
+            metrics.inc("placement_keys_moved_total", moved, store=self.name)
+        return moved
+
+    async def _delete_moved(self, keys: list[str]) -> None:
+        """Drop moved keys from their source shards (grouped, batched,
+        concurrent within a batch so the group-commit engines coalesce
+        the deletes into a handful of fsyncs)."""
+        by_shard: dict[int, list[str]] = {}
+        for k in keys:
+            by_shard.setdefault(self.router.shard_of(k), []).append(k)
+        for src, ks in by_shard.items():
+            child = self._shards[src]
+            for start in range(0, len(ks), 512):
+                await asyncio.gather(
+                    *(child.delete(k) for k in ks[start:start + 512]))
+
+    async def _fenced_flip(self, mutate, *, shards: int | None = None,  # tasklint: fenced-lane
+                           assignment: dict[int, str] | None = None) -> float:
+        """The zero-downtime handoff: close the op barrier, wait for
+        true quiescence (every in-flight op counted out), run the
+        final drain + structural swap, publish the successor placement
+        map at a strictly higher epoch, reopen. The barrier is closed
+        for exactly the final-drain window — the pre-copy and catch-up
+        rounds all ran with writes flowing — and the epoch advance is
+        monotone by construction, so a router that saw the old map
+        fails ``check_epoch`` the instant the new map is live.
+        """
+        budget = pause_budget_default()
+        pause_t0 = time.monotonic()
+        self._op_gate.clear()
+        try:
+            await self._drained.wait()
+            await mutate()
+            successor = self.placement.advanced(
+                shards=shards, assignment=assignment, migration=None)
+            if successor.epoch <= self.placement.epoch:
+                raise StateError(
+                    f"state store {self.name!r}: refusing a non-monotone "
+                    f"placement epoch flip")
+            self.placement = successor
+        finally:
+            self._op_gate.set()
+        pause = time.monotonic() - pause_t0
+        metrics.inc("placement_flips_total", store=self.name)
+        metrics.set_gauge("placement_pause_seconds", pause, store=self.name)
+        metrics.set_gauge("placement_epoch", float(self.placement.epoch),
+                          store=self.name)
+        if pause > budget:
+            logger.warning(
+                "placement: %s flip paused writes %.3fs (budget %.3fs)",
+                self.name, pause, budget)
+        return pause
+
+    async def migrate_shard(self, shard: int, *,
+                            member: str | None = None,
+                            target: StateStore | None = None,
+                            retire_source: bool = True,
+                            max_rounds: int = 8) -> dict:
+        """Move shard ``shard`` live, then flip the routing epoch.
+
+        Two transports, one contract (zero lost acked writes):
+
+        * ``member=...`` — replicated children: the PR 9 record stream
+          IS the copy. Wait for the target member to catch up (chaos
+          gate on the lane), then hand leadership over inside the
+          fenced flip (``transfer_leadership`` quiesces, fences the
+          old leader's session, and promotes at a bumped lease epoch).
+        * ``target=...`` — plain children: stream the shard's keys to
+          the target engine with a facade-level dirty-key tap, converge
+          the tap round-by-round, drain the residue under the pause,
+          and swap the child.
+        """
+        if shard < 0 or shard >= len(self._shards):
+            raise StateError(
+                f"state store {self.name!r} has no shard {shard}")
+        if (member is None) == (target is None):
+            raise StateError(
+                f"state store {self.name!r}: migrate_shard needs exactly "
+                f"one of member= (replicated handoff) or target= (key "
+                f"streaming)")
+        async with self._reshard_lock:
+            if member is not None:
+                return await self._migrate_leadership(shard, member)
+            return await self._migrate_copy(
+                shard, target, max_rounds=max_rounds,
+                retire_source=retire_source)
+
+    async def _migrate_leadership(self, shard: int, member: str) -> dict:
+        child = self._shards[shard]
+        transfer = getattr(child, "transfer_leadership", None)
+        if transfer is None:
+            raise StateError(
+                f"state store {self.name!r}: shard {shard} is not "
+                f"replicated — migrate with an explicit target= store")
+        lag_of = getattr(child, "member_lag", None)
+        try:
+            self._publish_migration({
+                "kind": "move", "shard": shard, "target": member,
+                "phase": "catchup"})
+            deadline = time.monotonic() + 30.0
+            while True:
+                await self._placement_gate(shard)
+                lag = lag_of(member) if lag_of is not None else 0
+                if lag is not None and lag <= 0:
+                    break
+                if time.monotonic() > deadline:
+                    raise StateError(
+                        f"state store {self.name!r}: shard {shard} "
+                        f"catch-up toward {member} did not converge")
+                await asyncio.sleep(0.02)
+            self._publish_migration({
+                "kind": "move", "shard": shard, "target": member,
+                "phase": "flip"})
+            pause = await self._fenced_flip(
+                lambda: transfer(member), assignment={shard: member})
+        finally:
+            self._publish_migration(None)
+        return {"action": "move", "shard": shard, "target": member,
+                "epoch": self.placement.epoch, "pause_seconds": pause}
+
+    async def _migrate_copy(self, shard: int, target: StateStore, *,
+                            max_rounds: int, retire_source: bool) -> dict:
+        old = self._shards[shard]
+        if self._chaos is not None:
+            target_attach = getattr(target, "attach_chaos", None)
+            if target_attach is not None:
+                target_attach(self._chaos)
+        of_shard = lambda k: self.router.shard_of(k) == shard
+        self._dirty = set()
+        moved = 0
+        try:
+            self._publish_migration({
+                "kind": "move", "shard": shard, "target": target.name,
+                "phase": "copy"})
+            snapshot_keys = await old.keys()
+            moved += await self._stream_keys(
+                snapshot_keys, target, chaos_shard=shard)
+            residue: list[str] = []
+            for _ in range(max_rounds):
+                residue = self._take_dirty(of_shard)
+                if len(residue) <= 64:
+                    break
+                self._publish_migration({
+                    "kind": "move", "shard": shard, "target": target.name,
+                    "phase": "catchup", "pending": len(residue)})
+                moved += await self._stream_keys(
+                    residue, target, chaos_shard=shard)
+                residue = []
+            else:
+                raise StateError(
+                    f"state store {self.name!r}: shard {shard} migration "
+                    f"dirty set did not converge in {max_rounds} rounds — "
+                    f"the writer outruns the copy; raise the pause budget "
+                    f"or throttle the writer")
+            self._publish_migration({
+                "kind": "move", "shard": shard, "target": target.name,
+                "phase": "flip"})
+
+            async def _mutate() -> None:
+                final = sorted(set(residue) | set(self._take_dirty(of_shard)))
+                await self._stream_keys(final, target)
+                self._shards[shard] = target
+
+            pause = await self._fenced_flip(
+                _mutate, assignment={shard: target.name})
+        finally:
+            self._dirty = None
+            self._publish_migration(None)
+        if retire_source:
+            try:
+                await old.aclose()
+            except Exception:
+                logger.debug("placement: %s: retired source shard close "
+                             "failed", self.name, exc_info=True)
+        return {"action": "move", "shard": shard, "target": target.name,
+                "epoch": self.placement.epoch, "keys_moved": moved,
+                "pause_seconds": pause}
+
+    async def split_shard(self, *, target: StateStore | None = None,
+                          max_rounds: int = 8) -> dict:
+        """Grow the ring ``N → N+1`` live: stream every key the grown
+        router sends to the new shard (an expected ``1/(N+1)`` of the
+        space, drawn from ALL shards — the HRW salt design never moves
+        a key between survivors), converge the dirty tap, then flip
+        router + placement epoch inside the fenced barrier. Source
+        copies of moved keys are deleted under the same pause so the
+        fan-out reads (``keys``/``query``) never see duplicates."""
+        async with self._reshard_lock:
+            n = len(self._shards)
+            if n + 1 > MAX_SHARDS:
+                raise ComponentError(
+                    f"state store {self.name!r}: cannot split past "
+                    f"{MAX_SHARDS} shards")
+            if target is None:
+                if self._child_factory is None:
+                    raise StateError(
+                        f"state store {self.name!r}: online split needs a "
+                        f"child factory (sqlite-backed stores wire one) or "
+                        f"an explicit target= store")
+                target = self._child_factory(n)
+            if self._chaos is not None:
+                target_attach = getattr(target, "attach_chaos", None)
+                if target_attach is not None:
+                    target_attach(self._chaos)
+            grown = ShardRouter(n + 1, self.hash_seed)
+            moving = lambda k: grown.shard_of(k) == n
+            self._dirty = set()
+            moved_keys: set[str] = set()
+            moved = 0
+            try:
+                self._publish_migration({
+                    "kind": "split", "shard": n, "phase": "copy"})
+                initial = [k for k in await self.keys() if moving(k)]
+                moved_keys.update(initial)
+                moved += await self._stream_keys(
+                    initial, target, chaos_shard=n)
+                residue: list[str] = []
+                for _ in range(max_rounds):
+                    residue = self._take_dirty(moving)
+                    if len(residue) <= 64:
+                        break
+                    moved_keys.update(residue)
+                    self._publish_migration({
+                        "kind": "split", "shard": n, "phase": "catchup",
+                        "pending": len(residue)})
+                    moved += await self._stream_keys(
+                        residue, target, chaos_shard=n)
+                    residue = []
+                else:
+                    raise StateError(
+                        f"state store {self.name!r}: split dirty set did "
+                        f"not converge in {max_rounds} rounds")
+                self._publish_migration({
+                    "kind": "split", "shard": n, "phase": "flip"})
+
+                async def _mutate() -> None:
+                    final = sorted(set(residue) | set(self._take_dirty(moving)))
+                    moved_keys.update(final)
+                    await self._stream_keys(final, target)
+                    # sources shed their moved copies while quiesced:
+                    # after the flip, keys()/query() fan out over the
+                    # grown ring and must not double-count
+                    await self._delete_moved(sorted(moved_keys))
+                    self._shards.append(target)
+                    self.router = grown
+                    self.heat.grow(1)
+
+                pause = await self._fenced_flip(
+                    _mutate, shards=n + 1,
+                    assignment={n: target.name})
+            finally:
+                self._dirty = None
+                self._publish_migration(None)
+            return {"action": "split", "new_shard": n, "shards": n + 1,
+                    "epoch": self.placement.epoch, "keys_moved": moved,
+                    "pause_seconds": pause}
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -1298,15 +1298,20 @@ def build_sharded_store(name: str, path: str | pathlib.Path = ":memory:", *,
             f"state store {name!r}: shards must be in 1..{MAX_SHARDS}, "
             f"not {shards}")
     per_shard_cache = (max(1, cache_size // shards) if cache_size else 0)
-    children = [
-        SqliteStateStore(
+
+    def _make_child(i: int) -> SqliteStateStore:
+        return SqliteStateStore(
             name, _shard_path(str(path), i),
             group_commit=group_commit,
             cache_size=per_shard_cache,
             shard=i)
-        for i in range(shards)
-    ]
-    return ShardedStateStore(name, children, hash_seed=hash_seed)
+
+    facade = ShardedStateStore(
+        name, [_make_child(i) for i in range(shards)], hash_seed=hash_seed)
+    # online split (PR 20) mints engine N on its own -shardN file
+    # through the same constructor the boot path used
+    facade._child_factory = _make_child
+    return facade
 
 
 @driver("state.sqlite", "state.azure.cosmosdb", "state.postgresql")

@@ -176,3 +176,79 @@ def test_admin_cli_parser_wiring():
     args = parser.parse_args(
         ["publish", "ps", "topic", "--app-id", "a", "--count", "50"])
     assert args.count == 50
+
+
+def test_shards_cli_parser_wiring():
+    from tasksrunner.cli import build_parser
+
+    parser = build_parser()
+    args = parser.parse_args(["shards", "--json"])
+    assert args.json is True and args.fn is not None
+    args = parser.parse_args(["shards", "--registry-file", "x/apps.json"])
+    assert args.registry_file == "x/apps.json"
+
+
+@pytest.mark.asyncio
+async def test_admin_placement_one_shot_sweep(tmp_path, monkeypatch):
+    """`/admin/placement` with TASKSRUNNER_RESHARD off (the default):
+    the endpoint runs one on-demand sweep — sidecar metadata from each
+    replica, merged per store — so `tasksrunner shards` always
+    answers. The sharded store's routing epoch, per-shard ranking, and
+    (quiet) plan must come through end-to-end."""
+    import textwrap as _tw
+
+    from tasksrunner.orchestrator.admin import info_path
+    from tasksrunner.orchestrator.run import Orchestrator
+
+    _write_env_echo_app(tmp_path)
+    components = tmp_path / "components"
+    components.mkdir()
+    (components / "statestore.yaml").write_text(_tw.dedent(f"""
+        apiVersion: dapr.io/v1alpha1
+        kind: Component
+        metadata:
+          name: statestore
+        spec:
+          type: state.sqlite
+          version: v1
+          metadata:
+          - name: databasePath
+            value: {tmp_path / "state.db"}
+          - name: shards
+            value: "2"
+    """))
+    config = RunConfig(
+        apps=[AppSpec(app_id="echo", module="envpkg.echo:make_app")],
+        registry_file=str(tmp_path / "apps.json"),
+        base_dir=tmp_path,
+        resources_path=str(components),
+    )
+    monkeypatch.setenv("PYTHONPATH", f"{tmp_path}{os.pathsep}{REPO}")
+    orch = Orchestrator(config)
+    await orch.start()
+    try:
+        replica = orch.replicas["echo"][0]
+        await asyncio.wait_for(replica.ready.wait(), timeout=30)
+        sidecar_port = orch._replica_info("echo")[0]["sidecar_port"]
+        # writes build the store and feed the heat tracker, so the
+        # metadata sweep has a placement document to merge
+        status, _ = await _admin(
+            f"http://127.0.0.1:{sidecar_port}/v1.0/state/statestore",
+            "POST",
+            [{"key": f"k{i}", "value": {"v": i}} for i in range(10)])
+        assert status in (200, 204)
+
+        admin_url = json.loads(
+            info_path(tmp_path / "apps.json").read_text())["admin_url"]
+        status, out = await _admin(f"{admin_url}/admin/placement")
+        assert status == 200
+        assert out["reshard"] is False
+        entry = out["apps"]["echo"]["stores"]["statestore"]
+        assert entry["epoch"] == 1 and entry["shards"] == 2
+        assert entry["replicas_reporting"] == 1
+        assert len(entry["ranking"]) == 2
+        assert {row["shard"] for row in entry["ranking"]} == {0, 1}
+        assert entry["plan"] is None, "10 writes must not look hot"
+        assert entry["migration"] is None
+    finally:
+        await orch.stop()

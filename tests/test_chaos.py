@@ -318,6 +318,54 @@ def test_for_workflow_resolves_most_specific_first():
                      "workflows/checkout/charge/activity"}
 
 
+def test_placement_targets_parse_roundtrip_and_validate():
+    """``targets.placement`` keys are ``<store>`` or
+    ``<store>/<shard>`` — the elastic-migration catch-up lane; single
+    rule names normalize to tuples and dangling refs fail at load
+    time like every other target kind."""
+    spec = parse_chaos(chaos_doc(
+        seed=3,
+        faults={
+            "slow": {"latency": {"duration": "10ms"}},
+            "dead": {"blackhole": {"deadline": "50ms"}},
+        },
+        targets={"placement": {"statestore": "slow",
+                               "statestore/2": ["dead"]}},
+    ))
+    assert spec.placement_targets == {"statestore": ("slow",),
+                                      "statestore/2": ("dead",)}
+    with pytest.raises(ComponentError, match="unknown fault rule"):
+        parse_chaos(chaos_doc(
+            faults={"f": {"error": {"raise": "OSError"}}},
+            targets={"placement": {"statestore": ["typo"]}}))
+
+
+def test_for_placement_resolves_most_specific_first():
+    spec = parse_chaos(chaos_doc(
+        faults={
+            "wide": {"latency": {"duration": "10ms"}},
+            "narrow": {"blackhole": {"deadline": "50ms"}},
+        },
+        targets={"placement": {"statestore": ["wide"],
+                               "statestore/2": ["narrow"]}},
+    ))
+    policies = ChaosPolicies([spec])
+    # exact <store>/<shard> binding beats the store-wide one
+    shard2 = policies.for_placement("statestore", 2)
+    assert [i.rule.name for i in shard2.injectors] == ["narrow"]
+    # other shards of the store fall back to the wide binding
+    shard0 = policies.for_placement("statestore", 0)
+    assert [i.rule.name for i in shard0.injectors] == ["wide"]
+    # no-shard resolution (store-wide drills)
+    assert [i.rule.name
+            for i in policies.for_placement("statestore").injectors] \
+        == ["wide"]
+    assert policies.for_placement("other", 2) is None
+    bound = {t for d in policies.describe() for t in d["targets"]}
+    assert bound == {"placement/statestore/migration",
+                     "placement/statestore/2/migration"}
+
+
 def test_scoping_filters_specs():
     spec = _flaky_spec()
     spec.scopes = ["backend"]

@@ -1,0 +1,532 @@
+"""Elastic shard placement (tasksrunner/state/placement.py + the
+sharding facade's migration machinery).
+
+Covers the tentpole contract end to end: the epoched routing flip
+(strictly monotone, atomic under concurrent load, 409-with-new-epoch
+for stale routers), live shard migration over the replication plane
+(leadership transfer with fenced handoff, zero lost acked writes with
+a mid-migration leader kill), the online split's movement bound
+against the PR 5 golden router, the chaos ``targets.placement`` lane
+(a blackholed catch-up stream aborts the migration cleanly with
+routing untouched), the heat tracker's EWMA/hysteresis/sketch, the
+pure planning helpers, and the epoch handshake through runtime +
+sidecar + client.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from tasksrunner.chaos.engine import ChaosPolicies
+from tasksrunner.chaos.spec import parse_chaos
+from tasksrunner.component.registry import ComponentRegistry
+from tasksrunner.component.spec import ComponentSpec
+from tasksrunner.errors import PlacementEpochError, StateError
+from tasksrunner.runtime import Runtime
+from tasksrunner.state.placement import (
+    PLACEMENT_EPOCH_HEADER,
+    PlacementMap,
+    ShardHeatTracker,
+    merge_heat_docs,
+    plan_rebalance,
+    rank_shards,
+)
+from tasksrunner.state.replication import build_replicated_store
+from tasksrunner.state.sharding import ShardRouter
+from tasksrunner.state.sqlite import SqliteStateStore, build_sharded_store
+
+KEYS = [f"task-{i}" for i in range(2000)]
+LEASE = 0.4
+
+
+async def _wait_for(predicate, *, timeout=6.0, message="condition"):
+    deadline = asyncio.get_running_loop().time() + timeout
+    while not predicate():
+        assert asyncio.get_running_loop().time() < deadline, \
+            f"timed out waiting for {message}"
+        await asyncio.sleep(0.02)
+
+
+def make_runtime(store):
+    """A runtime whose only component is ``store`` under the name
+    ``statestore`` (the test_actors pattern, minus the app channel)."""
+    spec = ComponentSpec(name="statestore", type="state.in-memory")
+    reg = ComponentRegistry([spec])
+    reg._instances["statestore"] = store
+    return Runtime("svc", reg)
+
+
+# -- PlacementMap -----------------------------------------------------------
+
+def test_placement_map_epoch_is_strictly_monotone():
+    base = PlacementMap(shards=4)
+    assert base.epoch == 1
+    nxt = base.advanced(assignment={2: "hostB"})
+    assert nxt.epoch == 2 and nxt.shards == 4
+    assert nxt.assignment == {2: "hostB"}
+    # successor merges, never drops, prior assignments
+    third = nxt.advanced(shards=5, assignment={4: "hostC"})
+    assert third.epoch == 3 and third.shards == 5
+    assert third.assignment == {2: "hostB", 4: "hostC"}
+
+
+def test_placement_map_migration_status_does_not_move_epoch():
+    base = PlacementMap(shards=2)
+    busy = base.with_migration({"phase": "catchup", "shard": 1})
+    assert busy.epoch == base.epoch
+    assert busy.migration["phase"] == "catchup"
+
+
+def test_placement_map_doc_roundtrip():
+    m = PlacementMap(shards=3, epoch=7, assignment={0: "r1"},
+                     migration={"phase": "flip"})
+    again = PlacementMap.from_doc(m.to_doc())
+    assert (again.epoch, again.shards, again.assignment, again.migration) \
+        == (7, 3, {0: "r1"}, {"phase": "flip"})
+
+
+# -- epoch validation (the 409 redirect) ------------------------------------
+
+@pytest.mark.asyncio
+async def test_check_epoch_rejects_stale_and_future_routers(tmp_path):
+    """ANY mismatch is a 409 carrying the live epoch: a lower caller
+    routed with a pre-flip map (classic stale), a higher caller knows a
+    flip this instance missed — either way the bytes must not land
+    until somebody resynchronizes."""
+    store = build_sharded_store("ck", tmp_path / "ck.db", shards=2)
+    try:
+        current = store.placement.epoch
+        store.check_epoch(current)  # exact match passes silently
+        with pytest.raises(PlacementEpochError) as exc_info:
+            store.check_epoch(current - 1)
+        assert exc_info.value.http_status == 409
+        assert exc_info.value.current_epoch == current
+        with pytest.raises(PlacementEpochError):
+            store.check_epoch(current + 1)
+    finally:
+        await store.aclose()
+
+
+@pytest.mark.asyncio
+async def test_runtime_check_placement_epoch_duck_types(tmp_path):
+    """The runtime helper validates only stores that HAVE a placement
+    map; unsharded engines and absent headers pass untouched."""
+    sharded = build_sharded_store("statestore", tmp_path / "s.db", shards=2)
+    rt = make_runtime(sharded)
+    try:
+        rt.check_placement_epoch("statestore", None)  # no header → no-op
+        rt.check_placement_epoch("statestore", sharded.placement.epoch)
+        with pytest.raises(PlacementEpochError):
+            rt.check_placement_epoch("statestore", 99)
+    finally:
+        await sharded.aclose()
+
+    plain = SqliteStateStore("statestore", ":memory:")
+    rt = make_runtime(plain)
+    try:
+        rt.check_placement_epoch("statestore", 99)  # no map → no check
+    finally:
+        await plain.aclose()
+
+
+@pytest.mark.asyncio
+async def test_sidecar_409_carries_new_epoch_and_client_retries(tmp_path):
+    """End to end through real HTTP: a client that routed with a stale
+    epoch gets 409 + the live epoch in the reply header, refreshes its
+    cache, retries once, and the write lands — a live flip costs one
+    round trip, never a failed operation."""
+    import aiohttp
+
+    from tasksrunner.client import AppClient
+    from tasksrunner.sidecar import Sidecar
+
+    store = build_sharded_store("statestore", tmp_path / "s.db", shards=2)
+    rt = make_runtime(store)
+    sc = Sidecar(rt, port=0)
+    await sc.start()
+    try:
+        base = f"http://127.0.0.1:{sc.port}"
+        async with aiohttp.ClientSession() as session:
+            # raw probe: stale epoch → 409, reply header names the truth
+            resp = await session.post(
+                f"{base}/v1.0/state/statestore",
+                json=[{"key": "k1", "value": {"v": 1}}],
+                headers={PLACEMENT_EPOCH_HEADER: "99"})
+            assert resp.status == 409
+            assert resp.headers[PLACEMENT_EPOCH_HEADER] == \
+                str(store.placement.epoch)
+            # matching epoch passes
+            resp = await session.post(
+                f"{base}/v1.0/state/statestore",
+                json=[{"key": "k1", "value": {"v": 1}}],
+                headers={PLACEMENT_EPOCH_HEADER:
+                         str(store.placement.epoch)})
+            assert resp.status == 204
+
+        # SDK client: poison its epoch cache, then watch it self-heal
+        client = AppClient.http(port=sc.port)
+        client._t._placement_epochs["statestore"] = 99
+        await client.save_state("statestore", "k2", {"v": 2})
+        assert await client.get_state("statestore", "k2") == {"v": 2}
+        assert client._t._placement_epochs["statestore"] == \
+            store.placement.epoch
+        await client.close()
+    finally:
+        await sc.stop()
+        await store.aclose()
+
+
+# -- online shard split -----------------------------------------------------
+
+@pytest.mark.asyncio
+async def test_split_moves_bounded_fraction_to_new_shard(tmp_path):
+    """Growing 4→5 must stream ~1/5 of the keyspace, all TO the new
+    shard — the same movement bound the PR 5 router test pins, now
+    verified through the LIVE path with data attached."""
+    store = build_sharded_store("split", tmp_path / "split.db", shards=4)
+    try:
+        before = {k: store.router.shard_of(k) for k in KEYS}
+        for k in before:
+            await store.set(k, {"k": k})
+        result = await store.split_shard()
+        assert result["action"] == "split"
+        assert result["shards"] == 5 and result["new_shard"] == 4
+        assert store.placement.epoch == result["epoch"] == 2
+        moved = [k for k in KEYS if store.router.shard_of(k) != before[k]]
+        assert 0 < len(moved) < len(KEYS) / 5 * 1.35
+        assert all(store.router.shard_of(k) == 4 for k in moved)
+        assert result["keys_moved"] >= len(moved)
+        # every key — moved or not — reads back through the new map
+        for k in KEYS:
+            assert (await store.get(k)).value == {"k": k}
+        # moved keys were deleted at their sources under the fence: the
+        # bytes live in exactly one engine
+        for k in moved[:50]:
+            assert await store._shards[before[k]].get(k) is None
+            assert (await store._shards[4].get(k)).value == {"k": k}
+    finally:
+        await store.aclose()
+
+
+@pytest.mark.asyncio
+async def test_split_flip_is_atomic_under_concurrent_writers(tmp_path):
+    """Writers hammer the store while the split streams and flips; no
+    write may be lost or land at a shard the new router won't read."""
+    store = build_sharded_store("atomic", tmp_path / "atomic.db", shards=3)
+    acked: list[tuple[str, int]] = []
+    stop = asyncio.Event()
+
+    async def writer(wid: int):
+        # 50 distinct keys per writer: the catch-up ladder converges
+        # when the MOVING slice of the dirty set fits the final paused
+        # round (~64 keys) — a working set it can never outrun is a
+        # misconfigured migration, not an atomicity test
+        i = 0
+        while not stop.is_set():
+            key = f"w{wid}-{i % 50}"
+            await store.set(key, {"v": i})
+            acked.append((key, i))
+            i += 1
+
+    try:
+        for i in range(600):
+            await store.set(f"seed-{i}", {"v": i})
+        writers = [asyncio.create_task(writer(w)) for w in range(4)]
+        await asyncio.sleep(0.05)
+        result = await store.split_shard()
+        await asyncio.sleep(0.05)
+        stop.set()
+        await asyncio.gather(*writers)
+        assert store.placement.epoch == 2 and result["shards"] == 4
+        # last acked value per key must be the one that reads back
+        last: dict[str, int] = {}
+        for key, v in acked:
+            last[key] = v
+        for key, v in last.items():
+            item = await store.get(key)
+            assert item is not None, f"lost acked write {key}"
+            assert item.value == {"v": v}
+        for i in range(600):
+            assert (await store.get(f"seed-{i}")).value == {"v": i}
+    finally:
+        stop.set()
+        await store.aclose()
+
+
+@pytest.mark.asyncio
+async def test_migrate_shard_to_fresh_engine_retires_source(tmp_path):
+    """Whole-shard copy migration: keys stream to the target engine,
+    routing flips at epoch+1, the source engine retires."""
+    store = build_sharded_store("mv", tmp_path / "mv.db", shards=3)
+    try:
+        for k in KEYS[:400]:
+            await store.set(k, {"k": k})
+        shard2 = [k for k in KEYS[:400] if store.router.shard_of(k) == 2]
+        assert shard2
+        target = SqliteStateStore("mv", tmp_path / "mv-new.db", shard=2)
+        result = await store.migrate_shard(2, target=target)
+        assert result["action"] == "move" and result["epoch"] == 2
+        assert store._shards[2] is target
+        for k in shard2:
+            assert (await store.get(k)).value == {"k": k}
+        await store.set(shard2[0], {"k": "after"})
+        assert (await target.get(shard2[0])).value == {"k": "after"}
+    finally:
+        await store.aclose()
+
+
+# -- migration over the replication plane -----------------------------------
+
+@pytest.mark.asyncio
+async def test_leadership_migration_fenced_handoff(tmp_path):
+    """Planned handoff: catch-up to zero lag, fence under the pause,
+    transfer the lease, flip the map. The old leader must reject
+    writes afterwards — no write can land at the old leader post-fence."""
+    store = build_replicated_store(
+        "hand", tmp_path / "hand.db", shards=2, replicas=2,
+        ack_quorum=2, lease_seconds=LEASE)
+    try:
+        for i in range(40):
+            await store.set(f"k{i}", {"v": i})
+        rset = store._shards[0]
+        old_leader = rset.leader_member()
+        target = next(n.node_id for n in rset.nodes
+                      if n.node_id != old_leader)
+        result = await store.migrate_shard(0, member=target)
+        assert result["target"] == target
+        assert store.placement.epoch == result["epoch"] == 2
+        assert store.placement.assignment[0] == target
+        await _wait_for(lambda: rset.leader_member() == target,
+                        message="lease records the new leader")
+        old_node = next(n for n in rset.nodes if n.node_id == old_leader)
+        assert not old_node.is_leader, \
+            "old leader still thinks it leads post-fence"
+        # data plane kept its promises across the handoff
+        for i in range(40):
+            assert (await store.get(f"k{i}")).value == {"v": i}
+        await store.set("post-handoff", {"v": -1})
+        assert (await store.get("post-handoff")).value == {"v": -1}
+    finally:
+        await store.aclose()
+
+
+@pytest.mark.asyncio
+async def test_leader_kill_mid_migration_loses_no_acked_write(tmp_path):
+    """THE chaos drill: writers bank acked keys while a migration is
+    in flight, and the OLD leader is crashed mid-catch-up (kill -9
+    semantics: no lease release). The migration must converge — the
+    target promotes via the normal lease takeover — and every acked
+    key must read back. Zero lost acked writes, not 'few'."""
+    store = build_replicated_store(
+        "kill", tmp_path / "kill.db", shards=2, replicas=3,
+        ack_quorum=2, lease_seconds=LEASE)
+    acked: list[str] = []
+    stop = asyncio.Event()
+
+    async def writer():
+        i = 0
+        while not stop.is_set():
+            key = f"mid-{i}"
+            try:
+                await store.set(key, {"v": i})
+            except (StateError, OSError):
+                await asyncio.sleep(0.05)  # promotion window: retry
+                continue
+            acked.append(key)
+            i += 1
+
+    try:
+        for i in range(30):
+            await store.set(f"pre-{i}", {"v": i})
+            acked.append(f"pre-{i}")
+        rset = store._shards[0]
+        old_leader = rset.leader_member()
+        victim = next(n for n in rset.nodes if n.node_id == old_leader)
+        target = next(n.node_id for n in rset.nodes
+                      if n.node_id != old_leader)
+        wtask = asyncio.create_task(writer())
+        await asyncio.sleep(0.05)
+        migration = asyncio.create_task(store.migrate_shard(0, member=target))
+        victim.crash()  # mid-migration, lease NOT released
+        try:
+            await asyncio.wait_for(migration, timeout=10.0)
+            assert store.placement.epoch >= 2
+        except StateError:
+            # transfer raced the crash and aborted: routing untouched,
+            # and the lease takeover below must still restore service
+            assert store.placement.epoch >= 1
+        await _wait_for(
+            lambda: rset.leader_member() not in (None, old_leader),
+            message="survivor takes the lease after the crash")
+        await asyncio.sleep(0.1)
+        stop.set()
+        await wtask
+        lost = [k for k in acked if await store.get(k) is None]
+        assert lost == [], f"lost {len(lost)} acked writes: {lost[:5]}"
+    finally:
+        stop.set()
+        await store.aclose()
+
+
+@pytest.mark.asyncio
+async def test_blackholed_catchup_lane_aborts_cleanly(tmp_path):
+    """chaos ``targets.placement``: a blackholed catch-up stream must
+    fail the migration with routing untouched — same epoch, every key
+    still served — never wedge the fenced pause open."""
+    spec = parse_chaos({
+        "apiVersion": "tasksrunner/v1alpha1",
+        "kind": "Chaos",
+        "metadata": {"name": "placement-chaos"},
+        "spec": {
+            "faults": {"dead": {"blackhole": {"deadline": "200ms"}}},
+            "targets": {"placement": {"bh/1": ["dead"]}},
+        },
+    })
+    store = build_sharded_store("bh", tmp_path / "bh.db", shards=3)
+    store.attach_chaos(ChaosPolicies([spec]))
+    try:
+        for k in KEYS[:300]:
+            await store.set(k, {"k": k})
+        epoch_before = store.placement.epoch
+        target = SqliteStateStore("bh", tmp_path / "bh-new.db", shard=1)
+        with pytest.raises((TimeoutError, asyncio.TimeoutError)):
+            await store.migrate_shard(1, target=target)
+        assert store.placement.epoch == epoch_before, \
+            "aborted migration must not flip routing"
+        assert store.placement.migration is None, \
+            "aborted migration must clear its status"
+        for k in KEYS[:300]:
+            assert (await store.get(k)).value == {"k": k}
+        # the OTHER shards migrate fine: the rule is shard-scoped
+        target0 = SqliteStateStore("bh", tmp_path / "bh-new0.db", shard=0)
+        result = await store.migrate_shard(0, target=target0)
+        assert result["epoch"] == epoch_before + 1
+        await target.aclose()
+    finally:
+        await store.aclose()
+
+
+# -- heat telemetry + planning ----------------------------------------------
+
+def test_heat_tracker_ewma_and_hysteresis():
+    clock = [0.0]
+    t = ShardHeatTracker(2, halflife=1.0, threshold=10.0, hysteresis=2.0,
+                         clock=lambda: clock[0])
+    for _ in range(100):
+        t.note_write(0, "hot-key")
+    clock[0] = 1.0
+    rates = t.sample()
+    assert rates[0] > 10.0 and rates[1] == 0.0
+    # above threshold but not yet for the whole hysteresis window
+    assert t.hot_shards() == []
+    for _ in range(100):
+        t.note_write(0)
+    clock[0] = 3.5
+    t.sample()
+    assert t.hot_shards() == [0], "sustained heat must rank hot"
+    # cooling below threshold resets the hysteresis clock
+    clock[0] = 30.0
+    t.sample()
+    assert t.hot_shards() == []
+
+
+def test_heat_tracker_hot_key_sketch_is_bounded():
+    t = ShardHeatTracker(1)
+    for i in range(10_000):
+        t.note_write(0, f"key-{i % 500}")
+        t.note_write(0, "heavy")
+    assert len(t._key_counts[0]) <= t.KEY_CAP + 1
+    assert t.hot_keys(0, limit=1)[0][0] == "heavy", \
+        "halve-and-prune must keep heavy hitters"
+
+
+def test_heat_tracker_grow_starts_cold():
+    t = ShardHeatTracker(2, threshold=1.0)
+    t.grow(1)
+    assert t.shards == 3
+    assert t.rates() == [0.0, 0.0, 0.0]
+
+
+def test_merge_and_rank_across_replicas():
+    rates = merge_heat_docs([
+        {"heat": {"rates": [1.0, 40.0]}},
+        {"heat": {"rates": [2.0, 30.0, 5.0]}},
+    ])
+    assert rates == [3.0, 70.0, 5.0]
+    ranking = rank_shards(rates, threshold=50.0)
+    assert ranking[0] == {"shard": 1, "rate": 70.0, "hot": True, "rank": 0}
+    assert [r["shard"] for r in ranking] == [1, 2, 0]
+
+
+def test_plan_rebalance_split_vs_move():
+    base = {"store": "s", "epoch": 1, "shards": 2}
+    # hot across many keys → ring growth redistributes them: split
+    plan = plan_rebalance(
+        dict(base, heat={"rates": [90.0, 1.0], "hot": [0],
+                         "top_keys": {"0": ["a", "b", "c"]}}),
+        threshold=50.0)
+    assert plan["action"] == "split" and plan["shard"] == 0
+    # one dominant key cannot be split away from itself: move
+    plan = plan_rebalance(
+        dict(base, heat={"rates": [90.0, 1.0], "hot": [0],
+                         "top_keys": {"0": ["solo"]}}),
+        threshold=50.0)
+    assert plan["action"] == "move"
+    assert plan["coldest_shard"] == 1
+    # nothing past hysteresis → no plan (anti-thrash)
+    assert plan_rebalance(
+        dict(base, heat={"rates": [90.0, 1.0], "hot": [],
+                         "top_keys": {}}), threshold=50.0) is None
+
+
+@pytest.mark.asyncio
+async def test_placement_doc_published_and_locality_rank(tmp_path):
+    store = build_sharded_store("doc", tmp_path / "doc.db", shards=2)
+    try:
+        for i in range(50):
+            await store.set(f"k{i}", {"v": i})
+        doc = store.placement_doc()
+        assert doc["epoch"] == 1 and doc["shards"] == 2
+        assert doc["store"] == "doc"
+        assert len(doc["heat"]["rates"]) == 2
+        # no local member configured → every key ranks local (1.0)
+        assert store.locality_rank("k0") == 1.0
+        # with an identity, unassigned shards still rank local; a
+        # shard assigned elsewhere ranks 0.0
+        store.local_member = "hostA"
+        assert store.locality_rank("k0") == 1.0
+        shard = store.router.shard_of("k0")
+        store.placement = store.placement.advanced(
+            assignment={shard: "hostB"})
+        assert store.locality_rank("k0") == 0.0
+    finally:
+        await store.aclose()
+
+
+@pytest.mark.asyncio
+async def test_orchestrator_controller_merges_and_plans(tmp_path):
+    """The control loop's merge: freshest epoch wins the routing view,
+    rates sum across replicas, and the plan comes from the cluster
+    heat, not one replica's."""
+    from tasksrunner.orchestrator.placement import PlacementController
+
+    controller = PlacementController("app", lambda: [])
+    view = controller._merge([
+        {"placement": {"statestore": {
+            "store": "statestore", "epoch": 2, "shards": 2,
+            "assignment": {"0": "r1"}, "migration": None,
+            "heat": {"rates": [30.0, 1.0], "hot": [0],
+                     "top_keys": {"0": ["a", "b"]}}}}},
+        {"placement": {"statestore": {
+            "store": "statestore", "epoch": 1, "shards": 2,
+            "assignment": {}, "migration": None,
+            "heat": {"rates": [40.0, 2.0], "hot": [0],
+                     "top_keys": {"0": ["b", "c"]}}}}},
+    ])
+    entry = view["statestore"]
+    assert entry["epoch"] == 2, "freshest routing truth wins"
+    assert entry["assignment"] == {"0": "r1"}
+    assert entry["replicas_reporting"] == 2
+    assert entry["ranking"][0]["rate"] == 70.0
+    assert entry["plan"]["action"] == "split"  # 3 distinct warm keys
